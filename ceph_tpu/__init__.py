@@ -1,0 +1,21 @@
+"""ceph_tpu — a TPU-native erasure-coded storage framework.
+
+A from-scratch, TPU-first framework with the capabilities of Ceph
+(reference: nautilus-dev snapshot). The erasure-coding hot path
+(Reed-Solomon / SHEC / LRC / Clay encode/decode) runs as batched
+GF(2^8) bit-sliced matrix multiplies on the TPU MXU via JAX/XLA,
+behind a plugin boundary semantically equivalent to Ceph's
+``ErasureCodeInterface`` / ``ErasureCodePluginRegistry``
+(reference: src/erasure-code/ErasureCodeInterface.h:155-464,
+src/erasure-code/ErasureCodePlugin.h:31-79).
+
+Layers (bottom-up, mirroring SURVEY.md §1):
+  - ``ceph_tpu.utils``     — buffers, config, perf counters, logging, checksums
+  - ``ceph_tpu.ops``       — GF(2^8) math core, JAX/Pallas kernels, native C++ fallbacks
+  - ``ceph_tpu.models``    — erasure-code codec plugins (the "model zoo")
+  - ``ceph_tpu.parallel``  — device meshes, sharded codecs, messenger, CRUSH, mon
+  - ``ceph_tpu.store``     — local object stores (MemStore, BlockStore)
+  - ``ceph_tpu.osd``       — stripe engine + EC backend write/read/recovery pipeline
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Math kernels: GF(2^8) arithmetic, bit-matrix expansion, JAX/TPU encode paths.
+
+The reference keeps all GF math in vendored native submodules (gf-complete,
+jerasure, isa-l — empty in the snapshot; see SURVEY.md §2.4). Here the math
+core is first-class: a numpy reference implementation (``gf256``), a binary
+bit-matrix expansion (``bitmatrix``), a JAX bit-sliced MXU path (``gf_jax``),
+and a native C++ host fallback (``native``).
+"""
+
+from ceph_tpu.ops import gf256  # noqa: F401

@@ -1,0 +1,134 @@
+"""GF(2^8) math core tests — field axioms, matrix gens, inversion, bitmatrix.
+
+Mirrors the reference's per-plugin math validation (encode/decode round trips,
+all-erasure sweeps — src/test/erasure-code/TestErasureCodeIsa.cc,
+TestErasureCodeJerasure.cc) at the pure-math layer.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import bitmatrix, gf256
+
+
+def test_tables_consistent():
+    # exp/log roundtrip
+    for a in range(1, 256):
+        assert gf256.GF_EXP[gf256.GF_LOG[a]] == a
+    # generator 2 has full order 255
+    seen = set()
+    x = 1
+    for _ in range(255):
+        seen.add(x)
+        x = int(gf256.gf_mul(x, 2))
+    assert len(seen) == 255
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    a, b, c = rng.integers(0, 256, size=(3, 512), dtype=np.uint8)
+    assert np.array_equal(gf256.gf_mul(a, b), gf256.gf_mul(b, a))
+    assert np.array_equal(
+        gf256.gf_mul(a, gf256.gf_mul(b, c)),
+        gf256.gf_mul(gf256.gf_mul(a, b), c),
+    )
+    # distributivity over XOR
+    assert np.array_equal(
+        gf256.gf_mul(a, b ^ c), gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+    )
+    # multiplicative inverse
+    nz = a[a != 0]
+    assert np.all(gf256.gf_mul(nz, gf256.gf_inv(nz)) == 1)
+
+
+def test_poly_is_0x11d():
+    # 2*128 = 256 -> reduced by 0x11d -> 0x1d
+    assert int(gf256.gf_mul(2, 128)) == 0x1D
+
+
+def test_invert_matrix_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 2, 4, 8):
+        while True:
+            m = rng.integers(0, 256, size=(n, n), dtype=np.uint8)
+            try:
+                inv = gf256.invert_matrix(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(
+            gf256.gf_matmul(m, inv), np.eye(n, dtype=np.uint8)
+        )
+
+
+def test_invert_singular_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.invert_matrix(m)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (7, 3), (8, 3), (8, 4), (12, 4)])
+def test_vandermonde_is_mds(k, m):
+    """Every k-subset of generator rows must be invertible (MDS property)."""
+    gen = gf256.systematic_generator(gf256.rs_vandermonde_matrix(k, m))
+    for rows in itertools.combinations(range(k + m), k):
+        gf256.invert_matrix(gen[list(rows)])  # raises if singular
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3), (21, 4)])
+def test_isa_rs_matrix_mds_within_envelope(k, m):
+    """ISA Vandermonde is MDS only inside k<=32,m<=4 (m=4 => k<=21):
+    reference clamps at ErasureCodeIsa.cc:330-360."""
+    gen = gf256.systematic_generator(gf256.rs_matrix_isa(k, m))
+    for rows in itertools.combinations(range(k + m), k):
+        gf256.invert_matrix(gen[list(rows)])
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3), (20, 10)])
+def test_cauchy_is_mds(k, m):
+    gen = gf256.systematic_generator(gf256.cauchy_matrix_isa(k, m))
+    rng = np.random.default_rng(2)
+    combos = list(itertools.combinations(range(k + m), k))
+    if len(combos) > 300:
+        combos = [combos[i] for i in rng.choice(len(combos), 300, replace=False)]
+    for rows in combos:
+        gf256.invert_matrix(gen[list(rows)])
+
+
+def test_encode_decode_roundtrip_all_erasures():
+    """Full encode + decode for every 1- and 2-erasure combination (the
+    reference ISA unit test 'probes all possible failure scenarios'
+    — isa/README)."""
+    k, m, n = 8, 3, 128
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+    gen = gf256.systematic_generator(gf256.rs_vandermonde_matrix(k, m))
+    chunks = np.concatenate([data, gf256.gf_matvec_chunks(gen[k:], data)], axis=0)
+    all_ids = list(range(k + m))
+    for r in (1, 2, 3):
+        for lost in itertools.combinations(all_ids, r):
+            present = [i for i in all_ids if i not in lost][: k]
+            dm = gf256.decode_matrix(gen, present, list(lost))
+            rec = gf256.gf_matvec_chunks(dm, chunks[present])
+            assert np.array_equal(rec, chunks[list(lost)]), (lost,)
+
+
+def test_bitmatrix_matches_gf_matmul():
+    """The bit-sliced binary matmul must be byte-identical to the GF matmul
+    (this equality is the corpus gate for the TPU kernel)."""
+    rng = np.random.default_rng(4)
+    for k, m in [(2, 1), (4, 2), (8, 3)]:
+        mat = rng.integers(0, 256, size=(m, k), dtype=np.uint8)
+        data = rng.integers(0, 256, size=(k, 256), dtype=np.uint8)
+        want = gf256.gf_matvec_chunks(mat, data)
+        bmat = bitmatrix.expand_bitmatrix(mat)
+        got = bitmatrix.bitsliced_matvec(bmat, data)
+        assert np.array_equal(want, got)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(5)
+    d = rng.integers(0, 256, size=(5, 77), dtype=np.uint8)
+    assert np.array_equal(bitmatrix.pack_bits(bitmatrix.unpack_bits(d)), d)
