@@ -1,0 +1,16 @@
+"""Erasure-code codec plugins — the framework's "model zoo".
+
+Semantically equivalent to the reference plugin layer
+(src/erasure-code/: ErasureCodeInterface.h, ErasureCode.{h,cc},
+ErasureCodePlugin.{h,cc} and the jerasure/isa/shec/lrc/clay plugins), but
+built TPU-first: every codec is a systematic GF(2^8) matrix (or a
+composition of them) whose encode/decode is dispatched to a numpy reference
+path, a native C++ host path, or the JAX bit-sliced MXU path.
+"""
+
+from ceph_tpu.models.interface import (  # noqa: F401
+    ErasureCodeInterface,
+    ErasureCodeError,
+    ErasureCodeProfile,
+)
+from ceph_tpu.models.registry import ErasureCodePluginRegistry, instance  # noqa: F401

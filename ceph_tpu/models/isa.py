@@ -1,0 +1,56 @@
+"""ISA-L-semantics plugin: accelerated Reed-Solomon with matrix-type choice.
+
+Reference: src/erasure-code/isa/ErasureCodeIsa.{h,cc}. Defaults k=7, m=3
+(ErasureCodeIsa.cc:45-46); ``technique`` (the reference calls the profile key
+``technique`` mapping to matrixtype) selects Vandermonde (``reed_sol_van``,
+gf_gen_rs_matrix) or Cauchy (``cauchy``, gf_gen_cauchy1_matrix).
+
+The Vandermonde construction is only MDS inside the envelope k<=32, m<=4
+(m==4 => k<=21); the reference enforces exactly this at
+ErasureCodeIsa.cc:330-360 and we reproduce the check. Decode matrices are
+cached per erasure signature in an LRU exactly as the reference's
+ErasureCodeIsaTableCache does (matrix_codec.MatrixErasureCode._decode_matrix).
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.models.interface import ErasureCodeError
+from ceph_tpu.models.matrix_codec import MatrixErasureCode
+from ceph_tpu.models.registry import ErasureCodePlugin
+from ceph_tpu.ops import gf256
+
+__erasure_code_version__ = "ceph-tpu-plugin-1"
+
+
+class ErasureCodeIsa(MatrixErasureCode):
+    def init(self, profile):
+        profile = dict(profile)
+        technique = profile.get("technique", "reed_sol_van")
+        k = self.to_int("k", profile, 7)
+        m = self.to_int("m", profile, 3)
+        if technique == "reed_sol_van":
+            # MDS safety envelope, ErasureCodeIsa.cc:330-360
+            if k > 32 or m > 4 or (m == 4 and k > 21):
+                raise ErasureCodeError(
+                    f"isa reed_sol_van is MDS only for k<=32, m<=4 "
+                    f"(m=4 => k<=21); got k={k}, m={m} — use technique=cauchy")
+            coding = gf256.rs_matrix_isa(k, m)
+        elif technique == "cauchy":
+            coding = gf256.cauchy_matrix_isa(k, m)
+        else:
+            raise ErasureCodeError(
+                f"technique={technique!r} must be reed_sol_van or cauchy")
+        profile.setdefault("plugin", "isa")
+        profile["technique"] = technique
+        self._setup(k, m, coding, profile)
+
+
+class IsaPlugin(ErasureCodePlugin):
+    def factory(self, profile):
+        codec = ErasureCodeIsa()
+        codec.init(profile)
+        return codec
+
+
+def __erasure_code_init__(name, registry):
+    registry.add(name, IsaPlugin())

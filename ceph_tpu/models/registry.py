@@ -1,0 +1,169 @@
+"""Plugin registry — semantic equivalent of ``ErasureCodePluginRegistry``.
+
+Reference: src/erasure-code/ErasureCodePlugin.{h,cc}. The reference dlopens
+``libec_<name>.so``, checks ``__erasure_code_version()`` against the build
+version, then calls ``__erasure_code_init(name, dir)`` which must
+self-register (ErasureCodePlugin.cc:126-184). Python has no dlopen, but the
+failure surface is preserved: a plugin is a module that must
+
+- expose ``__erasure_code_version__`` matching :data:`PLUGIN_VERSION`
+  (version check at the reference's ErasureCodePlugin.cc:144),
+- expose ``__erasure_code_init__(name, registry)`` (entry-point lookup at
+  :151) which must call ``registry.add(name, plugin)``.
+
+Built-in plugins resolve to ``ceph_tpu.models.<name>``; external plugin
+directories (the ``erasure_code_dir`` of the reference) are searched for
+``ec_<name>.py`` files loaded via importlib. All failure modes of the
+reference's loader (missing library, missing entry point, version mismatch,
+init failure, init-forgets-to-register) raise distinct errors and are
+exercised by tests/test_plugin_registry.py, mirroring
+src/test/erasure-code/TestErasureCodePlugin.cc and its purpose-built broken
+plugins (ErasureCodePluginFailToInitialize.cc, …FailToRegister.cc,
+…MissingEntryPoint.cc, …MissingVersion.cc).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import threading
+from abc import ABC, abstractmethod
+from pathlib import Path
+
+from ceph_tpu.models.interface import ErasureCodeError, ErasureCodeInterface
+
+#: bumped when the plugin ABI changes (reference ties it to the git version)
+PLUGIN_VERSION = "ceph-tpu-plugin-1"
+
+#: built-in plugin name -> module
+_BUILTIN_MODULES = {
+    "example": "ceph_tpu.models.example_xor",
+    "jerasure": "ceph_tpu.models.jerasure",
+    "isa": "ceph_tpu.models.isa",
+    "shec": "ceph_tpu.models.shec",
+    "lrc": "ceph_tpu.models.lrc",
+    "clay": "ceph_tpu.models.clay",
+}
+
+
+class PluginLoadError(ErasureCodeError):
+    pass
+
+
+class ErasureCodePlugin(ABC):
+    """A factory for codec instances (reference: ErasureCodePlugin.h:31-43)."""
+
+    @abstractmethod
+    def factory(self, profile: dict) -> ErasureCodeInterface:
+        """Instantiate and init() a codec for the profile."""
+
+
+class ErasureCodePluginRegistry:
+    """Singleton name -> plugin map with lazy loading
+    (reference: ErasureCodePlugin.h:45-79)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._plugins: dict[str, ErasureCodePlugin] = {}
+        self.disable_dlclose = False  # parity knob; no-op in-process
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        with self._lock:
+            if name in self._plugins:
+                raise PluginLoadError(f"plugin {name!r} already registered",
+                                      errno_=17)
+            self._plugins[name] = plugin
+
+    def get(self, name: str) -> ErasureCodePlugin | None:
+        with self._lock:
+            return self._plugins.get(name)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._plugins.pop(name, None)
+
+    def load(self, name: str, directory: str | None = None) -> ErasureCodePlugin:
+        """Load plugin ``name``; mirrors ErasureCodePlugin.cc:126-184."""
+        with self._lock:
+            if name in self._plugins:
+                return self._plugins[name]
+            module = self._import_plugin_module(name, directory)
+            version = getattr(module, "__erasure_code_version__", None)
+            if version is None:
+                raise PluginLoadError(
+                    f"plugin {name!r} has no __erasure_code_version__ "
+                    f"(reference: missing __erasure_code_version symbol)")
+            if version != PLUGIN_VERSION:
+                raise PluginLoadError(
+                    f"plugin {name!r} version {version!r} != expected "
+                    f"{PLUGIN_VERSION!r}", errno_=95)
+            init = getattr(module, "__erasure_code_init__", None)
+            if init is None:
+                raise PluginLoadError(
+                    f"plugin {name!r} has no __erasure_code_init__ entry point")
+            try:
+                init(name, self)
+            except PluginLoadError:
+                raise
+            except Exception as exc:
+                raise PluginLoadError(
+                    f"plugin {name!r} init failed: {exc!r}") from exc
+            if name not in self._plugins:
+                raise PluginLoadError(
+                    f"plugin {name!r} init() did not register itself "
+                    f"(reference: load: {name} [init, registered]... missing)",
+                    errno_=98)
+            return self._plugins[name]
+
+    def _import_plugin_module(self, name: str, directory: str | None):
+        if directory:
+            path = Path(directory) / f"ec_{name}.py"
+            if not path.exists():
+                raise PluginLoadError(
+                    f"no plugin file {path} for {name!r}", errno_=2)
+            spec = importlib.util.spec_from_file_location(
+                f"ceph_tpu_ext_plugin_{name}", path)
+            module = importlib.util.module_from_spec(spec)
+            try:
+                spec.loader.exec_module(module)
+            except Exception as exc:
+                raise PluginLoadError(
+                    f"plugin file {path} failed to import: {exc!r}") from exc
+            return module
+        modname = _BUILTIN_MODULES.get(name)
+        if modname is None:
+            raise PluginLoadError(f"unknown plugin {name!r}", errno_=2)
+        try:
+            return importlib.import_module(modname)
+        except ImportError as exc:
+            raise PluginLoadError(
+                f"plugin module {modname} failed to import: {exc!r}") from exc
+
+    def factory(self, plugin_name: str, profile: dict,
+                directory: str | None = None) -> ErasureCodeInterface:
+        """Resolve plugin, instantiate codec, init with profile
+        (reference: ErasureCodePluginRegistry::factory,
+        ErasureCodePlugin.cc:92-120)."""
+        plugin = self.load(plugin_name, directory)
+        codec = plugin.factory(dict(profile))
+        return codec
+
+    def preload(self, names: list[str], directory: str | None = None) -> None:
+        """Preload plugins at daemon start (reference: config
+        osd_erasure_code_plugins, ErasureCodePlugin.cc:186-202)."""
+        for name in names:
+            self.load(name, directory)
+
+
+def instance() -> ErasureCodePluginRegistry:
+    return ErasureCodePluginRegistry.instance()

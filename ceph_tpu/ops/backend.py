@@ -1,0 +1,86 @@
+"""Kernel backend dispatch for matrix codecs.
+
+The reference picks its hot kernel at plugin granularity (jerasure vs isa vs
+shec all end in different native libraries). Here every matrix codec shares
+one kernel contract —
+
+    encode:  parity[m, N] = mat[m, k] (x) data[k, N]   over GF(2^8)
+    decode:  wanted[w, N] = dmat[w, p] (x) present[p, N]
+
+— and the backend decides *where* it runs:
+
+- ``numpy``:  the gf256 reference path (always available, bit-exact oracle);
+- ``native``: C++ host library via ctypes (ISA-L-style nibble-table SIMD);
+- ``jax``:    bit-sliced binary matmul on the TPU MXU (ops/gf_jax.py).
+
+``auto`` prefers jax when a device is usable, then native, then numpy.
+All paths are bit-identical (enforced by tests/test_gf_jax.py and
+tests/test_native.py — the corpus gate of
+src/test/erasure-code/ceph_erasure_code_non_regression.cc applied across
+backends instead of across versions).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import numpy as np
+
+from ceph_tpu.ops import gf256
+
+# name -> matvec(mat[m,k] uint8, data[k,N] uint8) -> [m,N] uint8
+_BACKENDS: dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {}
+_AUTO_ORDER = ["jax", "native", "numpy"]
+
+
+def register_backend(name: str, fn) -> None:
+    _BACKENDS[name] = fn
+
+
+def available_backends() -> list[str]:
+    _load_lazy()
+    return [n for n in _AUTO_ORDER if n in _BACKENDS]
+
+
+register_backend("numpy", gf256.gf_matvec_chunks)
+
+_lazy_done = False
+
+
+def _load_lazy() -> None:
+    """Import optional backends on first use (jax import is expensive)."""
+    global _lazy_done
+    if _lazy_done:
+        return
+    _lazy_done = True
+    try:
+        from ceph_tpu.ops import gf_jax  # noqa: F401  (self-registers)
+    except Exception:  # pragma: no cover - jax always present in this image
+        pass
+    try:
+        from ceph_tpu.ops import native  # noqa: F401  (self-registers)
+    except Exception:
+        pass
+
+
+def resolve(name: str = "auto"):
+    """Return (backend_name, matvec_fn)."""
+    _load_lazy()
+    if name == "auto":
+        forced = os.environ.get("CEPH_TPU_BACKEND")
+        if forced:
+            name = forced
+        else:
+            for cand in _AUTO_ORDER:
+                if cand in _BACKENDS:
+                    return cand, _BACKENDS[cand]
+    if name not in _BACKENDS:
+        raise KeyError(
+            f"backend {name!r} not available (have {sorted(_BACKENDS)})")
+    return name, _BACKENDS[name]
+
+
+def matvec(mat: np.ndarray, data: np.ndarray, backend: str = "auto") -> np.ndarray:
+    _, fn = resolve(backend)
+    return fn(mat, data)

@@ -1,0 +1,128 @@
+"""Plugin registry loading + failure-mode tests.
+
+Mirrors src/test/erasure-code/TestErasureCodePlugin.cc and its purpose-built
+broken plugins (ErasureCodePluginFailToInitialize.cc, …FailToRegister.cc,
+…MissingEntryPoint.cc, …MissingVersion.cc).
+"""
+
+import textwrap
+import threading
+
+import pytest
+
+from ceph_tpu.models.registry import (
+    ErasureCodePluginRegistry,
+    PluginLoadError,
+    PLUGIN_VERSION,
+)
+
+
+@pytest.fixture()
+def registry():
+    return ErasureCodePluginRegistry()  # fresh, not the singleton
+
+
+def _write_plugin(tmp_path, name, body):
+    (tmp_path / f"ec_{name}.py").write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_load_builtin(registry):
+    plugin = registry.load("example")
+    codec = plugin.factory({"k": "2", "m": "1"})
+    assert codec.get_chunk_count() == 3
+
+
+def test_factory_end_to_end(registry):
+    codec = registry.factory("jerasure", {"k": "4", "m": "2"})
+    assert codec.get_chunk_count() == 6
+
+
+def test_unknown_plugin(registry):
+    with pytest.raises(PluginLoadError):
+        registry.load("no_such_plugin")
+
+
+def test_missing_version(registry, tmp_path):
+    d = _write_plugin(tmp_path, "nover", """
+        def __erasure_code_init__(name, registry):
+            pass
+    """)
+    with pytest.raises(PluginLoadError, match="version"):
+        registry.load("nover", d)
+
+
+def test_version_mismatch(registry, tmp_path):
+    d = _write_plugin(tmp_path, "badver", """
+        __erasure_code_version__ = "something-else"
+        def __erasure_code_init__(name, registry):
+            pass
+    """)
+    with pytest.raises(PluginLoadError, match="version"):
+        registry.load("badver", d)
+
+
+def test_missing_entry_point(registry, tmp_path):
+    d = _write_plugin(tmp_path, "noentry", f"""
+        __erasure_code_version__ = {PLUGIN_VERSION!r}
+    """)
+    with pytest.raises(PluginLoadError, match="entry point"):
+        registry.load("noentry", d)
+
+
+def test_fail_to_initialize(registry, tmp_path):
+    d = _write_plugin(tmp_path, "failinit", f"""
+        __erasure_code_version__ = {PLUGIN_VERSION!r}
+        def __erasure_code_init__(name, registry):
+            raise RuntimeError("boom")
+    """)
+    with pytest.raises(PluginLoadError, match="init failed"):
+        registry.load("failinit", d)
+
+
+def test_fail_to_register(registry, tmp_path):
+    d = _write_plugin(tmp_path, "noreg", f"""
+        __erasure_code_version__ = {PLUGIN_VERSION!r}
+        def __erasure_code_init__(name, registry):
+            pass  # forgets to register
+    """)
+    with pytest.raises(PluginLoadError, match="did not register"):
+        registry.load("noreg", d)
+
+
+def test_missing_file(registry, tmp_path):
+    with pytest.raises(PluginLoadError, match="no plugin file"):
+        registry.load("ghost", str(tmp_path))
+
+
+def test_double_register(registry):
+    registry.load("example")
+    with pytest.raises(PluginLoadError, match="already registered"):
+        registry.load("example", None) if False else registry.add(
+            "example", registry.get("example"))
+
+
+def test_preload(registry):
+    registry.preload(["example", "jerasure", "isa"])
+    for name in ("example", "jerasure", "isa"):
+        assert registry.get(name) is not None
+
+
+def test_concurrent_load(registry):
+    """Thread-safety of load (reference guards with a Mutex +
+    ceph_assert(lock.is_locked()), ErasureCodePlugin.cc:62,131)."""
+    errors = []
+
+    def worker():
+        try:
+            registry.load("jerasure")
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert registry.get("jerasure") is not None
