@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Driver benchmark gate: k=8,m=3 RS encode GB/s on one TPU chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+Measures the canonical config of BASELINE.md — Reed-Solomon k=8, m=3
+(ISA profile), 1 MiB objects (reference run:
+``ceph_erasure_code_benchmark -p isa -P k=8 -P m=3 -S 1048576 -i 1000``,
+src/erasure-code/isa/README:36-38) — as a device-resident stripe-batched
+encode, the way the OSD stripe accumulator feeds the chip (SURVEY.md §7.5).
+
+Measurement method: the axon tunnel to the chip has ~10^2 ms RTT and
+``block_until_ready`` there does not guarantee device completion, so naive
+host timing is wrong in both directions. We run the encode inside a single
+jitted ``fori_loop`` whose carry feeds one parity row back into the input
+(a true data dependency, so XLA cannot collapse or overlap iterations) and
+take the slope between two iteration counts — dispatch and fetch overhead
+cancel; the chain update itself adds ~12% traffic, so the number is mildly
+conservative.
+
+vs_baseline is the ratio against ISA-L-class single-socket CPU encode,
+taken as 7 GB/s (the 5-10 GB/s external ballpark of BASELINE.md; the
+reference repo itself publishes no absolute numbers). Target: >= 10x.
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+ISA_L_BASELINE_GBPS = 7.0  # BASELINE.md external ballpark midpoint
+
+K, M = 8, 3
+OBJECT_SIZE = 1 << 20            # 1 MiB, canonical config
+BATCH_OBJECTS = 128              # objects per kernel launch (128 MiB batch)
+LOOP_COUNTS = (5, 25)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from ceph_tpu.ops import gf256, gf_pallas
+
+    mat = gf256.rs_matrix_isa(K, M)  # ISA-L gf_gen_rs_matrix semantics
+
+    # correctness gate before timing: TPU output must match the CPU oracle
+    rng = np.random.default_rng(0)
+    small = rng.integers(0, 256, size=(K, 1 << 16), dtype=np.uint8)
+    assert np.array_equal(
+        gf_pallas.matvec(mat, small),
+        gf256.gf_matvec_chunks(mat, small),
+    ), "TPU encode is not bit-exact vs CPU reference"
+
+    n = BATCH_OBJECTS * OBJECT_SIZE // K
+    data = rng.integers(0, 256, size=(K, n), dtype=np.uint8)
+    ddata = jax.device_put(jnp.asarray(data))
+    bmat = gf_pallas._perm_cache.get(mat)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chained(d, iters):
+        def body(i, dd):
+            p = gf_pallas._matvec_padded(bmat, dd, K, M,
+                                         gf_pallas.DEFAULT_TILE)
+            return dd.at[0:1].set(p[0:1])  # data dependency between iters
+        return jax.lax.fori_loop(0, iters, body, d)
+
+    def force(out):
+        return int(jnp.sum(out[:, ::4096].astype(jnp.uint32)))
+
+    force(chained(ddata, 2))  # warmup / compile
+    times = {}
+    for iters in LOOP_COUNTS:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            force(chained(ddata, iters))
+            best = min(best, time.perf_counter() - t0)
+        times[iters] = best
+    slope = (times[LOOP_COUNTS[1]] - times[LOOP_COUNTS[0]]) / (
+        LOOP_COUNTS[1] - LOOP_COUNTS[0])
+
+    data_bytes = K * n
+    gbps = data_bytes / slope / 1e9
+    print(json.dumps({
+        "metric": "ec_encode_rs_k8m3_device_GBps",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / ISA_L_BASELINE_GBPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Benchmark harnesses (the reference's src/test/erasure-code benchmark suite)."""

@@ -1,0 +1,100 @@
+"""JAX/TPU GF(2^8) kernel path — bit-sliced binary matmul on the MXU.
+
+The reference's hot kernel (ISA-L ``ec_encode_data`` /
+``jerasure_matrix_encode``, called from
+src/erasure-code/isa/ErasureCodeIsa.cc:118-130) does position-wise GF(2^8)
+multiply-accumulate with SIMD nibble tables. A TPU has no byte-granular
+shuffle ALU, so translating that would waste the chip (SURVEY.md §7 "hard
+parts"). Instead, multiplication by a fixed field element is lowered to
+GF(2) linear algebra (ops/bitmatrix.py):
+
+    parity_bits[8m, N] = B[8m, 8k] @ data_bits[8k, N]   (mod 2)
+
+which is an int8 matmul with int32 accumulation — exactly the MXU's native
+operation — followed by ``& 1``. Unpack/pack of byte -> bit-planes are
+cheap VPU shifts that XLA fuses around the matmul. The result is
+byte-identical to the numpy reference (the cross-backend corpus gate,
+tests/test_gf_jax.py).
+
+The encode for a whole stripe *batch* is the same matmul with N = batch *
+chunk_size — stripes are a free leading dimension folded into the lane axis
+(SURVEY.md §5 "stripe batch = leading vmap dim").
+
+Matrices are tiny and static per codec; they are expanded host-side once and
+cached as device constants. Jit specializes per (8m, 8k, N) — callers should
+bucket N (chunk sizes are already 32-aligned by the base class) to bound
+recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+    HAVE_JAX = True
+except Exception:  # pragma: no cover
+    HAVE_JAX = False
+
+from ceph_tpu.ops import backend as backend_mod
+from ceph_tpu.ops import bitmatrix
+
+_SHIFTS = np.arange(8, dtype=np.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=()) if HAVE_JAX else (lambda f: f)
+def _bitsliced_matvec_device(bmat: "jax.Array", data: "jax.Array") -> "jax.Array":
+    """bmat [R, 8k] int8 (0/1), data [k, N] uint8 -> [R//8, N] uint8."""
+    k, n = data.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    # unpack: [k, N] -> [8k, N] bit planes (plane 8j+c = bit c of chunk j)
+    dbits = ((data[:, None, :] >> shifts[None, :, None]) & 1).astype(jnp.int8)
+    dbits = dbits.reshape(8 * k, n)
+    # MXU: int8 x int8 -> int32
+    acc = jax.lax.dot_general(
+        bmat, dbits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    pbits = (acc & 1).astype(jnp.uint8)  # [R, N]
+    r = bmat.shape[0]
+    planes = pbits.reshape(r // 8, 8, n)
+    weights = (jnp.uint8(1) << shifts)[None, :, None]
+    return (planes * weights).sum(axis=1, dtype=jnp.uint32).astype(jnp.uint8)
+
+
+class _MatrixCache:
+    """Host GF matrix -> device-resident binary matrix, keyed by bytes."""
+
+    def __init__(self) -> None:
+        self._cache: dict[bytes, "jax.Array"] = {}
+
+    def get(self, mat: np.ndarray) -> "jax.Array":
+        key = mat.shape[0].to_bytes(2, "little") + mat.tobytes()
+        dev = self._cache.get(key)
+        if dev is None:
+            bmat = bitmatrix.expand_bitmatrix(mat).astype(np.int8)
+            dev = jnp.asarray(bmat)
+            self._cache[key] = dev
+        return dev
+
+
+_matrix_cache = _MatrixCache()
+
+
+def matvec_device(mat: np.ndarray, data) -> "jax.Array":
+    """Device-in/device-out encode: data may be a jax array already in HBM."""
+    bmat = _matrix_cache.get(np.asarray(mat, dtype=np.uint8))
+    return _bitsliced_matvec_device(bmat, jnp.asarray(data, dtype=jnp.uint8))
+
+
+def matvec(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Host-in/host-out backend entry conforming to ops.backend contract."""
+    return np.asarray(jax.device_get(matvec_device(mat, data)))
+
+
+if HAVE_JAX:
+    backend_mod.register_backend("jax", matvec)

@@ -1,0 +1,150 @@
+"""XOR-strip Pallas kernel — the flagship TPU-native GF(2^8) codec path.
+
+jerasure's fastest CPU techniques (``cauchy_good``, liberation family)
+never do byte-wise GF multiplies: they expand the coding matrix to GF(2)
+(ops/bitmatrix.py), slice each chunk into w=8 *strips*, and make every
+parity strip an XOR of selected data strips, scheduled for L1 reuse
+(reference: jerasure bitmatrix/schedule technique used by
+src/erasure-code/jerasure/ErasureCodeJerasure.h:156-190; the strip/packet
+layout is per-technique chunk layout, decode uses the same machinery).
+
+That is *exactly* the right shape for a TPU VPU, with strips as wide int32
+rows instead of CPU cache packets:
+
+- chunk [C bytes] -> 8 contiguous strips of C/8 bytes (a pure reshape);
+- device layout [8k, W/128, 128] int32 words (full sublane/lane tiles —
+  no padding waste, unlike a [k, N] uint8 array whose 8-sublane tiles
+  waste 3/4 of HBM traffic);
+- parity strip r = XOR-reduce of the data-strip rows j with B[r,j]=1,
+  each a full [SB, 128] int32 VPU op in VMEM;
+- HBM traffic = data in + parity out. No bit unpack, no MXU, ~3 int32
+  VPU ops per data byte -> HBM-bound by design.
+
+Encode and decode are the same kernel with different binary matrices
+(decode expands the inverted matrix). The XOR schedule (which rows, which
+terms) is baked per matrix at trace time — matrices are tiny and static
+per codec, mirroring the reference's per-codec schedule precompute.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ceph_tpu.ops import bitmatrix
+
+#: int32 words per strip-block row in one grid step (lanes are fixed at 128)
+DEFAULT_SUBBLOCK = 256
+
+
+def _xor_kernel(data_ref, out_ref, *, schedule: tuple[tuple[int, ...], ...]):
+    """data_ref [8k, SB, 128] int32; out_ref [R, SB, 128] int32.
+
+    schedule[r] = data strip rows to XOR into output strip r (static).
+    """
+    for r, terms in enumerate(schedule):
+        acc = data_ref[terms[0]]
+        for j in terms[1:]:
+            acc = acc ^ data_ref[j]
+        out_ref[r] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("schedule", "rows", "sb"))
+def _xor_encode_padded(data: jax.Array, schedule, rows: int, sb: int):
+    """data [8k, B, 128] int32 with B % sb == 0 -> [rows, B, 128] int32."""
+    k8, b, _ = data.shape
+    grid = (b // sb,)
+    return pl.pallas_call(
+        functools.partial(_xor_kernel, schedule=schedule),
+        grid=grid,
+        in_specs=[pl.BlockSpec((k8, sb, 128), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((rows, sb, 128), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, b, 128), jnp.int32),
+    )(data)
+
+
+def _schedule_from_bitmatrix(bmat: np.ndarray) -> tuple[tuple[int, ...], ...]:
+    """Row r -> tuple of contributing strip rows. All-zero rows are invalid
+    (a zero parity strip would mean a degenerate matrix row)."""
+    sched = []
+    for r in range(bmat.shape[0]):
+        terms = tuple(int(j) for j in np.flatnonzero(bmat[r]))
+        if not terms:
+            raise ValueError(f"bit-matrix row {r} is all-zero")
+        sched.append(terms)
+    return tuple(sched)
+
+
+class StripCodecKernel:
+    """Compiled XOR-strip transform for one GF matrix.
+
+    Operates on the strip layout: input [k, C] uint8 chunks reshape to
+    [8k, C/8] strips; C must be a multiple of 8*128*4 = 4096 bytes
+    (the base class chunk alignment guarantees this for the tpu plugin).
+    """
+
+    def __init__(self, mat: np.ndarray):
+        mat = np.asarray(mat, dtype=np.uint8)
+        self.m_out, self.k_in = mat.shape
+        self.bmat = bitmatrix.expand_bitmatrix(mat)
+        self.schedule = _schedule_from_bitmatrix(self.bmat)
+
+    def __call__(self, data, sub_block: int = DEFAULT_SUBBLOCK):
+        """data: [k, C] uint8 (numpy or jax, host or device) -> [m, C] uint8
+        in strip layout (chunk c = its 8 strips concatenated)."""
+        data = jnp.asarray(data)
+        k, c = data.shape
+        assert k == self.k_in, (k, self.k_in)
+        assert c % 4096 == 0, f"chunk size {c} must be a multiple of 4096"
+        w = c // 8 // 4           # int32 words per strip
+        blocks = w // 128          # 128-lane blocks per strip
+        sb = min(sub_block, blocks)
+        while blocks % sb:
+            sb //= 2
+        strips = jax.lax.bitcast_convert_type(
+            data.reshape(8 * k, w, 4), jnp.int32).reshape(8 * k, blocks, 128)
+        out = _xor_encode_padded(strips, self.schedule, 8 * self.m_out, sb)
+        out8 = jax.lax.bitcast_convert_type(
+            out.reshape(8 * self.m_out, w, 1), jnp.uint8)
+        return out8.reshape(self.m_out, c)
+
+
+@functools.lru_cache(maxsize=512)
+def _kernel_cache_key(shape_rows: int, mat_bytes: bytes) -> "StripCodecKernel":
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(shape_rows, -1)
+    return StripCodecKernel(mat)
+
+
+def get_kernel(mat: np.ndarray) -> StripCodecKernel:
+    mat = np.asarray(mat, dtype=np.uint8)
+    return _kernel_cache_key(mat.shape[0], mat.tobytes())
+
+
+def strip_matvec(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Host-in/host-out strip-layout transform (numpy-compatible oracle is
+    strip_matvec_reference)."""
+    return np.asarray(jax.device_get(get_kernel(mat)(data)))
+
+
+def strip_matvec_reference(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Numpy oracle for the strip layout: same math, host-side."""
+    mat = np.asarray(mat, dtype=np.uint8)
+    data = np.asarray(data, dtype=np.uint8)
+    m, k = mat.shape
+    _, c = data.shape
+    w = c // 8
+    bmat = bitmatrix.expand_bitmatrix(mat)
+    strips = data.reshape(8 * k, w)
+    out = np.zeros((8 * m, w), dtype=np.uint8)
+    for r in range(8 * m):
+        for j in np.flatnonzero(bmat[r]):
+            out[r] ^= strips[j]
+    return out.reshape(m, c)

@@ -1,0 +1,8 @@
+"""Distribution layer: device meshes, sharded codecs, messenger, CRUSH, mon.
+
+The reference scales via placement parallelism (CRUSH), EC striping across
+OSDs, and a messenger over TCP/RDMA/DPDK (SURVEY.md §2.3, §5). The TPU
+translation: stripe batches and chunk bytes are sharded over a
+``jax.sharding.Mesh`` with XLA collectives riding ICI/DCN; host-side
+control/placement stays in Python/C++ (messenger, CRUSH, mon).
+"""

@@ -1,0 +1,43 @@
+"""Device-mesh helpers for the sharded EC pipeline.
+
+Mesh axes (the storage analog of DP/TP/SP — SURVEY.md §2.3 parallelism map):
+
+- ``stripe``: data-parallel over stripe batches (the reference's per-stripe
+  loop, ECUtil.cc:136-148, becomes this leading dimension);
+- ``shard``:  parallel over the chunk byte dimension *and* the home axis for
+  chunk placement collectives (the storage twin of tensor parallelism —
+  one EC shard per OSD, doc/dev/osd_internals/erasure_coding/ecbackend.rst).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, stripe: int | None = None,
+              shard: int | None = None, devices=None) -> Mesh:
+    """Build a 2D ('stripe', 'shard') mesh over the first n devices.
+
+    Default factorization: shard axis as large as possible up to 4 (matching
+    small EC groups), remainder to stripe.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if stripe is None or shard is None:
+        shard = shard or _largest_factor_leq(n_devices, 4)
+        stripe = stripe or n_devices // shard
+    assert stripe * shard == n_devices, (stripe, shard, n_devices)
+    arr = np.array(devices).reshape(stripe, shard)
+    return Mesh(arr, axis_names=("stripe", "shard"))
+
+
+def _largest_factor_leq(n: int, cap: int) -> int:
+    for f in range(min(cap, n), 0, -1):
+        if n % f == 0:
+            return f
+    return 1

@@ -1,16 +1,21 @@
 """Test harness config: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip TPU hardware is not available in CI; sharding/collective tests run
-on 8 virtual CPU devices (the same trick the driver's dryrun uses). Must be
-set before jax is imported anywhere.
+Multi-chip TPU hardware is not available in CI; sharding/collective tests
+run on 8 virtual CPU devices (the same trick the driver's multichip dryrun
+uses). The environment's sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon already captured, so plain env vars are too late — use
+jax.config.update before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
