@@ -1,0 +1,7 @@
+"""'native' backend registration (C++ host kernels via ctypes)."""
+
+from ceph_tpu.ops import backend as backend_mod
+from ceph_tpu.ops import native_loader
+
+if native_loader.available():
+    backend_mod.register_backend("native", native_loader.matvec)

@@ -1,0 +1,259 @@
+// Native host GF(2^8) + checksum kernels for ceph_tpu.
+//
+// Stands in for the reference's vendored native math (gf-complete, jerasure,
+// isa-l, crc32c asm — all empty submodules or raw asm in the snapshot; see
+// SURVEY.md §2.4). Roles:
+//   * CPU fallback backend for every codec (ops/backend.py "native"),
+//   * the honest single-socket baseline the TPU kernels are measured
+//     against (BASELINE.md),
+//   * host-side checksum pass (crc32c / xxhash64) for the stripe engine
+//     (the role of src/common/Checksummer.h and crc32c_intel_fast_asm.s).
+//
+// GF(2^8) poly 0x11d (gf-complete w=8 / ISA-L field). The hot loop uses the
+// same split-nibble table technique ISA-L implements in asm: y = T_lo[x&15]
+// ^ T_hi[x>>4] with 16-entry tables in SIMD registers via PSHUFB (AVX2),
+// scalar table fallback otherwise.
+//
+// Build: ops/native/Makefile (lazy, driven by ops/native_loader.py).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Tables
+// ---------------------------------------------------------------------------
+
+static uint8_t MUL[256][256];
+static uint8_t NIB_LO[256][16];  // NIB_LO[c][n] = c * n        (low nibble)
+static uint8_t NIB_HI[256][16];  // NIB_HI[c][n] = c * (n << 4) (high nibble)
+static int inited = 0;
+
+static uint8_t gf_mul_slow(uint8_t a, uint8_t b) {
+  uint16_t r = 0;
+  uint16_t aa = a;
+  while (b) {
+    if (b & 1) r ^= aa;
+    aa <<= 1;
+    if (aa & 0x100) aa ^= 0x11d;
+    b >>= 1;
+  }
+  return (uint8_t)r;
+}
+
+void gf256_init(void) {
+  if (inited) return;
+  for (int a = 0; a < 256; a++)
+    for (int b = 0; b < 256; b++)
+      MUL[a][b] = gf_mul_slow((uint8_t)a, (uint8_t)b);
+  for (int c = 0; c < 256; c++) {
+    for (int n = 0; n < 16; n++) {
+      NIB_LO[c][n] = MUL[c][n];
+      NIB_HI[c][n] = MUL[c][n << 4];
+    }
+  }
+  inited = 1;
+}
+
+// ---------------------------------------------------------------------------
+// Region ops
+// ---------------------------------------------------------------------------
+
+void gf256_region_xor(uint8_t *dst, const uint8_t *src, uint64_t len) {
+  uint64_t i = 0;
+#if defined(__AVX2__)
+  for (; i + 32 <= len; i += 32) {
+    __m256i d = _mm256_loadu_si256((const __m256i *)(dst + i));
+    __m256i s = _mm256_loadu_si256((const __m256i *)(src + i));
+    _mm256_storeu_si256((__m256i *)(dst + i), _mm256_xor_si256(d, s));
+  }
+#endif
+  for (; i < len; i++) dst[i] ^= src[i];
+}
+
+// dst ^= c * src  (the gf_vect_mad of ISA-L)
+void gf256_region_mul_add(uint8_t *dst, const uint8_t *src, uint8_t c,
+                          uint64_t len) {
+  if (c == 0) return;
+  if (c == 1) { gf256_region_xor(dst, src, len); return; }
+  uint64_t i = 0;
+#if defined(__AVX2__)
+  __m128i lo128 = _mm_loadu_si128((const __m128i *)NIB_LO[c]);
+  __m128i hi128 = _mm_loadu_si128((const __m128i *)NIB_HI[c]);
+  __m256i lo = _mm256_broadcastsi128_si256(lo128);
+  __m256i hi = _mm256_broadcastsi128_si256(hi128);
+  __m256i maskf = _mm256_set1_epi8(0x0f);
+  for (; i + 32 <= len; i += 32) {
+    __m256i s = _mm256_loadu_si256((const __m256i *)(src + i));
+    __m256i sl = _mm256_and_si256(s, maskf);
+    __m256i sh = _mm256_and_si256(_mm256_srli_epi64(s, 4), maskf);
+    __m256i r = _mm256_xor_si256(_mm256_shuffle_epi8(lo, sl),
+                                 _mm256_shuffle_epi8(hi, sh));
+    __m256i d = _mm256_loadu_si256((const __m256i *)(dst + i));
+    _mm256_storeu_si256((__m256i *)(dst + i), _mm256_xor_si256(d, r));
+  }
+#endif
+  const uint8_t *t = MUL[c];
+  for (; i < len; i++) dst[i] ^= t[src[i]];
+}
+
+// out[m][len] = mat[m][k] (x) data[k][len]; rows are contiguous slabs.
+// This is the ec_encode_data role (ISA-L) — the CPU hot kernel.
+void gf256_matvec(const uint8_t *mat, int m, int k, const uint8_t *data,
+                  uint8_t *out, uint64_t len) {
+  for (int i = 0; i < m; i++) {
+    uint8_t *dst = out + (uint64_t)i * len;
+    std::memset(dst, 0, len);
+    for (int j = 0; j < k; j++)
+      gf256_region_mul_add(dst, data + (uint64_t)j * len, mat[i * k + j], len);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// crc32c (Castagnoli) — the BlueStore/messenger checksum
+// (role of src/common/crc32c_intel_fast_asm.s + sctp_crc32.c)
+// ---------------------------------------------------------------------------
+
+static uint32_t CRC_TBL[8][256];
+static int crc_inited = 0;
+
+static void crc32c_init_tbl(void) {
+  if (crc_inited) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int j = 0; j < 8; j++) c = (c >> 1) ^ (0x82f63b78u & (~(c & 1) + 1));
+    CRC_TBL[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = CRC_TBL[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = (c >> 8) ^ CRC_TBL[0][c & 0xff];
+      CRC_TBL[t][i] = c;
+    }
+  }
+  crc_inited = 1;
+}
+
+uint32_t ceph_crc32c(uint32_t crc, const uint8_t *buf, uint64_t len) {
+  crc32c_init_tbl();
+  crc = ~crc;
+  uint64_t i = 0;
+#if defined(__SSE4_2__)
+  for (; i + 8 <= len; i += 8) {
+    uint64_t v;
+    std::memcpy(&v, buf + i, 8);
+    crc = (uint32_t)_mm_crc32_u64(crc, v);
+  }
+  for (; i < len; i++) crc = _mm_crc32_u8(crc, buf[i]);
+#else
+  for (; i + 8 <= len; i += 8) {
+    crc ^= (uint32_t)(buf[i] | (buf[i + 1] << 8) | (buf[i + 2] << 16) |
+                      ((uint32_t)buf[i + 3] << 24));
+    uint32_t hi = (uint32_t)(buf[i + 4] | (buf[i + 5] << 8) |
+                             (buf[i + 6] << 16) | ((uint32_t)buf[i + 7] << 24));
+    uint32_t c = CRC_TBL[7][crc & 0xff] ^ CRC_TBL[6][(crc >> 8) & 0xff] ^
+                 CRC_TBL[5][(crc >> 16) & 0xff] ^ CRC_TBL[4][crc >> 24] ^
+                 CRC_TBL[3][hi & 0xff] ^ CRC_TBL[2][(hi >> 8) & 0xff] ^
+                 CRC_TBL[1][(hi >> 16) & 0xff] ^ CRC_TBL[0][hi >> 24];
+    crc = c;
+  }
+  for (; i < len; i++) crc = (crc >> 8) ^ CRC_TBL[0][(crc ^ buf[i]) & 0xff];
+#endif
+  return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// xxhash64 (role of the xxHash submodule used by Checksummer.h)
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+static inline uint64_t rd64(const uint8_t *p) {
+  uint64_t v; std::memcpy(&v, p, 8); return v;
+}
+static inline uint32_t rd32(const uint8_t *p) {
+  uint32_t v; std::memcpy(&v, p, 4); return v;
+}
+static inline uint64_t round1(uint64_t acc, uint64_t input) {
+  acc += input * P2; acc = rotl64(acc, 31); acc *= P1; return acc;
+}
+static inline uint64_t merge(uint64_t acc, uint64_t val) {
+  val = round1(0, val); acc ^= val; acc = acc * P1 + P4; return acc;
+}
+
+uint64_t ceph_xxhash64(uint64_t seed, const uint8_t *p, uint64_t len) {
+  const uint8_t *end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    const uint8_t *limit = end - 32;
+    do {
+      v1 = round1(v1, rd64(p)); p += 8;
+      v2 = round1(v2, rd64(p)); p += 8;
+      v3 = round1(v3, rd64(p)); p += 8;
+      v4 = round1(v4, rd64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = merge(h, v1); h = merge(h, v2); h = merge(h, v3); h = merge(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += len;
+  while (p + 8 <= end) {
+    h ^= round1(0, rd64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)rd32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33; h *= P2; h ^= h >> 29; h *= P3; h ^= h >> 32;
+  return h;
+}
+
+uint32_t ceph_xxhash32(uint32_t seed, const uint8_t *p, uint64_t len) {
+  const uint32_t Q1 = 0x9E3779B1u, Q2 = 0x85EBCA77u, Q3 = 0xC2B2AE3Du,
+                 Q4 = 0x27D4EB2Fu, Q5 = 0x165667B1u;
+  const uint8_t *end = p + len;
+  uint32_t h;
+  auto rotl32 = [](uint32_t x, int r) { return (x << r) | (x >> (32 - r)); };
+  if (len >= 16) {
+    uint32_t v1 = seed + Q1 + Q2, v2 = seed + Q2, v3 = seed, v4 = seed - Q1;
+    const uint8_t *limit = end - 16;
+    do {
+      v1 = rotl32(v1 + rd32(p) * Q2, 13) * Q1; p += 4;
+      v2 = rotl32(v2 + rd32(p) * Q2, 13) * Q1; p += 4;
+      v3 = rotl32(v3 + rd32(p) * Q2, 13) * Q1; p += 4;
+      v4 = rotl32(v4 + rd32(p) * Q2, 13) * Q1; p += 4;
+    } while (p <= limit);
+    h = rotl32(v1, 1) + rotl32(v2, 7) + rotl32(v3, 12) + rotl32(v4, 18);
+  } else {
+    h = seed + Q5;
+  }
+  h += (uint32_t)len;
+  while (p + 4 <= end) { h = rotl32(h + rd32(p) * Q3, 17) * Q4; p += 4; }
+  while (p < end) { h = rotl32(h + (*p) * Q5, 11) * Q1; p++; }
+  h ^= h >> 15; h *= Q2; h ^= h >> 13; h *= Q3; h ^= h >> 16;
+  return h;
+}
+
+}  // extern "C"

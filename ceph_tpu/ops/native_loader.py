@@ -1,0 +1,119 @@
+"""ctypes loader for the native C++ kernel library (lazy build via make).
+
+Python<->native binding uses ctypes (no pybind11 in this image). The library
+is built on first use into ops/native/_build/ and cached; if the toolchain
+is unavailable the loader degrades gracefully and callers fall back to
+numpy paths (ops/backend.py resolution order).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).parent / "native"
+_SO = _DIR / "_build" / "libceph_tpu_native.so"
+_lock = threading.Lock()
+_lib = None
+_failed = False
+
+
+def get_lib():
+    """Return the loaded library or None if build/load failed."""
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if not _SO.exists() or _SO.stat().st_mtime < (
+                    _DIR / "gf256.cc").stat().st_mtime:
+                subprocess.run(
+                    ["make", "-s", "-C", str(_DIR)],
+                    check=True, capture_output=True, timeout=300)
+            lib = ctypes.CDLL(str(_SO))
+            _bind(lib)
+            lib.gf256_init()
+            _lib = lib
+        except Exception:
+            _failed = True
+        return _lib
+
+
+def _bind(lib) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.gf256_init.restype = None
+    lib.gf256_region_xor.argtypes = [u8p, u8p, ctypes.c_uint64]
+    lib.gf256_region_mul_add.argtypes = [u8p, u8p, ctypes.c_uint8,
+                                         ctypes.c_uint64]
+    lib.gf256_matvec.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p, u8p,
+                                 ctypes.c_uint64]
+    lib.ceph_crc32c.restype = ctypes.c_uint32
+    lib.ceph_crc32c.argtypes = [ctypes.c_uint32, u8p, ctypes.c_uint64]
+    lib.ceph_xxhash64.restype = ctypes.c_uint64
+    lib.ceph_xxhash64.argtypes = [ctypes.c_uint64, u8p, ctypes.c_uint64]
+    lib.ceph_xxhash32.restype = ctypes.c_uint32
+    lib.ceph_xxhash32.argtypes = [ctypes.c_uint32, u8p, ctypes.c_uint64]
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def matvec(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """[m,k] (x) [k,N] -> [m,N] via the native ec_encode_data-role kernel."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    m, k = mat.shape
+    n = data.shape[1]
+    out = np.empty((m, n), dtype=np.uint8)
+    lib.gf256_matvec(_as_u8p(mat), m, k, _as_u8p(data), _as_u8p(out), n)
+    return out
+
+
+def region_xor(dst: np.ndarray, src: np.ndarray) -> None:
+    lib = get_lib()
+    lib.gf256_region_xor(_as_u8p(dst), _as_u8p(src), dst.size)
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """Standard CRC-32C (Castagnoli): crc32c(b"123456789") == 0xE3069283.
+    Pass the previous value to continue a running crc."""
+    lib = get_lib()
+    if lib is None:
+        from ceph_tpu.utils import checksum
+        return checksum.crc32c_sw(data, crc)
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else np.ascontiguousarray(data, np.uint8)
+    return int(lib.ceph_crc32c(ctypes.c_uint32(crc), _as_u8p(buf), buf.size))
+
+
+def xxhash64(data, seed: int = 0) -> int:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else np.ascontiguousarray(data, np.uint8)
+    return int(lib.ceph_xxhash64(ctypes.c_uint64(seed), _as_u8p(buf), buf.size))
+
+
+def xxhash32(data, seed: int = 0) -> int:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else np.ascontiguousarray(data, np.uint8)
+    return int(lib.ceph_xxhash32(ctypes.c_uint32(seed), _as_u8p(buf), buf.size))

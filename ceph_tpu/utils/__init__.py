@@ -1,0 +1,6 @@
+"""Foundation layer: buffers, config, logging, perf counters, checksums.
+
+The role of the reference's src/include/buffer.h, src/common/{options.cc,
+config.cc, perf_counters.h, admin_socket.h, Checksummer.h} (SURVEY.md §1
+layers 0-2).
+"""

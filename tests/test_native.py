@@ -1,0 +1,93 @@
+"""Native C++ library tests: GF kernels vs numpy oracle, checksum vectors.
+
+Cross-backend bit-exactness is the corpus gate (SURVEY.md §4.2); checksum
+functions are validated against published check values.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import backend, gf256, native_loader
+from ceph_tpu.utils import checksum
+
+pytestmark = pytest.mark.skipif(
+    not native_loader.available(), reason="native library unavailable")
+
+
+def test_native_matvec_bit_exact():
+    rng = np.random.default_rng(0)
+    for k, m, n in [(2, 1, 64), (8, 3, 4096), (12, 4, 1000)]:
+        mat = rng.integers(0, 256, size=(m, k), dtype=np.uint8)
+        data = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+        assert np.array_equal(native_loader.matvec(mat, data),
+                              gf256.gf_matvec_chunks(mat, data))
+
+
+def test_native_backend_registered():
+    assert "native" in backend.available_backends()
+
+
+def test_native_codec_roundtrip():
+    from ceph_tpu.models import instance
+    codec = instance().factory("isa", {"k": "8", "m": "3",
+                                       "backend": "native"})
+    data = bytes(range(256)) * 64
+    enc = codec.encode(list(range(11)), data)
+    cs = codec.get_chunk_size(len(data))
+    avail = {i: enc[i] for i in range(11) if i not in (0, 9)}
+    dec = codec.decode([0, 9], avail, cs)
+    assert np.array_equal(dec[0], enc[0])
+    assert np.array_equal(dec[9], enc[9])
+
+
+def test_crc32c_check_value():
+    # iSCSI CRC-32C published check value
+    assert checksum.crc32c(b"123456789") == 0xE3069283
+    assert checksum.crc32c_sw(b"123456789") == 0xE3069283
+
+
+def test_crc32c_incremental():
+    whole = checksum.crc32c(b"hello world")
+    part = checksum.crc32c(b"world", checksum.crc32c(b"hello "))
+    assert whole == part
+    assert checksum.crc32c_sw(b"world", checksum.crc32c_sw(b"hello ")) == whole
+
+
+def test_crc32c_native_matches_sw_random():
+    rng = np.random.default_rng(1)
+    for n in (1, 7, 8, 63, 4096):
+        buf = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        assert checksum.crc32c(buf) == checksum.crc32c_sw(buf)
+
+
+def test_xxhash64_vectors():
+    # published XXH64 test vectors
+    assert checksum.xxhash64(b"") == 0xEF46DB3751D8E999
+    assert checksum.xxhash64(b"a") == 0xD24EC4F1A98C6E5B
+    assert checksum.xxhash64(b"abc") == 0x44BC2CF5AD770999
+
+
+def test_xxhash32_vectors():
+    assert checksum.xxhash32(b"") == 0x02CC5D05
+    assert checksum.xxhash32(b"a") == 0x550D7456
+
+
+def test_checksummer_blockwise():
+    data = np.arange(16384, dtype=np.uint32).view(np.uint8)
+    cs = checksum.Checksummer("crc32c", 4096)
+    sums = cs.calculate(data)
+    assert len(sums) == len(data) // 4096
+    assert cs.verify(data, sums) == -1
+    corrupted = data.copy()
+    corrupted[5000] ^= 0xFF
+    assert cs.verify(corrupted, sums) == 4096
+
+
+def test_region_xor():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 256, size=1000, dtype=np.uint8)
+    b = rng.integers(0, 256, size=1000, dtype=np.uint8)
+    want = a ^ b
+    dst = a.copy()
+    native_loader.region_xor(dst, b)
+    assert np.array_equal(dst, want)
