@@ -1,0 +1,129 @@
+"""LRC composition codec tests (reference: src/test/erasure-code lrc tests
++ doc/rados/operations/erasure-code-lrc examples)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models import ErasureCodeError, instance
+from ceph_tpu.models.lrc import generate_kml
+
+
+def make(**profile):
+    prof = {}
+    for k, v in profile.items():
+        prof[str(k)] = v if isinstance(v, str) else str(v)
+    prof["backend"] = "numpy"
+    return instance().factory("lrc", prof)
+
+
+def test_kml_generation():
+    mapping, layers = generate_kml(4, 2, 3)
+    # lgc = 2 groups of l+1=4: DD_ _ per group
+    assert mapping == "DD__DD__"
+    assert layers[0][0] == "DDc_DDc_"
+    assert layers[1][0] == "DDDc____"
+    assert layers[2][0] == "____DDDc"
+
+
+def test_kml_constraints():
+    with pytest.raises(ErasureCodeError):
+        generate_kml(4, 2, 4)  # (k+m)%l != 0
+    with pytest.raises(ErasureCodeError):
+        generate_kml(5, 1, 3)  # k % lgc != 0
+
+
+def test_roundtrip_and_systematic():
+    codec = make(k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    assert n == 8
+    assert codec.get_data_chunk_count() == 4
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=4096 * 4, dtype=np.uint8).tobytes()
+    enc = codec.encode(list(range(n)), data)
+    assert len(enc) == n
+    # data chunks live at mapping 'D' positions
+    dpos = [i for i, ch in enumerate("DD__DD__") if ch == "D"]
+    concat = np.concatenate([enc[p] for p in dpos]).tobytes()
+    assert concat[: len(data)] == data
+
+
+def test_single_erasure_local_repair():
+    """Single failure repairs within the local group — fewer reads than k."""
+    codec = make(k=4, m=2, l=3)
+    n = 8
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=8192, dtype=np.uint8).tobytes()
+    enc = codec.encode(list(range(n)), data)
+    cs = codec.get_chunk_size(len(data))
+    for lost in range(n):
+        avail = {i: enc[i] for i in range(n) if i != lost}
+        dec = codec.decode([lost], avail, cs)
+        assert np.array_equal(dec[lost], enc[lost]), lost
+        plan = codec.minimum_to_decode([lost], [i for i in range(n) if i != lost])
+        assert len(plan) == 3, (lost, sorted(plan))  # local group l=3 reads
+
+
+def test_multi_erasure_global_fallback():
+    codec = make(k=4, m=2, l=3)
+    n = 8
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=8192, dtype=np.uint8).tobytes()
+    enc = codec.encode(list(range(n)), data)
+    cs = codec.get_chunk_size(len(data))
+    recovered = unrecoverable = 0
+    for lost in itertools.combinations(range(n), 2):
+        avail = {i: enc[i] for i in range(n) if i not in lost}
+        try:
+            dec = codec.decode(list(lost), avail, cs)
+        except ErasureCodeError:
+            unrecoverable += 1
+            continue
+        recovered += 1
+        for c in lost:
+            assert np.array_equal(dec[c], enc[c]), lost
+    assert recovered > 0 and unrecoverable == 0  # 2 failures always covered
+
+
+def test_explicit_layers_profile():
+    """The low-level mapping+layers JSON interface
+    (doc/rados/operations/erasure-code-lrc 'layers' examples)."""
+    codec = make(
+        mapping="__DD__DD",
+        layers='[["_cDD_cDD", {"plugin": "jerasure", "technique": "cauchy_orig"}],'
+               ' ["cDDD____", {}], ["____cDDD", {}]]',
+    )
+    n = codec.get_chunk_count()
+    assert n == 8 and codec.get_data_chunk_count() == 4
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, size=4096 * 4, dtype=np.uint8).tobytes()
+    enc = codec.encode(list(range(n)), data)
+    cs = codec.get_chunk_size(len(data))
+    avail = {i: enc[i] for i in range(n) if i != 2}
+    dec = codec.decode([2], avail, cs)
+    assert np.array_equal(dec[2], enc[2])
+
+
+def test_bad_profiles():
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2)  # l missing
+    with pytest.raises(ErasureCodeError):
+        make(k=4, m=2, l=3, mapping="DDDD____")  # kml + mapping
+    with pytest.raises(ErasureCodeError):
+        make(mapping="DD__", layers='[["DD__", {}]]')  # no c in layer
+    with pytest.raises(ErasureCodeError):
+        make(mapping="DD__", layers='[["DDc_", {}]]')  # position 3 uncovered
+
+
+def test_decode_concat_reads_data_positions():
+    codec = make(k=4, m=2, l=3)
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=10000, dtype=np.uint8).tobytes()
+    enc = codec.encode(list(range(8)), data)
+    del enc[0], enc[4]
+    out = codec.decode_chunks([0, 1, 4, 5],
+                              enc)
+    dpos = [0, 1, 4, 5]
+    concat = np.concatenate([out[p] for p in dpos]).tobytes()
+    assert concat[: len(data)] == data
