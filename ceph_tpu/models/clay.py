@@ -1,0 +1,453 @@
+"""Clay codes — Coupled-LAYer MSR codes (repair-bandwidth optimal).
+
+Reference: src/erasure-code/clay/ErasureCodeClay.{h,cc} (FAST'18 "Clay
+Codes: Moulding MDS Codes to Yield Vector Codes"). Parameters k, m,
+d in [k, k+m-1] (default k+m-1); q = d-k+1, nu pads (k+m) to a multiple of
+q with virtual zero chunks, t = (k+m+nu)/q, and every chunk is an *array*
+of ``sub_chunk_no = q^t`` sub-chunks (ErasureCodeClay.cc:295).
+
+Geometry: nodes live on a q x t grid (node = y*q + x); a sub-chunk is
+addressed by a plane vector z in [q]^t. Node (x,y) at plane z is *coupled*
+with node (z_y, y) at the companion plane z(y->x): the pair's coupled
+values (C) and uncoupled values (U) form one codeword of a fixed k=2,m=2
+scalar MDS code (the reference's "pft"); slot order is canonical with the
+higher-x member first. For each plane, the U values across all q*t nodes
+form a codeword of the scalar MDS code with k+nu data chunks (the "mds",
+default jerasure reed_sol_van — both sub-codecs come from our registry,
+mirroring the reference's ScalarMDS composition, ErasureCodeClay.h:35-40).
+
+Encode = decode_layered with the m parity nodes erased
+(ErasureCodeClay.cc:128-157). decode_layered processes planes in
+"intersection score" order, converting helpers C->U, MDS-decoding each
+plane's erased U, then U->C for the erased nodes
+(ErasureCodeClay.cc:644-709).
+
+The point of all this machinery: single-node repair reads only
+sub_chunk_no/q sub-chunks from each of d helpers (repair path,
+ErasureCodeClay.cc:394-644) — optimal repair bandwidth, surfaced through
+``minimum_to_decode`` returning (offset, count) sub-chunk ranges exactly
+like the reference (ErasureCodeInterface.h:280-300).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.models.base import ErasureCode, SIMD_ALIGN
+from ceph_tpu.models.interface import ErasureCodeError
+from ceph_tpu.models.registry import ErasureCodePlugin
+
+__erasure_code_version__ = "ceph-tpu-plugin-1"
+
+
+def _lcm(a: int, b: int) -> int:
+    import math
+    return a * b // math.gcd(a, b)
+
+
+class ErasureCodeClay(ErasureCode):
+    DEFAULT_K, DEFAULT_M = 4, 2
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._k = self._m = self.d = 0
+        self.q = self.t = self.nu = 0
+        self.sub_chunk_no = 1
+        self.mds = None   # scalar MDS over q*t nodes (k+nu data)
+        self.pft = None   # pairwise transform: k=2, m=2 codec
+
+    # -- profile -----------------------------------------------------------
+
+    def init(self, profile):
+        from ceph_tpu.models.registry import instance
+        profile = dict(profile)
+        k = self.to_int("k", profile, self.DEFAULT_K)
+        m = self.to_int("m", profile, self.DEFAULT_M)
+        d = self.to_int("d", profile, k + m - 1)
+        if k < 2:
+            raise ErasureCodeError(f"clay: k={k} must be >= 2")
+        if m < 1:
+            raise ErasureCodeError(f"clay: m={m} must be >= 1")
+        if not (k <= d <= k + m - 1):
+            raise ErasureCodeError(
+                f"clay: d={d} must be within [{k}, {k + m - 1}]")
+        scalar_mds = profile.get("scalar_mds", "jerasure")
+        if scalar_mds not in ("jerasure", "isa", "shec"):
+            raise ErasureCodeError(
+                f"clay: scalar_mds={scalar_mds!r} must be jerasure|isa|shec")
+        technique = profile.get("technique",
+                                "single" if scalar_mds == "shec"
+                                else "reed_sol_van")
+        self._k, self._m, self.d = k, m, d
+        self.q = d - k + 1
+        self.nu = (self.q - (k + m) % self.q) % self.q
+        if k + m + self.nu > 254:
+            raise ErasureCodeError("clay: k+m+nu must be <= 254")
+        self.t = (k + m + self.nu) // self.q
+        self.sub_chunk_no = self.q ** self.t
+
+        backend = str(profile.get("backend", "auto"))
+        mds_profile = {"plugin": scalar_mds, "technique": technique,
+                       "k": str(k + self.nu), "m": str(m),
+                       "backend": backend}
+        pft_profile = {"plugin": scalar_mds, "technique": technique,
+                       "k": "2", "m": "2", "backend": backend}
+        if scalar_mds == "shec":
+            mds_profile["c"] = pft_profile["c"] = "2"
+        mds_plugin = mds_profile.pop("plugin")
+        pft_plugin = pft_profile.pop("plugin")
+        self.mds = instance().factory(mds_plugin, mds_profile)
+        self.pft = instance().factory(pft_plugin, pft_profile)
+        profile.setdefault("plugin", "clay")
+        profile["d"] = str(d)
+        profile["scalar_mds"] = scalar_mds
+        profile["technique"] = technique
+        self._profile = profile
+
+    # -- geometry ----------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self._k + self._m
+
+    def get_data_chunk_count(self) -> int:
+        return self._k
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_no
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        unit = _lcm(SIMD_ALIGN, self.sub_chunk_no)
+        base = -(-stripe_width // self.k)
+        return -(-base // unit) * unit
+
+    def _node_id(self, chunk: int) -> int:
+        """External chunk id -> internal node id (parity shifts past the nu
+        virtual nodes, ErasureCodeClay.cc:134-140)."""
+        return chunk if chunk < self.k else chunk + self.nu
+
+    def _chunk_id(self, node: int) -> int | None:
+        if node < self.k:
+            return node
+        if node < self.k + self.nu:
+            return None  # virtual
+        return node - self.nu
+
+    def get_plane_vector(self, z: int) -> list[int]:
+        zv = [0] * self.t
+        for i in range(self.t):
+            zv[self.t - 1 - i] = z % self.q
+            z //= self.q
+        return zv
+
+    # -- pairwise transform helpers ---------------------------------------
+
+    def _pft_solve(self, want: list[int], known: dict[int, np.ndarray]):
+        """One pairwise-transform solve: slots 0,1 = coupled pair (higher-x
+        member first), slots 2,3 = their uncoupled values."""
+        return self.pft.decode_chunks(want, known)
+
+    @staticmethod
+    def _slots(x: int, zy: int):
+        """Canonical slot order: (own, partner, own_u, partner_u)."""
+        if zy > x:
+            return 1, 0, 3, 2
+        return 0, 1, 2, 3
+
+    # -- encode / decode (full-chunk paths) --------------------------------
+
+    def encode_chunks(self, want_to_encode, chunks):
+        n = self.k + self.m
+        size = len(next(iter(chunks.values())))
+        nodes = {}
+        for i in range(n):
+            node = self._node_id(i)
+            if i < self.k:
+                nodes[node] = np.array(chunks[i], dtype=np.uint8)
+            else:
+                nodes[node] = np.zeros(size, dtype=np.uint8)
+        for i in range(self.k, self.k + self.nu):
+            nodes[i] = np.zeros(size, dtype=np.uint8)
+        erased = {self._node_id(i) for i in range(self.k, n)}
+        self._decode_layered(erased, nodes, size)
+        out = {}
+        for pos in want_to_encode:
+            if self.k <= pos < n:
+                out[pos] = nodes[self._node_id(pos)]
+        return out
+
+    def decode(self, want_to_read, chunks, chunk_size):
+        avail = set(chunks)
+        if self._is_repair(set(want_to_read), avail) and \
+                chunk_size > len(next(iter(chunks.values()))):
+            return self._repair(list(want_to_read)[0], chunks, chunk_size)
+        return super().decode(want_to_read, chunks, chunk_size)
+
+    def decode_chunks(self, want_to_read, chunks):
+        n = self.k + self.m
+        size = len(next(iter(chunks.values())))
+        nodes, erased = {}, set()
+        for i in range(n):
+            node = self._node_id(i)
+            if i in chunks:
+                nodes[node] = np.array(chunks[i], dtype=np.uint8)
+            else:
+                nodes[node] = np.zeros(size, dtype=np.uint8)
+                erased.add(node)
+        for i in range(self.k, self.k + self.nu):
+            nodes[i] = np.zeros(size, dtype=np.uint8)
+        if len(erased) > self.m:
+            raise ErasureCodeError(
+                f"clay: {len(erased)} erasures > m={self.m}", errno_=5)
+        self._decode_layered(set(erased), nodes, size)
+        return {i: nodes[self._node_id(i)] for i in want_to_read}
+
+    # -- the layered decoder (ErasureCodeClay.cc:644-709) ------------------
+
+    def _decode_layered(self, erased: set[int], nodes: dict[int, np.ndarray],
+                        size: int) -> None:
+        q, t = self.q, self.t
+        if size % self.sub_chunk_no:
+            raise ErasureCodeError(
+                f"clay: chunk size {size} not a multiple of "
+                f"{self.sub_chunk_no} sub-chunks")
+        sc = size // self.sub_chunk_no
+        # pad erasures to exactly m with virtual/parity nodes
+        for i in range(self.k + self.nu, q * t):
+            if len(erased) >= self.m:
+                break
+            erased.add(i)
+        u_buf = {i: np.zeros(size, dtype=np.uint8) for i in range(q * t)}
+
+        order = np.zeros(self.sub_chunk_no, dtype=np.int64)
+        zvecs = [self.get_plane_vector(z) for z in range(self.sub_chunk_no)]
+        for z in range(self.sub_chunk_no):
+            zv = zvecs[z]
+            order[z] = sum(1 for i in erased if i % q == zv[i // q])
+        max_score = int(order.max()) if len(erased) else 0
+
+        def sl(arr, z):
+            return arr[z * sc:(z + 1) * sc]
+
+        for score in range(max_score + 1):
+            planes = [z for z in range(self.sub_chunk_no) if order[z] == score]
+            # phase 1: compute U for intact nodes, then MDS-decode erased U
+            for z in planes:
+                zv = zvecs[z]
+                for y in range(t):
+                    for x in range(q):
+                        node_xy = q * y + x
+                        if node_xy in erased:
+                            continue
+                        node_sw = q * y + zv[y]
+                        if zv[y] == x:
+                            sl(u_buf[node_xy], z)[:] = sl(nodes[node_xy], z)
+                        elif zv[y] < x or node_sw in erased:
+                            self._uncoupled_from_coupled(
+                                nodes, u_buf, x, y, z, zv, sc)
+                self._decode_uncoupled(erased, z, sc, u_buf)
+            # phase 2: convert erased nodes' U back to C
+            for z in planes:
+                zv = zvecs[z]
+                for node_xy in erased:
+                    x, y = node_xy % q, node_xy // q
+                    node_sw = q * y + zv[y]
+                    if zv[y] == x:
+                        sl(nodes[node_xy], z)[:] = sl(u_buf[node_xy], z)
+                    elif node_sw not in erased:
+                        self._recover_type1(nodes, u_buf, x, y, z, zv, sc)
+                    elif zv[y] < x:
+                        self._coupled_from_uncoupled(
+                            nodes, u_buf, x, y, z, zv, sc)
+
+    def _z_sw(self, z: int, x: int, zy: int, y: int) -> int:
+        return z + (x - zy) * self.q ** (self.t - 1 - y)
+
+    def _uncoupled_from_coupled(self, nodes, u_buf, x, y, z, zv, sc):
+        """(C_xy, C_sw) -> (U_xy, U_sw) (ErasureCodeClay.cc:837-867)."""
+        node_xy, node_sw = self.q * y + x, self.q * y + zv[y]
+        z_sw = self._z_sw(z, x, zv[y], y)
+        i0, i1, i2, i3 = self._slots(x, zv[y])
+        known = {i0: nodes[node_xy][z * sc:(z + 1) * sc],
+                 i1: nodes[node_sw][z_sw * sc:(z_sw + 1) * sc]}
+        out = self._pft_solve([2, 3], known)
+        u_buf[node_xy][z * sc:(z + 1) * sc] = out[i2]
+        u_buf[node_sw][z_sw * sc:(z_sw + 1) * sc] = out[i3]
+
+    def _coupled_from_uncoupled(self, nodes, u_buf, x, y, z, zv, sc):
+        """(U_xy, U_sw) -> (C_xy, C_sw) (ErasureCodeClay.cc:810-835);
+        called with zv[y] < x so slot order is fixed."""
+        node_xy, node_sw = self.q * y + x, self.q * y + zv[y]
+        z_sw = self._z_sw(z, x, zv[y], y)
+        known = {2: u_buf[node_xy][z * sc:(z + 1) * sc],
+                 3: u_buf[node_sw][z_sw * sc:(z_sw + 1) * sc]}
+        out = self._pft_solve([0, 1], known)
+        nodes[node_xy][z * sc:(z + 1) * sc] = out[0]
+        nodes[node_sw][z_sw * sc:(z_sw + 1) * sc] = out[1]
+
+    def _recover_type1(self, nodes, u_buf, x, y, z, zv, sc):
+        """C_xy from (C_sw, U_xy) (ErasureCodeClay.cc:772-808)."""
+        node_xy, node_sw = self.q * y + x, self.q * y + zv[y]
+        z_sw = self._z_sw(z, x, zv[y], y)
+        i0, i1, i2, i3 = self._slots(x, zv[y])
+        known = {i1: nodes[node_sw][z_sw * sc:(z_sw + 1) * sc],
+                 i2: u_buf[node_xy][z * sc:(z + 1) * sc]}
+        out = self._pft_solve([i0], known)
+        nodes[node_xy][z * sc:(z + 1) * sc] = out[i0]
+
+    def _decode_uncoupled(self, erased: set[int], z: int, sc: int,
+                          u_buf) -> None:
+        """MDS-decode the plane's erased uncoupled values
+        (ErasureCodeClay.cc:739-757)."""
+        known = {i: u_buf[i][z * sc:(z + 1) * sc]
+                 for i in range(self.q * self.t) if i not in erased}
+        out = self.mds.decode_chunks(sorted(erased), known)
+        for i in erased:
+            u_buf[i][z * sc:(z + 1) * sc] = out[i]
+
+    # -- repair path (sub-chunk-efficient single failure) ------------------
+
+    def _is_repair(self, want: set[int], avail: set[int]) -> bool:
+        """ErasureCodeClay.cc:303-322."""
+        if want <= avail or len(want) > 1:
+            return False
+        lost = self._node_id(next(iter(want)))
+        for x in range(self.q):
+            node = (lost // self.q) * self.q + x
+            chunk = self._chunk_id(node)
+            if chunk is not None and chunk not in want and chunk not in avail:
+                return False
+        return len(avail) >= self.d
+
+    def get_repair_subchunks(self, lost_node: int) -> list[tuple[int, int]]:
+        """(offset, count) sub-chunk ranges each helper must read
+        (ErasureCodeClay.cc:362-376)."""
+        y, x = lost_node // self.q, lost_node % self.q
+        seq = self.q ** (self.t - 1 - y)
+        return [(x * seq + i * self.q * seq, seq)
+                for i in range(self.q ** y)]
+
+    def minimum_to_decode(self, want_to_read, available):
+        want, avail = set(want_to_read), set(available)
+        if not self._is_repair(want, avail):
+            chunks = self._minimum_to_decode_chunks(want_to_read, available)
+            return {c: [(0, self.sub_chunk_no)] for c in chunks}
+        lost = self._node_id(next(iter(want)))
+        ranges = self.get_repair_subchunks(lost)
+        minimum = {}
+        for x in range(self.q):  # lost node's y-group first
+            node = (lost // self.q) * self.q + x
+            chunk = self._chunk_id(node)
+            if chunk is not None and chunk not in want:
+                minimum[chunk] = ranges
+        for chunk in sorted(avail):
+            if len(minimum) >= self.d:
+                break
+            minimum.setdefault(chunk, ranges)
+        if len(minimum) != self.d:
+            raise ErasureCodeError("clay: repair needs d helpers", errno_=5)
+        return minimum
+
+    def _repair(self, want_chunk: int, chunks, chunk_size: int):
+        """Repair one chunk from d helpers' sub-chunk reads
+        (ErasureCodeClay.cc:394-644). Helper buffers hold only the
+        repair-plane sub-chunks, concatenated in plane order."""
+        q, t = self.q, self.t
+        lost = self._node_id(want_chunk)
+        repair_subchunks = self.sub_chunk_no // q
+        helper_len = len(next(iter(chunks.values())))
+        if helper_len % repair_subchunks:
+            raise ErasureCodeError("clay: bad helper buffer size")
+        sc = helper_len // repair_subchunks
+        if chunk_size != self.sub_chunk_no * sc:
+            raise ErasureCodeError("clay: chunk_size/helper size mismatch")
+
+        helper, aloof = {}, set()
+        for i in range(self.k + self.m):
+            node = self._node_id(i)
+            if i in chunks:
+                helper[node] = np.asarray(chunks[i], dtype=np.uint8)
+            elif i != want_chunk:
+                aloof.add(node)
+        for i in range(self.k, self.k + self.nu):
+            helper[i] = np.zeros(helper_len, dtype=np.uint8)
+        recovered = np.zeros(chunk_size, dtype=np.uint8)
+
+        # plane ordering by intersection score over {lost} + aloof
+        plan = self.get_repair_subchunks(lost)
+        repair_planes = [z for off, cnt in plan for z in range(off, off + cnt)]
+        plane_to_ind = {z: i for i, z in enumerate(repair_planes)}
+        erasures = {(lost // q) * q + x for x in range(q)} | aloof
+        if len(erasures) > self.m:
+            raise ErasureCodeError(
+                f"clay: repair infeasible, {len(erasures)} erasures > m",
+                errno_=5)
+        u_buf = {i: np.zeros(chunk_size, dtype=np.uint8)
+                 for i in range(q * t)}
+        scored: dict[int, list[int]] = {}
+        for z in repair_planes:
+            zv = self.get_plane_vector(z)
+            score = sum(1 for node in ({lost} | aloof)
+                        if node % q == zv[node // q])
+            scored.setdefault(score, []).append(z)
+
+        def hsl(node, z):  # helper sub-chunk (by repair-plane index)
+            i = plane_to_ind[z]
+            return helper[node][i * sc:(i + 1) * sc]
+
+        for score in sorted(scored):
+            for z in scored[score]:
+                zv = self.get_plane_vector(z)
+                # phase 1: U for intact nodes on this plane
+                for y in range(t):
+                    for x in range(q):
+                        node_xy = q * y + x
+                        if node_xy in erasures:
+                            continue
+                        node_sw = q * y + zv[y]
+                        z_sw = self._z_sw(z, x, zv[y], y)
+                        i0, i1, i2, i3 = self._slots(x, zv[y])
+                        if zv[y] == x:
+                            u_buf[node_xy][z * sc:(z + 1) * sc] = hsl(node_xy, z)
+                        elif node_sw in aloof:
+                            known = {i0: hsl(node_xy, z),
+                                     i3: u_buf[node_sw][z_sw * sc:(z_sw + 1) * sc]}
+                            out = self._pft_solve([i2], known)
+                            u_buf[node_xy][z * sc:(z + 1) * sc] = out[i2]
+                        else:
+                            known = {i0: hsl(node_xy, z),
+                                     i1: hsl(node_sw, z_sw)}
+                            out = self._pft_solve([i2], known)
+                            u_buf[node_xy][z * sc:(z + 1) * sc] = out[i2]
+                self._decode_uncoupled(erasures, z, sc, u_buf)
+                # phase 2: recover lost node's C on this plane
+                for node in sorted(erasures):
+                    x, y = node % q, node // q
+                    node_sw = q * y + zv[y]
+                    z_sw = self._z_sw(z, x, zv[y], y)
+                    i0, i1, i2, i3 = self._slots(x, zv[y])
+                    if node in aloof:
+                        continue
+                    if x == zv[y]:
+                        if node == lost:
+                            recovered[z * sc:(z + 1) * sc] = \
+                                u_buf[node][z * sc:(z + 1) * sc]
+                    else:
+                        # partner is the lost node: its companion sub-chunk
+                        if node_sw != lost or node not in helper:
+                            continue
+                        known = {i0: hsl(node, z),
+                                 i2: u_buf[node][z * sc:(z + 1) * sc]}
+                        out = self._pft_solve([i1], known)
+                        recovered[z_sw * sc:(z_sw + 1) * sc] = out[i1]
+        return {want_chunk: recovered}
+
+
+class ClayPlugin(ErasureCodePlugin):
+    def factory(self, profile):
+        codec = ErasureCodeClay()
+        codec.init(profile)
+        return codec
+
+
+def __erasure_code_init__(name, registry):
+    registry.add(name, ClayPlugin())
