@@ -1,0 +1,6 @@
+"""OSD-side erasure coding: stripe engine, transactions, EC backend.
+
+The role of src/osd/ECUtil.{h,cc}, ECTransaction.{h,cc}, ECBackend.{h,cc}
+(SURVEY.md §2.2) — the consumer layer that turns logical object writes into
+per-shard chunk operations, batched onto the TPU.
+"""

@@ -1,0 +1,237 @@
+"""Stripe math + batched encode/decode — the ECUtil role, TPU-batched.
+
+Reference: src/osd/ECUtil.{h,cc}. ``stripe_info_t`` (ECUtil.h:27-80) maps
+logical object offsets to stripes and chunk offsets; ``ECUtil::encode``
+loops ``ec_impl->encode`` once per stripe_width window (ECUtil.cc:120-159).
+
+The TPU translation (SURVEY.md §5 "stripe batch = leading vmap dim"): the
+per-stripe loop disappears. For matrix codecs the position-wise math lets S
+stripes fold into one [k, S*chunk_size] kernel call — one launch for a
+whole append batch instead of S launches; the generic fallback loops for
+codecs with cross-position structure (Clay).
+
+``HashInfo`` is the cumulative per-shard crc xattr (ECUtil.h:101-162,
+append logic ECUtil.cc:161-177, stored under the hinfo key :235): every
+shard append folds the new chunk bytes into a running crc32c so scrub can
+verify a shard without reading its peers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu.models.interface import ErasureCodeError
+from ceph_tpu.utils import checksum
+
+#: initial per-shard crc seed (the reference seeds with -1, ECUtil.h:117)
+HINFO_SEED = 0xFFFFFFFF
+
+
+@dataclass(frozen=True)
+class StripeInfo:
+    """stripe_width/chunk offset algebra (stripe_info_t, ECUtil.h:27-80)."""
+
+    stripe_width: int   # k * chunk_size bytes of logical data per stripe
+    chunk_size: int     # bytes per chunk per stripe
+
+    def __post_init__(self):
+        if self.stripe_width % self.chunk_size:
+            raise ValueError(
+                f"stripe_width {self.stripe_width} not a multiple of "
+                f"chunk_size {self.chunk_size}")
+
+    @property
+    def k(self) -> int:
+        return self.stripe_width // self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        return -(-offset // self.stripe_width) * self.stripe_width
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return -(-offset // self.stripe_width) * self.chunk_size
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def offset_len_to_stripe_bounds(self, offset: int,
+                                    length: int) -> tuple[int, int]:
+        """Expand [offset, offset+length) to stripe-aligned bounds
+        (ECUtil.h:72-79)."""
+        start = self.logical_to_prev_stripe_offset(offset)
+        end = self.logical_to_next_stripe_offset(offset + length)
+        return start, end - start
+
+
+def encode(sinfo: StripeInfo, codec, data: bytes | np.ndarray,
+           want: list[int] | None = None) -> dict[int, np.ndarray]:
+    """Encode a stripe-aligned logical extent into per-shard buffers.
+
+    data length must be a multiple of stripe_width; the result maps shard
+    id -> concatenated chunk bytes across all S stripes (what each shard
+    OSD stores contiguously). Matrix codecs encode all S stripes in ONE
+    kernel call; others loop (ECUtil.cc:136-148 semantics).
+    """
+    buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) else data.astype(np.uint8, copy=False).ravel()
+    sw, cs = sinfo.stripe_width, sinfo.chunk_size
+    if len(buf) % sw:
+        raise ErasureCodeError(
+            f"encode: length {len(buf)} not a multiple of stripe_width {sw}")
+    s = len(buf) // sw
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    assert sw == k * cs, (sw, k, cs)
+    want = list(range(n)) if want is None else list(want)
+    # [S, k, cs] -> per-shard contiguous [S*cs]
+    stripes = buf.reshape(s, k, cs)
+    data_shards = stripes.transpose(1, 0, 2).reshape(k, s * cs)
+    from ceph_tpu.models.matrix_codec import MatrixErasureCode
+    out: dict[int, np.ndarray] = {}
+    if isinstance(codec, MatrixErasureCode) and not codec.chunk_mapping:
+        # position-wise codec: stripes fold into the byte axis
+        parity = codec._matvec(codec.coding_matrix, data_shards)
+        for i in want:
+            out[i] = data_shards[i] if i < k else parity[i - k]
+    else:
+        per_stripe = [codec.encode_chunks(
+            want, {j: stripes[si, j] for j in range(k)}) for si in range(s)]
+        for i in want:
+            if i < k:
+                out[i] = data_shards[i]
+            else:
+                out[i] = np.concatenate([per_stripe[si][i] for si in range(s)])
+    return out
+
+
+def decode(sinfo: StripeInfo, codec, shards: dict[int, np.ndarray],
+           want: list[int]) -> dict[int, np.ndarray]:
+    """Reconstruct wanted shards from surviving per-shard buffers
+    (ECUtil.cc:47-118). Shard buffers hold S concatenated chunks."""
+    some = next(iter(shards.values()))
+    cs = sinfo.chunk_size
+    if len(some) % cs:
+        raise ErasureCodeError(
+            f"decode: shard length {len(some)} not a multiple of {cs}")
+    s = len(some) // cs
+    missing = [i for i in want if i not in shards]
+    if not missing:
+        return {i: np.asarray(shards[i], dtype=np.uint8) for i in want}
+    from ceph_tpu.models.matrix_codec import MatrixErasureCode
+    if isinstance(codec, MatrixErasureCode) and not codec.chunk_mapping:
+        # one kernel call across all stripes
+        return codec.decode_chunks(
+            want, {i: np.asarray(v, dtype=np.uint8)
+                   for i, v in shards.items()})
+    out = {i: np.zeros(s * cs, dtype=np.uint8) for i in want}
+    for si in range(s):
+        got = codec.decode_chunks(
+            want, {i: np.asarray(v[si * cs:(si + 1) * cs], dtype=np.uint8)
+                   for i, v in shards.items()})
+        for i in want:
+            out[i][si * cs:(si + 1) * cs] = got[i]
+    return out
+
+
+class HashInfo:
+    """Cumulative per-shard crc32c (ECUtil.h:101-162).
+
+    Updated on every append; serialized as a shard xattr so
+    handle_sub_read can verify a shard against it (ECBackend.cc:1032-1051).
+    """
+
+    def __init__(self, num_chunks: int) -> None:
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [HINFO_SEED] * num_chunks
+
+    def append(self, old_size: int, shard_chunks: dict[int, np.ndarray]):
+        """Fold an append at chunk-offset ``old_size`` into the crcs
+        (ECUtil.cc:161-177: appends must be contiguous)."""
+        if old_size != self.total_chunk_size:
+            raise ValueError(
+                f"hinfo append at {old_size} != current size "
+                f"{self.total_chunk_size} (appends must be contiguous)")
+        sizes = {len(v) for v in shard_chunks.values()}
+        if len(sizes) != 1:
+            raise ValueError("hinfo append: unequal shard chunk sizes")
+        for shard, data in shard_chunks.items():
+            self.cumulative_shard_hashes[shard] = checksum.crc32c(
+                data, self.cumulative_shard_hashes[shard])
+        self.total_chunk_size += sizes.pop()
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    def to_dict(self) -> dict:
+        return {"total_chunk_size": self.total_chunk_size,
+                "hashes": list(self.cumulative_shard_hashes)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HashInfo":
+        hi = cls(len(d["hashes"]))
+        hi.total_chunk_size = d["total_chunk_size"]
+        hi.cumulative_shard_hashes = list(d["hashes"])
+        return hi
+
+
+class StripeBatcher:
+    """Device-side stripe batch accumulator (SURVEY.md §7.5, the novel
+    piece): coalesce many small sub-writes into one kernel launch.
+
+    Appends are queued host-side; ``flush()`` encodes everything queued in
+    a single batched call and returns per-op shard buffers in submission
+    order (commit order is preserved — the pipeline-ordering invariant of
+    ECBackend::check_ops, ECBackend.cc:2107). Size-triggered auto-flush;
+    the OSD write pipeline calls flush() at commit points.
+    """
+
+    def __init__(self, sinfo: StripeInfo, codec,
+                 flush_bytes: int = 8 << 20) -> None:
+        self.sinfo = sinfo
+        self.codec = codec
+        self.flush_bytes = flush_bytes
+        self._pending: list[tuple[object, np.ndarray]] = []
+        self._pending_bytes = 0
+
+    def append(self, op_id, data: bytes | np.ndarray) -> None:
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) else data
+        if len(buf) % self.sinfo.stripe_width:
+            raise ErasureCodeError(
+                f"append: {len(buf)} bytes not stripe-aligned")
+        self._pending.append((op_id, buf))
+        self._pending_bytes += len(buf)
+
+    def should_flush(self) -> bool:
+        return self._pending_bytes >= self.flush_bytes
+
+    def flush(self) -> list[tuple[object, dict[int, np.ndarray]]]:
+        """Encode all queued ops in one batch; returns [(op_id, shards)]
+        in submission order."""
+        if not self._pending:
+            return []
+        ops, bufs = zip(*self._pending)
+        self._pending, self._pending_bytes = [], 0
+        batch = np.concatenate(bufs)
+        shards = encode(self.sinfo, self.codec, batch)
+        results = []
+        cs, sw = self.sinfo.chunk_size, self.sinfo.stripe_width
+        off = 0  # in chunk units per shard
+        for op_id, buf in zip(ops, bufs):
+            nchunk = len(buf) // sw * cs
+            results.append((op_id, {
+                i: v[off:off + nchunk] for i, v in shards.items()}))
+            off += nchunk
+        return results

@@ -1,0 +1,130 @@
+"""Stripe engine tests (ECUtil role): offset algebra, batched encode/decode,
+HashInfo, stripe batcher ordering."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models import instance
+from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.ec_util import HashInfo, StripeBatcher, StripeInfo
+from ceph_tpu.utils import checksum
+
+
+@pytest.fixture()
+def codec():
+    return instance().factory("jerasure", {"k": "4", "m": "2",
+                                           "backend": "numpy"})
+
+
+def sinfo_for(codec, chunk_size=64):
+    return StripeInfo(codec.get_data_chunk_count() * chunk_size, chunk_size)
+
+
+def test_stripe_info_algebra():
+    si = StripeInfo(4096, 1024)  # k=4
+    assert si.k == 4
+    assert si.logical_to_prev_stripe_offset(5000) == 4096
+    assert si.logical_to_next_stripe_offset(5000) == 8192
+    assert si.logical_to_prev_chunk_offset(5000) == 1024
+    assert si.logical_to_next_chunk_offset(5000) == 2048
+    assert si.aligned_logical_offset_to_chunk_offset(8192) == 2048
+    assert si.aligned_chunk_offset_to_logical_offset(2048) == 8192
+    assert si.offset_len_to_stripe_bounds(5000, 100) == (4096, 4096)
+    assert si.offset_len_to_stripe_bounds(4000, 200) == (0, 8192)
+    with pytest.raises(ValueError):
+        StripeInfo(4096, 1000)
+
+
+def test_batched_encode_matches_per_stripe(codec):
+    """One batched kernel call must equal the reference's per-stripe loop."""
+    si = sinfo_for(codec)
+    rng = np.random.default_rng(0)
+    s = 7
+    data = rng.integers(0, 256, size=s * si.stripe_width, dtype=np.uint8)
+    batched = ec_util.encode(si, codec, data)
+    # per-stripe reference
+    for shard in range(6):
+        per = []
+        for stripe in range(s):
+            chunk = data[stripe * si.stripe_width:(stripe + 1) * si.stripe_width]
+            enc = codec.encode(list(range(6)), chunk.tobytes())
+            per.append(enc[shard][: si.chunk_size])
+        assert np.array_equal(batched[shard], np.concatenate(per)), shard
+
+
+def test_batched_decode(codec):
+    si = sinfo_for(codec)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=5 * si.stripe_width, dtype=np.uint8)
+    shards = ec_util.encode(si, codec, data)
+    survivors = {i: shards[i] for i in (0, 2, 3, 5)}
+    out = ec_util.decode(si, codec, survivors, [1, 4])
+    assert np.array_equal(out[1], shards[1])
+    assert np.array_equal(out[4], shards[4])
+
+
+def test_batched_encode_clay_loop_path():
+    """Clay has sub-chunk structure -> generic per-stripe path."""
+    clay = instance().factory("clay", {"k": "4", "m": "2",
+                                       "backend": "numpy"})
+    cs = clay.get_chunk_size(4 * 512)
+    si = StripeInfo(4 * cs, cs)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=3 * si.stripe_width, dtype=np.uint8)
+    shards = ec_util.encode(si, clay, data)
+    survivors = {i: shards[i] for i in range(6) if i != 0}
+    out = ec_util.decode(si, clay, survivors, [0])
+    assert np.array_equal(out[0], shards[0])
+
+
+def test_hash_info_cumulative(codec):
+    si = sinfo_for(codec)
+    rng = np.random.default_rng(3)
+    hi = HashInfo(6)
+    total = {i: [] for i in range(6)}
+    off = 0
+    for _ in range(3):
+        data = rng.integers(0, 256, size=2 * si.stripe_width, dtype=np.uint8)
+        shards = ec_util.encode(si, codec, data)
+        hi.append(off, shards)
+        for i in range(6):
+            total[i].append(shards[i])
+        off += 2 * si.chunk_size
+    assert hi.total_chunk_size == 6 * si.chunk_size
+    for i in range(6):
+        whole = np.concatenate(total[i])
+        assert hi.get_chunk_hash(i) == checksum.crc32c(
+            whole, ec_util.HINFO_SEED), i
+    # non-contiguous append rejected
+    with pytest.raises(ValueError):
+        hi.append(0, {0: np.zeros(64, dtype=np.uint8)})
+    # serialization round trip
+    assert HashInfo.from_dict(hi.to_dict()).to_dict() == hi.to_dict()
+
+
+def test_stripe_batcher_order_and_content(codec):
+    si = sinfo_for(codec)
+    rng = np.random.default_rng(4)
+    batcher = StripeBatcher(si, codec, flush_bytes=1 << 20)
+    bufs = {}
+    for op in range(5):
+        data = rng.integers(0, 256, size=(op % 3 + 1) * si.stripe_width,
+                            dtype=np.uint8)
+        bufs[f"op{op}"] = data
+        batcher.append(f"op{op}", data)
+    results = batcher.flush()
+    assert [op for op, _ in results] == [f"op{i}" for i in range(5)]
+    for op, shards in results:
+        want = ec_util.encode(si, codec, bufs[op])
+        for i in range(6):
+            assert np.array_equal(shards[i], want[i]), (op, i)
+    assert batcher.flush() == []
+
+
+def test_stripe_batcher_autoflush_threshold(codec):
+    si = sinfo_for(codec)
+    batcher = StripeBatcher(si, codec, flush_bytes=2 * si.stripe_width)
+    batcher.append("a", np.zeros(si.stripe_width, dtype=np.uint8))
+    assert not batcher.should_flush()
+    batcher.append("b", np.zeros(si.stripe_width, dtype=np.uint8))
+    assert batcher.should_flush()
