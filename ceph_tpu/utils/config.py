@@ -1,0 +1,209 @@
+"""Typed, schema-driven configuration — the Option/ConfigProxy role.
+
+Reference: src/common/options.cc (1,434 ``Option(`` declarations with
+typed defaults, levels, descriptions, see_also) and src/common/config.cc /
+config_proxy.h (``g_conf()``). Reproduced: a declarative Option schema, a
+layered ConfigProxy (compiled defaults < config file < mon/central <
+environment < runtime ``injectargs``-style set), type coercion with
+validation, and change observers (md_config_obs_t role) so subsystems get
+callbacks when their keys change (the reference's runtime injectargs is at
+OSD.cc:6133-6146).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+LEVELS = ("basic", "advanced", "dev")
+
+# source precedence, low -> high (config.cc layered sources)
+SOURCES = ("default", "file", "mon", "env", "override")
+
+
+@dataclass(frozen=True)
+class Option:
+    """One typed option schema entry (options.cc Option builder chain)."""
+
+    name: str
+    type: type           # int, float, bool, str
+    default: Any
+    level: str = "advanced"
+    desc: str = ""
+    see_also: tuple = ()
+    min: Any = None
+    max: Any = None
+    enum_allowed: tuple = ()
+
+    def coerce(self, value: Any) -> Any:
+        if self.type is bool and isinstance(value, str):
+            out = value.lower() in ("true", "yes", "1")
+        else:
+            try:
+                out = self.type(value)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"option {self.name}: {value!r} is not a {self.type.__name__}")
+        if self.min is not None and out < self.min:
+            raise ValueError(f"option {self.name}: {out} < min {self.min}")
+        if self.max is not None and out > self.max:
+            raise ValueError(f"option {self.name}: {out} > max {self.max}")
+        if self.enum_allowed and out not in self.enum_allowed:
+            raise ValueError(
+                f"option {self.name}: {out!r} not in {self.enum_allowed}")
+        return out
+
+
+class OptionSchema:
+    def __init__(self) -> None:
+        self._options: dict[str, Option] = {}
+
+    def add(self, option: Option) -> Option:
+        if option.name in self._options:
+            raise ValueError(f"duplicate option {option.name}")
+        # validate the default itself
+        option.coerce(option.default)
+        self._options[option.name] = option
+        return option
+
+    def get(self, name: str) -> Option:
+        try:
+            return self._options[name]
+        except KeyError:
+            raise KeyError(f"unknown option {name!r}")
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._options
+
+    def names(self) -> list[str]:
+        return sorted(self._options)
+
+
+#: the global schema, populated below and by subsystems at import
+SCHEMA = OptionSchema()
+
+
+class ConfigProxy:
+    """Layered typed config with observers (config_proxy.h / g_conf())."""
+
+    def __init__(self, schema: OptionSchema = SCHEMA) -> None:
+        self.schema = schema
+        self._lock = threading.RLock()
+        self._values: dict[str, dict[str, Any]] = {s: {} for s in SOURCES}
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+
+    def get(self, name: str) -> Any:
+        opt = self.schema.get(name)
+        with self._lock:
+            for source in reversed(SOURCES):
+                if name in self._values[source]:
+                    return self._values[source][name]
+        return opt.default
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any, source: str = "override") -> None:
+        opt = self.schema.get(name)
+        if source not in SOURCES:
+            raise ValueError(f"unknown config source {source!r}")
+        coerced = opt.coerce(value)
+        with self._lock:
+            old = self.get(name)
+            self._values[source][name] = coerced
+            new = self.get(name)
+            observers = list(self._observers.get(name, ()))
+        if new != old:
+            for fn in observers:
+                fn(name, new)
+
+    def inject_args(self, args: dict[str, Any]) -> None:
+        """Runtime overrides (the injectargs path, OSD.cc:6133)."""
+        for name, value in args.items():
+            self.set(name, value, "override")
+
+    def load_file(self, path: str) -> None:
+        """Load a json config file into the 'file' layer."""
+        with open(path) as f:
+            data = json.load(f)
+        for name, value in data.items():
+            self.set(name, value, "file")
+
+    def load_env(self, prefix: str = "CEPH_TPU_") -> None:
+        """Environment layer: CEPH_TPU_<OPTION_NAME>."""
+        for name in self.schema.names():
+            env = prefix + name.upper()
+            if env in os.environ:
+                self.set(name, os.environ[env], "env")
+
+    def add_observer(self, name: str,
+                     fn: Callable[[str, Any], None]) -> None:
+        self.schema.get(name)
+        with self._lock:
+            self._observers.setdefault(name, []).append(fn)
+
+    def dump(self) -> dict[str, Any]:
+        return {name: self.get(name) for name in self.schema.names()}
+
+    def diff(self) -> dict[str, Any]:
+        """Only values differing from compiled defaults."""
+        out = {}
+        for name in self.schema.names():
+            val = self.get(name)
+            if val != self.schema.get(name).default:
+                out[name] = val
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Core option declarations (the subset of options.cc this framework uses;
+# reference defaults preserved where the option mirrors one there)
+# ---------------------------------------------------------------------------
+
+for _o in [
+    Option("osd_pool_erasure_code_stripe_unit", int, 4096, "advanced",
+           "EC stripe unit bytes per chunk per stripe (options.cc:2150-2157)"),
+    Option("osd_erasure_code_plugins", str, "jerasure isa shec lrc clay",
+           "advanced", "plugins to preload (options.cc:2197)"),
+    Option("erasure_code_backend", str, "auto", "advanced",
+           "kernel backend: auto|jax|native|numpy",
+           enum_allowed=("auto", "jax", "native", "numpy")),
+    Option("ec_stripe_batch_flush_bytes", int, 8 << 20, "advanced",
+           "device stripe-batch accumulator flush threshold"),
+    Option("bluestore_csum_type", str, "crc32c", "advanced",
+           "checksum algorithm (BlueStore.h:1925)",
+           enum_allowed=("none", "crc32c", "crc32c_16", "crc32c_8",
+                         "xxhash32", "xxhash64")),
+    Option("bluestore_csum_block_size", int, 4096, "advanced",
+           "checksum granularity"),
+    Option("bluestore_debug_inject_read_err", bool, False, "dev",
+           "EIO injection on read (options.cc:4343)"),
+    Option("bluestore_debug_inject_csum_err_probability", float, 0.0, "dev",
+           "random csum corruption probability (options.cc:4375)",
+           min=0.0, max=1.0),
+    Option("ms_inject_socket_failures", int, 0, "dev",
+           "messenger: inject a failure every N messages (qa msgr yamls)"),
+    Option("ms_crc_data", bool, True, "advanced",
+           "checksum message payloads (Messenger crcflags)"),
+    Option("osd_heartbeat_interval", float, 1.0, "advanced",
+           "seconds between peer pings (scaled down from the reference's 6)"),
+    Option("osd_heartbeat_grace", float, 4.0, "advanced",
+           "seconds before a silent peer is reported failed"),
+    Option("mon_election_timeout", float, 2.0, "advanced",
+           "mon election timeout seconds"),
+    Option("debug_default_level", int, 1, "advanced",
+           "default per-subsystem log level", min=0, max=30),
+    Option("log_ring_size", int, 10000, "advanced",
+           "in-memory log ring entries kept for crash dump (Log.cc role)"),
+]:
+    SCHEMA.add(_o)
+
+_g_conf = ConfigProxy()
+
+
+def g_conf() -> ConfigProxy:
+    """The process-global config (the reference's g_conf())."""
+    return _g_conf

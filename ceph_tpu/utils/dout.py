@@ -1,0 +1,67 @@
+"""Leveled, per-subsystem logging with an in-memory crash ring.
+
+Reference: src/log/Log.cc (async log thread + in-memory ring kept for
+crash dump) and the ``dout(N)`` macros of src/common/debug.h with
+per-subsystem debug levels (e.g. ``dout(20)`` in ErasureCodeIsa.cc:69).
+
+Here: ``Dout(subsys)`` instances gate on per-subsystem levels from the
+global config; every record (even below the emit threshold... above the
+ring threshold) lands in a bounded ring that ``dump_recent()`` returns —
+the crash-dump behavior of the reference's ring buffer.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+
+from ceph_tpu.utils.config import g_conf
+
+_lock = threading.Lock()
+_levels: dict[str, int] = {}
+_ring: collections.deque = collections.deque(maxlen=10000)
+#: records at or below this level always enter the ring even when not
+#: emitted (the reference keeps high-debug entries in memory for crashes)
+RING_LEVEL = 20
+
+
+def set_subsys_level(subsys: str, level: int) -> None:
+    with _lock:
+        _levels[subsys] = level
+
+
+def get_subsys_level(subsys: str) -> int:
+    with _lock:
+        if subsys in _levels:
+            return _levels[subsys]
+    return g_conf()["debug_default_level"]
+
+
+def dump_recent(count: int = 1000) -> list[str]:
+    """The crash-dump ring (Log.cc dump_recent role)."""
+    with _lock:
+        items = list(_ring)[-count:]
+    return items
+
+
+class Dout:
+    """Per-subsystem leveled logger: ``log = Dout('osd'); log(5, 'msg')``."""
+
+    def __init__(self, subsys: str, stream=None) -> None:
+        self.subsys = subsys
+        self.stream = stream or sys.stderr
+
+    def __call__(self, level: int, *parts) -> None:
+        msg = " ".join(str(p) for p in parts)
+        record = (f"{time.strftime('%Y-%m-%d %H:%M:%S')} "
+                  f"{level:2d} {self.subsys}: {msg}")
+        if level <= RING_LEVEL:
+            with _lock:
+                _ring.append(record)
+        if level <= get_subsys_level(self.subsys):
+            print(record, file=self.stream)
+
+    def error(self, *parts) -> None:
+        self(-1, *parts)
