@@ -1,0 +1,199 @@
+"""OSDMap — epoch-versioned cluster state + object->PG->OSD mapping.
+
+Role of src/osd/OSDMap.{h,cc}: which OSDs exist / are up / are in,
+the pool table (pg_num, EC profile, crush rule), pg_temp overrides, and
+the mapping pipeline ``object -> ps -> pgid -> up/acting set`` via
+CRUSH (OSDMap::pg_to_up_acting_osds). Every daemon and client holds a
+copy; an op is only valid against the epoch it was targeted with.
+
+Mapping pipeline (as in the reference):
+  ps    = stable_mod(hash_name(object), pg_num, pg_num_mask)
+  x     = hash2(ps, pool_id)          # per-pool decorrelation
+  up    = crush.do_rule(rule, x, size, down=not-up osds)
+  acting= pg_temp override if present else up; primary = first non-NONE
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ceph_tpu.parallel import crush
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+
+def pg_num_mask(pg_num: int) -> int:
+    m = 1
+    while m < pg_num:
+        m <<= 1
+    return m - 1
+
+
+@dataclass
+class PoolInfo:
+    pool_id: int
+    name: str
+    pg_num: int
+    rule: str
+    size: int                      # replicas, or k+m for EC
+    min_size: int                  # floor to serve I/O (k for EC)
+    ec_profile: dict = field(default_factory=dict)  # empty = replicated
+    stripe_unit: int = 4096        # see osd_pool_erasure_code_stripe_unit
+
+    @property
+    def is_ec(self) -> bool:
+        return bool(self.ec_profile)
+
+
+@dataclass
+class OSDInfo:
+    osd_id: int
+    up: bool = False
+    in_cluster: bool = True
+    addr: str = ""                 # "host:port" of the OSD messenger
+
+
+class OSDMap:
+    """Full map at one epoch. Mutations happen only on the mon
+    (OSDMonitor role), which bumps the epoch per change batch."""
+
+    def __init__(self) -> None:
+        self.epoch = 0
+        self.osds: dict[int, OSDInfo] = {}
+        self.pools: dict[int, PoolInfo] = {}
+        self.pool_by_name: dict[str, int] = {}
+        self.crush = crush.CrushMap()
+        self.pg_temp: dict[tuple[int, int], list[int]] = {}
+        self._next_pool_id = 1
+
+    # -- mutation (mon side) ------------------------------------------
+    def add_osd(self, osd_id: int, addr: str = "") -> OSDInfo:
+        info = OSDInfo(osd_id, addr=addr)
+        self.osds[osd_id] = info
+        return info
+
+    def mark_up(self, osd_id: int, addr: str) -> None:
+        self.osds[osd_id].up = True
+        self.osds[osd_id].addr = addr
+
+    def mark_down(self, osd_id: int) -> None:
+        if osd_id in self.osds:
+            self.osds[osd_id].up = False
+
+    def mark_out(self, osd_id: int) -> None:
+        self.osds[osd_id].in_cluster = False
+        self.crush.reweight(osd_id, 0.0)
+
+    def create_pool(self, name: str, pg_num: int, rule: str, size: int,
+                    min_size: int, ec_profile: dict | None = None,
+                    stripe_unit: int | None = None) -> PoolInfo:
+        if stripe_unit is None:
+            from ceph_tpu.utils.config import g_conf
+            stripe_unit = g_conf()["osd_pool_erasure_code_stripe_unit"]
+        pid = self._next_pool_id
+        self._next_pool_id += 1
+        pool = PoolInfo(pid, name, pg_num, rule, size, min_size,
+                        dict(ec_profile or {}), stripe_unit)
+        self.pools[pid] = pool
+        self.pool_by_name[name] = pid
+        return pool
+
+    # -- queries ------------------------------------------------------
+    def down_set(self) -> set[int]:
+        return {o for o, i in self.osds.items()
+                if not i.up or not i.in_cluster}
+
+    def object_to_pg(self, pool_id: int, name: str) -> int:
+        pool = self.pools[pool_id]
+        ps = crush.hash_name(name)
+        return crush.stable_mod(ps, pool.pg_num, pg_num_mask(pool.pg_num))
+
+    def pg_to_up_acting(self, pool_id: int, ps: int
+                        ) -> tuple[list[int], list[int], int]:
+        """Returns (up, acting, primary). primary = first non-NONE of
+        acting, or NONE when the PG is entirely unserviceable."""
+        pool = self.pools[pool_id]
+        x = crush.hash2(ps, pool_id)
+        up = self.crush.do_rule(pool.rule, x, pool.size,
+                                down=self.down_set())
+        acting = self.pg_temp.get((pool_id, ps), up)
+        primary = next((o for o in acting if o != crush.NONE), crush.NONE)
+        return up, acting, primary
+
+    def object_locator(self, pool_id: int, name: str
+                       ) -> tuple[int, list[int], int]:
+        """(ps, acting, primary) for an object — the Objecter's
+        _calc_target essentials (osdc/Objecter.cc:2795)."""
+        ps = self.object_to_pg(pool_id, name)
+        _, acting, primary = self.pg_to_up_acting(pool_id, ps)
+        return ps, acting, primary
+
+    def pgs_of_pool(self, pool_id: int) -> list[int]:
+        return list(range(self.pools[pool_id].pg_num))
+
+    # -- wire encoding ------------------------------------------------
+    def encode(self) -> bytes:
+        e = Encoder()
+        body = Encoder()
+        body.u32(self.epoch)
+        body.map(self.osds, Encoder.i32, lambda en, o: (
+            en.bool(o.up), en.bool(o.in_cluster), en.str(o.addr)))
+        body.map(self.pools, Encoder.i32, lambda en, p: (
+            en.str(p.name), en.u32(p.pg_num), en.str(p.rule),
+            en.u32(p.size), en.u32(p.min_size), en.str_map(p.ec_profile),
+            en.u32(p.stripe_unit)))
+        body.u32(self._next_pool_id)
+        # crush map
+        body.map(self.crush.buckets, Encoder.i32, lambda en, b: (
+            en.str(b.name), en.str(b.type),
+            en.list(b.items, Encoder.i32),
+            en.list(b.weights, Encoder.f64)))
+        body.map(self.crush.device_weights, Encoder.i32, Encoder.f64)
+        body.map(self.crush.rules, Encoder.str, lambda en, r: (
+            en.str(r.root), en.str(r.failure_domain), en.str(r.mode)))
+        body.map(self.pg_temp,
+                 lambda en, k: (en.i32(k[0]), en.u32(k[1])),
+                 lambda en, v: en.list(v, Encoder.i32))
+        e.section(1, body)
+        return e.getvalue()
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "OSDMap":
+        _, d = Decoder(buf).section(1)
+        m = cls()
+        m.epoch = d.u32()
+
+        def dec_osd(dd: Decoder):
+            return (dd.bool(), dd.bool(), dd.str())
+
+        for oid, (up, inc, addr) in d.map(Decoder.i32, dec_osd).items():
+            m.osds[oid] = OSDInfo(oid, up, inc, addr)
+
+        def dec_pool(dd: Decoder):
+            return (dd.str(), dd.u32(), dd.str(), dd.u32(), dd.u32(),
+                    dd.str_map(), dd.u32())
+
+        for pid, (name, pg_num, rule, size, min_size, prof, su) in \
+                d.map(Decoder.i32, dec_pool).items():
+            m.pools[pid] = PoolInfo(pid, name, pg_num, rule, size,
+                                    min_size, prof, su)
+            m.pool_by_name[name] = pid
+        m._next_pool_id = d.u32()
+
+        def dec_bucket(dd: Decoder):
+            return (dd.str(), dd.str(), dd.list(Decoder.i32),
+                    dd.list(Decoder.f64))
+
+        for bid, (name, btype, items, weights) in \
+                d.map(Decoder.i32, dec_bucket).items():
+            m.crush.buckets[bid] = crush.Bucket(bid, name, btype,
+                                                items, weights)
+            m.crush.by_name[name] = bid
+            m.crush._next_bucket_id = min(m.crush._next_bucket_id, bid - 1)
+        m.crush.device_weights = d.map(Decoder.i32, Decoder.f64)
+        for rname, (root, fd, mode) in d.map(
+                Decoder.str,
+                lambda dd: (dd.str(), dd.str(), dd.str())).items():
+            m.crush.rules[rname] = crush.Rule(rname, root, fd, mode)
+        m.pg_temp = d.map(lambda dd: (dd.i32(), dd.u32()),
+                          lambda dd: dd.list(Decoder.i32))
+        return m

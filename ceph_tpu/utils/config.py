@@ -20,6 +20,10 @@ from typing import Any, Callable
 
 LEVELS = ("basic", "advanced", "dev")
 
+
+class ConfigError(Exception):
+    """A config file/layer failed validation as a whole."""
+
 # source precedence, low -> high (config.cc layered sources)
 SOURCES = ("default", "file", "mon", "env", "override")
 
@@ -126,9 +130,22 @@ class ConfigProxy:
             self.set(name, value, "override")
 
     def load_file(self, path: str) -> None:
-        """Load a json config file into the 'file' layer."""
+        """Load a json config file into the 'file' layer.
+
+        All entries are validated (known name, coercible value) before
+        any is applied, so a bad entry cannot leave the layer
+        half-loaded with observers already fired."""
         with open(path) as f:
             data = json.load(f)
+        errors = []
+        for name, value in data.items():
+            try:
+                self.schema.get(name).coerce(value)
+            except (KeyError, ValueError, TypeError) as exc:
+                errors.append(f"{name}: {exc}")
+        if errors:
+            raise ConfigError(f"invalid config file {path}: "
+                              + "; ".join(errors))
         for name, value in data.items():
             self.set(name, value, "file")
 

@@ -27,6 +27,16 @@ _ring: collections.deque = collections.deque(maxlen=10000)
 RING_LEVEL = 20
 
 
+def _ring_buf() -> collections.deque:
+    """The crash ring, resized lazily when log_ring_size changes.
+    Call with _lock held."""
+    global _ring
+    size = g_conf()["log_ring_size"]
+    if _ring.maxlen != size:
+        _ring = collections.deque(_ring, maxlen=size)
+    return _ring
+
+
 def set_subsys_level(subsys: str, level: int) -> None:
     with _lock:
         _levels[subsys] = level
@@ -42,7 +52,7 @@ def get_subsys_level(subsys: str) -> int:
 def dump_recent(count: int = 1000) -> list[str]:
     """The crash-dump ring (Log.cc dump_recent role)."""
     with _lock:
-        items = list(_ring)[-count:]
+        items = list(_ring_buf())[-count:]
     return items
 
 
@@ -59,7 +69,7 @@ class Dout:
                   f"{level:2d} {self.subsys}: {msg}")
         if level <= RING_LEVEL:
             with _lock:
-                _ring.append(record)
+                _ring_buf().append(record)
         if level <= get_subsys_level(self.subsys):
             print(record, file=self.stream)
 
