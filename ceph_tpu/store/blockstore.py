@@ -1,0 +1,308 @@
+"""BlockStore — durable log-structured object store (BlueStore role).
+
+Reference: src/os/bluestore/. Same commit discipline, simplified
+geometry: object payloads append to a single data blob file, metadata
+(attrs/omap/size/extent map) lives in the WAL-backed kv (store/kv.py —
+the RocksDB seat). Commit order per transaction, as in BlueStore's txc
+state machine (BlueStore.cc:9037):
+
+  1. append write payloads to the data file, fdatasync;
+  2. commit one kv batch with all metadata updates (kv WAL fsync);
+  3. fire on_commit.
+
+A crash between 1 and 2 leaks dead bytes at the data-file tail but
+never exposes a partial transaction — the kv batch is the atomicity
+point. Checksums are at blob granularity exactly like BlueStore's
+csum_type=crc32c default (BlueStore.h:1925): each written blob carries
+its crc32c; any read of any slice re-reads the whole blob and verifies
+(_verify_csum role, BlueStore.cc:8061) raising EIOError on mismatch —
+the trigger for EC repair upstream.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+from ceph_tpu.store import object_store as osr
+from ceph_tpu.store.kv import FileDB, WriteBatch
+from ceph_tpu.store.object_store import (
+    EIOError,
+    NoSuchCollection,
+    NoSuchObject,
+    ObjectStore,
+    Transaction,
+)
+from ceph_tpu.utils import checksum
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+
+class _Extent:
+    """A logical range backed by a slice of a crc-protected blob in the
+    data file (BlueStore's lextent -> blob indirection)."""
+
+    __slots__ = ("logical_off", "length", "blob_off", "blob_len",
+                 "blob_crc", "slice_off")
+
+    def __init__(self, logical_off: int, length: int, blob_off: int,
+                 blob_len: int, blob_crc: int, slice_off: int) -> None:
+        self.logical_off = logical_off
+        self.length = length
+        self.blob_off = blob_off      # file offset of the whole blob
+        self.blob_len = blob_len
+        self.blob_crc = blob_crc
+        self.slice_off = slice_off    # this extent's start within the blob
+
+    @property
+    def end(self) -> int:
+        return self.logical_off + self.length
+
+
+class _Meta:
+    __slots__ = ("size", "attrs", "omap", "extents")
+
+    def __init__(self) -> None:
+        self.size = 0
+        self.attrs: dict[str, bytes] = {}
+        self.omap: dict[str, bytes] = {}
+        self.extents: list[_Extent] = []   # sorted, non-overlapping
+
+    def encode(self) -> bytes:
+        e = Encoder()
+        e.u64(self.size)
+        e.map(self.attrs, Encoder.str, Encoder.bytes)
+        e.map(self.omap, Encoder.str, Encoder.bytes)
+        e.list(self.extents, lambda en, x: (
+            en.u64(x.logical_off), en.u64(x.length), en.u64(x.blob_off),
+            en.u64(x.blob_len), en.u32(x.blob_crc), en.u64(x.slice_off)))
+        return e.getvalue()
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "_Meta":
+        d = Decoder(buf)
+        m = cls()
+        m.size = d.u64()
+        m.attrs = d.map(Decoder.str, Decoder.bytes)
+        m.omap = d.map(Decoder.str, Decoder.bytes)
+        m.extents = d.list(lambda dd: _Extent(
+            dd.u64(), dd.u64(), dd.u64(), dd.u64(), dd.u32(), dd.u64()))
+        return m
+
+
+def _clip(extents: list[_Extent], a: int, b: int) -> list[_Extent]:
+    """Remove logical range [a, b) from the extent list, splitting
+    extents that straddle the boundary (slices keep pointing into their
+    original crc'd blob)."""
+    out: list[_Extent] = []
+    for x in extents:
+        if x.end <= a or x.logical_off >= b:
+            out.append(x)
+            continue
+        if x.logical_off < a:
+            out.append(_Extent(x.logical_off, a - x.logical_off,
+                               x.blob_off, x.blob_len, x.blob_crc,
+                               x.slice_off))
+        if x.end > b:
+            cut = b - x.logical_off
+            out.append(_Extent(b, x.end - b, x.blob_off, x.blob_len,
+                               x.blob_crc, x.slice_off + cut))
+    return out
+
+
+class BlockStore(ObjectStore):
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._db: FileDB | None = None
+        self._data = None
+        self._eio: set[tuple[str, str]] = set()
+
+    # -- lifecycle ----------------------------------------------------
+    def mount(self) -> None:
+        self._db = FileDB(os.path.join(self.path, "db"))
+        self._data = open(os.path.join(self.path, "data"), "a+b")
+
+    def umount(self) -> None:
+        if self._db:
+            self._db.close()
+            self._db = None
+        if self._data:
+            self._data.close()
+            self._data = None
+
+    # -- metadata helpers ---------------------------------------------
+    @staticmethod
+    def _okey(cid: str, oid: str) -> str:
+        return f"o/{cid}/{oid}"
+
+    @staticmethod
+    def _ckey(cid: str) -> str:
+        return f"c/{cid}"
+
+    def _require_coll(self, cid: str) -> None:
+        if self._db.get(self._ckey(cid)) is None:
+            raise NoSuchCollection(cid)
+
+    def _meta(self, cid: str, oid: str) -> _Meta:
+        raw = self._db.get(self._okey(cid, oid))
+        if raw is None:
+            self._require_coll(cid)
+            raise NoSuchObject(f"{cid}/{oid}")
+        return _Meta.decode(raw)
+
+    # -- transactions -------------------------------------------------
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Callable[[], None] | None = None) -> None:
+        assert self._db is not None, "not mounted"
+        # stage 1: data-file appends for every WRITE op
+        data_dirty = False
+        blob_at: dict[int, tuple[int, int, int]] = {}  # op idx -> blob
+        self._data.seek(0, os.SEEK_END)
+        for i, op in enumerate(txn.ops):
+            if op[0] == osr.OP_WRITE:
+                payload = op[4]
+                file_off = self._data.tell()
+                self._data.write(payload)
+                blob_at[i] = (file_off, len(payload),
+                              checksum.crc32c(payload))
+                data_dirty = True
+        if data_dirty:
+            self._data.flush()
+            os.fdatasync(self._data.fileno())
+
+        # stage 2: one kv batch for all metadata effects
+        batch = WriteBatch()
+        metas: dict[tuple[str, str], _Meta | None] = {}
+
+        def load(cid: str, oid: str, create: bool) -> _Meta:
+            key = (cid, oid)
+            if key in metas and metas[key] is None:
+                # removed earlier in this txn: recreate fresh or fail
+                if not create:
+                    raise NoSuchObject(f"{cid}/{oid}")
+                metas[key] = _Meta()
+            if key not in metas:
+                raw = self._db.get(self._okey(cid, oid))
+                if raw is not None:
+                    metas[key] = _Meta.decode(raw)
+                elif create:
+                    # collection must exist (created earlier in this txn
+                    # or already present)
+                    if self._db.get(self._ckey(cid)) is None and \
+                            not any(o[0] == osr.OP_MKCOLL and o[1] == cid
+                                    for o in txn.ops):
+                        raise NoSuchCollection(cid)
+                    metas[key] = _Meta()
+                else:
+                    raise NoSuchObject(f"{cid}/{oid}")
+            return metas[key]
+
+        for i, op in enumerate(txn.ops):
+            code = op[0]
+            if code == osr.OP_MKCOLL:
+                batch.put(self._ckey(op[1]), b"")
+            elif code == osr.OP_RMCOLL:
+                batch.delete(self._ckey(op[1]))
+                for k, _ in list(self._db.iterate(f"o/{op[1]}/")):
+                    batch.delete(k)
+                # objects staged earlier in this txn must not be re-put
+                # by the final metas flush after this delete
+                for key in list(metas):
+                    if key[0] == op[1]:
+                        metas[key] = None
+            elif code == osr.OP_TOUCH:
+                load(op[1], op[2], create=True)
+            elif code == osr.OP_WRITE:
+                m = load(op[1], op[2], create=True)
+                off, payload = op[3], op[4]
+                foff, flen, fcrc = blob_at[i]
+                m.extents = _clip(m.extents, off, off + flen)
+                m.extents.append(_Extent(off, flen, foff, flen, fcrc, 0))
+                m.extents.sort(key=lambda x: x.logical_off)
+                m.size = max(m.size, off + flen)
+            elif code == osr.OP_ZERO:
+                m = load(op[1], op[2], create=True)
+                off, ln = op[3], op[4]
+                m.extents = _clip(m.extents, off, off + ln)
+                m.size = max(m.size, off + ln)
+            elif code == osr.OP_TRUNCATE:
+                m = load(op[1], op[2], create=True)
+                size = op[3]
+                m.extents = _clip(m.extents, size, 1 << 62)
+                m.size = size
+            elif code == osr.OP_REMOVE:
+                metas[(op[1], op[2])] = None
+                batch.delete(self._okey(op[1], op[2]))
+            elif code == osr.OP_SETATTR:
+                load(op[1], op[2], create=True).attrs[op[3]] = op[4]
+            elif code == osr.OP_RMATTR:
+                load(op[1], op[2], create=False).attrs.pop(op[3], None)
+            elif code == osr.OP_OMAP_SET:
+                load(op[1], op[2], create=True).omap.update(op[3])
+            elif code == osr.OP_OMAP_RM:
+                m = load(op[1], op[2], create=False)
+                for k in op[3]:
+                    m.omap.pop(k, None)
+        for (cid, oid), m in metas.items():
+            if m is not None:
+                batch.put(self._okey(cid, oid), m.encode())
+        self._db.submit(batch, sync=True)
+        if on_commit:
+            on_commit()
+
+    # -- reads --------------------------------------------------------
+    def _read_blob(self, x: _Extent) -> bytes:
+        self._data.seek(x.blob_off)
+        blob = self._data.read(x.blob_len)
+        if len(blob) != x.blob_len or checksum.crc32c(blob) != x.blob_crc:
+            raise EIOError(
+                f"checksum mismatch reading blob at {x.blob_off}")
+        return blob
+
+    def read(self, cid: str, oid: str, off: int = 0,
+             length: int | None = None) -> bytes:
+        if (cid, oid) in self._eio:
+            raise EIOError(f"injected EIO on {cid}/{oid}")
+        m = self._meta(cid, oid)
+        end = m.size if length is None else min(off + length, m.size)
+        if end <= off:
+            return b""
+        buf = bytearray(end - off)  # holes read as zeros
+        for x in m.extents:
+            lo, hi = max(x.logical_off, off), min(x.end, end)
+            if lo >= hi:
+                continue
+            blob = self._read_blob(x)
+            s = x.slice_off + (lo - x.logical_off)
+            buf[lo - off:hi - off] = blob[s:s + (hi - lo)]
+        return bytes(buf)
+
+    def stat(self, cid: str, oid: str) -> int:
+        return self._meta(cid, oid).size
+
+    def getattr(self, cid: str, oid: str, name: str) -> bytes:
+        attrs = self._meta(cid, oid).attrs
+        if name not in attrs:
+            raise NoSuchObject(f"attr {name} on {cid}/{oid}")
+        return attrs[name]
+
+    def getattrs(self, cid: str, oid: str) -> dict[str, bytes]:
+        return dict(self._meta(cid, oid).attrs)
+
+    def omap_get(self, cid: str, oid: str) -> dict[str, bytes]:
+        return dict(self._meta(cid, oid).omap)
+
+    def list_collections(self) -> list[str]:
+        return [k[2:] for k, _ in self._db.iterate("c/")]
+
+    def list_objects(self, cid: str) -> list[str]:
+        self._require_coll(cid)
+        prefix = f"o/{cid}/"
+        return [k[len(prefix):] for k, _ in self._db.iterate(prefix)]
+
+    # -- fault injection ----------------------------------------------
+    def inject_data_error(self, cid: str, oid: str) -> None:
+        self._eio.add((cid, oid))
+
+    def clear_data_error(self, cid: str, oid: str) -> None:
+        self._eio.discard((cid, oid))

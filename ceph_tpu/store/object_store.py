@@ -1,0 +1,224 @@
+"""ObjectStore interface + Transaction — the src/os/ObjectStore.h role.
+
+A ``Transaction`` is an ordered batch of mutations that the store
+applies atomically and durably; ``queue_transaction`` completes the
+commit callback only once the batch is recoverable (the reference's
+``queue_transactions`` + on_commit contexts, ObjectStore.h). Ops are
+enumerated and wire-encodable (our Encoder) because EC sub-writes ship
+whole shard transactions to peer OSDs (ECSubWrite carries a
+Transaction, src/osd/ECMsgTypes.h:23-89).
+
+Naming: ``cid`` is a collection (one per PG shard, e.g. "pg_1.2s0"),
+``oid`` an object within it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+
+class StoreError(Exception):
+    pass
+
+
+class EIOError(StoreError):
+    """Data-level read failure (bad checksum or injected EIO) — the
+    reference surfaces these as -EIO to trigger repair
+    (bluestore_debug_inject_read_err, OSD.cc:5261-5264)."""
+
+
+class NoSuchObject(StoreError):
+    pass
+
+
+class NoSuchCollection(StoreError):
+    pass
+
+
+# transaction op codes (the OP_* enum of ObjectStore::Transaction)
+OP_TOUCH = 1
+OP_WRITE = 2
+OP_ZERO = 3
+OP_TRUNCATE = 4
+OP_REMOVE = 5
+OP_SETATTR = 6
+OP_RMATTR = 7
+OP_OMAP_SET = 8
+OP_OMAP_RM = 9
+OP_MKCOLL = 10
+OP_RMCOLL = 11
+
+
+class Transaction:
+    """Ordered mutation batch; append-style builder like the reference's
+    ``t.write(...); t.setattr(...)`` call chains."""
+
+    def __init__(self) -> None:
+        self.ops: list[tuple] = []
+
+    # -- builders -----------------------------------------------------
+    def touch(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append((OP_TOUCH, cid, oid)); return self
+
+    def write(self, cid: str, oid: str, off: int, data: bytes) -> "Transaction":
+        self.ops.append((OP_WRITE, cid, oid, off, bytes(data))); return self
+
+    def zero(self, cid: str, oid: str, off: int, length: int) -> "Transaction":
+        self.ops.append((OP_ZERO, cid, oid, off, length)); return self
+
+    def truncate(self, cid: str, oid: str, size: int) -> "Transaction":
+        self.ops.append((OP_TRUNCATE, cid, oid, size)); return self
+
+    def remove(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append((OP_REMOVE, cid, oid)); return self
+
+    def setattr(self, cid: str, oid: str, name: str, value: bytes) -> "Transaction":
+        self.ops.append((OP_SETATTR, cid, oid, name, bytes(value))); return self
+
+    def rmattr(self, cid: str, oid: str, name: str) -> "Transaction":
+        self.ops.append((OP_RMATTR, cid, oid, name)); return self
+
+    def omap_set(self, cid: str, oid: str, kv: dict[str, bytes]) -> "Transaction":
+        self.ops.append((OP_OMAP_SET, cid, oid,
+                         {k: bytes(v) for k, v in kv.items()})); return self
+
+    def omap_rm(self, cid: str, oid: str, keys: list[str]) -> "Transaction":
+        self.ops.append((OP_OMAP_RM, cid, oid, list(keys))); return self
+
+    def create_collection(self, cid: str) -> "Transaction":
+        self.ops.append((OP_MKCOLL, cid)); return self
+
+    def remove_collection(self, cid: str) -> "Transaction":
+        self.ops.append((OP_RMCOLL, cid)); return self
+
+    def append(self, other: "Transaction") -> "Transaction":
+        self.ops.extend(other.ops); return self
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    # -- wire ---------------------------------------------------------
+    def encode(self) -> bytes:
+        body = Encoder()
+
+        def enc_op(e: Encoder, op: tuple) -> None:
+            code = op[0]
+            e.u8(code)
+            if code in (OP_MKCOLL, OP_RMCOLL):
+                e.str(op[1])
+                return
+            e.str(op[1]); e.str(op[2])
+            if code == OP_WRITE:
+                e.u64(op[3]); e.bytes(op[4])
+            elif code == OP_ZERO:
+                e.u64(op[3]); e.u64(op[4])
+            elif code == OP_TRUNCATE:
+                e.u64(op[3])
+            elif code == OP_SETATTR:
+                e.str(op[3]); e.bytes(op[4])
+            elif code == OP_RMATTR:
+                e.str(op[3])
+            elif code == OP_OMAP_SET:
+                e.map(op[3], Encoder.str, Encoder.bytes)
+            elif code == OP_OMAP_RM:
+                e.list(op[3], Encoder.str)
+
+        body.list(self.ops, enc_op)
+        e = Encoder()
+        e.section(1, body)
+        return e.getvalue()
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Transaction":
+        _, d = Decoder(buf).section(1)
+
+        def dec_op(dd: Decoder) -> tuple:
+            code = dd.u8()
+            if code in (OP_MKCOLL, OP_RMCOLL):
+                return (code, dd.str())
+            cid, oid = dd.str(), dd.str()
+            if code == OP_WRITE:
+                return (code, cid, oid, dd.u64(), dd.bytes())
+            if code == OP_ZERO:
+                return (code, cid, oid, dd.u64(), dd.u64())
+            if code == OP_TRUNCATE:
+                return (code, cid, oid, dd.u64())
+            if code == OP_SETATTR:
+                return (code, cid, oid, dd.str(), dd.bytes())
+            if code == OP_RMATTR:
+                return (code, cid, oid, dd.str())
+            if code == OP_OMAP_SET:
+                return (code, cid, oid, dd.map(Decoder.str, Decoder.bytes))
+            if code == OP_OMAP_RM:
+                return (code, cid, oid, dd.list(Decoder.str))
+            return (code, cid, oid)
+
+        t = cls()
+        t.ops = d.list(dec_op)
+        return t
+
+
+class ObjectStore:
+    """Abstract store. Implementations must make a queued transaction's
+    effects atomic (all-or-nothing on crash) and fire ``on_commit`` only
+    at durability."""
+
+    def mount(self) -> None: ...
+    def umount(self) -> None: ...
+
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Callable[[], None] | None = None) -> None:
+        raise NotImplementedError
+
+    # -- reads (never require a transaction) --------------------------
+    def read(self, cid: str, oid: str, off: int = 0,
+             length: int | None = None) -> bytes:
+        raise NotImplementedError
+
+    def stat(self, cid: str, oid: str) -> int:
+        """Object size in bytes; raises NoSuchObject."""
+        raise NotImplementedError
+
+    def getattr(self, cid: str, oid: str, name: str) -> bytes:
+        raise NotImplementedError
+
+    def getattrs(self, cid: str, oid: str) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get(self, cid: str, oid: str) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def list_collections(self) -> list[str]:
+        raise NotImplementedError
+
+    def list_objects(self, cid: str) -> list[str]:
+        raise NotImplementedError
+
+    def exists(self, cid: str, oid: str) -> bool:
+        try:
+            self.stat(cid, oid)
+            return True
+        except StoreError:
+            return False
+
+    # -- fault injection (store->inject_data_error role) --------------
+    def inject_data_error(self, cid: str, oid: str) -> None:
+        raise NotImplementedError
+
+    def clear_data_error(self, cid: str, oid: str) -> None:
+        raise NotImplementedError
+
+
+def create_store(kind: str, path: str | None = None) -> ObjectStore:
+    """Factory (ObjectStore::create role, src/os/ObjectStore.cc:62-95)."""
+    from ceph_tpu.store.blockstore import BlockStore
+    from ceph_tpu.store.memstore import MemStore
+    if kind == "memstore":
+        return MemStore()
+    if kind == "blockstore":
+        if path is None:
+            raise ValueError("blockstore requires a path")
+        return BlockStore(path)
+    raise ValueError(f"unknown store kind {kind!r}")

@@ -21,20 +21,22 @@ from ceph_tpu.utils.config import g_conf
 
 _lock = threading.Lock()
 _levels: dict[str, int] = {}
-_ring: collections.deque = collections.deque(maxlen=10000)
+_ring: collections.deque = collections.deque(
+    maxlen=g_conf()["log_ring_size"])
 #: records at or below this level always enter the ring even when not
 #: emitted (the reference keeps high-debug entries in memory for crashes)
 RING_LEVEL = 20
 
 
-def _ring_buf() -> collections.deque:
-    """The crash ring, resized lazily when log_ring_size changes.
-    Call with _lock held."""
+def _resize_ring(_name: str, value: int) -> None:
+    """log_ring_size observer: resize off the hot path, keeping the
+    newest records."""
     global _ring
-    size = g_conf()["log_ring_size"]
-    if _ring.maxlen != size:
-        _ring = collections.deque(_ring, maxlen=size)
-    return _ring
+    with _lock:
+        _ring = collections.deque(_ring, maxlen=value)
+
+
+g_conf().add_observer("log_ring_size", _resize_ring)
 
 
 def set_subsys_level(subsys: str, level: int) -> None:
@@ -52,7 +54,7 @@ def get_subsys_level(subsys: str) -> int:
 def dump_recent(count: int = 1000) -> list[str]:
     """The crash-dump ring (Log.cc dump_recent role)."""
     with _lock:
-        items = list(_ring_buf())[-count:]
+        items = list(_ring)[-count:]
     return items
 
 
@@ -69,7 +71,7 @@ class Dout:
                   f"{level:2d} {self.subsys}: {msg}")
         if level <= RING_LEVEL:
             with _lock:
-                _ring_buf().append(record)
+                _ring.append(record)
         if level <= get_subsys_level(self.subsys):
             print(record, file=self.stream)
 

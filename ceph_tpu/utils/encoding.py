@@ -66,11 +66,15 @@ class Encoder:
     def str_map(self, d: dict) -> "Encoder":
         return self.map(d, Encoder.str, Encoder.str)
 
-    def section(self, version: int, body: "Encoder") -> "Encoder":
-        """ENCODE_START(version, ...) ... ENCODE_FINISH: version byte +
-        length-prefixed body; decoders skip bytes they don't parse."""
+    def section(self, version: int, body: "Encoder",
+                compat: int = 1) -> "Encoder":
+        """ENCODE_START(version, compat, ...) ... ENCODE_FINISH:
+        version + compat bytes + length-prefixed body. ``compat`` is the
+        oldest decoder version able to read this encoding; decoders skip
+        trailing bytes they don't parse."""
         payload = body.getvalue()
         self.u8(version)
+        self.u8(compat)
         self.bytes(payload)
         return self
 
@@ -122,13 +126,17 @@ class Decoder:
 
     def section(self, max_supported: int) -> tuple[int, "Decoder"]:
         """DECODE_START: returns (version, sub-decoder over the section
-        body). Newer-than-supported versions still decode the fields the
-        reader knows; unknown trailing bytes are skippable."""
+        body). A newer encoding is readable as long as its ``compat``
+        floor is within what this reader supports (the known field
+        prefix decodes; unknown trailing bytes are skipped). Raises
+        DecodeError when the encoder declared itself incompatible."""
         version = self.u8()
+        compat = self.u8()
         body = self.bytes()
-        if version > max_supported:
-            # still readable: the known prefix of the body
-            pass
+        if compat > max_supported:
+            raise DecodeError(
+                f"encoding v{version} requires decoder >= v{compat}, "
+                f"this reader supports <= v{max_supported}")
         return version, Decoder(body)
 
     def remaining(self) -> int:

@@ -25,13 +25,22 @@ def test_versioned_section_skips_unknown_tail():
     inner = Encoder()
     inner.u32(42).str("future-field")
     e = Encoder()
-    e.section(3, inner)
+    e.section(3, inner, compat=1)  # newer encoding, old readers OK
     e.u32(99)  # data after the section
     d = Decoder(e.getvalue())
     ver, body = d.section(max_supported=1)
     assert ver == 3
     assert body.u32() == 42  # known prefix decodes
     assert d.u32() == 99     # outer stream not corrupted by unread tail
+
+
+def test_versioned_section_compat_floor_rejected():
+    import pytest
+    from ceph_tpu.utils.encoding import DecodeError
+    e = Encoder()
+    e.section(5, Encoder().u32(1), compat=4)  # needs a v4+ reader
+    with pytest.raises(DecodeError):
+        Decoder(e.getvalue()).section(max_supported=3)
 
 
 def make_map(n_osds=6):
