@@ -1,0 +1,248 @@
+"""Typed messages — the src/messages/ role (~170 headers there; the
+subset this framework's daemons speak, most importantly the EC sub-op
+messages MOSDECSubOpWrite/Read and their replies,
+src/messages/MOSDECSubOpWrite.h:21, carried structs at
+src/osd/ECMsgTypes.h:23-89).
+
+Each message declares FIELDS = [(name, kind), ...]; encode/decode are
+generated from that schema over the versioned-section Encoder, so
+every message is forward-compatible (new fields append; old readers
+skip them) like the reference's versioned message encodings.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+_ENC = {
+    "u8": Encoder.u8, "u16": Encoder.u16, "u32": Encoder.u32,
+    "u64": Encoder.u64, "i32": Encoder.i32, "i64": Encoder.i64,
+    "f64": Encoder.f64, "bool": Encoder.bool, "str": Encoder.str,
+    "bytes": Encoder.bytes,
+    "str_map": Encoder.str_map,
+    "bytes_map": lambda e, v: e.map(v, Encoder.str, Encoder.bytes),
+    "i32_list": lambda e, v: e.list(v, Encoder.i32),
+    "u64_list": lambda e, v: e.list(v, Encoder.u64),
+    "str_list": lambda e, v: e.list(v, Encoder.str),
+}
+_DEC = {
+    "u8": Decoder.u8, "u16": Decoder.u16, "u32": Decoder.u32,
+    "u64": Decoder.u64, "i32": Decoder.i32, "i64": Decoder.i64,
+    "f64": Decoder.f64, "bool": Decoder.bool, "str": Decoder.str,
+    "bytes": Decoder.bytes,
+    "str_map": Decoder.str_map,
+    "bytes_map": lambda d: d.map(Decoder.str, Decoder.bytes),
+    "i32_list": lambda d: d.list(Decoder.i32),
+    "u64_list": lambda d: d.list(Decoder.u64),
+    "str_list": lambda d: d.list(Decoder.str),
+}
+
+_DEFAULTS = {
+    "u8": 0, "u16": 0, "u32": 0, "u64": 0, "i32": 0, "i64": 0,
+    "f64": 0.0, "bool": False, "str": "", "bytes": b"",
+}
+
+_REGISTRY: dict[int, type] = {}
+
+
+class Message:
+    MSG_TYPE = 0
+    FIELDS: list[tuple[str, str]] = []
+
+    def __init__(self, **kw) -> None:
+        self.seq = 0
+        for name, kind in self.FIELDS:
+            if name in kw:
+                setattr(self, name, kw.pop(name))
+            else:
+                default = _DEFAULTS.get(kind)
+                setattr(self, name,
+                        default if default is not None
+                        else ({} if kind.endswith("map") else []))
+        if kw:
+            raise TypeError(
+                f"{type(self).__name__}: unknown fields {sorted(kw)}")
+
+    def __init_subclass__(cls) -> None:
+        if cls.MSG_TYPE:
+            existing = _REGISTRY.get(cls.MSG_TYPE)
+            if existing is not None and existing is not cls:
+                raise TypeError(
+                    f"MSG_TYPE {cls.MSG_TYPE} already used by "
+                    f"{existing.__name__}")
+            _REGISTRY[cls.MSG_TYPE] = cls
+
+    def encode_payload(self) -> bytes:
+        body = Encoder()
+        for name, kind in self.FIELDS:
+            _ENC[kind](body, getattr(self, name))
+        e = Encoder()
+        e.section(1, body)
+        return e.getvalue()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "Message":
+        _, d = Decoder(buf).section(1)
+        msg = cls()
+        for name, kind in cls.FIELDS:
+            if d.eof():
+                break      # older peer: trailing fields keep defaults
+            setattr(msg, name, _DEC[kind](d))
+        return msg
+
+    def __repr__(self) -> str:
+        fields = ", ".join(
+            f"{n}={getattr(self, n)!r}" for n, _ in self.FIELDS[:4])
+        return f"{type(self).__name__}({fields})"
+
+
+def decode_message(mtype: int, payload: bytes) -> Message:
+    cls = _REGISTRY.get(mtype)
+    if cls is None:
+        raise ValueError(f"unknown message type {mtype}")
+    return cls.decode_payload(payload)
+
+
+# -- heartbeat (MOSDPing role, osd/OSD.cc handle_osd_ping) -------------
+
+class MPing(Message):
+    MSG_TYPE = 1
+    FIELDS = [("osd_id", "i32"), ("epoch", "u32"), ("stamp", "f64")]
+
+
+class MPingReply(Message):
+    MSG_TYPE = 2
+    FIELDS = [("osd_id", "i32"), ("epoch", "u32"), ("stamp", "f64")]
+
+
+# -- mon plane ---------------------------------------------------------
+
+class MMonCommand(Message):
+    """Admin command (mon/Monitor handle_command role): e.g.
+    {"prefix": "osd pool create", ...}."""
+    MSG_TYPE = 10
+    FIELDS = [("tid", "u64"), ("cmd", "str_map")]
+
+
+class MMonCommandReply(Message):
+    MSG_TYPE = 11
+    FIELDS = [("tid", "u64"), ("code", "i32"), ("outs", "str"),
+              ("data", "bytes")]
+
+
+class MMonSubscribe(Message):
+    """Subscribe to map updates (MMonSubscribe role)."""
+    MSG_TYPE = 12
+    FIELDS = [("what", "str"), ("start_epoch", "u32")]
+
+
+class MOSDBoot(Message):
+    MSG_TYPE = 13
+    FIELDS = [("osd_id", "i32"), ("addr", "str")]
+
+
+class MOSDFailure(Message):
+    """Failure report, osd -> mon (MOSDFailure role)."""
+    MSG_TYPE = 14
+    FIELDS = [("target_osd", "i32"), ("reporter", "i32"),
+              ("epoch", "u32"), ("failed_for", "f64")]
+
+
+class MOSDMap(Message):
+    """Full map push (the reference sends incrementals + fulls; we send
+    fulls — maps here are small)."""
+    MSG_TYPE = 15
+    FIELDS = [("epoch", "u32"), ("map_bytes", "bytes")]
+
+
+class MOSDAlive(Message):
+    MSG_TYPE = 16
+    FIELDS = [("osd_id", "i32"), ("epoch", "u32")]
+
+
+# -- client I/O (MOSDOp/MOSDOpReply role) ------------------------------
+
+OSD_OP_WRITE_FULL = 1
+OSD_OP_READ = 2
+OSD_OP_REMOVE = 3
+OSD_OP_STAT = 4
+
+class MOSDOp(Message):
+    MSG_TYPE = 20
+    FIELDS = [("tid", "u64"), ("client", "str"), ("epoch", "u32"),
+              ("pool", "i32"), ("ps", "u32"), ("oid", "str"),
+              ("op", "u8"), ("offset", "u64"), ("length", "u64"),
+              ("data", "bytes")]
+
+
+class MOSDOpReply(Message):
+    MSG_TYPE = 21
+    FIELDS = [("tid", "u64"), ("code", "i32"), ("epoch", "u32"),
+              ("data", "bytes"), ("version", "u64")]
+
+
+# -- EC sub-ops (ECMsgTypes.h ECSubWrite/ECSubRead + replies) ----------
+
+class MECSubWrite(Message):
+    """Primary -> shard: apply this shard-local transaction for (pgid,
+    version). Carries a store Transaction (ECSubWrite carries shard
+    ObjectStore txns + log entries, ECMsgTypes.h:23-89)."""
+    MSG_TYPE = 30
+    FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
+              ("shard", "u8"), ("epoch", "u32"), ("oid", "str"),
+              ("version", "u64"), ("txn_bytes", "bytes")]
+
+
+class MECSubWriteReply(Message):
+    MSG_TYPE = 31
+    FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
+              ("shard", "u8"), ("committed", "bool"), ("version", "u64")]
+
+
+class MECSubRead(Message):
+    """Primary -> shard: read shard chunk(s) (ECSubRead: offsets +
+    subchunk lists; attrs on request)."""
+    MSG_TYPE = 32
+    FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
+              ("shard", "u8"), ("oid", "str"), ("offset", "u64"),
+              ("length", "u64"), ("want_attrs", "bool")]
+
+
+class MECSubReadReply(Message):
+    MSG_TYPE = 33
+    FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
+              ("shard", "u8"), ("oid", "str"), ("code", "i32"),
+              ("data", "bytes"), ("attrs", "bytes_map")]
+
+
+# -- recovery (MOSDPGPush role) ----------------------------------------
+
+class MPGPush(Message):
+    """Primary -> shard during recovery: reconstructed chunk + attrs."""
+    MSG_TYPE = 34
+    FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
+              ("oid", "str"), ("version", "u64"), ("data", "bytes"),
+              ("attrs", "bytes_map")]
+
+
+class MPGPushReply(Message):
+    MSG_TYPE = 35
+    FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
+              ("oid", "str"), ("committed", "bool")]
+
+
+# -- peering-lite (MOSDPGQuery/MOSDPGNotify role) ----------------------
+
+class MPGQuery(Message):
+    """Primary asks a shard holder what it has for a PG."""
+    MSG_TYPE = 36
+    FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
+              ("epoch", "u32")]
+
+
+class MPGNotify(Message):
+    """Shard's answer: objects it holds and their versions."""
+    MSG_TYPE = 37
+    FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
+              ("epoch", "u32"), ("objects", "str_list"),
+              ("versions", "u64_list")]

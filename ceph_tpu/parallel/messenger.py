@@ -1,0 +1,306 @@
+"""Async messenger — the src/msg/ role (AsyncMessenger flavor).
+
+Reference: ``Messenger`` (src/msg/Messenger.h) with the AsyncMessenger
+event-driven implementation (src/msg/async/): one event loop serving
+many connections, typed messages, per-message crc32c (crcflags,
+src/msg/Messenger.cc:60), per-peer byte throttles, and socket-failure
+injection ("ms inject socket failures" qa yamls).
+
+Design here: each daemon owns one ``Messenger`` = one asyncio loop on a
+private thread (the reference's worker-thread pool collapsed to one —
+Python's concurrency seat). Connections are bidirectional and cached;
+a reply rides the same ``Connection`` the request arrived on (the
+reference's Connection/get_connection model). Connections are
+**lossy**: on error they drop and the next send reconnects; reliability
+is the upper layer's job (Objecter resend on new epoch, EC sub-op
+resend on peering change), as with the reference's lossy-client policy
+(src/ceph_osd.cc:531-557).
+
+The TPU seam: this messenger is the *control/metadata* plane. Bulk
+chunk movement between TPU workers rides XLA collectives over ICI/DCN
+(parallel/sharded_codec.py) — the NetworkStack-plugin seam
+(msg/async/Stack.cc:66-95) where RDMA/DPDK slot into the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+import threading
+from typing import Callable
+
+from ceph_tpu.parallel.messages import Message, decode_message
+from ceph_tpu.utils import checksum
+from ceph_tpu.utils.config import g_conf
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("ms")
+
+_MAGIC = 0xCE9FA127
+_HDR = struct.Struct("<IQH")   # magic, seq, msg type
+
+
+class Connection:
+    """One live peer link. ``peer_name`` ("osd.3") and ``peer_addr``
+    (its listening address, "" for unbound clients) identify the far
+    end; both are learned from frame headers."""
+
+    def __init__(self, msgr: "Messenger", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter) -> None:
+        self.msgr = msgr
+        self.reader = reader
+        self.writer = writer
+        self.lock = asyncio.Lock()
+        self.peer_name = ""
+        self.peer_addr = ""
+        self.closed = False
+
+    def send_message(self, msg: Message) -> None:
+        """Thread-safe fire-and-forget reply path."""
+        self.msgr._submit(self.msgr._send_on(self, msg))
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class Throttle:
+    """Byte-budget backpressure (the reference's dispatch throttler)."""
+
+    def __init__(self, max_bytes: int) -> None:
+        self.max = max_bytes
+        self.cur = 0
+        self._cond = asyncio.Condition()
+
+    async def acquire(self, n: int) -> None:
+        async with self._cond:
+            while self.cur + n > self.max and self.cur > 0:
+                await self._cond.wait()
+            self.cur += n
+
+    async def release(self, n: int) -> None:
+        async with self._cond:
+            self.cur -= n
+            self._cond.notify_all()
+
+
+class Messenger:
+    """One daemon's endpoint: bind+accept, connection cache, typed
+    dispatch. ``entity_name`` is the Ceph-style identity ("osd.3",
+    "mon.a", "client.1")."""
+
+    def __init__(self, entity_name: str,
+                 dispatch_throttle_bytes: int | None = None) -> None:
+        self.entity_name = entity_name
+        self.addr: str = ""
+        self._dispatcher: Callable[[Message, Connection], None] | None = None
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever,
+            name=f"ms-{entity_name}", daemon=True)
+        self._server: asyncio.AbstractServer | None = None
+        # dest addr -> Connection, or a Future while a connect is in
+        # flight (so a send burst shares one connection, preserving the
+        # one-conn-per-peer FIFO property)
+        self._out: dict[str, object] = {}
+        self._in: set[Connection] = set()        # accepted conns
+        self._crc_data = g_conf()["ms_crc_data"]
+        self._seq = 0
+        self._throttle_bytes = (dispatch_throttle_bytes
+                                or g_conf()["ms_dispatch_throttle_bytes"])
+        self._throttle: Throttle | None = None
+        self._inject_every = g_conf()["ms_inject_socket_failures"]
+        self._inject_rng = random.Random(checksum.crc32c(entity_name.encode()))
+        self._running = False
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread.start()
+
+    def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Start listening; returns bound "host:port" (port 0 = pick)."""
+        self.start()
+
+        async def _bind():
+            self._server = await asyncio.start_server(
+                self._accept, host, port)
+            sock = self._server.sockets[0]
+            return "%s:%d" % sock.getsockname()[:2]
+
+        self.addr = asyncio.run_coroutine_threadsafe(
+            _bind(), self._loop).result(timeout=10)
+        return self.addr
+
+    def set_dispatcher(self, fn: Callable[[Message, Connection], None]) -> None:
+        """fn(message, connection) runs on the messenger loop — the
+        fast-dispatch seat (OSD::ms_fast_dispatch): keep it quick or
+        hand off to a work queue."""
+        self._dispatcher = fn
+
+    def shutdown(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+
+        async def _stop():
+            if self._server:
+                self._server.close()
+            for c in list(self._out.values()) + list(self._in):
+                if isinstance(c, Connection):
+                    c.close()
+            self._out.clear()
+            self._in.clear()
+            for task in asyncio.all_tasks():
+                if task is not asyncio.current_task():
+                    task.cancel()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_stop(), self._loop).result(5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    def _submit(self, coro) -> None:
+        if self._running:
+            try:
+                asyncio.run_coroutine_threadsafe(coro, self._loop)
+            except RuntimeError:
+                pass
+
+    # -- receive path -------------------------------------------------
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        conn = Connection(self, reader, writer)
+        self._in.add(conn)
+        try:
+            await self._read_loop(conn)
+        finally:
+            self._in.discard(conn)
+
+    async def _read_loop(self, conn: Connection) -> None:
+        if self._throttle is None:
+            self._throttle = Throttle(self._throttle_bytes)
+        try:
+            while True:
+                hdr = await conn.reader.readexactly(_HDR.size)
+                magic, seq, mtype = _HDR.unpack(hdr)
+                if magic != _MAGIC:
+                    log(1, "bad magic from peer, dropping connection")
+                    break
+                (nlen,) = struct.unpack(
+                    "<H", await conn.reader.readexactly(2))
+                meta = (await conn.reader.readexactly(nlen)).decode()
+                peer_name, _, peer_addr = meta.partition("|")
+                conn.peer_name, conn.peer_addr = peer_name, peer_addr
+                plen, crc = struct.unpack(
+                    "<II", await conn.reader.readexactly(8))
+                # throttle BEFORE buffering the body: the budget bounds
+                # in-memory message bytes (the reference throttles the
+                # same way, before reading the frame body)
+                await self._throttle.acquire(plen)
+                try:
+                    payload = await conn.reader.readexactly(plen)
+                    # crc==0 marks an unchecksummed frame (ms_crc_data
+                    # off at the sender — the crcflags contract)
+                    if crc and checksum.crc32c(payload) != crc:
+                        log(0, f"message crc mismatch from {peer_name}, "
+                            "dropping connection")
+                        break
+                    try:
+                        msg = decode_message(mtype, payload)
+                        msg.seq = seq
+                        if self._dispatcher:
+                            self._dispatcher(msg, conn)
+                    except Exception as exc:  # dispatcher bugs can't kill IO
+                        log(0, f"dispatch error for type {mtype}: {exc!r}")
+                finally:
+                    await self._throttle.release(plen)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+            for addr, c in list(self._out.items()):
+                if c is conn:
+                    self._out.pop(addr, None)
+
+    # -- send path ----------------------------------------------------
+    def send_message(self, msg: Message, dest_addr: str) -> None:
+        """Thread-safe, fire-and-forget (the reference's send_message
+        contract). Lossy: upper layers own retries."""
+        self._submit(self._send_to(msg, dest_addr))
+
+    async def _get_conn(self, dest_addr: str) -> Connection | None:
+        """Resolve (or establish) the one cached connection to a peer.
+        A Future parks in the cache while a connect is in flight so a
+        burst of sends shares the socket instead of stampeding."""
+        ent = self._out.get(dest_addr)
+        if isinstance(ent, asyncio.Future):
+            ent = await asyncio.shield(ent)
+        if isinstance(ent, Connection) and not ent.closed:
+            return ent
+        fut: asyncio.Future = self._loop.create_future()
+        self._out[dest_addr] = fut
+        try:
+            host, port = dest_addr.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(
+                host, int(port))
+        except OSError:
+            log(10, f"connect to {dest_addr} failed")
+            self._out.pop(dest_addr, None)
+            fut.set_result(None)
+            return None
+        conn = Connection(self, reader, writer)
+        conn.peer_addr = dest_addr
+        self._out[dest_addr] = conn
+        fut.set_result(conn)
+        # outbound links read replies on the same stream
+        self._loop.create_task(self._read_loop(conn))
+        return conn
+
+    async def _send_to(self, msg: Message, dest_addr: str) -> None:
+        for _attempt in (0, 1):   # one transparent reconnect
+            conn = await self._get_conn(dest_addr)
+            if conn is None:
+                return
+            if await self._send_on(conn, msg):
+                return
+            if self._out.get(dest_addr) is conn:
+                self._out.pop(dest_addr, None)
+
+    async def _send_on(self, conn: Connection, msg: Message) -> bool:
+        if self._inject_every and \
+                self._inject_rng.randrange(self._inject_every) == 0:
+            log(5, f"injected socket failure to {conn.peer_addr}")
+            conn.close()
+            if self._out.get(conn.peer_addr) is conn:
+                self._out.pop(conn.peer_addr, None)
+            return True   # message silently lost (lossy semantics)
+        payload = msg.encode_payload()
+        self._seq += 1
+        meta = f"{self.entity_name}|{self.addr}".encode()
+        crc = checksum.crc32c(payload) if self._crc_data else 0
+        frame = (_HDR.pack(_MAGIC, self._seq, msg.MSG_TYPE)
+                 + struct.pack("<H", len(meta)) + meta
+                 + struct.pack("<II", len(payload), crc)
+                 + payload)
+        try:
+            async with conn.lock:
+                conn.writer.write(frame)
+                await conn.writer.drain()
+            return True
+        except (ConnectionError, OSError):
+            conn.close()
+            return False
+
+    # -- introspection ------------------------------------------------
+    def get_connection_count(self) -> int:
+        return sum(1 for c in self._out.values()
+                   if isinstance(c, Connection))

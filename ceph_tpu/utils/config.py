@@ -205,6 +205,9 @@ for _o in [
            "messenger: inject a failure every N messages (qa msgr yamls)"),
     Option("ms_crc_data", bool, True, "advanced",
            "checksum message payloads (Messenger crcflags)"),
+    Option("ms_dispatch_throttle_bytes", int, 100 << 20, "advanced",
+           "max in-dispatch message bytes before backpressure "
+           "(Messenger policy throttler)"),
     Option("osd_heartbeat_interval", float, 1.0, "advanced",
            "seconds between peer pings (scaled down from the reference's 6)"),
     Option("osd_heartbeat_grace", float, 4.0, "advanced",
