@@ -1,0 +1,344 @@
+"""Monitor — the consensus/control plane (src/mon/ role).
+
+Reference: ``Monitor`` + ``Paxos`` (src/mon/Paxos.h:174) + the
+PaxosService subclasses, chiefly OSDMonitor (osdmap epochs, EC profile
+commands) and ConfigMonitor. Collapsed here to one daemon class with:
+
+  - a persisted commit log (MonitorDBStore role, backed by store/kv):
+    every map change is a numbered committed value, replayed on
+    restart — the Paxos log discipline with a single mon; the
+    propose/accept quorum round of multi-mon Paxos is not implemented
+    (one mon == one acceptor), but the commit/replay layout matches so
+    quorum can be added at the propose seam.
+  - OSDMonitor logic: MOSDBoot marks OSDs up (new epoch), failure
+    reports and beacon-timeout mark them down (OSDMap epochs move
+    forward only), pool/EC-profile commands validated by actually
+    instantiating the codec — the reference validates profiles on the
+    mon via the same plugin registry the OSDs use
+    (OSDMonitor::prepare_command pattern, SURVEY §3.5).
+  - map publication: subscribers (MMonSubscribe) get an MOSDMap push
+    on every commit.
+  - health: HEALTH_OK / HEALTH_WARN from up/in accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ceph_tpu.models import registry as ec_registry
+from ceph_tpu.parallel import crush
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.parallel.messenger import Connection, Messenger
+from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.store.kv import KeyValueDB, MemDB, WriteBatch
+from ceph_tpu.utils.config import g_conf
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("mon")
+
+
+class Monitor:
+    """A single monitor daemon ("mon.a")."""
+
+    def __init__(self, name: str = "a", db: KeyValueDB | None = None) -> None:
+        self.name = name
+        self.db = db or MemDB()
+        self.osdmap = OSDMap()
+        self.ec_profiles: dict[str, dict] = {}
+        self.msgr = Messenger(f"mon.{name}")
+        self.msgr.set_dispatcher(self._dispatch)
+        self.addr = ""
+        self._lock = threading.RLock()
+        self._subscribers: dict[str, Connection] = {}  # peer entity -> conn
+        self._last_beacon: dict[int, float] = {}
+        self._failure_reports: dict[int, dict[int, float]] = {}
+        self._tick_stop = threading.Event()
+        self._tick_thread: threading.Thread | None = None
+        self._replay()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        # the grace countdown for every replayed-up osd starts now: a
+        # dead one that never re-beacons must still time out
+        now = time.monotonic()
+        for osd, info in self.osdmap.osds.items():
+            if info.up:
+                self._last_beacon.setdefault(osd, now)
+        self.addr = self.msgr.bind(host, port)
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name=f"mon.{self.name}-tick",
+            daemon=True)
+        self._tick_thread.start()
+        log(1, f"mon.{self.name} up at {self.addr}, "
+            f"epoch {self.osdmap.epoch}")
+        return self.addr
+
+    def stop(self) -> None:
+        self._tick_stop.set()
+        if self._tick_thread:
+            self._tick_thread.join(timeout=5)
+        self.msgr.shutdown()
+        self.db.close()
+
+    # -- paxos-lite commit log ----------------------------------------
+    def _last_committed(self) -> int:
+        raw = self.db.get("paxos/last_committed")
+        return int(raw.decode()) if raw else 0
+
+    def _commit(self) -> None:
+        """Commit the current (already mutated) state as the next
+        version, then publish. Caller holds the lock."""
+        self.osdmap.epoch += 1
+        version = self._last_committed() + 1
+        batch = WriteBatch()
+        batch.put(f"paxos/{version:016d}", self._encode_state())
+        batch.put("paxos/last_committed", str(version).encode())
+        self.db.submit(batch, sync=True)
+        log(10, f"committed version {version} (epoch {self.osdmap.epoch})")
+        self._publish()
+
+    def _encode_state(self) -> bytes:
+        from ceph_tpu.utils.encoding import Encoder
+        e = Encoder()
+        e.bytes(self.osdmap.encode())
+        e.str(json.dumps(self.ec_profiles))
+        return e.getvalue()
+
+    def _replay(self) -> None:
+        last = self._last_committed()
+        if last == 0:
+            return
+        from ceph_tpu.utils.encoding import Decoder
+        raw = self.db.get(f"paxos/{last:016d}")
+        d = Decoder(raw)
+        self.osdmap = OSDMap.decode(d.bytes())
+        self.ec_profiles = json.loads(d.str())
+        # a restarted mon can't know which osds are still alive; they
+        # re-boot or get timed out by the beacon grace
+        log(1, f"mon.{self.name} replayed to version {last}, "
+            f"epoch {self.osdmap.epoch}")
+
+    def _publish(self) -> None:
+        msg = M.MOSDMap(epoch=self.osdmap.epoch,
+                        map_bytes=self.osdmap.encode())
+        for name, conn in list(self._subscribers.items()):
+            if conn.closed:
+                del self._subscribers[name]   # dead clients drop out
+                continue
+            conn.send_message(msg)
+
+    # -- dispatch -----------------------------------------------------
+    def _dispatch(self, msg: M.Message, conn: Connection) -> None:
+        with self._lock:
+            if isinstance(msg, M.MOSDBoot):
+                self._handle_boot(msg, conn)
+            elif isinstance(msg, M.MOSDAlive):
+                self._last_beacon[msg.osd_id] = time.monotonic()
+            elif isinstance(msg, M.MOSDFailure):
+                self._handle_failure(msg)
+            elif isinstance(msg, M.MMonSubscribe):
+                self._subscribers[conn.peer_name] = conn
+                conn.send_message(M.MOSDMap(
+                    epoch=self.osdmap.epoch,
+                    map_bytes=self.osdmap.encode()))
+            elif isinstance(msg, M.MMonCommand):
+                code, outs, data = self._handle_command(dict(msg.cmd))
+                conn.send_message(M.MMonCommandReply(
+                    tid=msg.tid, code=code, outs=outs, data=data))
+
+    def _handle_boot(self, msg: M.MOSDBoot, conn: Connection) -> None:
+        osd = msg.osd_id
+        if osd not in self.osdmap.osds:
+            self.osdmap.add_osd(osd, msg.addr)
+        # crush self-registration (the reference's osd crush location
+        # update on boot): root -> per-osd host bucket -> device, plus
+        # the default "data" rule
+        cm = self.osdmap.crush
+        if "default" not in cm.by_name:
+            cm.add_bucket("default", "root")
+        if "data" not in cm.rules:
+            cm.add_rule(crush.Rule("data", root="default",
+                                   failure_domain="osd", mode="indep"))
+        host = f"host-{osd}"
+        if host not in cm.by_name:
+            cm.add_bucket(host, "host", parent="default", weight=1.0)
+        if osd not in cm.device_weights:
+            cm.add_device(osd, host)
+        self.osdmap.mark_up(osd, msg.addr)
+        self._last_beacon[osd] = time.monotonic()
+        self._failure_reports.pop(osd, None)
+        log(1, f"osd.{osd} booted at {msg.addr}")
+        self._commit()
+
+    def _handle_failure(self, msg: M.MOSDFailure) -> None:
+        target = msg.target_osd
+        info = self.osdmap.osds.get(target)
+        if info is None or not info.up:
+            return
+        now = time.monotonic()
+        reporters = self._failure_reports.setdefault(target, {})
+        reporters[msg.reporter] = now
+        # stale reports age out (mon_osd_report_timeout role) so two
+        # spurious reports hours apart can't combine against a live osd
+        expiry = 2 * g_conf()["osd_heartbeat_grace"]
+        for rep, ts in list(reporters.items()):
+            if now - ts > expiry:
+                del reporters[rep]
+        # the reference requires mon_osd_min_down_reporters (default 2);
+        # scaled to our small clusters: 1 reporter + beacon silence, or
+        # 2 fresh reporters outright
+        silent = (now - self._last_beacon.get(target, 0.0)) > \
+            g_conf()["osd_heartbeat_grace"]
+        if len(reporters) >= 2 or silent:
+            log(1, f"osd.{target} marked down "
+                f"({len(reporters)} reporters, silent={silent})")
+            self.osdmap.mark_down(target)
+            self._failure_reports.pop(target, None)
+            self._commit()
+
+    # -- beacon timeout backstop --------------------------------------
+    def _tick_loop(self) -> None:
+        interval = g_conf()["osd_heartbeat_interval"]
+        while not self._tick_stop.wait(interval):
+            self.tick()
+
+    def tick(self) -> None:
+        grace = g_conf()["osd_heartbeat_grace"] * 2  # mon backstop
+        now = time.monotonic()
+        with self._lock:
+            changed = False
+            for osd, info in self.osdmap.osds.items():
+                if info.up and \
+                        now - self._last_beacon.get(osd, now) > grace:
+                    log(1, f"osd.{osd} beacon timeout, marking down")
+                    self.osdmap.mark_down(osd)
+                    changed = True
+            if changed:
+                self._commit()
+
+    # -- command handling (OSDMonitor::prepare_command role) ----------
+    def _handle_command(self, cmd: dict) -> tuple[int, str, bytes]:
+        prefix = cmd.get("prefix", "")
+        try:
+            if prefix == "osd erasure-code-profile set":
+                return self._cmd_profile_set(cmd)
+            if prefix == "osd erasure-code-profile ls":
+                return 0, "", json.dumps(
+                    sorted(self.ec_profiles)).encode()
+            if prefix == "osd erasure-code-profile get":
+                name = cmd["name"]
+                if name not in self.ec_profiles:
+                    return -2, f"profile {name!r} not found", b""
+                return 0, "", json.dumps(self.ec_profiles[name]).encode()
+            if prefix == "osd pool create":
+                return self._cmd_pool_create(cmd)
+            if prefix == "osd pool ls":
+                return 0, "", json.dumps(
+                    sorted(self.osdmap.pool_by_name)).encode()
+            if prefix == "osd tree":
+                return 0, "", json.dumps(self._osd_tree()).encode()
+            if prefix == "osd out":
+                osd = int(cmd["id"])
+                if osd not in self.osdmap.osds:
+                    return -2, f"no osd.{osd}", b""
+                self.osdmap.mark_out(osd)
+                self._commit()
+                return 0, f"marked out osd.{osd}", b""
+            if prefix == "osd in":
+                osd = int(cmd["id"])
+                if osd not in self.osdmap.osds:
+                    return -2, f"no osd.{osd}", b""
+                self.osdmap.osds[osd].in_cluster = True
+                self.osdmap.crush.reweight(osd, 1.0)
+                self._commit()
+                return 0, f"marked in osd.{osd}", b""
+            if prefix == "status":
+                return 0, "", json.dumps(self._status()).encode()
+            if prefix == "health":
+                return 0, self._health(), b""
+            return -22, f"unknown command {prefix!r}", b""
+        except KeyError as exc:
+            return -22, f"missing argument: {exc}", b""
+        except ValueError as exc:   # bad ints, malformed JSON, ...
+            return -22, f"invalid argument: {exc}", b""
+
+    def _cmd_profile_set(self, cmd: dict) -> tuple[int, str, bytes]:
+        name = cmd["name"]
+        # command maps are str->str on the wire; the profile itself
+        # travels as a JSON string value
+        raw = cmd.get("profile", "{}")
+        parsed = json.loads(raw)
+        if not isinstance(parsed, dict):
+            raise ValueError(f"profile must be a JSON object, got "
+                             f"{type(parsed).__name__}")
+        profile = {k: str(v) for k, v in parsed.items()}
+        profile.setdefault("plugin", "jerasure")
+        # validate by instantiating the codec — exactly what the
+        # reference's mon does before accepting a profile
+        try:
+            ec_registry.instance().factory(profile["plugin"], profile)
+        except Exception as exc:
+            return -22, f"invalid profile: {exc}", b""
+        self.ec_profiles[name] = profile
+        self._commit()
+        return 0, f"profile {name} set", b""
+
+    def _cmd_pool_create(self, cmd: dict) -> tuple[int, str, bytes]:
+        name = cmd["pool"]
+        if name in self.osdmap.pool_by_name:
+            return -17, f"pool {name!r} already exists", b""
+        pg_num = int(cmd.get("pg_num", 8))
+        rule = cmd.get("rule", "data")
+        if rule not in self.osdmap.crush.rules:
+            return -2, f"no crush rule {rule!r} (boot an osd first)", b""
+        profile_name = cmd.get("erasure_code_profile", "")
+        if profile_name:
+            if profile_name not in self.ec_profiles:
+                return -2, f"no profile {profile_name!r}", b""
+            profile = self.ec_profiles[profile_name]
+            codec = ec_registry.instance().factory(
+                profile.get("plugin", "jerasure"), profile)
+            k = codec.get_data_chunk_count()
+            size = codec.get_chunk_count()
+            self.osdmap.create_pool(
+                name, pg_num, rule, size=size, min_size=k,
+                ec_profile=dict(profile))
+        else:
+            size = int(cmd.get("size", 3))
+            self.osdmap.create_pool(
+                name, pg_num, rule, size=size,
+                min_size=max(1, size - 1))
+        self._commit()
+        return 0, f"pool {name!r} created", b""
+
+    def _osd_tree(self) -> dict:
+        return {
+            "buckets": [
+                {"id": b.id, "name": b.name, "type": b.type,
+                 "children": b.items}
+                for b in self.osdmap.crush.buckets.values()],
+            "osds": [
+                {"id": o.osd_id, "up": o.up, "in": o.in_cluster,
+                 "addr": o.addr}
+                for o in self.osdmap.osds.values()],
+        }
+
+    def _status(self) -> dict:
+        up = sum(1 for o in self.osdmap.osds.values() if o.up)
+        inc = sum(1 for o in self.osdmap.osds.values() if o.in_cluster)
+        return {
+            "health": self._health(),
+            "epoch": self.osdmap.epoch,
+            "num_osds": len(self.osdmap.osds),
+            "num_up_osds": up,
+            "num_in_osds": inc,
+            "pools": sorted(self.osdmap.pool_by_name),
+        }
+
+    def _health(self) -> str:
+        down = [o.osd_id for o in self.osdmap.osds.values() if not o.up]
+        if down:
+            return f"HEALTH_WARN: {len(down)} osds down: {down}"
+        return "HEALTH_OK"

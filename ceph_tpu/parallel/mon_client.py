@@ -1,0 +1,111 @@
+"""MonClient — every daemon/client's embedded mon session
+(src/mon/MonClient.h role): map subscription, synchronous commands,
+liveness beacons.
+
+A daemon has one messenger dispatcher; it routes mon-plane messages
+here first:  ``if self.monc.handle_message(msg, conn): return``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.parallel.messenger import Connection, Messenger
+from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("monc")
+
+
+class MonClient:
+    def __init__(self, msgr: Messenger, mon_addr: str) -> None:
+        self.msgr = msgr
+        self.mon_addr = mon_addr
+        self.osdmap: OSDMap | None = None
+        self._map_cond = threading.Condition()
+        self._map_callbacks: list[Callable[[OSDMap], None]] = []
+        self._next_tid = 1
+        self._pending: dict[int, list] = {}   # tid -> [event, reply]
+        self._lock = threading.Lock()
+
+    # -- inbound ------------------------------------------------------
+    def handle_message(self, msg: M.Message, conn: Connection) -> bool:
+        """Returns True when the message was mon-plane and consumed."""
+        if isinstance(msg, M.MOSDMap):
+            newmap = OSDMap.decode(msg.map_bytes)
+            with self._map_cond:
+                if self.osdmap is None or \
+                        newmap.epoch > self.osdmap.epoch:
+                    self.osdmap = newmap
+                    self._map_cond.notify_all()
+                    callbacks = list(self._map_callbacks)
+                else:
+                    callbacks = []
+            for fn in callbacks:
+                fn(newmap)
+            return True
+        if isinstance(msg, M.MMonCommandReply):
+            with self._lock:
+                ent = self._pending.pop(msg.tid, None)
+            if ent:
+                ent[1] = msg
+                ent[0].set()
+            return True
+        return False
+
+    def add_map_callback(self, fn: Callable[[OSDMap], None]) -> None:
+        with self._map_cond:
+            self._map_callbacks.append(fn)
+
+    # -- outbound -----------------------------------------------------
+    def subscribe(self) -> None:
+        """Ask for the current map + pushes on every epoch."""
+        self.msgr.send_message(
+            M.MMonSubscribe(what="osdmap", start_epoch=0), self.mon_addr)
+
+    def wait_for_map(self, min_epoch: int = 1, timeout: float = 10.0
+                     ) -> OSDMap:
+        with self._map_cond:
+            ok = self._map_cond.wait_for(
+                lambda: self.osdmap is not None
+                and self.osdmap.epoch >= min_epoch, timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"no osdmap epoch >= {min_epoch} within {timeout}s")
+            return self.osdmap
+
+    def boot_osd(self, osd_id: int, addr: str) -> None:
+        self.msgr.send_message(
+            M.MOSDBoot(osd_id=osd_id, addr=addr), self.mon_addr)
+
+    def beacon(self, osd_id: int, epoch: int) -> None:
+        self.msgr.send_message(
+            M.MOSDAlive(osd_id=osd_id, epoch=epoch), self.mon_addr)
+
+    def report_failure(self, target: int, reporter: int, epoch: int,
+                       failed_for: float) -> None:
+        self.msgr.send_message(
+            M.MOSDFailure(target_osd=target, reporter=reporter,
+                          epoch=epoch, failed_for=failed_for),
+            self.mon_addr)
+
+    def command(self, cmd: dict, timeout: float = 10.0
+                ) -> tuple[int, str, bytes]:
+        """Synchronous admin command; retries ride on the caller."""
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            ent = [threading.Event(), None]
+            self._pending[tid] = ent
+        self.msgr.send_message(
+            M.MMonCommand(tid=tid, cmd={k: str(v)
+                                        for k, v in cmd.items()}),
+            self.mon_addr)
+        if not ent[0].wait(timeout):
+            with self._lock:
+                self._pending.pop(tid, None)
+            raise TimeoutError(f"mon command {cmd.get('prefix')!r} timed out")
+        reply: M.MMonCommandReply = ent[1]
+        return reply.code, reply.outs, reply.data

@@ -1,0 +1,213 @@
+"""Monitor tests — boot/epoch flow, commands, EC profile validation,
+map subscription pushes, failure handling, commit-log replay.
+
+Mirrors the mon-side behaviors the reference exercises through
+OSDMonitor command paths and qa standalone scripts."""
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.parallel.mon import Monitor
+from ceph_tpu.parallel.mon_client import MonClient
+from ceph_tpu.parallel.messenger import Messenger
+from ceph_tpu.store.kv import FileDB
+
+
+@pytest.fixture
+def mon():
+    m = Monitor("a")
+    m.start()
+    yield m
+    m.stop()
+
+
+@pytest.fixture
+def client(mon):
+    msgr = Messenger("client.test")
+    msgr.start()
+    monc = MonClient(msgr, mon.addr)
+    msgr.set_dispatcher(lambda msg, conn: monc.handle_message(msg, conn))
+    yield monc
+    msgr.shutdown()
+
+
+def boot(monc, osd_id, addr="127.0.0.1:0"):
+    monc.boot_osd(osd_id, addr)
+
+
+def test_boot_bumps_epoch_and_pushes_map(mon, client):
+    client.subscribe()
+    m0 = client.wait_for_map(0)
+    for o in range(4):
+        boot(client, o)
+    m = client.wait_for_map(m0.epoch + 4)
+    assert len(m.osds) == 4
+    assert all(m.osds[o].up for o in range(4))
+    assert 0 in m.crush.device_weights
+
+
+def test_profile_validation_rejects_bad_accepts_good(mon, client):
+    client.subscribe()
+    code, outs, _ = client.command({
+        "prefix": "osd erasure-code-profile set", "name": "bad",
+        "profile": json.dumps({"plugin": "jerasure", "k": "0", "m": "2"})})
+    assert code == -22
+    code, outs, _ = client.command({
+        "prefix": "osd erasure-code-profile set", "name": "nope",
+        "profile": json.dumps({"plugin": "no_such_plugin"})})
+    assert code == -22
+    code, _, _ = client.command({
+        "prefix": "osd erasure-code-profile set", "name": "k4m2",
+        "profile": json.dumps({"plugin": "jerasure", "k": "4", "m": "2"})})
+    assert code == 0
+    code, _, data = client.command(
+        {"prefix": "osd erasure-code-profile get", "name": "k4m2"})
+    assert code == 0 and json.loads(data)["k"] == "4"
+
+
+def test_pool_create_from_profile(mon, client):
+    client.subscribe()
+    for o in range(6):
+        boot(client, o)
+    client.command({
+        "prefix": "osd erasure-code-profile set", "name": "k4m2",
+        "profile": json.dumps({"plugin": "jerasure", "k": "4", "m": "2"})})
+    code, outs, _ = client.command({
+        "prefix": "osd pool create", "pool": "ecpool", "pg_num": "8",
+        "erasure_code_profile": "k4m2"})
+    assert code == 0, outs
+    m = client.wait_for_map(7)
+    pid = m.pool_by_name["ecpool"]
+    pool = m.pools[pid]
+    assert (pool.size, pool.min_size) == (6, 4)
+    assert pool.ec_profile["k"] == "4"
+    # mapping works end-to-end on the pushed map
+    ps, acting, primary = m.object_locator(pid, "obj")
+    assert len(acting) == 6 and primary in range(6)
+    # duplicate create rejected
+    code, _, _ = client.command({
+        "prefix": "osd pool create", "pool": "ecpool",
+        "erasure_code_profile": "k4m2"})
+    assert code == -17
+
+
+def test_pool_create_needs_existing_profile_and_rule(mon, client):
+    client.subscribe()
+    boot(client, 0)
+    code, outs, _ = client.command({
+        "prefix": "osd pool create", "pool": "p",
+        "erasure_code_profile": "missing"})
+    assert code == -2
+
+
+def test_status_health_and_failure_reports(mon, client):
+    client.subscribe()
+    for o in range(3):
+        boot(client, o)
+    m = client.wait_for_map(3)
+    code, _, data = client.command({"prefix": "status"})
+    st = json.loads(data)
+    assert st["num_up_osds"] == 3 and st["health"] == "HEALTH_OK"
+    # two failure reports -> marked down
+    client.report_failure(target=2, reporter=0, epoch=m.epoch,
+                          failed_for=5.0)
+    client.report_failure(target=2, reporter=1, epoch=m.epoch,
+                          failed_for=5.0)
+    m2 = client.wait_for_map(m.epoch + 1)
+    assert not m2.osds[2].up
+    code, outs, _ = client.command({"prefix": "health"})
+    assert "HEALTH_WARN" in outs
+    # re-boot brings it back
+    boot(client, 2)
+    m3 = client.wait_for_map(m2.epoch + 1)
+    assert m3.osds[2].up
+
+
+def test_unknown_command(mon, client):
+    code, outs, _ = client.command({"prefix": "bogus nonsense"})
+    assert code == -22
+
+
+def test_replicated_pool_needs_rule_too(mon, client):
+    # before any osd boots there is no "data" rule: creating a
+    # replicated pool must fail instead of poisoning the map
+    code, outs, _ = client.command(
+        {"prefix": "osd pool create", "pool": "p", "size": "2"})
+    assert code == -2
+    boot(client, 0)
+    code, _, _ = client.command(
+        {"prefix": "osd pool create", "pool": "p", "size": "2"})
+    assert code == 0
+
+
+def test_profile_non_object_json_rejected(mon, client):
+    code, outs, _ = client.command({
+        "prefix": "osd erasure-code-profile set", "name": "x",
+        "profile": "[1, 2]"})
+    assert code == -22 and "JSON object" in outs
+
+
+def test_osd_out_then_in_is_reversible(mon, client):
+    client.subscribe()
+    for o in range(3):
+        boot(client, o)
+    m = client.wait_for_map(3)
+    code, _, _ = client.command({"prefix": "osd out", "id": "1"})
+    assert code == 0
+    m = client.wait_for_map(m.epoch + 1)
+    assert not m.osds[1].in_cluster
+    assert m.crush.device_weights[1] == 0.0
+    code, _, _ = client.command({"prefix": "osd in", "id": "1"})
+    assert code == 0
+    m = client.wait_for_map(m.epoch + 1)
+    assert m.osds[1].in_cluster
+    assert m.crush.device_weights[1] == 1.0
+    code, _, _ = client.command({"prefix": "osd out", "id": "99"})
+    assert code == -2
+
+
+def test_mon_restart_replays_state(tmp_path):
+    db_path = str(tmp_path / "mon")
+    mon1 = Monitor("a", db=FileDB(db_path))
+    mon1.start()
+    msgr = Messenger("client.r")
+    msgr.start()
+    monc = MonClient(msgr, mon1.addr)
+    msgr.set_dispatcher(lambda m, c: monc.handle_message(m, c))
+    monc.subscribe()
+    monc.boot_osd(7, "127.0.0.1:1234")
+    monc.command({
+        "prefix": "osd erasure-code-profile set", "name": "k2m1",
+        "profile": json.dumps({"plugin": "jerasure", "k": "2", "m": "1"})})
+    code, _, _ = monc.command({
+        "prefix": "osd pool create", "pool": "surviving",
+        "erasure_code_profile": "k2m1"})
+    assert code == 0
+    epoch = monc.wait_for_map(3).epoch
+    mon1.stop()
+    msgr.shutdown()
+
+    mon2 = Monitor("a", db=FileDB(db_path))
+    assert mon2.osdmap.epoch == epoch
+    assert "surviving" in mon2.osdmap.pool_by_name
+    assert mon2.ec_profiles["k2m1"]["k"] == "2"
+    assert 7 in mon2.osdmap.osds
+    mon2.db.close()
+
+
+def test_beacon_timeout_marks_down(mon, client):
+    from ceph_tpu.utils.config import g_conf
+    client.subscribe()
+    boot(client, 0)
+    m = client.wait_for_map(1)
+    # silence beacons; mon backstop = 2x grace
+    deadline = time.time() + 3 * g_conf()["osd_heartbeat_grace"] + 2
+    while time.time() < deadline:
+        mm = client.osdmap
+        if mm and not mm.osds[0].up:
+            break
+        time.sleep(0.2)
+    assert not client.osdmap.osds[0].up
