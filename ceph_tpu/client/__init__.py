@@ -1,0 +1,1 @@
+"""Client I/O engine + librados-style API (src/osdc/ + src/librados/)."""

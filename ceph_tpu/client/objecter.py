@@ -1,0 +1,158 @@
+"""Objecter — the client-side op engine (src/osdc/Objecter.{h,cc}).
+
+``op_submit`` (Objecter.cc:2265) assigns a tid, computes the target
+primary from the current osdmap (+CRUSH) the way ``_calc_target``
+(:2795) does, and sends one MOSDOp. Reliability over the lossy
+messenger is this layer's job, as in the reference:
+
+  - on every new map epoch, every pending op is retargeted and resent
+    (the primary may have moved);
+  - a tick thread resends ops that have been in flight longer than
+    ``objecter_resend_interval`` (lost message / dead primary);
+  - an ESTALE reply (op reached a non-primary) leaves the op pending
+    for the next map push / tick instead of hammering the ex-primary
+    with the same stale target at RTT rate.
+
+Duplicate delivery on resend is safe for ALL ops: the OSD keeps a
+(client, tid) dup-op cache and answers a resend of an already-applied
+mutation with the original reply instead of re-executing it (the
+reference's reqid-based dup detection in the pg log).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.parallel.messenger import Connection, Messenger
+from ceph_tpu.parallel.mon_client import MonClient
+from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.utils.config import g_conf
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("objecter")
+
+ESTALE = -116
+
+
+class ObjecterError(Exception):
+    def __init__(self, code: int, message: str = "") -> None:
+        super().__init__(message or f"op failed: code {code}")
+        self.code = code
+
+
+class _Op:
+    __slots__ = ("tid", "msg", "event", "reply", "sent_at", "attempts")
+
+    def __init__(self, tid: int, msg: M.MOSDOp) -> None:
+        self.tid = tid
+        self.msg = msg
+        self.event = threading.Event()
+        self.reply: M.MOSDOpReply | None = None
+        self.sent_at = 0.0
+        self.attempts = 0
+
+
+class Objecter:
+    def __init__(self, msgr: Messenger, monc: MonClient) -> None:
+        self.msgr = msgr
+        self.monc = monc
+        self._lock = threading.Lock()
+        self._next_tid = 1
+        self._pending: dict[int, _Op] = {}
+        self._stop = threading.Event()
+        self._tick = threading.Thread(
+            target=self._tick_loop, name="objecter-tick", daemon=True)
+        self._tick.start()
+        monc.add_map_callback(self._on_map)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._tick.join(timeout=5)
+
+    # -- inbound ------------------------------------------------------
+    def handle_message(self, msg: M.Message, conn: Connection) -> bool:
+        if not isinstance(msg, M.MOSDOpReply):
+            return False
+        with self._lock:
+            op = self._pending.get(msg.tid)
+        if op is None:
+            return True        # dup reply after resend: drop
+        if msg.code == ESTALE:
+            # reached a non-primary; our map is behind. Leave the op
+            # pending: the mon's map push retargets it (and the tick
+            # loop backstops a lost push).
+            return True
+        with self._lock:
+            self._pending.pop(msg.tid, None)
+        op.reply = msg
+        op.event.set()
+        return True
+
+    # -- submit -------------------------------------------------------
+    def op_submit(self, pool: int, oid: str, op: int, *, offset: int = 0,
+                  length: int = 0, data: bytes = b"", ps: int = -1,
+                  timeout: float = 30.0) -> M.MOSDOpReply:
+        """Synchronous submit (the aio variant is just this on a
+        thread); raises ObjecterError on errno replies."""
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+        msg = M.MOSDOp(tid=tid, client=self.msgr.entity_name, epoch=0,
+                       pool=pool, ps=max(ps, 0), oid=oid, op=op,
+                       offset=offset, length=length, data=bytes(data))
+        rec = _Op(tid, msg)
+        with self._lock:
+            self._pending[tid] = rec
+        self._send(rec)
+        if not rec.event.wait(timeout):
+            with self._lock:
+                self._pending.pop(tid, None)
+            raise ObjecterError(-110, f"op on {oid!r} timed out")  # ETIMEDOUT
+        reply = rec.reply
+        if reply.code < 0:
+            raise ObjecterError(reply.code)
+        return reply
+
+    def _send(self, op: _Op) -> None:
+        osdmap = self.monc.osdmap
+        if osdmap is None:
+            return
+        pool = osdmap.pools.get(op.msg.pool)
+        if pool is None:
+            return                      # wait for a map that has it
+        if op.msg.op == M.OSD_OP_LIST:
+            ps = op.msg.ps
+            _, _, primary = osdmap.pg_to_up_acting(op.msg.pool, ps)
+        else:
+            ps, _, primary = osdmap.object_locator(op.msg.pool,
+                                                   op.msg.oid)
+            op.msg.ps = ps
+        if primary < 0:
+            return                      # PG unserviceable; tick retries
+        info = osdmap.osds.get(primary)
+        if info is None or not info.addr:
+            return
+        op.msg.epoch = osdmap.epoch
+        op.sent_at = time.monotonic()
+        op.attempts += 1
+        self.msgr.send_message(op.msg, info.addr)
+
+    # -- resend machinery ---------------------------------------------
+    def _on_map(self, newmap: OSDMap) -> None:
+        with self._lock:
+            ops = list(self._pending.values())
+        for op in ops:
+            self._send(op)
+
+    def _tick_loop(self) -> None:
+        interval = g_conf()["objecter_resend_interval"]
+        while not self._stop.wait(interval / 2):
+            now = time.monotonic()
+            with self._lock:
+                ops = [o for o in self._pending.values()
+                       if now - o.sent_at > interval]
+            for op in ops:
+                log(10, f"resending tid {op.tid} ({op.msg.oid})")
+                self._send(op)

@@ -1,0 +1,135 @@
+"""librados-style client API (src/librados/ RadosClient/IoCtxImpl roles).
+
+Usage mirrors the reference's bindings:
+
+    client = RadosClient(mon_addr)
+    client.connect()
+    ioctx = client.open_ioctx("mypool")
+    ioctx.write_full("obj", b"hello")
+    data = ioctx.read("obj")
+    client.shutdown()
+
+Admin commands go through ``client.mon_command`` (the reference's
+``rados_mon_command``).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.client.objecter import Objecter, ObjecterError
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.parallel.messenger import Messenger
+from ceph_tpu.parallel.mon_client import MonClient
+
+_client_seq = [0]
+
+
+class RadosError(Exception):
+    def __init__(self, code: int, message: str = "") -> None:
+        super().__init__(message or f"rados error {code}")
+        self.code = code
+
+
+class IoCtx:
+    """Per-pool I/O context (IoCtxImpl role)."""
+
+    def __init__(self, client: "RadosClient", pool_id: int,
+                 pool_name: str) -> None:
+        self.client = client
+        self.pool_id = pool_id
+        self.pool_name = pool_name
+
+    def _submit(self, oid: str, op: int, **kw) -> M.MOSDOpReply:
+        try:
+            return self.client.objecter.op_submit(
+                self.pool_id, oid, op, **kw)
+        except ObjecterError as exc:
+            raise RadosError(exc.code, str(exc)) from None
+
+    # -- data ops -----------------------------------------------------
+    def write_full(self, oid: str, data: bytes) -> int:
+        """Replace the object; returns the new object version."""
+        return self._submit(oid, M.OSD_OP_WRITE_FULL, data=data).version
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> int:
+        return self._submit(oid, M.OSD_OP_WRITE, data=data,
+                            offset=offset).version
+
+    def append(self, oid: str, data: bytes) -> int:
+        return self._submit(oid, M.OSD_OP_APPEND, data=data).version
+
+    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+        return self._submit(oid, M.OSD_OP_READ, offset=offset,
+                            length=length).data
+
+    def stat(self, oid: str) -> int:
+        """Object size in bytes."""
+        rep = self._submit(oid, M.OSD_OP_STAT)
+        return json.loads(rep.data)["size"]
+
+    def remove(self, oid: str) -> None:
+        self._submit(oid, M.OSD_OP_REMOVE)
+
+    def list_objects(self) -> list[str]:
+        """Union of per-PG listings (PGLS role)."""
+        osdmap = self.client.monc.osdmap
+        out: set[str] = set()
+        for ps in osdmap.pgs_of_pool(self.pool_id):
+            rep = self._submit("", M.OSD_OP_LIST, ps=ps)
+            out.update(json.loads(rep.data))
+        return sorted(out)
+
+
+class RadosClient:
+    def __init__(self, mon_addr: str, name: str | None = None) -> None:
+        if name is None:
+            _client_seq[0] += 1
+            name = f"client.{_client_seq[0]}"
+        self.msgr = Messenger(name)
+        self.monc = MonClient(self.msgr, mon_addr)
+        self.objecter: Objecter | None = None
+        self._connected = False
+
+    def connect(self, timeout: float = 10.0) -> "RadosClient":
+        self.msgr.set_dispatcher(self._dispatch)
+        self.msgr.start()
+        # clients bind too: OSD replies ride the same connection the op
+        # arrived on, but map pushes need our listening addr
+        self.msgr.bind()
+        self.objecter = Objecter(self.msgr, self.monc)
+        self.monc.subscribe()
+        self.monc.wait_for_map(1, timeout)
+        self._connected = True
+        return self
+
+    def shutdown(self) -> None:
+        if self.objecter:
+            self.objecter.shutdown()
+        self.msgr.shutdown()
+        self._connected = False
+
+    def _dispatch(self, msg, conn) -> None:
+        if self.monc.handle_message(msg, conn):
+            return
+        if self.objecter and self.objecter.handle_message(msg, conn):
+            return
+
+    # -- admin --------------------------------------------------------
+    def mon_command(self, cmd: dict, timeout: float = 10.0
+                    ) -> tuple[int, str, bytes]:
+        return self.monc.command(cmd, timeout)
+
+    def open_ioctx(self, pool_name: str) -> IoCtx:
+        osdmap = self.monc.osdmap
+        pid = osdmap.pool_by_name.get(pool_name)
+        if pid is None:
+            # maybe our map is stale; wait for a newer epoch once
+            osdmap = self.monc.wait_for_map(osdmap.epoch + 1, 5.0)
+            pid = osdmap.pool_by_name.get(pool_name)
+        if pid is None:
+            raise RadosError(-2, f"pool {pool_name!r} not found")
+        return IoCtx(self, pid, pool_name)
+
+    def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        self.monc.wait_for_map(epoch, timeout)

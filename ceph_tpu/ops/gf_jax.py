@@ -91,9 +91,34 @@ def matvec_device(mat: np.ndarray, data) -> "jax.Array":
     return _bitsliced_matvec_device(bmat, jnp.asarray(data, dtype=jnp.uint8))
 
 
+#: smallest jit-specialization bucket for the host entry (bytes of N)
+_BUCKET_MIN = 4096
+
+
+def _bucket(n: int) -> int:
+    b = _BUCKET_MIN
+    while b < n:
+        b <<= 1
+    return b
+
+
 def matvec(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
-    """Host-in/host-out backend entry conforming to ops.backend contract."""
-    return np.asarray(jax.device_get(matvec_device(mat, data)))
+    """Host-in/host-out backend entry conforming to ops.backend contract.
+
+    N is padded up to a power-of-2 bucket so jit specializes per
+    (matrix, bucket) instead of per exact chunk length — a daemon
+    serving arbitrary object sizes would otherwise recompile (and
+    stall) on every new size. Zero-padding is exact for GF matmul:
+    extra columns produce extra parity columns we slice off.
+    """
+    k, n = data.shape
+    nb = _bucket(n)
+    if nb != n:
+        padded = np.zeros((k, nb), dtype=np.uint8)
+        padded[:, :n] = data
+        data = padded
+    out = np.asarray(jax.device_get(matvec_device(mat, data)))
+    return out[:, :n] if nb != n else out
 
 
 if HAVE_JAX:

@@ -1,0 +1,373 @@
+"""ECBackend — erasure-coded PG backend (src/osd/ECBackend.{h,cc}).
+
+Write path (submit_transaction -> try_reads_to_commit semantics,
+ECBackend.cc:1447,1901-2048): the primary encodes the object into k+m
+chunks in ONE batched kernel call (ceph_tpu/osd/ec_util.encode — the
+TPU translation of the per-stripe loop), builds one shard-local
+transaction per acting position (chunk data + version attr + hinfo +
+the PG log entry, all atomic), applies its own locally and fans the
+rest out as MECSubWrite; the client is acked when every up shard
+committed (handle_sub_write_reply -> on_all_commit, :1090).
+
+Read path (objects_read_and_reconstruct, :2301): choose the cheapest
+sufficient shard set via the codec's ``minimum_to_decode``
+(get_min_avail_to_read_shards role, :1558), fan out MECSubRead, and
+either fast-path concatenate (all data shards present) or decode the
+missing ones (ECUtil::decode role). Shard reads are crc-verified
+against the stored hinfo on the serving OSD (handle_sub_read
+:1032-1051), so a silently-corrupt shard answers -EIO and the read
+retries around it.
+
+Recovery (recover_object/continue_recovery_op, :537,703): reconstruct
+the missing position's chunk from surviving shards and MPGPush it.
+
+Object layout per shard: the object's chunk stream concatenated across
+stripes (what ECTransaction::encode_and_write writes per shard); attrs:
+``v`` (version), ``sz`` (logical size before padding), ``hinfo``
+(cumulative shard crcs, ECUtil.h:101-162).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+import numpy as np
+
+from ceph_tpu.models import registry as ec_registry
+from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.ec_util import HashInfo, StripeInfo
+from ceph_tpu.osd.pg import (
+    LOG_REMOVE,
+    LOG_WRITE,
+    PG,
+    LogEntry,
+    pg_cid,
+)
+from ceph_tpu.osd.pg_backend import (
+    SUBOP_TIMEOUT,
+    InflightWrite,
+    Listener,
+    PGBackend,
+    SubOpWait,
+    object_remove_txn,
+    object_write_txn,
+)
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.store.object_store import EIOError, NoSuchObject, StoreError
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("osd")
+
+
+class ECReadError(StoreError):
+    """Not enough readable shards to reconstruct."""
+
+
+class ECBackend(PGBackend):
+    def __init__(self, parent: Listener, pool_info) -> None:
+        super().__init__(parent, pool_info)
+        profile = dict(pool_info.ec_profile)
+        if "backend" not in profile:
+            # the OSD's synchronous op path runs host-side kernels (the
+            # ISA-L seat: our native C++ AVX2 lib, numpy fallback); the
+            # jax/TPU path serves the batched stripe engine, where
+            # shapes are static and launches amortized — a per-op jit
+            # dispatch would stall the latency-sensitive daemon
+            from ceph_tpu.ops import backend as backend_mod
+            avail = backend_mod.available_backends()
+            profile["backend"] = ("native" if "native" in avail
+                                  else "numpy")
+        self.codec = ec_registry.instance().factory(
+            profile.get("plugin", "jerasure"), profile)
+        self.k = self.codec.get_data_chunk_count()
+        self.n = self.codec.get_chunk_count()
+        stripe_unit = pool_info.stripe_unit
+        self.sinfo = StripeInfo(stripe_width=self.k * stripe_unit,
+                                chunk_size=stripe_unit)
+
+    # -- layout helpers -----------------------------------------------
+    def local_cid(self, pg: PG) -> str:
+        pos = self.my_position(pg)
+        return pg_cid(pg.pool, pg.ps, pos if pos >= 0 else 0)
+
+    def my_position(self, pg: PG) -> int:
+        try:
+            return pg.acting.index(self.parent.whoami)
+        except ValueError:
+            return -1
+
+    def _pad(self, data: bytes) -> bytes:
+        sw = self.sinfo.stripe_width
+        rem = len(data) % sw
+        if rem == 0 and data:
+            return data
+        return data + b"\x00" * (sw - rem if rem else sw)
+
+    def _chunks_to_logical(self, shards: dict[int, np.ndarray],
+                           size: int) -> bytes:
+        cs = self.sinfo.chunk_size
+        arr = np.stack([np.asarray(shards[i], dtype=np.uint8)
+                        for i in range(self.k)])
+        s = arr.shape[1] // cs
+        out = arr.reshape(self.k, s, cs).transpose(1, 0, 2).tobytes()
+        return out[:size]
+
+    # -- writes -------------------------------------------------------
+    def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
+                     on_commit: Callable[[int], None]) -> None:
+        padded = self._pad(bytes(data))
+        shards = ec_util.encode(self.sinfo, self.codec, padded)
+        hinfo = HashInfo(self.n)
+        hinfo.append(0, shards)
+        hinfo_raw = json.dumps(hinfo.to_dict()).encode()
+        size_raw = len(data).to_bytes(8, "little")
+
+        entry = LogEntry(version, LOG_WRITE, oid)
+        kv, drop = pg.log.stage(entry)
+        positions = self.up_positions(pg)
+        tid = self.parent.new_tid()
+        iw = InflightWrite(tid, pg, oid, version, set(positions),
+                           lambda: on_commit(0))
+        self.parent.register_write(iw)
+        epoch = self.parent.get_osdmap().epoch
+        for pos in positions:
+            osd = pg.acting[pos]
+            cid = pg_cid(pg.pool, pg.ps, pos)
+            txn = object_write_txn(
+                cid, oid, shards[pos].tobytes(), version,
+                attrs={"sz": size_raw, "hinfo": hinfo_raw})
+            pg.log.apply_to_txn(txn, cid, kv, drop)
+            if osd == self.parent.whoami:
+                self.parent.queue_local_txn(
+                    txn,
+                    lambda p=pos: iw.complete(p) and iw.on_all_commit())
+            else:
+                self.parent.send_osd(osd, M.MECSubWrite(
+                    tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
+                    epoch=epoch, oid=oid, version=version,
+                    txn_bytes=txn.encode()))
+        # a write of every shard supersedes any pending recovery for it
+        for missing in pg.peer_missing.values():
+            missing.pop(oid, None)
+
+    def submit_remove(self, pg: PG, oid: str, version: int,
+                      on_commit: Callable[[int], None]) -> None:
+        entry = LogEntry(version, LOG_REMOVE, oid)
+        kv, drop = pg.log.stage(entry)
+        positions = self.up_positions(pg)
+        tid = self.parent.new_tid()
+        iw = InflightWrite(tid, pg, oid, version, set(positions),
+                           lambda: on_commit(0))
+        self.parent.register_write(iw)
+        epoch = self.parent.get_osdmap().epoch
+        for pos in positions:
+            osd = pg.acting[pos]
+            cid = pg_cid(pg.pool, pg.ps, pos)
+            txn = object_remove_txn(cid, oid)
+            pg.log.apply_to_txn(txn, cid, kv, drop)
+            if osd == self.parent.whoami:
+                self.parent.queue_local_txn(
+                    txn,
+                    lambda p=pos: iw.complete(p) and iw.on_all_commit())
+            else:
+                self.parent.send_osd(osd, M.MECSubWrite(
+                    tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
+                    epoch=epoch, oid=oid, version=version,
+                    txn_bytes=txn.encode()))
+        for missing in pg.peer_missing.values():
+            missing.pop(oid, None)
+
+    # -- shard read fan-out -------------------------------------------
+    MAX_READ_ATTEMPTS = 6
+
+    def _read_shards(self, pg: PG, oid: str, want_chunks: list[int],
+                     avoid: set[int] | None = None
+                     ) -> tuple[dict[int, np.ndarray], dict[str, bytes]]:
+        """Read the chunks named by minimum_to_decode over (up - avoid)
+        positions; returns ({chunk: bytes}, attrs-from-one-shard).
+
+        Retries around shards that time out or answer EIO
+        (get_min_avail_to_read_shards + send_all_remaining_reads role),
+        and REFUSES to combine chunks that disagree on the object
+        version: a shard whose commit lags (its sub-write is still in
+        flight) answers with the previous version; mixing it into a
+        decode would produce silent garbage, so the read backs off and
+        retries until the shards agree (the ordering guarantee the
+        reference gets from the ECBackend rmw pipeline + ExtentCache).
+        """
+        avoid = set(avoid or ())
+        with pg.lock:
+            for pos, missing in pg.peer_missing.items():
+                if oid in missing:
+                    avoid.add(pos)
+        mypos = self.my_position(pg)
+        enoent_everywhere = True
+        for attempt in range(self.MAX_READ_ATTEMPTS):
+            available = [p for p in self.up_positions(pg)
+                         if p not in avoid]
+            try:
+                plan = self.codec.minimum_to_decode(
+                    want_chunks, available)
+            except Exception:
+                if enoent_everywhere and attempt > 0:
+                    raise NoSuchObject(oid)
+                raise ECReadError(
+                    f"{oid}: cannot reconstruct chunks {want_chunks} "
+                    f"from positions {available}")
+            need = sorted(plan)
+            results: dict[int, np.ndarray] = {}
+            vers: dict[int, int] = {}
+            attrs: dict[str, bytes] = {}
+            remote = {p for p in need if p != mypos}
+            tid = self.parent.new_tid()
+            wait = SubOpWait(set(remote))
+            self.parent.register_wait(tid, wait)
+            try:
+                for pos in remote:
+                    self.parent.send_osd(pg.acting[pos], M.MECSubRead(
+                        tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
+                        oid=oid, offset=0, length=0, want_attrs=True))
+                if mypos in need:
+                    cid = pg_cid(pg.pool, pg.ps, mypos)
+                    try:
+                        results[mypos] = np.frombuffer(
+                            self.parent.store.read(cid, oid),
+                            dtype=np.uint8)
+                        local_attrs = self.parent.store.getattrs(
+                            cid, oid)
+                        vers[mypos] = int.from_bytes(
+                            local_attrs.get("v", b""), "little")
+                        attrs = attrs or local_attrs
+                        enoent_everywhere = False
+                    except NoSuchObject:
+                        avoid.add(mypos)
+                    except StoreError:
+                        enoent_everywhere = False
+                        avoid.add(mypos)
+                replies = wait.wait(SUBOP_TIMEOUT) if remote else {}
+            finally:
+                self.parent.unregister_wait(tid)
+            failed = set()
+            for pos in remote:
+                rep = replies.get(pos)
+                if rep is None or rep.code != 0:
+                    failed.add(pos)
+                    if rep is not None and rep.code != -2:
+                        enoent_everywhere = False
+                    continue
+                enoent_everywhere = False
+                results[pos] = np.frombuffer(rep.data, dtype=np.uint8)
+                vers[pos] = rep.version
+                if rep.attrs:
+                    attrs = dict(rep.attrs)
+            missing_reads = set(need) - set(results)
+            if missing_reads:
+                avoid |= failed | missing_reads
+                continue
+            if len(set(vers.values())) > 1:
+                # a shard is mid-commit: back off and re-read; do NOT
+                # avoid it — it is catching up, not failing
+                log(10, f"{oid}: shard versions disagree {vers}, "
+                    "retrying")
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            return results, attrs
+        if enoent_everywhere:
+            raise NoSuchObject(oid)
+        raise ECReadError(
+            f"{oid}: no consistent readable shard set after "
+            f"{self.MAX_READ_ATTEMPTS} attempts")
+
+    def _attr_size(self, attrs: dict[str, bytes]) -> int:
+        raw = attrs.get("sz")
+        if raw is None:
+            raise NoSuchObject("no sz attr")
+        return int.from_bytes(raw, "little")
+
+    # -- reads --------------------------------------------------------
+    def read_object(self, pg: PG, oid: str) -> bytes:
+        want = list(range(self.k))
+        chunks, attrs = self._read_shards(pg, oid, want)
+        size = self._attr_size(attrs)
+        if all(i in chunks for i in want):
+            return self._chunks_to_logical(chunks, size)
+        decoded = ec_util.decode(self.sinfo, self.codec, chunks, want)
+        return self._chunks_to_logical(decoded, size)
+
+    def stat_object(self, pg: PG, oid: str) -> int:
+        mypos = self.my_position(pg)
+        if mypos >= 0:
+            cid = pg_cid(pg.pool, pg.ps, mypos)
+            try:
+                return int.from_bytes(
+                    self.parent.store.getattr(cid, oid, "sz"), "little")
+            except StoreError:
+                pass
+        # degraded: any shard's attrs carry the size
+        _, attrs = self._read_shards(pg, oid, [0])
+        return self._attr_size(attrs)
+
+    # -- recovery -----------------------------------------------------
+    def build_push(self, pg: PG, oid: str, shard: int, version: int,
+                   tid: int) -> M.MPGPush | None:
+        if shard >= len(pg.acting) or pg.acting[shard] < 0:
+            return None
+        if version == 0:     # missed removal
+            return M.MPGPush(
+                pool=pg.pool, ps=pg.ps, shard=shard, oid=oid,
+                version=0, data=b"", attrs={}, remove=True, tid=tid)
+        try:
+            chunks, attrs = self._read_shards(
+                pg, oid, [shard], avoid={shard})
+        except StoreError as exc:
+            log(1, f"recover {oid} shard {shard}: {exc}")
+            return None
+        if shard in chunks:
+            chunk = chunks[shard]
+        else:
+            decoded = ec_util.decode(
+                self.sinfo, self.codec, chunks, [shard])
+            chunk = decoded[shard]
+        push_attrs = {"v": version.to_bytes(8, "little")}
+        for name in ("sz", "hinfo"):
+            if name in attrs:
+                push_attrs[name] = attrs[name]
+        return M.MPGPush(
+            pool=pg.pool, ps=pg.ps, shard=shard, oid=oid,
+            version=version, data=np.asarray(chunk).tobytes(),
+            attrs=push_attrs, remove=False, tid=tid)
+
+    # -- shard-side read service (handle_sub_read role) ---------------
+    @staticmethod
+    def serve_sub_read(store, msg: M.MECSubRead) -> M.MECSubReadReply:
+        """Runs on the shard OSD: read + hinfo crc verify
+        (ECBackend.cc:955-1051)."""
+        from ceph_tpu.utils import checksum
+        cid = pg_cid(msg.pool, msg.ps, msg.shard)
+        reply = M.MECSubReadReply(
+            tid=msg.tid, pool=msg.pool, ps=msg.ps, shard=msg.shard,
+            oid=msg.oid, code=0, data=b"", attrs={})
+        try:
+            length = msg.length or None
+            data = store.read(cid, msg.oid, msg.offset, length)
+            attrs = store.getattrs(cid, msg.oid)
+            reply.version = int.from_bytes(attrs.get("v", b""), "little")
+            hraw = attrs.get("hinfo")
+            if hraw and msg.offset == 0 and not msg.length:
+                hinfo = HashInfo.from_dict(json.loads(hraw))
+                crc = checksum.crc32c(data, ec_util.HINFO_SEED)
+                if crc != hinfo.get_chunk_hash(msg.shard):
+                    raise EIOError(
+                        f"{msg.oid} shard {msg.shard}: crc {crc:#x} != "
+                        f"hinfo {hinfo.get_chunk_hash(msg.shard):#x}")
+            reply.data = data
+            if msg.want_attrs:
+                reply.attrs = dict(attrs)
+        except EIOError as exc:
+            log(1, f"sub_read EIO: {exc}")
+            reply.code = -5
+        except StoreError:
+            reply.code = -2
+        return reply

@@ -1,0 +1,302 @@
+"""PGBackend — the replication-strategy seam + the replicated twin.
+
+Reference: src/osd/PGBackend.{h,cc}; ``build_pg_backend``
+(PGBackend.cc:532-569) picks ReplicatedBackend or ECBackend from the
+pool type. The backend owns HOW object data moves between acting-set
+members; the PG above it owns versions, the log, and peering; the OSD
+below it owns messengers and the store.
+
+``Listener`` is the service interface the OSD hands to backends (the
+reference's PGBackend::Listener), so backends stay testable without a
+full daemon.
+
+Sub-op plumbing: every fan-out gets a tid. Write fan-outs register an
+:class:`InflightWrite` (pending position set + completion callback —
+the pending_commit tracking of ECBackend.cc:1090); read fan-outs
+register a blocking :class:`SubOpWait`. The OSD routes
+MECSubWriteReply/MECSubReadReply by tid, and on every map epoch drops
+pending positions whose OSD died (the write then completes on the
+surviving shards and the dead shard is recorded missing, to be fixed
+by recovery — the reference's on-peering-change accounting).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Protocol
+
+from ceph_tpu.osd.pg import (
+    LOG_REMOVE,
+    LOG_WRITE,
+    NO_SHARD,
+    PG,
+    LogEntry,
+    pg_cid,
+)
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.store.object_store import (
+    ObjectStore,
+    StoreError,
+    Transaction,
+)
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("osd")
+
+#: how long a primary waits for one sub-op round trip before treating
+#: the shard as unavailable (messenger is lossy; peers may be dead)
+SUBOP_TIMEOUT = 5.0
+
+
+class SubOpWait:
+    """Blocking rendezvous for a read fan-out."""
+
+    def __init__(self, expected: set[int]) -> None:
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.pending: set[int] = set(expected)
+        self.results: dict[int, object] = {}
+
+    def complete(self, shard: int, result: object) -> None:
+        with self.lock:
+            self.results[shard] = result
+            self.pending.discard(shard)
+            self.cond.notify_all()
+
+    def drop(self, shard: int) -> None:
+        with self.lock:
+            self.pending.discard(shard)
+            self.cond.notify_all()
+
+    def wait(self, timeout: float = SUBOP_TIMEOUT) -> dict[int, object]:
+        with self.lock:
+            self.cond.wait_for(lambda: not self.pending, timeout)
+            return dict(self.results)
+
+
+class InflightWrite:
+    """One write fan-out awaiting shard commits."""
+
+    def __init__(self, tid: int, pg: PG, oid: str, version: int,
+                 pending: set[int], on_all_commit: Callable[[], None]
+                 ) -> None:
+        self.tid = tid
+        self.pg = pg
+        self.oid = oid
+        self.version = version
+        self.acting = list(pg.acting)     # snapshot at submit time
+        self.pending = set(pending)
+        self.on_all_commit = on_all_commit
+        self.created_at = time.monotonic()
+        self._lock = threading.Lock()
+        self._done = False
+
+    def complete(self, pos: int) -> bool:
+        """Mark one position committed; returns True when this call
+        finished the write (caller then fires on_all_commit)."""
+        with self._lock:
+            self.pending.discard(pos)
+            if self.pending or self._done:
+                return False
+            self._done = True
+            return True
+
+    def drop_down_shards(self, osdmap: OSDMap) -> tuple[bool, list[int]]:
+        """Map-change hook: stop waiting for dead shards; the write
+        completes on survivors. Returns (finished, dropped_positions);
+        the CALLER records the dropped shards missing under pg.lock
+        (never taken here: lock order is pg.lock -> iw._lock, because
+        complete() runs inside store-commit callbacks under pg.lock)."""
+        finished = False
+        dropped: list[int] = []
+        with self._lock:
+            for pos in list(self.pending):
+                osd = self.acting[pos] if pos < len(self.acting) else -1
+                info = osdmap.osds.get(osd)
+                if info is None or not info.up:
+                    self.pending.discard(pos)
+                    dropped.append(pos)
+            if not self.pending and not self._done:
+                self._done = True
+                finished = True
+        return finished, dropped
+
+    def expire(self) -> list[int]:
+        """Timeout sweep: abandon the write, returning the positions
+        never heard from (caller records them missing). The client owns
+        end-to-end completion: it times out and resends, and the dup-op
+        cache makes the resend safe."""
+        with self._lock:
+            self._done = True
+            dropped = sorted(self.pending)
+            self.pending.clear()
+        return dropped
+
+
+class Listener(Protocol):
+    """What a backend needs from its hosting OSD."""
+
+    whoami: int
+    store: ObjectStore
+
+    def get_osdmap(self) -> OSDMap: ...
+    def send_osd(self, osd: int, msg: M.Message) -> None: ...
+    def new_tid(self) -> int: ...
+    def register_write(self, iw: InflightWrite) -> None: ...
+    def register_wait(self, tid: int, wait: SubOpWait) -> None: ...
+    def unregister_wait(self, tid: int) -> None: ...
+    def queue_local_txn(self, txn: Transaction,
+                        on_commit: Callable[[], None]) -> None: ...
+
+
+class PGBackend:
+    """Abstract backend (PGBackend.h role)."""
+
+    def __init__(self, parent: Listener, pool_info) -> None:
+        self.parent = parent
+        self.pool = pool_info
+
+    # -- client-facing entry points (primary side) --------------------
+    def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
+                     on_commit: Callable[[int], None]) -> None:
+        """Apply a full-object write at ``version`` across the acting
+        set; ``on_commit(code)`` once every up shard has committed."""
+        raise NotImplementedError
+
+    def submit_remove(self, pg: PG, oid: str, version: int,
+                      on_commit: Callable[[int], None]) -> None:
+        raise NotImplementedError
+
+    def read_object(self, pg: PG, oid: str) -> bytes:
+        """Full-object read, reconstructing if degraded. Raises
+        StoreError/NoSuchObject on failure."""
+        raise NotImplementedError
+
+    def stat_object(self, pg: PG, oid: str) -> int:
+        raise NotImplementedError
+
+    def build_push(self, pg: PG, oid: str, shard: int, version: int,
+                   tid: int) -> "M.MPGPush | None":
+        """Rebuild one shard's copy of ``oid`` as a push message
+        (recover_object / continue_recovery_op role); None when the
+        object cannot be reconstructed right now. The OSD delivers it
+        and waits for the ack before log-syncing the shard."""
+        raise NotImplementedError
+
+    def local_cid(self, pg: PG) -> str:
+        raise NotImplementedError
+
+    # -- acting-set helpers -------------------------------------------
+    def up_positions(self, pg: PG) -> list[int]:
+        """Acting-set positions whose OSD is currently up."""
+        osdmap = self.parent.get_osdmap()
+        out = []
+        for pos, osd in enumerate(pg.acting):
+            if osd < 0:
+                continue
+            info = osdmap.osds.get(osd)
+            if info is not None and info.up:
+                out.append(pos)
+        return out
+
+    def min_size_ok(self, pg: PG) -> bool:
+        return len(self.up_positions(pg)) >= self.pool.min_size
+
+
+def object_write_txn(cid: str, oid: str, data: bytes, version: int,
+                     attrs: dict[str, bytes] | None = None) -> Transaction:
+    """Write-full of one store object + its version attr (and extras),
+    all in one atomic txn."""
+    txn = Transaction()
+    txn.create_collection(cid)
+    txn.remove(cid, oid)
+    txn.touch(cid, oid)
+    if data:
+        txn.write(cid, oid, 0, data)
+    txn.setattr(cid, oid, "v", version.to_bytes(8, "little"))
+    for name, val in (attrs or {}).items():
+        txn.setattr(cid, oid, name, val)
+    return txn
+
+
+def object_remove_txn(cid: str, oid: str) -> Transaction:
+    txn = Transaction()
+    txn.create_collection(cid)
+    txn.remove(cid, oid)
+    return txn
+
+
+class ReplicatedBackend(PGBackend):
+    """Primary-copy replication (src/osd/ReplicatedBackend.{h,cc}):
+    the primary ships the whole mutation to every acting replica and
+    acks the client when all up replicas committed."""
+
+    def local_cid(self, pg: PG) -> str:
+        return pg_cid(pg.pool, pg.ps, NO_SHARD)
+
+    def _fan_out(self, pg: PG, oid: str, entry: LogEntry,
+                 txn_builder: Callable[[str], Transaction],
+                 on_commit: Callable[[int], None]) -> None:
+        cid = self.local_cid(pg)
+        kv, drop = pg.log.stage(entry)
+        positions = self.up_positions(pg)
+        tid = self.parent.new_tid()
+        iw = InflightWrite(tid, pg, oid, entry.version, set(positions),
+                           lambda: on_commit(0))
+        self.parent.register_write(iw)
+        epoch = self.parent.get_osdmap().epoch
+        for pos in positions:
+            osd = pg.acting[pos]
+            txn = txn_builder(cid)
+            pg.log.apply_to_txn(txn, cid, kv, drop)
+            if osd == self.parent.whoami:
+                self.parent.queue_local_txn(
+                    txn,
+                    lambda p=pos: iw.complete(p) and iw.on_all_commit())
+            else:
+                self.parent.send_osd(osd, M.MECSubWrite(
+                    tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
+                    epoch=epoch, oid=oid, version=entry.version,
+                    txn_bytes=txn.encode()))
+
+    def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
+                     on_commit: Callable[[int], None]) -> None:
+        entry = LogEntry(version, LOG_WRITE, oid)
+        self._fan_out(
+            pg, oid, entry,
+            lambda cid: object_write_txn(cid, oid, data, version),
+            on_commit)
+
+    def submit_remove(self, pg: PG, oid: str, version: int,
+                      on_commit: Callable[[int], None]) -> None:
+        entry = LogEntry(version, LOG_REMOVE, oid)
+        self._fan_out(pg, oid, entry,
+                      lambda cid: object_remove_txn(cid, oid), on_commit)
+
+    def read_object(self, pg: PG, oid: str) -> bytes:
+        return self.parent.store.read(self.local_cid(pg), oid)
+
+    def stat_object(self, pg: PG, oid: str) -> int:
+        return self.parent.store.stat(self.local_cid(pg), oid)
+
+    def build_push(self, pg: PG, oid: str, shard: int, version: int,
+                   tid: int) -> M.MPGPush | None:
+        cid = self.local_cid(pg)
+        if shard >= len(pg.acting) or pg.acting[shard] < 0:
+            return None
+        if version == 0:       # shard missed a removal
+            return M.MPGPush(
+                pool=pg.pool, ps=pg.ps, shard=NO_SHARD, oid=oid,
+                version=0, data=b"", attrs={}, remove=True, tid=tid)
+        try:
+            data = self.parent.store.read(cid, oid)
+            attrs = self.parent.store.getattrs(cid, oid)
+        except StoreError:
+            log(1, f"recover {oid}: primary copy unreadable")
+            return None
+        return M.MPGPush(
+            pool=pg.pool, ps=pg.ps, shard=NO_SHARD, oid=oid,
+            version=version, data=data, attrs=dict(attrs), remove=False,
+            tid=tid)

@@ -215,25 +215,85 @@ class CrushMap:
                 continue
         return NONE
 
+    def _parent_index(self) -> dict[int, int]:
+        """child (device or bucket id) -> parent bucket id."""
+        parent: dict[int, int] = {}
+        for b in self.buckets.values():
+            for item in b.items:
+                parent[item] = b.id
+        return parent
+
+    def _domain_of(self, osd: int, domain: str,
+                   parent: dict[int, int]) -> int:
+        """Ancestor bucket id of ``osd`` with type ``domain`` (NONE if
+        no such ancestor)."""
+        node = parent.get(osd)
+        while node is not None:
+            bucket = self.buckets[node]
+            if bucket.type == domain:
+                return bucket.id
+            node = parent.get(node)
+        return NONE
+
     def do_rule(self, rule_name: str, x: int, size: int,
                 down: set[int] | None = None) -> list[int]:
         """crush_do_rule: map input x to ``size`` devices under rule.
 
-        ``down`` devices are treated as out (rejected), triggering
-        re-draws — firstn fills past them, indep leaves NONE only when
-        tries exhaust."""
+        firstn (replication): ``down`` devices are rejected inline, so
+        the result fills past failures (later slots shift up).
+
+        indep (EC): position stability is the contract
+        (crush_choose_indep semantics, mapper.c) — pass 1 computes the
+        layout as if nothing were down, so healthy slots NEVER move
+        when a peer fails; pass 2 redraws only the failed slots,
+        excluding every kept device (and its failure domain). A slot
+        that cannot be refilled stays NONE so shard k keeps meaning
+        shard k."""
         rule = self.rules[rule_name]
         root = self.bucket_of(rule.root)
-        out: set[int] = set(down or ())
-        result: list[int] = []
-        taken: set[int] = set()
+        down = set(down or ())
+        if rule.mode != "indep":
+            out: set[int] = set(down)
+            result: list[int] = []
+            taken: set[int] = set()
+            for slot in range(size):
+                osd = self._descend(root, x, slot, rule.failure_domain,
+                                    out, taken)
+                if osd != NONE:
+                    out.add(osd)
+                    result.append(osd)
+            return result
+
+        # pass 1: stable layout, failures ignored
+        out = set()
+        taken = set()
+        result = []
         for slot in range(size):
-            osd = self._descend(root, x, slot, rule.failure_domain, out, taken)
+            osd = self._descend(root, x, slot, rule.failure_domain,
+                                out, taken)
+            result.append(osd)
             if osd != NONE:
                 out.add(osd)
-                result.append(osd)
-            elif rule.mode == "indep":
-                result.append(NONE)
+        if not down.intersection(result):
+            return result
+        # pass 2: redraw only the failed slots
+        kept = {o for o in result if o != NONE and o not in down}
+        taken2: set[int] = set()
+        if rule.failure_domain != "osd":
+            parent = self._parent_index()
+            for o in kept:
+                dom = self._domain_of(o, rule.failure_domain, parent)
+                if dom != NONE:
+                    taken2.add(dom)
+        out2 = set(kept) | down
+        for slot, osd in enumerate(result):
+            if osd == NONE or osd not in down:
+                continue
+            repl = self._descend(root, x, slot, rule.failure_domain,
+                                 out2, taken2)
+            result[slot] = repl
+            if repl != NONE:
+                out2.add(repl)
         return result
 
 

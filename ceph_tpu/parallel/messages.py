@@ -166,6 +166,9 @@ OSD_OP_WRITE_FULL = 1
 OSD_OP_READ = 2
 OSD_OP_REMOVE = 3
 OSD_OP_STAT = 4
+OSD_OP_WRITE = 5       # offset write (EC: RMW over the full object)
+OSD_OP_APPEND = 6
+OSD_OP_LIST = 7        # list objects of one PG (PGLS role)
 
 class MOSDOp(Message):
     MSG_TYPE = 20
@@ -209,26 +212,36 @@ class MECSubRead(Message):
 
 
 class MECSubReadReply(Message):
+    """``version`` is the shard's object version ("v" attr): the
+    primary only combines chunks that agree on it (a shard whose write
+    has not committed yet answers with the old version and the read
+    retries — the pipeline-ordering seat of ECBackend check_ops)."""
     MSG_TYPE = 33
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("oid", "str"), ("code", "i32"),
-              ("data", "bytes"), ("attrs", "bytes_map")]
+              ("data", "bytes"), ("attrs", "bytes_map"),
+              ("version", "u64")]
 
 
 # -- recovery (MOSDPGPush role) ----------------------------------------
 
 class MPGPush(Message):
-    """Primary -> shard during recovery: reconstructed chunk + attrs."""
+    """Primary -> shard during recovery: reconstructed chunk + attrs,
+    or a delete (``remove``) when the shard missed a removal. The
+    shard's pgmeta/log is NOT touched by a push; the primary ships a
+    separate log-sync txn once every push of the batch is acked (so a
+    lost push can never leave a shard that *looks* caught up)."""
     MSG_TYPE = 34
     FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
               ("oid", "str"), ("version", "u64"), ("data", "bytes"),
-              ("attrs", "bytes_map")]
+              ("attrs", "bytes_map"), ("remove", "bool"),
+              ("tid", "u64")]
 
 
 class MPGPushReply(Message):
     MSG_TYPE = 35
     FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
-              ("oid", "str"), ("committed", "bool")]
+              ("oid", "str"), ("committed", "bool"), ("tid", "u64")]
 
 
 # -- peering-lite (MOSDPGQuery/MOSDPGNotify role) ----------------------
@@ -237,12 +250,15 @@ class MPGQuery(Message):
     """Primary asks a shard holder what it has for a PG."""
     MSG_TYPE = 36
     FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
-              ("epoch", "u32")]
+              ("epoch", "u32"), ("tid", "u64")]
 
 
 class MPGNotify(Message):
-    """Shard's answer: objects it holds and their versions."""
+    """Shard's answer: objects it holds and their versions, plus how
+    far its pgmeta log got (``last_version``) so the primary can choose
+    log replay vs backfill."""
     MSG_TYPE = 37
     FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
               ("epoch", "u32"), ("objects", "str_list"),
-              ("versions", "u64_list")]
+              ("versions", "u64_list"), ("last_version", "u64"),
+              ("tid", "u64")]

@@ -1,0 +1,157 @@
+"""MiniCluster — the vstart.sh / ceph-helpers.sh role, in-process.
+
+Boots one mon + N OSDs (each a real daemon with its own messenger and
+store) in one Python process, the way qa/standalone tests boot many
+ceph-osd processes on one host. Helpers mirror ceph-helpers.sh:
+``create_ec_pool``, ``kill_osd``/``revive_osd``, ``wait_for_clean``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ceph_tpu.client.rados import RadosClient
+from ceph_tpu.osd.osd import OSD
+from ceph_tpu.parallel.mon import Monitor
+from ceph_tpu.store.object_store import create_store
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("qa")
+
+
+class MiniCluster:
+    def __init__(self, n_osds: int = 3, store: str = "memstore",
+                 data_dir: str | None = None) -> None:
+        self.n_osds = n_osds
+        self.store_kind = store
+        self.data_dir = data_dir
+        self.mon: Monitor | None = None
+        self.mon_addr = ""
+        self.osds: dict[int, OSD] = {}
+        self._stores: dict[int, object] = {}
+        self._clients: list[RadosClient] = []
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "MiniCluster":
+        self.mon = Monitor("a")
+        self.mon_addr = self.mon.start()
+        for i in range(self.n_osds):
+            self.start_osd(i)
+        self.wait_for_osds_up(timeout=15)
+        return self
+
+    def _make_store(self, osd_id: int):
+        if self.store_kind == "memstore":
+            return create_store("memstore")
+        path = f"{self.data_dir}/osd.{osd_id}"
+        return create_store(self.store_kind, path)
+
+    def start_osd(self, osd_id: int) -> OSD:
+        store = self._stores.get(osd_id) or self._make_store(osd_id)
+        self._stores[osd_id] = store
+        osd = OSD(osd_id, store, self.mon_addr)
+        osd.start()
+        self.osds[osd_id] = osd
+        return osd
+
+    def stop(self) -> None:
+        for client in self._clients:
+            client.shutdown()
+        self._clients.clear()
+        for osd in list(self.osds.values()):
+            osd.stop()
+        self.osds.clear()
+        if self.mon:
+            self.mon.stop()
+
+    def __enter__(self) -> "MiniCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- clients ------------------------------------------------------
+    def client(self) -> RadosClient:
+        c = RadosClient(self.mon_addr).connect()
+        self._clients.append(c)
+        return c
+
+    # -- helpers (ceph-helpers.sh roles) ------------------------------
+    def mon_cmd(self, **cmd) -> tuple[int, str, bytes]:
+        client = self._clients[0] if self._clients else self.client()
+        return client.mon_command(cmd)
+
+    def create_pool(self, name: str, pg_num: int = 8,
+                    size: int = 3) -> None:
+        code, outs, _ = self.mon_cmd(prefix="osd pool create", pool=name,
+                                     pg_num=pg_num, size=size)
+        assert code == 0, outs
+
+    def create_ec_pool(self, name: str, k: int = 2, m: int = 1,
+                       plugin: str = "jerasure", pg_num: int = 8,
+                       **profile_extra) -> None:
+        import json
+        profile = {"plugin": plugin, "k": str(k), "m": str(m),
+                   **{a: str(b) for a, b in profile_extra.items()}}
+        code, outs, _ = self.mon_cmd(
+            prefix="osd erasure-code-profile set", name=f"{name}_profile",
+            profile=json.dumps(profile))
+        assert code == 0, outs
+        code, outs, _ = self.mon_cmd(
+            prefix="osd pool create", pool=name, pg_num=pg_num,
+            erasure_code_profile=f"{name}_profile")
+        assert code == 0, outs
+
+    def kill_osd(self, osd_id: int) -> None:
+        """Hard-stop an OSD (Thrasher.kill_osd role): the daemon dies,
+        its store survives for revive."""
+        osd = self.osds.pop(osd_id)
+        osd.stop()
+        log(1, f"killed osd.{osd_id}")
+
+    def revive_osd(self, osd_id: int) -> OSD:
+        assert osd_id not in self.osds
+        osd = self.start_osd(osd_id)
+        log(1, f"revived osd.{osd_id}")
+        return osd
+
+    # -- waiting ------------------------------------------------------
+    def wait_for_osds_up(self, n: int | None = None,
+                         timeout: float = 15.0) -> None:
+        want = self.n_osds if n is None else n
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            up = sum(1 for o in self.mon.osdmap.osds.values() if o.up)
+            if up >= want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"only {up}/{want} osds up after {timeout}s")
+
+    def wait_for_osd_down(self, osd_id: int, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.mon.osdmap.osds.get(osd_id)
+            if info is not None and not info.up:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"osd.{osd_id} still up after {timeout}s")
+
+    def wait_for_clean(self, timeout: float = 30.0) -> None:
+        """All PGs of all pools recovered: every primary has empty
+        peer_missing (wait_for_clean role)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._is_clean():
+                return
+            time.sleep(0.1)
+        raise TimeoutError("cluster not clean")
+
+    def _is_clean(self) -> bool:
+        for osd in self.osds.values():
+            for pg in list(osd.pgs.values()):
+                if pg.state != pg.ACTIVE or pg.peer_missing:
+                    return False
+        return True
+
+    def epoch(self) -> int:
+        return self.mon.osdmap.epoch

@@ -208,6 +208,10 @@ for _o in [
     Option("ms_dispatch_throttle_bytes", int, 100 << 20, "advanced",
            "max in-dispatch message bytes before backpressure "
            "(Messenger policy throttler)"),
+    Option("osd_op_num_shards", int, 4, "advanced",
+           "worker shards of the OSD op queue (op_shardedwq role)"),
+    Option("objecter_resend_interval", float, 2.0, "advanced",
+           "client op resend period over the lossy messenger"),
     Option("osd_heartbeat_interval", float, 1.0, "advanced",
            "seconds between peer pings (scaled down from the reference's 6)"),
     Option("osd_heartbeat_grace", float, 4.0, "advanced",

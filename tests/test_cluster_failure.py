@@ -1,0 +1,116 @@
+"""Integration: failure handling — degraded reads, recovery, thrash.
+
+The qa/standalone test-erasure-code.sh "kill osds and read back" role
+plus thrash-lite (qa/tasks ceph_manager.Thrasher.kill_osd/revive_osd).
+These tests use their own cluster instances (they mutate membership).
+"""
+
+import os
+import time
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.utils.config import g_conf
+
+
+@pytest.fixture
+def fast_death():
+    """Tighten failure-detection knobs so kill->down takes ~2s."""
+    conf = g_conf()
+    old_int = conf["osd_heartbeat_interval"]
+    old_grace = conf["osd_heartbeat_grace"]
+    conf.set("osd_heartbeat_interval", 0.25)
+    conf.set("osd_heartbeat_grace", 1.0)
+    yield
+    conf.set("osd_heartbeat_interval", old_int)
+    conf.set("osd_heartbeat_grace", old_grace)
+
+
+def test_ec_degraded_read_and_recovery(fast_death):
+    with MiniCluster(n_osds=4) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("ec", k=2, m=1, pg_num=4)
+        io = rados.open_ioctx("ec")
+        blobs = {f"obj{i}": os.urandom(20_000 + i) for i in range(8)}
+        for oid, blob in blobs.items():
+            io.write_full(oid, blob)
+
+        victim = 1
+        epoch = cluster.epoch()
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim, timeout=30)
+        rados.wait_for_epoch(epoch + 1, timeout=10)
+
+        # degraded reads must still return every byte (decode path)
+        for oid, blob in blobs.items():
+            assert io.read(oid) == blob, f"degraded read of {oid}"
+
+        # writes while degraded
+        io.write_full("while_down", b"d" * 10_000)
+        assert io.read("while_down") == b"d" * 10_000
+
+        # revive: peering finds the stale shard, recovery pushes chunks
+        cluster.revive_osd(victim)
+        cluster.wait_for_osds_up(timeout=15)
+        # touch every pg so primaries re-peer promptly
+        for oid, blob in blobs.items():
+            assert io.read(oid) == blob
+        cluster.wait_for_clean(timeout=30)
+        for oid, blob in blobs.items():
+            assert io.read(oid) == blob
+
+
+def test_replicated_failover_to_new_primary(fast_death):
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_pool("rep", pg_num=4, size=3)
+        io = rados.open_ioctx("rep")
+        for i in range(6):
+            io.write_full(f"o{i}", f"payload-{i}".encode() * 100)
+
+        # kill one osd; every PG it was primary for moves to a replica
+        epoch = cluster.epoch()
+        cluster.kill_osd(0)
+        cluster.wait_for_osd_down(0, timeout=30)
+        rados.wait_for_epoch(epoch + 1, timeout=10)
+        for i in range(6):
+            assert io.read(f"o{i}") == f"payload-{i}".encode() * 100
+        # writes land on the new primaries
+        io.write_full("post_fail", b"x" * 500)
+        assert io.read("post_fail") == b"x" * 500
+
+        # revive; stale shard catches up (including ops it missed)
+        cluster.revive_osd(0)
+        cluster.wait_for_osds_up(timeout=15)
+        for i in range(6):
+            assert io.read(f"o{i}") == f"payload-{i}".encode() * 100
+        assert io.read("post_fail") == b"x" * 500
+        cluster.wait_for_clean(timeout=30)
+
+
+def test_removal_propagates_to_revived_osd(fast_death):
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_pool("rp", pg_num=2, size=3)
+        io = rados.open_ioctx("rp")
+        io.write_full("doomed", b"z" * 1000)
+        io.write_full("keeper", b"k" * 1000)
+
+        epoch = cluster.epoch()
+        cluster.kill_osd(2)
+        cluster.wait_for_osd_down(2, timeout=30)
+        rados.wait_for_epoch(epoch + 1, timeout=10)
+        io.remove("doomed")                 # osd.2 misses this
+
+        cluster.revive_osd(2)
+        cluster.wait_for_osds_up(timeout=15)
+        # trigger peering on all pgs
+        assert io.read("keeper") == b"k" * 1000
+        cluster.wait_for_clean(timeout=30)
+        # the revived osd must have dropped its stale copy
+        time.sleep(0.5)
+        store = cluster._stores[2]
+        for cid in store.list_collections():
+            if cid.startswith("pg_"):
+                assert "doomed" not in store.list_objects(cid), cid
