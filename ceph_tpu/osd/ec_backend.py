@@ -197,20 +197,29 @@ class ECBackend(PGBackend):
         retries until the shards agree (the ordering guarantee the
         reference gets from the ECBackend rmw pipeline + ExtentCache).
         """
-        avoid = set(avoid or ())
-        with pg.lock:
-            for pos, missing in pg.peer_missing.items():
-                if oid in missing:
-                    avoid.add(pos)
+        base_avoid = set(avoid or ())
         mypos = self.my_position(pg)
         enoent_everywhere = True
         for attempt in range(self.MAX_READ_ATTEMPTS):
+            # re-seed from peer_missing every attempt: a degraded
+            # object's entries drain as recovery pushes land, so a read
+            # that initially lacks enough shards waits for recovery
+            # (the reference blocks reads on degraded objects) instead
+            # of failing on the first try
+            avoid = set(base_avoid)
+            with pg.lock:
+                for pos, missing in pg.peer_missing.items():
+                    if oid in missing:
+                        avoid.add(pos)
             available = [p for p in self.up_positions(pg)
                          if p not in avoid]
             try:
                 plan = self.codec.minimum_to_decode(
                     want_chunks, available)
             except Exception:
+                if attempt < self.MAX_READ_ATTEMPTS - 1:
+                    time.sleep(0.1 * (attempt + 1))
+                    continue
                 if enoent_everywhere and attempt > 0:
                     raise NoSuchObject(oid)
                 raise ECReadError(
@@ -242,10 +251,10 @@ class ECBackend(PGBackend):
                         attrs = attrs or local_attrs
                         enoent_everywhere = False
                     except NoSuchObject:
-                        avoid.add(mypos)
+                        base_avoid.add(mypos)
                     except StoreError:
                         enoent_everywhere = False
-                        avoid.add(mypos)
+                        base_avoid.add(mypos)
                 replies = wait.wait(SUBOP_TIMEOUT) if remote else {}
             finally:
                 self.parent.unregister_wait(tid)
@@ -264,7 +273,7 @@ class ECBackend(PGBackend):
                     attrs = dict(rep.attrs)
             missing_reads = set(need) - set(results)
             if missing_reads:
-                avoid |= failed | missing_reads
+                base_avoid |= failed | missing_reads
                 continue
             if len(set(vers.values())) > 1:
                 # a shard is mid-commit: back off and re-read; do NOT
@@ -314,10 +323,11 @@ class ECBackend(PGBackend):
                    tid: int) -> M.MPGPush | None:
         if shard >= len(pg.acting) or pg.acting[shard] < 0:
             return None
-        if version == 0:     # missed removal
+        if version <= 0:     # missed removal (removal log v = -version)
             return M.MPGPush(
                 pool=pg.pool, ps=pg.ps, shard=shard, oid=oid,
-                version=0, data=b"", attrs={}, remove=True, tid=tid)
+                version=-version, data=b"", attrs={}, remove=True,
+                tid=tid)
         try:
             chunks, attrs = self._read_shards(
                 pg, oid, [shard], avoid={shard})
@@ -330,22 +340,121 @@ class ECBackend(PGBackend):
             decoded = ec_util.decode(
                 self.sinfo, self.codec, chunks, [shard])
             chunk = decoded[shard]
-        push_attrs = {"v": version.to_bytes(8, "little")}
+        # push the version the surviving shards actually agree on: the
+        # wanted version may have been superseded by a later write
+        # (actual_v higher) or may never have committed anywhere (every
+        # sub-op of that write lost — actual_v lower). Pushing what
+        # survives is right in both cases: the push guard refuses it if
+        # the target is already newer, and a target behind converges to
+        # the cluster-wide surviving state (the unacked write's client
+        # resends).
+        actual_v = int.from_bytes(attrs.get("v", b""), "little")
+        if actual_v < version:
+            log(1, f"recover {oid} shard {shard}: shards at v"
+                f"{actual_v} < wanted v{version}; pushing surviving "
+                "state (the wanted write never fully committed)")
+        push_attrs = {"v": actual_v.to_bytes(8, "little")}
         for name in ("sz", "hinfo"):
             if name in attrs:
                 push_attrs[name] = attrs[name]
         return M.MPGPush(
             pool=pg.pool, ps=pg.ps, shard=shard, oid=oid,
-            version=version, data=np.asarray(chunk).tobytes(),
+            version=actual_v, data=np.asarray(chunk).tobytes(),
             attrs=push_attrs, remove=False, tid=tid)
+
+    def recover_rollback(self, pg: PG, oid: str, wanted: int
+                         ) -> dict[int, M.MPGPush] | None:
+        """EC log rollback (ecbackend.rst:9-26 role): a write that never
+        reached k shards can neither be acked (the client saw a timeout)
+        nor reconstructed — recovery would retry it forever. Probe every
+        up shard; if no version >= wanted has k chunks, rewrite the
+        object on EVERY up shard at the newest version that does (same
+        version label as the dead write, so the push guard accepts it
+        everywhere and peering sees a consistent object), or remove the
+        partial chunks entirely if no version ever reached k."""
+        positions = self.up_positions(pg)
+        if len(positions) < len(pg.acting) or \
+                any(o < 0 for o in pg.acting):
+            # a down shard may hold chunks we cannot see: rolling back
+            # on partial visibility could destroy an acked object.
+            # Defer until the acting set is whole (recovery retries).
+            return None
+        tid = self.parent.new_tid()
+        wait = SubOpWait(set(positions))
+        self.parent.register_wait(tid, wait)
+        for pos in positions:
+            self.parent.send_osd(pg.acting[pos], M.MECSubRead(
+                tid=tid, pool=pg.pool, ps=pg.ps, shard=pos, oid=oid,
+                offset=0, length=0, want_attrs=True))
+        replies = wait.wait(SUBOP_TIMEOUT)
+        self.parent.unregister_wait(tid)
+        vers: dict[int, list[int]] = {}      # version -> holders
+        chunks: dict[int, np.ndarray] = {}
+        attrs_by_pos: dict[int, dict] = {}
+        for pos in positions:
+            rep = replies.get(pos)
+            if rep is None:
+                return None      # a shard's state is unknown: no guess
+            if rep.code == -2:
+                continue         # absent here
+            if rep.code != 0:
+                continue         # EIO: unusable shard, scrub's business
+            vers.setdefault(rep.version, []).append(pos)
+            chunks[pos] = np.frombuffer(rep.data, dtype=np.uint8)
+            attrs_by_pos[pos] = dict(rep.attrs)
+        usable = [v for v, poss in vers.items() if len(poss) >= self.k]
+        if usable and max(usable) >= wanted:
+            return None          # reconstructible: normal path handles
+        # label every rewrite with the highest version any shard holds,
+        # so the push guard accepts it on the ahead shards too
+        label = max([wanted] + list(vers))
+
+        def mk(pos: int, data: bytes, attrs: dict,
+               remove: bool) -> M.MPGPush:
+            return M.MPGPush(pool=pg.pool, ps=pg.ps, shard=pos, oid=oid,
+                             version=label, data=data, attrs=attrs,
+                             remove=remove, tid=0)
+
+        if not usable:
+            # no version ever reached k chunks: the object cannot exist
+            # — roll back to nonexistence wherever a partial chunk sits
+            log(1, f"{pg}: {oid} has no version with k={self.k} "
+                "chunks; rolling back to nonexistence")
+            return {pos: mk(pos, b"", {}, True)
+                    for poss in vers.values() for pos in poss}
+        best = max(usable)
+        have = {p: chunks[p] for p in vers[best]}
+        size = int.from_bytes(
+            attrs_by_pos[vers[best][0]].get("sz", b""), "little")
+        want_data = list(range(self.k))
+        if all(i in have for i in want_data):
+            data_chunks = {i: have[i] for i in want_data}
+        else:
+            data_chunks = ec_util.decode(self.sinfo, self.codec,
+                                         have, want_data)
+        logical = self._chunks_to_logical(data_chunks, size)
+        padded = self._pad(bytes(logical))
+        shards = ec_util.encode(self.sinfo, self.codec, padded)
+        hinfo = HashInfo(self.n)
+        hinfo.append(0, shards)
+        attrs = {"sz": size.to_bytes(8, "little"),
+                 "hinfo": json.dumps(hinfo.to_dict()).encode()}
+        log(1, f"{pg}: rolling back {oid} to content of v{best} "
+            f"(labelled v{label}) on positions {positions}")
+        return {pos: mk(pos, shards[pos].tobytes(), attrs, False)
+                for pos in positions}
 
     # -- shard-side read service (handle_sub_read role) ---------------
     @staticmethod
-    def serve_sub_read(store, msg: M.MECSubRead) -> M.MECSubReadReply:
+    def serve_sub_read(store, msg: M.MECSubRead,
+                       cid: str | None = None) -> M.MECSubReadReply:
         """Runs on the shard OSD: read + hinfo crc verify
-        (ECBackend.cc:955-1051)."""
+        (ECBackend.cc:955-1051). ``csum_only`` serves scrub: return
+        (version, crc) without the data and WITHOUT the hinfo gate —
+        scrub wants the raw observation, not a -EIO verdict."""
         from ceph_tpu.utils import checksum
-        cid = pg_cid(msg.pool, msg.ps, msg.shard)
+        if cid is None:
+            cid = pg_cid(msg.pool, msg.ps, msg.shard)
         reply = M.MECSubReadReply(
             tid=msg.tid, pool=msg.pool, ps=msg.ps, shard=msg.shard,
             oid=msg.oid, code=0, data=b"", attrs={})
@@ -354,6 +463,11 @@ class ECBackend(PGBackend):
             data = store.read(cid, msg.oid, msg.offset, length)
             attrs = store.getattrs(cid, msg.oid)
             reply.version = int.from_bytes(attrs.get("v", b""), "little")
+            if msg.csum_only:
+                reply.crc = checksum.crc32c(data, ec_util.HINFO_SEED)
+                if msg.want_attrs:
+                    reply.attrs = dict(attrs)
+                return reply
             hraw = attrs.get("hinfo")
             if hraw and msg.offset == 0 and not msg.length:
                 hinfo = HashInfo.from_dict(json.loads(hraw))

@@ -28,11 +28,14 @@ import queue
 import threading
 import time
 
+from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.ec_backend import ECBackend
 from ceph_tpu.osd.pg import (
+    LOG_REMOVE,
     NO_SHARD,
     PG,
     PGMETA,
+    LogEntry,
     PGLog,
     pg_cid,
     read_shard_info,
@@ -146,11 +149,18 @@ class OSD:
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         self._stopping = False
-        self.logger = self._make_perf(osd_id)
+        self._perf_name = f"osd.{osd_id}"
+        try:
+            self.logger = self._make_perf(self._perf_name)
+        except ValueError:
+            # same osd id alive in another in-process cluster (qa runs
+            # several MiniClusters side by side): disambiguate
+            self._perf_name = f"osd.{osd_id}.{id(self):x}"
+            self.logger = self._make_perf(self._perf_name)
 
     @staticmethod
-    def _make_perf(osd_id: int) -> PerfCounters:
-        perf = collection().create(f"osd.{osd_id}")
+    def _make_perf(name: str) -> PerfCounters:
+        perf = collection().create(name)
         perf.add_u64_counter("op", "client ops")
         perf.add_u64_counter("op_w", "client writes")
         perf.add_u64_counter("op_r", "client reads")
@@ -182,7 +192,7 @@ class OSD:
         self.reader_wq.drain_stop()
         self.msgr.shutdown()
         self.store.umount()
-        collection().remove(f"osd.{self.whoami}")
+        collection().remove(self._perf_name)
 
     # -- Listener interface (what backends use) -----------------------
     def get_osdmap(self) -> OSDMap:
@@ -234,7 +244,16 @@ class OSD:
     # -- map handling --------------------------------------------------
     def _on_map(self, newmap: OSDMap) -> None:
         with self._map_lock:
-            self.osdmap = newmap
+            oldmap, self.osdmap = self.osdmap, newmap
+        # a peer that (re)booted gets a fresh heartbeat grace window:
+        # without this, a down->up map pair arriving between two ticks
+        # leaves the pre-kill silence clock running and we'd report the
+        # reborn daemon failed with the NEW epoch (re-killing it)
+        for osd, info in newmap.osds.items():
+            old = oldmap.osds.get(osd) if oldmap else None
+            if info.up and (old is None or not old.up
+                            or old.addr != info.addr):
+                self._hb_last_rx.pop(osd, None)
         # writes waiting on now-dead shards complete on survivors.
         # NOTE: this runs on the messenger event loop — it must never
         # block (no pg.lock, which peering holds for seconds); the
@@ -355,7 +374,15 @@ class OSD:
         self.store.queue_transaction(txn, committed)
 
     def _handle_sub_read(self, msg: M.MECSubRead, conn: Connection) -> None:
-        conn.send_message(ECBackend.serve_sub_read(self.store, msg))
+        # msg.shard is the acting position; replicated PGs store in the
+        # unsharded collection (scrub fans csum reads over replicas)
+        osdmap = self.get_osdmap()
+        pool = osdmap.pools.get(msg.pool) if osdmap else None
+        shard = msg.shard if (pool is not None and pool.is_ec) \
+            else NO_SHARD
+        cid = pg_cid(msg.pool, msg.ps, shard)
+        conn.send_message(
+            ECBackend.serve_sub_read(self.store, msg, cid))
 
     def _handle_pg_query(self, msg: M.MPGQuery, conn: Connection) -> None:
         # msg.shard is the acting-set POSITION (a routing tag echoed in
@@ -365,15 +392,38 @@ class OSD:
         shard = msg.shard if (pool is not None and pool.is_ec) \
             else NO_SHARD
         cid = pg_cid(msg.pool, msg.ps, shard)
-        last_version, objects = read_shard_info(self.store, cid)
+        shard_log = PGLog.load(self.store, cid)
+        last_version, objects = read_shard_info(self.store, cid,
+                                                log=shard_log)
+        ents = [shard_log.entries[v] for v in sorted(shard_log.entries)]
         oids = sorted(objects)
         conn.send_message(M.MPGNotify(
             pool=msg.pool, ps=msg.ps, shard=msg.shard, epoch=msg.epoch,
             objects=oids, versions=[objects[o] for o in oids],
-            last_version=last_version, tid=msg.tid))
+            last_version=last_version, tid=msg.tid,
+            log_versions=[e.version for e in ents],
+            log_ops=[e.op for e in ents],
+            log_oids=[e.oid for e in ents]))
 
     def _handle_pg_push(self, msg: M.MPGPush, conn: Connection) -> None:
         cid = pg_cid(msg.pool, msg.ps, msg.shard)
+        # never let a stale push clobber newer committed state (a
+        # recovery round built from pre-write reads could arrive after
+        # the write's own sub-op); equal versions DO apply — that is
+        # how scrub repairs a wrong-data-right-version shard
+        try:
+            existing_v = int.from_bytes(
+                self.store.getattr(cid, msg.oid, "v"), "little")
+        except StoreError:
+            existing_v = -1
+        if existing_v > msg.version:
+            # refuse honestly: the primary keeps the object in
+            # peer_missing, and the next peering round pulls OUR newer
+            # copy instead of pretending the push repaired us
+            conn.send_message(M.MPGPushReply(
+                pool=msg.pool, ps=msg.ps, shard=msg.shard, oid=msg.oid,
+                committed=False, tid=msg.tid))
+            return
         if msg.remove:
             txn = Transaction()
             txn.create_collection(cid)
@@ -561,9 +611,12 @@ class OSD:
         # own shard state
         my_cid = pg_cid(pg.pool, pg.ps, shard_of(mypos))
         pg.log = PGLog.load(self.store, my_cid)
-        my_lv, my_objects = read_shard_info(self.store, my_cid)
-        infos: dict[int, tuple[int, dict[str, int]]] = {
-            mypos: (pg.log.last_version, my_objects)}
+        my_lv, my_objects = read_shard_info(self.store, my_cid,
+                                            log=pg.log)
+        # pos -> (last_version, {oid: v}, [LogEntry])
+        infos: dict[int, tuple] = {
+            mypos: (pg.log.last_version, my_objects,
+                    list(pg.log.entries.values()))}
 
         # query the other up acting shards
         remote = [p for p in be.up_positions(pg) if p != mypos]
@@ -584,7 +637,10 @@ class OSD:
                     silent.append(pos)
                     continue
                 infos[pos] = (rep.last_version,
-                              dict(zip(rep.objects, rep.versions)))
+                              dict(zip(rep.objects, rep.versions)),
+                              [LogEntry(v, op, oid) for v, op, oid in
+                               zip(rep.log_versions, rep.log_ops,
+                                   rep.log_oids)])
             if silent:
                 # an unheard shard may hold STALE data; treating it as
                 # caught-up would let reads mix old chunks into a
@@ -596,23 +652,94 @@ class OSD:
                 self._schedule_repeer(pg)
                 return
 
-        # authority = shard that saw the most committed ops
+        # authority = shard that saw the most committed ops; but all
+        # per-object decisions use the MERGED survivor log, so a shard
+        # whose last_version raced ahead (later writes while an old
+        # push was pending) can never cause an acked object's deletion
         auth_pos = max(infos, key=lambda p: infos[p][0])
-        auth_lv, auth_objects = infos[auth_pos]
-        pg.log.last_version = max(pg.log.last_version, auth_lv)
+        auth_lv, auth_objects, auth_entries = infos[auth_pos]
+        auth_tail = min((e.version for e in auth_entries),
+                        default=auth_lv)
+        # log-vs-backfill split (doc/dev/osd_internals/pg.rst): a shard
+        # whose log ends below the authority's tail cannot replay the
+        # gap — the entries that would bridge it were trimmed — and its
+        # own entries describe possibly-since-removed objects; merging
+        # them would resurrect acked deletions. Such shards are
+        # BACKFILLED: their logs are ignored and the authority's
+        # listing is the truth for them.
+        backfill = {pos for pos, (lv, _, _) in infos.items()
+                    if lv < auth_tail - 1}
+        merged: dict[int, LogEntry] = {}
+        for pos, (_, _, entries) in infos.items():
+            if pos in backfill:
+                continue
+            for ent in entries:
+                merged.setdefault(ent.version, ent)
+        pg.log.entries = merged
+        if merged:
+            pg.log.tail = min(merged)
+        pg.log.last_version = max(auth_lv, max(merged, default=0))
 
-        # per-shard missing/stale/extra objects
+        # latest merged log entry per object = the truth for it
+        latest: dict[str, LogEntry] = {}
+        for v in sorted(merged):
+            ent = merged[v]
+            latest[ent.oid] = ent
+
         pg.peer_missing = {}
-        for pos, (lv, objects) in infos.items():
+        pg.rollback_pending.clear()
+        for pos, (lv, objects, _) in infos.items():
             missing: dict[str, int] = {}
+            if pos in backfill:
+                # authority listing overlaid with the surviving log
+                truth = dict(auth_objects)
+                for oid, ent in latest.items():
+                    if ent.op == LOG_REMOVE:
+                        truth.pop(oid, None)
+                    else:
+                        truth[oid] = ent.version
+                for oid, v in truth.items():
+                    if objects.get(oid, 0) != v:
+                        missing[oid] = v
+                for oid in objects:
+                    if oid not in truth:
+                        # object the truth doesn't hold on a log-gapped
+                        # shard: a trimmed removal — delete it (any
+                        # racing new write carries version > auth_lv
+                        # and survives the push guard)
+                        missing[oid] = -max(auth_lv, 1)
+                if missing:
+                    pg.peer_missing.setdefault(pos, {}).update(missing)
+                continue
+            for oid, ent in latest.items():
+                have_v = objects.get(oid, 0)
+                if ent.op == LOG_REMOVE:
+                    if oid in objects:
+                        # missed the removal; negative version marks a
+                        # delete-push carrying the removal's log version
+                        # so the push guard can order it vs later writes
+                        missing[oid] = -ent.version
+                elif have_v != ent.version:
+                    missing[oid] = ent.version
+            # objects older than every surviving log (stable ancient
+            # data): push to shards that lack them, NEVER delete on a
+            # bare listing difference
             for oid, v in auth_objects.items():
-                if objects.get(oid, 0) != v:
+                if oid not in latest and objects.get(oid, 0) != v:
                     missing[oid] = v
-            for oid in objects:
-                if oid not in auth_objects and lv < auth_lv:
-                    missing[oid] = 0          # missed a removal
+            for oid, v in objects.items():
+                if oid not in latest and oid not in auth_objects:
+                    # a survivor holds data the authority never saw and
+                    # no log explains: resurrect it everywhere
+                    for other, (_, other_objs, _) in infos.items():
+                        if other != pos and other_objs.get(oid, 0) < v:
+                            pg.peer_missing.setdefault(
+                                other, {})[oid] = v
             if missing:
-                pg.peer_missing[pos] = missing
+                pg.peer_missing.setdefault(pos, {}).update(missing)
+        if backfill:
+            log(1, f"{pg}: backfilling positions {sorted(backfill)} "
+                f"(logs end below authority tail {auth_tail})")
         # acting positions that answered nothing stay unknown: retried
         # on the next map change / op
         pg.state = PG.ACTIVE
@@ -621,6 +748,257 @@ class OSD:
         self._flush_waiting(pg)
         if pg.peer_missing:
             self.op_wq.enqueue(pg.pgid, lambda: self._recover(pg))
+
+    # -- scrub (PGBackend::be_compare_scrubmaps role) -----------------
+    def scrub_pg(self, pgid: tuple[int, int], repair: bool = True,
+                 timeout: float = 60.0) -> dict:
+        """Primary-side scrub of one PG: fan checksum reads over every
+        up shard of every object, compare against the authoritative
+        hinfo (EC) or the self-validating replica crcs (replicated),
+        and optionally repair divergent shards through the recovery
+        path. Blocking external entry (harness/admin socket); the work
+        runs on its own thread — scrub fan-outs can block for many
+        SUBOP_TIMEOUTs and must not occupy an op_wq worker (client ops
+        for unrelated PGs hash onto the same shards)."""
+        done = threading.Event()
+        result: dict = {}
+
+        def run() -> None:
+            try:
+                result.update(self._do_scrub(pgid, repair))
+            except Exception as exc:          # surface, don't vanish
+                result["error"] = repr(exc)
+            finally:
+                done.set()
+
+        threading.Thread(target=run, name=f"scrub-{pgid}",
+                         daemon=True).start()
+        if not done.wait(timeout):
+            raise TimeoutError(f"scrub of pg {pgid} timed out")
+        return result
+
+    def _do_scrub(self, pgid: tuple[int, int], repair: bool) -> dict:
+        pool_id, ps = pgid
+        osdmap = self.get_osdmap()
+        _, acting, primary = osdmap.pg_to_up_acting(pool_id, ps)
+        if primary != self.whoami:
+            return {"error": "not primary"}
+        with self._pgs_lock:
+            pg = self.pgs.get(pgid)
+            if pg is None:
+                # a PG that served no op since failover still needs
+                # scrubbing: instantiate + peer it on demand
+                pg = PG(pool_id, ps)
+                pg.backend = self.backend_for(pool_id)
+                self.pgs[pgid] = pg
+        with pg.lock:
+            if pg.state == PG.CREATED:
+                pg.acting = list(acting)
+                pg.epoch = osdmap.epoch
+                self._peer(pg)
+            if pg.state != PG.ACTIVE:
+                return {"error": "pg not active here"}
+        listing = self._scrub_listing(pg)
+        with pg.lock:
+            latest: dict[str, int] = {}
+            for v in sorted(pg.log.entries):
+                latest[pg.log.entries[v].oid] = pg.log.entries[v].op
+        inconsistent: dict[str, list[int]] = {}
+        repairable: dict[str, list[int]] = {}
+        for oid in listing:
+            if latest.get(oid) == LOG_REMOVE:
+                # the log says this object is deleted: a lingering
+                # copy is recovery's cleanup, not an inconsistency
+                # to "repair" back into existence
+                continue
+            bad, auth_version = self._scrub_object(pg, oid)
+            if not bad:
+                continue
+            inconsistent[oid] = sorted(bad)
+            if repair and auth_version > 0:
+                # auth_version 0 = no shard produced a judgeable copy
+                # (all EIO): report unrepairable, and never push a
+                # version-0 entry that build_push would read as removal
+                repairable[oid] = sorted(bad)
+                with pg.lock:
+                    for pos in bad:
+                        pg.peer_missing.setdefault(pos, {})[
+                            oid] = auth_version
+        out = {"objects": len(listing),
+               "inconsistent": inconsistent, "repaired": []}
+        if repair and repairable:
+            self._repair_primary_copies(pg, repairable)
+            # the heartbeat's _kick_recovery may already be running a
+            # round (in which case _recover returns immediately): keep
+            # kicking until the repair targets drain or time runs out,
+            # and judge "repaired" from peer_missing, not from one
+            # round's acks
+            deadline = time.monotonic() + SUBOP_TIMEOUT * 4
+            while time.monotonic() < deadline:
+                self._recover(pg)
+                with pg.lock:
+                    pending = [
+                        oid for oid, bad in repairable.items()
+                        if any(oid in pg.peer_missing.get(pos, {})
+                               for pos in bad)]
+                if not pending:
+                    break
+                time.sleep(0.05)
+            with pg.lock:
+                out["repaired"] = [
+                    oid for oid, bad in repairable.items()
+                    if all(oid not in pg.peer_missing.get(pos, {})
+                           for pos in bad)]
+        return out
+
+    def _scrub_listing(self, pg: PG) -> list[str]:
+        """Union of every up shard's object listing (the reference
+        builds scrubmaps from EVERY shard and compares them,
+        be_compare_scrubmaps): an object present only on a replica —
+        stale leftover, or lost from the primary — still gets judged."""
+        oids = set(self._list_pg(pg))
+        positions = [p for p in pg.backend.up_positions(pg)
+                     if pg.acting[p] != self.whoami]
+        if positions:
+            tid = self.new_tid()
+            wait = SubOpWait(set(positions))
+            self.register_wait(tid, wait)
+            for pos in positions:
+                self.send_osd(pg.acting[pos], M.MPGQuery(
+                    pool=pg.pool, ps=pg.ps, shard=pos,
+                    epoch=pg.epoch, tid=tid))
+            replies = wait.wait(SUBOP_TIMEOUT)
+            self.unregister_wait(tid)
+            for rep in replies.values():
+                oids.update(rep.objects)
+        return sorted(oids)
+
+    SCRUB_ATTEMPTS = 3
+
+    def _scrub_object(self, pg: PG, oid: str
+                      ) -> tuple[set[int], int]:
+        """Compare one object across shards; returns (bad positions,
+        authoritative version).
+
+        Scrub runs ONLINE, so the observation can race an in-flight
+        write or removal. Two defenses: (a) version disagreement is
+        retried, and never by itself convicts a shard — a laggard
+        mid-commit shard is catching up, not corrupt (missed-write
+        divergence is peering's job, via the log); (b) conviction
+        requires SELF-inconsistency — computed crc mismatching the
+        shard's own stored hinfo (EC) / crc attr (replicated) — or a
+        read error (EIO / unexpected ENOENT)."""
+        be = pg.backend
+        is_ec = isinstance(be, ECBackend)
+        for attempt in range(self.SCRUB_ATTEMPTS):
+            positions = be.up_positions(pg)
+            tid = self.new_tid()
+            wait = SubOpWait(set(positions))
+            self.register_wait(tid, wait)
+            for pos in positions:
+                self.send_osd(pg.acting[pos], M.MECSubRead(
+                    tid=tid, pool=pg.pool, ps=pg.ps, shard=pos, oid=oid,
+                    offset=0, length=0, want_attrs=True, csum_only=True))
+            replies = wait.wait(SUBOP_TIMEOUT)
+            self.unregister_wait(tid)
+
+            obs: dict[int, tuple[int, int, dict]] = {}  # pos->(v,crc,attrs)
+            bad: set[int] = set()
+            enoent: set[int] = set()
+            for pos in positions:
+                rep = replies.get(pos)
+                if rep is None:
+                    continue           # silent shard: not judged
+                if rep.code == -2:
+                    enoent.add(pos)
+                    continue
+                if rep.code != 0:
+                    bad.add(pos)       # EIO
+                    continue
+                obs[pos] = (rep.version, rep.crc, dict(rep.attrs))
+            vers = {v for v, _, _ in obs.values()}
+            settled = len(vers) <= 1 and not (obs and enoent)
+            if settled or attempt == self.SCRUB_ATTEMPTS - 1:
+                break
+            time.sleep(0.05 * (attempt + 1))   # mid-write: re-observe
+
+        if not obs:
+            # nothing judgeable: all-ENOENT = concurrently removed (or
+            # never existed here) — clean; EIO-everywhere = bad but
+            # unrepairable (auth 0 ⇒ caller won't push)
+            return bad, 0
+        # shards that still lack the object while others hold it
+        bad |= enoent
+        auth_version = 0
+        if is_ec:
+            # each shard carries the full hinfo vector; a shard whose
+            # chunk crc mismatches its OWN stored hinfo is corrupt
+            clean: dict[int, int] = {}
+            for pos, (v, crc, attrs) in obs.items():
+                try:
+                    hinfo = ec_util.HashInfo.from_dict(
+                        json.loads(attrs.get("hinfo", b"")))
+                    ok = crc == hinfo.get_chunk_hash(pos)
+                except (ValueError, KeyError, TypeError):
+                    ok = False         # unparseable hinfo: corrupt
+                if ok:
+                    clean[pos] = v
+                else:
+                    bad.add(pos)
+            if clean:
+                auth_version = max(clean.values())
+        else:
+            # a replica whose computed crc mismatches the crc stored at
+            # write time convicts itself — no vote needed, which is what
+            # saves a size=2 pool from electing the corrupt copy
+            clean = {}
+            for pos, (v, crc, attrs) in obs.items():
+                stored = attrs.get("crc")
+                if stored is not None and \
+                        int.from_bytes(stored, "little") != crc:
+                    bad.add(pos)
+                else:
+                    clean[pos] = v
+            if clean:
+                # deepest self-consistent version is the authority
+                # (be_select_auth_object prefers deepest version)
+                auth_version = max(clean.values())
+        if bad:
+            log(1, f"{pg}: scrub found {oid} inconsistent at "
+                f"positions {sorted(bad)}")
+        return bad, auth_version
+
+    def _repair_primary_copies(self, pg: PG,
+                               inconsistent: dict[str, list[int]]) -> None:
+        """Replicated repair reads the PRIMARY copy; if the primary's
+        own copy is the bad one, pull a good replica's first (the bad
+        positions are already in peer_missing, so _pull_copy skips
+        them as donors)."""
+        be = pg.backend
+        if isinstance(be, ECBackend):
+            return                      # EC reconstructs around any shard
+        mypos = pg.acting.index(self.whoami) \
+            if self.whoami in pg.acting else -1
+        for oid, bad in inconsistent.items():
+            if mypos not in bad:
+                continue
+            with pg.lock:
+                want = pg.peer_missing.get(mypos, {}).get(oid, 1)
+            data, attrs, version = be._pull_copy(
+                pg, oid, max(want, 1), exclude={mypos})
+            if data is None:
+                continue
+            cid = be.local_cid(pg)
+            txn = object_write_txn(
+                cid, oid, data, version,
+                attrs={k: v for k, v in attrs.items() if k != "v"})
+            self.queue_local_txn(txn, lambda: None)
+            with pg.lock:
+                missing = pg.peer_missing.get(mypos)
+                if missing:
+                    missing.pop(oid, None)
+                    if not missing:
+                        pg.peer_missing.pop(mypos, None)
 
     def _schedule_repeer(self, pg: PG, delay: float = 0.5) -> None:
         def retry() -> None:
@@ -636,14 +1014,38 @@ class OSD:
         timer.start()
 
     # -- recovery (continue_recovery_op role) -------------------------
-    def _recover(self, pg: PG) -> None:
+    def _recover(self, pg: PG) -> dict[int, list[str]]:
+        acked_by_pos: dict[int, list[str]] = {}
         with pg.lock:
-            if pg.state != PG.ACTIVE or not pg.peer_missing:
-                return
+            # prune positions whose missing set emptied (e.g. a
+            # full-shard write superseded the recovery)
+            for pos in [p for p, m in pg.peer_missing.items() if not m]:
+                del pg.peer_missing[pos]
+            if pg.state != PG.ACTIVE or not pg.peer_missing \
+                    or pg.recovery_in_flight:
+                return acked_by_pos
+            pg.recovery_in_flight = True
             work = {pos: dict(missing)
                     for pos, missing in pg.peer_missing.items()}
+            # snapshot: a peering mid-round swaps which OSD holds a
+            # position and recomputes peer_missing; a stale round must
+            # neither push to the new holder as if it were the old one
+            # nor clear entries the new peering computed
+            acting = list(pg.acting)
+            epoch = pg.epoch
+        try:
+            self._recover_work(pg, work, acked_by_pos, acting, epoch)
+        finally:
+            with pg.lock:
+                pg.recovery_in_flight = False
+        return acked_by_pos
+
+    def _recover_work(self, pg: PG, work: dict[int, dict[str, int]],
+                      acked_by_pos: dict[int, list[str]],
+                      acting: list[int], epoch: int) -> None:
+        unrebuildable: dict[str, int] = {}    # oid -> wanted version
         for pos, missing in work.items():
-            osd = pg.acting[pos] if pos < len(pg.acting) else -1
+            osd = acting[pos] if pos < len(acting) else -1
             if osd < 0:
                 continue
             tid = self.new_tid()
@@ -659,7 +1061,12 @@ class OSD:
                     push = None
                 if push is None:
                     wait.drop(oid)
+                    if version > 0:
+                        unrebuildable[oid] = max(
+                            unrebuildable.get(oid, 0), version)
                     continue
+                with pg.lock:
+                    pg.rollback_pending.pop(oid, None)
                 if osd == self.whoami:
                     # apply inline (we run on this PG's wq thread; the
                     # self-reply completes the wait synchronously)
@@ -670,50 +1077,126 @@ class OSD:
             self.unregister_wait(tid)
             acked = [oid for oid, rep in replies.items()
                      if getattr(rep, "committed", False)]
+            acked_by_pos[pos] = acked
             # the shard's pgmeta only advances once every pushed object
             # is acked durable — a lost push leaves it visibly behind,
             # so the next peering retries instead of trusting it
             if set(acked) == set(missing):
-                self._log_sync_shard(pg, pos, acked)
+                self._log_sync_shard(pg, pos, acked, acting, epoch)
             elif acked:
                 with pg.lock:
-                    m = pg.peer_missing.get(pos)
-                    if m:
-                        for oid in acked:
-                            m.pop(oid, None)
+                    if pg.epoch == epoch:
+                        m = pg.peer_missing.get(pos)
+                        if m:
+                            for oid in acked:
+                                m.pop(oid, None)
                 log(1, f"{pg}: pos {pos} partial recovery "
                     f"({len(acked)}/{len(missing)}), log-sync deferred")
+        if unrebuildable:
+            self._try_rollback(pg, unrebuildable, acting, epoch)
 
-    def _log_sync_shard(self, pg: PG, pos: int, oids: list[str]) -> None:
-        is_ec = isinstance(pg.backend, ECBackend)
-        shard = pos if is_ec else NO_SHARD
-        cid = pg_cid(pg.pool, pg.ps, shard)
-        kv: dict[str, bytes] = {}
-        from ceph_tpu.utils.encoding import Encoder
-        for v, ent in pg.log.entries.items():
-            ee = Encoder(); ent.encode(ee)
-            kv[f"log/{v:016d}"] = ee.getvalue()
-        kv["info"] = PGLog._info_bytes(pg.log.last_version, pg.log.tail)
+    def _try_rollback(self, pg: PG, failed: dict[str, int],
+                      acting: list[int], epoch: int) -> None:
+        """Objects no recovery round can rebuild (a write that died
+        before reaching enough shards): after two consecutive failed
+        rounds, roll them back cluster-wide through the backend (EC
+        log-rollback role). Hysteresis matters — a single failure may
+        just be a fan-out still in flight."""
+        for oid, wanted in failed.items():
+            with pg.lock:
+                n = pg.rollback_pending.get(oid, 0) + 1
+                pg.rollback_pending[oid] = n
+            if n < 2:
+                continue
+            pushes = pg.backend.recover_rollback(pg, oid, wanted)
+            if not pushes:
+                continue
+            waits = []
+            for pos, push in pushes.items():
+                tid = self.new_tid()
+                push.tid = tid
+                w = SubOpWait({oid})
+                self.register_wait(tid, w)
+                osd = acting[pos] if pos < len(acting) else -1
+                if osd == self.whoami:
+                    self._handle_pg_push(push, _SelfConn(self))
+                elif osd >= 0:
+                    self.send_osd(osd, push)
+                else:
+                    self.unregister_wait(tid)
+                    continue
+                waits.append((pos, tid, w))
+            for pos, tid, w in waits:
+                reps = w.wait(SUBOP_TIMEOUT)
+                self.unregister_wait(tid)
+                rep = reps.get(oid)
+                if rep is not None and getattr(rep, "committed", False):
+                    with pg.lock:
+                        if pg.epoch != epoch:
+                            continue
+                        m = pg.peer_missing.get(pos)
+                        if m:
+                            m.pop(oid, None)
+                            if not m:
+                                pg.peer_missing.pop(pos, None)
+            with pg.lock:
+                pg.rollback_pending.pop(oid, None)
+
+    def _log_sync_shard(self, pg: PG, pos: int, oids: list[str],
+                        acting: list[int], epoch: int) -> None:
+        # build the sync under the lock so a concurrent re-peer can't
+        # swap the log (or the position's holder) between the epoch
+        # check and the txn construction; destination comes from the
+        # round's acting SNAPSHOT, never the live acting
+        with pg.lock:
+            if pg.epoch != epoch:
+                # a peering ran mid-round: the position may name a
+                # different OSD now, and peer_missing was recomputed —
+                # this round's bookkeeping no longer applies
+                log(1, f"{pg}: pos {pos} recovery round from epoch "
+                    f"{epoch} superseded, not log-syncing")
+                return
+            is_ec = isinstance(pg.backend, ECBackend)
+            shard = pos if is_ec else NO_SHARD
+            cid = pg_cid(pg.pool, pg.ps, shard)
+            kv: dict[str, bytes] = {}
+            from ceph_tpu.utils.encoding import Encoder
+            for v, ent in pg.log.entries.items():
+                ee = Encoder(); ent.encode(ee)
+                kv[f"log/{v:016d}"] = ee.getvalue()
+            kv["info"] = PGLog._info_bytes(pg.log.last_version,
+                                           pg.log.tail)
+            last_version = pg.log.last_version
         txn = Transaction()
         txn.create_collection(cid)
         txn.touch(cid, PGMETA)
+        # REPLACE the shard's log namespace: a backfilled shard's stale
+        # pre-gap entries must not survive the sync (omap_set merges),
+        # or the next peering would merge them back in as truth
+        txn.omap_rmrange(cid, PGMETA, "log/")
         txn.omap_set(cid, PGMETA, kv)
         tid = self.new_tid()
-        iw = InflightWrite(tid, pg, "", pg.log.last_version, {pos},
-                           lambda: self._mark_recovered(pg, pos, oids))
+        iw = InflightWrite(tid, pg, "", last_version, {pos},
+                           lambda: self._mark_recovered(
+                               pg, pos, oids, epoch))
         self.register_write(iw)
-        osd = pg.acting[pos] if pos < len(pg.acting) else -1
+        osd = acting[pos] if pos < len(acting) else -1
         if osd == self.whoami:
             self.queue_local_txn(
                 txn, lambda: iw.complete(pos) and iw.on_all_commit())
         elif osd >= 0:
             self.send_osd(osd, M.MECSubWrite(
                 tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
-                epoch=pg.epoch, oid="", version=pg.log.last_version,
+                epoch=epoch, oid="", version=last_version,
                 txn_bytes=txn.encode()))
 
-    def _mark_recovered(self, pg: PG, pos: int, oids: list[str]) -> None:
+    def _mark_recovered(self, pg: PG, pos: int, oids: list[str],
+                        epoch: int) -> None:
         with pg.lock:
+            if pg.epoch != epoch:
+                log(1, f"{pg}: pos {pos} recovery completion from "
+                    f"epoch {epoch} superseded, not clearing")
+                return
             missing = pg.peer_missing.get(pos)
             if missing:
                 for oid in oids:
@@ -743,6 +1226,22 @@ class OSD:
                     iw.pg.pgid,
                     lambda w=iw, d=dropped: self._record_missing(w, d))
 
+    def _kick_recovery(self) -> None:
+        """Retry recovery for PGs whose missing set persists (a push
+        failed or a shard was unreachable last round) — the reference's
+        recovery-reservation requeue. Runs from the heartbeat tick."""
+        with self._pgs_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            # lock-free peek (pg.lock may be held for seconds by a
+            # blocked fan-out and this runs on the heartbeat thread —
+            # blocking here would stall beacons); _recover re-checks
+            # everything under the lock
+            if pg.state == PG.ACTIVE and not pg.recovery_in_flight \
+                    and pg.missing_dirty():
+                self.op_wq.enqueue(pg.pgid,
+                                   lambda p=pg: self._recover(p))
+
     # -- heartbeats ----------------------------------------------------
     def _heartbeat_loop(self) -> None:
         interval = g_conf()["osd_heartbeat_interval"]
@@ -754,6 +1253,7 @@ class OSD:
             self.monc.beacon(self.whoami, osdmap.epoch)
             now = time.monotonic()
             self._expire_inflight(now)
+            self._kick_recovery()
             for osd, info in osdmap.osds.items():
                 if osd == self.whoami:
                     continue

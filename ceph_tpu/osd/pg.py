@@ -149,15 +149,6 @@ class PGLog:
                 if v > from_version]
 
 
-@dataclass
-class ShardPeerInfo:
-    """What peering learned about one acting-set shard (the notify)."""
-    osd: int
-    shard: int
-    last_version: int
-    objects: dict[str, int]   # oid -> version
-
-
 class PG:
     """Primary-side PG instance (PrimaryLogPG role). Replica-side state
     is just collections + pgmeta; replicas don't instantiate PG."""
@@ -179,7 +170,22 @@ class PG:
         # shards known to be missing objects (peer_missing role):
         # position -> {oid: version_needed}
         self.peer_missing: dict[int, dict[str, int]] = {}
+        self.recovery_in_flight = False
+        # oid -> consecutive recovery rounds it was unreconstructible
+        # (rollback hysteresis: one failed round may just be a write
+        # mid-commit; two means the write is dead)
+        self.rollback_pending: dict[str, int] = {}
         self.backend = None       # set by the OSD when instantiated
+
+    def missing_dirty(self) -> bool:
+        """Any shard still missing objects? Safe to call WITHOUT the pg
+        lock (heartbeat/harness peek): a concurrent mutation mid-scan
+        just means the answer is already stale — report dirty and let
+        the locked consumer re-check."""
+        try:
+            return any(m for m in self.peer_missing.values())
+        except RuntimeError:      # dict changed size during iteration
+            return True
 
     @property
     def pgid(self) -> tuple[int, int]:
@@ -190,20 +196,26 @@ class PG:
                 f"acting={self.acting} v={self.log.last_version})")
 
 
-def read_shard_info(store: ObjectStore, cid: str) -> tuple[int, dict[str, int]]:
+def read_shard_info(store: ObjectStore, cid: str,
+                    log: "PGLog | None" = None
+                    ) -> tuple[int, dict[str, int]]:
     """Replica-side answer to MPGQuery: (last_version, {oid: version}).
 
     Version of each object rides its "v" attr (written in the same txn
-    as the data, so it is never stale).
+    as the data, so it is never stale). Pass an already-loaded ``log``
+    to reuse its last_version instead of re-reading the pgmeta omap.
     """
-    try:
-        omap = store.omap_get(cid, PGMETA)
-    except StoreError:
-        return 0, {}
-    last_version = 0
-    info = omap.get("info")
-    if info:
-        last_version = Decoder(info).u64()
+    if log is not None:
+        last_version = log.last_version
+    else:
+        try:
+            omap = store.omap_get(cid, PGMETA)
+        except StoreError:
+            return 0, {}
+        last_version = 0
+        info = omap.get("info")
+        if info:
+            last_version = Decoder(info).u64()
     objects: dict[str, int] = {}
     try:
         for oid in store.list_objects(cid):

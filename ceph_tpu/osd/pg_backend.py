@@ -185,6 +185,15 @@ class PGBackend:
         and waits for the ack before log-syncing the shard."""
         raise NotImplementedError
 
+    def recover_rollback(self, pg: PG, oid: str, wanted: int
+                         ) -> "dict[int, M.MPGPush] | None":
+        """Last-resort recovery when ``oid`` at ``wanted`` cannot be
+        rebuilt at all: roll the object back cluster-wide to the newest
+        state enough shards still agree on (the EC log-rollback role,
+        ecbackend.rst:9-26). Returns {position: push} or None when
+        rollback does not apply / state is unknown."""
+        return None
+
     def local_cid(self, pg: PG) -> str:
         raise NotImplementedError
 
@@ -263,10 +272,18 @@ class ReplicatedBackend(PGBackend):
 
     def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
                      on_commit: Callable[[int], None]) -> None:
+        from ceph_tpu.osd.ec_util import HINFO_SEED
+        from ceph_tpu.utils import checksum
+        # self-validating copy: scrub compares each replica's computed
+        # crc against the one stored at write time, so a corrupt shard
+        # convicts itself even when versions tie (the replicated twin
+        # of the EC hinfo)
+        crc_attr = checksum.crc32c(data, HINFO_SEED).to_bytes(4, "little")
         entry = LogEntry(version, LOG_WRITE, oid)
         self._fan_out(
             pg, oid, entry,
-            lambda cid: object_write_txn(cid, oid, data, version),
+            lambda cid: object_write_txn(cid, oid, data, version,
+                                         attrs={"crc": crc_attr}),
             on_commit)
 
     def submit_remove(self, pg: PG, oid: str, version: int,
@@ -286,17 +303,63 @@ class ReplicatedBackend(PGBackend):
         cid = self.local_cid(pg)
         if shard >= len(pg.acting) or pg.acting[shard] < 0:
             return None
-        if version == 0:       # shard missed a removal
+        if version <= 0:       # shard missed a removal (v = -version)
             return M.MPGPush(
                 pool=pg.pool, ps=pg.ps, shard=NO_SHARD, oid=oid,
-                version=0, data=b"", attrs={}, remove=True, tid=tid)
+                version=-version, data=b"", attrs={}, remove=True,
+                tid=tid)
+        data = attrs = None
+        push_version = version
         try:
-            data = self.parent.store.read(cid, oid)
             attrs = self.parent.store.getattrs(cid, oid)
+            v_local = int.from_bytes(attrs.get("v", b""), "little")
+            if v_local >= version:
+                data = self.parent.store.read(cid, oid)
+                push_version = v_local
         except StoreError:
-            log(1, f"recover {oid}: primary copy unreadable")
-            return None
+            pass
+        if data is None:
+            # the local copy is absent or stale (the PRIMARY may be the
+            # shard being recovered): pull the wanted-or-newer version
+            # from a replica that has it (the reference's pull path)
+            data, attrs, push_version = self._pull_copy(
+                pg, oid, version, exclude={shard})
+            if data is None:
+                log(1, f"recover {oid}: no replica holds v>={version}")
+                return None
         return M.MPGPush(
             pool=pg.pool, ps=pg.ps, shard=NO_SHARD, oid=oid,
-            version=version, data=data, attrs=dict(attrs), remove=False,
-            tid=tid)
+            version=push_version, data=data, attrs=dict(attrs),
+            remove=False, tid=tid)
+
+    def _pull_copy(self, pg: PG, oid: str, version: int,
+                   exclude: set[int]
+                   ) -> tuple[bytes | None, dict | None, int]:
+        with pg.lock:
+            donors = [p for p in self.up_positions(pg)
+                      if p not in exclude
+                      and oid not in pg.peer_missing.get(p, {})
+                      and pg.acting[p] != self.parent.whoami]
+        for pos in donors:
+            tid = self.parent.new_tid()
+            wait = SubOpWait({pos})
+            self.parent.register_wait(tid, wait)
+            self.parent.send_osd(pg.acting[pos], M.MECSubRead(
+                tid=tid, pool=pg.pool, ps=pg.ps, shard=pos, oid=oid,
+                offset=0, length=0, want_attrs=True))
+            replies = wait.wait(SUBOP_TIMEOUT)
+            self.parent.unregister_wait(tid)
+            rep = replies.get(pos)
+            if rep is None or rep.code != 0 or rep.version < version:
+                continue
+            stored = rep.attrs.get("crc")
+            if stored is not None:
+                from ceph_tpu.osd.ec_util import HINFO_SEED
+                from ceph_tpu.utils import checksum
+                if checksum.crc32c(rep.data, HINFO_SEED) != \
+                        int.from_bytes(stored, "little"):
+                    log(1, f"pull {oid}: donor pos {pos} fails its own "
+                        "crc, trying next donor")
+                    continue      # silently-corrupt donor: never spread
+            return rep.data, dict(rep.attrs), rep.version
+        return None, None, 0

@@ -208,7 +208,8 @@ class MECSubRead(Message):
     MSG_TYPE = 32
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("oid", "str"), ("offset", "u64"),
-              ("length", "u64"), ("want_attrs", "bool")]
+              ("length", "u64"), ("want_attrs", "bool"),
+              ("csum_only", "bool")]
 
 
 class MECSubReadReply(Message):
@@ -220,7 +221,7 @@ class MECSubReadReply(Message):
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("oid", "str"), ("code", "i32"),
               ("data", "bytes"), ("attrs", "bytes_map"),
-              ("version", "u64")]
+              ("version", "u64"), ("crc", "u32")]
 
 
 # -- recovery (MOSDPGPush role) ----------------------------------------
@@ -254,11 +255,16 @@ class MPGQuery(Message):
 
 
 class MPGNotify(Message):
-    """Shard's answer: objects it holds and their versions, plus how
-    far its pgmeta log got (``last_version``) so the primary can choose
-    log replay vs backfill."""
+    """Shard's answer: objects it holds and their versions, how far
+    its pgmeta log got (``last_version``), and its log entries
+    (``log_*`` parallel lists). The primary MERGES every survivor's
+    log and judges each object by the latest merged entry — deletes
+    need explicit REMOVE evidence; a bare listing difference never
+    deletes (the log-vs-backfill discipline of the reference's
+    peering, doc/dev/osd_internals/pg.rst)."""
     MSG_TYPE = 37
     FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
               ("epoch", "u32"), ("objects", "str_list"),
               ("versions", "u64_list"), ("last_version", "u64"),
-              ("tid", "u64")]
+              ("tid", "u64"), ("log_versions", "u64_list"),
+              ("log_ops", "i32_list"), ("log_oids", "str_list")]

@@ -54,6 +54,10 @@ class Monitor:
         self._subscribers: dict[str, Connection] = {}  # peer entity -> conn
         self._last_beacon: dict[int, float] = {}
         self._failure_reports: dict[int, dict[int, float]] = {}
+        # epoch at which each osd last booted (up_from role): failure
+        # reports carrying an older epoch were formed before the boot
+        # and must not count against the reborn daemon
+        self._up_epoch: dict[int, int] = {}
         self._tick_stop = threading.Event()
         self._tick_thread: threading.Thread | None = None
         self._replay()
@@ -171,11 +175,20 @@ class Monitor:
         self._failure_reports.pop(osd, None)
         log(1, f"osd.{osd} booted at {msg.addr}")
         self._commit()
+        self._up_epoch[osd] = self.osdmap.epoch
 
     def _handle_failure(self, msg: M.MOSDFailure) -> None:
         target = msg.target_osd
         info = self.osdmap.osds.get(target)
         if info is None or not info.up:
+            return
+        if msg.epoch < self._up_epoch.get(target, 0):
+            # report predates the target's boot (heartbeat reports
+            # resend every tick; in-flight ones can land after the
+            # revival map) — a stale opinion of the PREVIOUS daemon
+            log(10, f"ignoring stale failure report for osd.{target} "
+                f"(epoch {msg.epoch} < up_epoch "
+                f"{self._up_epoch.get(target, 0)})")
             return
         now = time.monotonic()
         reporters = self._failure_reports.setdefault(target, {})

@@ -115,6 +115,30 @@ class MiniCluster:
         log(1, f"revived osd.{osd_id}")
         return osd
 
+    def scrub_pool(self, pool_name: str, repair: bool = True) -> dict:
+        """Scrub every PG of a pool on its primary (the 'ceph pg scrub'
+        role); returns aggregated results."""
+        osdmap = self.mon.osdmap
+        pool_id = osdmap.pool_by_name[pool_name]
+        agg = {"objects": 0, "inconsistent": {}, "repaired": []}
+        for ps in osdmap.pgs_of_pool(pool_id):
+            _, _, primary = osdmap.pg_to_up_acting(pool_id, ps)
+            osd = self.osds.get(primary)
+            if osd is None:
+                agg.setdefault("skipped", []).append(f"{pool_id}.{ps}")
+                continue
+            # the primary instantiates + peers the PG on demand, so a
+            # PG that served no op since failover still gets scrubbed
+            res = osd.scrub_pg((pool_id, ps), repair=repair)
+            if "error" in res:
+                agg.setdefault("skipped", []).append(
+                    f"{pool_id}.{ps}: {res['error']}")
+                continue
+            agg["objects"] += res["objects"]
+            agg["inconsistent"].update(res["inconsistent"])
+            agg["repaired"].extend(res["repaired"])
+        return agg
+
     # -- waiting ------------------------------------------------------
     def wait_for_osds_up(self, n: int | None = None,
                          timeout: float = 15.0) -> None:
@@ -141,17 +165,36 @@ class MiniCluster:
         peer_missing (wait_for_clean role)."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self._is_clean():
+            if not self._dirty_pgs():
                 return
             time.sleep(0.1)
-        raise TimeoutError("cluster not clean")
+        raise TimeoutError(f"cluster not clean: {self._dirty_pgs()}")
 
-    def _is_clean(self) -> bool:
+    def _dirty_pgs(self) -> list[str]:
+        dirty = []
+        osdmap = self.mon.osdmap
         for osd in self.osds.values():
             for pg in list(osd.pgs.values()):
-                if pg.state != pg.ACTIVE or pg.peer_missing:
-                    return False
-        return True
+                if pg.state != pg.ACTIVE:
+                    dirty.append(f"osd.{osd.whoami}:{pg!r}")
+                    continue
+                # an ACTIVE pg whose acting set predates the current
+                # map is about to re-peer: not clean yet (otherwise
+                # wait_for_clean races the map-change enqueue)
+                _, acting, _ = osdmap.pg_to_up_acting(pg.pool, pg.ps)
+                if list(acting) != list(pg.acting):
+                    dirty.append(
+                        f"osd.{osd.whoami}:{pg!r} stale acting "
+                        f"(map has {acting})")
+                elif pg.missing_dirty():
+                    with pg.lock:
+                        counts = {p: len(m) for p, m in
+                                  pg.peer_missing.items() if m}
+                    if counts:
+                        dirty.append(
+                            f"osd.{osd.whoami}:pg{pg.pool}.{pg.ps} "
+                            f"missing={counts}")
+        return dirty
 
     def epoch(self) -> int:
         return self.mon.osdmap.epoch

@@ -233,6 +233,9 @@ class BlockStore(ObjectStore):
             elif code == osr.OP_REMOVE:
                 metas[(op[1], op[2])] = None
                 batch.delete(self._okey(op[1], op[2]))
+                # a rewrite replaces the data; injected/latent read
+                # errors do not survive it
+                self._eio.discard((op[1], op[2]))
             elif code == osr.OP_SETATTR:
                 load(op[1], op[2], create=True).attrs[op[3]] = op[4]
             elif code == osr.OP_RMATTR:
@@ -243,6 +246,10 @@ class BlockStore(ObjectStore):
                 m = load(op[1], op[2], create=False)
                 for k in op[3]:
                     m.omap.pop(k, None)
+            elif code == osr.OP_OMAP_RMRANGE:
+                m = load(op[1], op[2], create=True)
+                for k in [k for k in m.omap if k.startswith(op[3])]:
+                    del m.omap[k]
         for (cid, oid), m in metas.items():
             if m is not None:
                 batch.put(self._okey(cid, oid), m.encode())

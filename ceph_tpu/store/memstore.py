@@ -110,6 +110,9 @@ class MemStore(ObjectStore):
                     o.data.extend(b"\x00" * (size - len(o.data)))
             elif code == osr.OP_REMOVE:
                 self._coll(op[1]).pop(op[2], None)
+                # rewriting an object replaces its data: a previously
+                # injected/latent read error does not survive it
+                self._eio.discard((op[1], op[2]))
             elif code == osr.OP_SETATTR:
                 self._get_or_create(op[1], op[2]).attrs[op[3]] = op[4]
             elif code == osr.OP_RMATTR:
@@ -120,6 +123,10 @@ class MemStore(ObjectStore):
                 o = self._obj(op[1], op[2])
                 for k in op[3]:
                     o.omap.pop(k, None)
+            elif code == osr.OP_OMAP_RMRANGE:
+                o = self._get_or_create(op[1], op[2])
+                for k in [k for k in o.omap if k.startswith(op[3])]:
+                    del o.omap[k]
         if on_commit:
             on_commit()
 

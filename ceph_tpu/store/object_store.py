@@ -49,6 +49,7 @@ OP_OMAP_SET = 8
 OP_OMAP_RM = 9
 OP_MKCOLL = 10
 OP_RMCOLL = 11
+OP_OMAP_RMRANGE = 12
 
 
 class Transaction:
@@ -87,6 +88,12 @@ class Transaction:
     def omap_rm(self, cid: str, oid: str, keys: list[str]) -> "Transaction":
         self.ops.append((OP_OMAP_RM, cid, oid, list(keys))); return self
 
+    def omap_rmrange(self, cid: str, oid: str, prefix: str) -> "Transaction":
+        """Remove every omap key starting with ``prefix`` (the
+        reference's omap_rmkeyrange; lets a log-sync atomically REPLACE
+        a shard's log namespace instead of merging into stale keys)."""
+        self.ops.append((OP_OMAP_RMRANGE, cid, oid, prefix)); return self
+
     def create_collection(self, cid: str) -> "Transaction":
         self.ops.append((OP_MKCOLL, cid)); return self
 
@@ -124,6 +131,8 @@ class Transaction:
                 e.map(op[3], Encoder.str, Encoder.bytes)
             elif code == OP_OMAP_RM:
                 e.list(op[3], Encoder.str)
+            elif code == OP_OMAP_RMRANGE:
+                e.str(op[3])
 
         body.list(self.ops, enc_op)
         e = Encoder()
@@ -153,6 +162,8 @@ class Transaction:
                 return (code, cid, oid, dd.map(Decoder.str, Decoder.bytes))
             if code == OP_OMAP_RM:
                 return (code, cid, oid, dd.list(Decoder.str))
+            if code == OP_OMAP_RMRANGE:
+                return (code, cid, oid, dd.str())
             return (code, cid, oid)
 
         t = cls()

@@ -114,3 +114,117 @@ def test_removal_propagates_to_revived_osd(fast_death):
         for cid in store.list_collections():
             if cid.startswith("pg_"):
                 assert "doomed" not in store.list_objects(cid), cid
+
+
+def test_ec_rollback_of_unreconstructible_write(fast_death):
+    """EC log-rollback (ecbackend.rst:9-26 role): a write recorded in
+    one shard's log but whose chunks never reached k shards can neither
+    be acked nor reconstructed — recovery must roll the object back to
+    the newest k-agreed content instead of retrying forever."""
+    import os
+
+    from ceph_tpu.osd.pg import PGMETA, LOG_WRITE, LogEntry, PGLog, pg_cid
+    from ceph_tpu.store.object_store import Transaction
+    from ceph_tpu.utils.encoding import Encoder
+
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("ecrb", k=2, m=1, pg_num=1)
+        io = rados.open_ioctx("ecrb")
+        payload = os.urandom(20_000)
+        io.write_full("robj", payload)          # v1, acked
+
+        osdmap = cluster.mon.osdmap
+        pool_id = osdmap.pool_by_name["ecrb"]
+        _, acting, primary = osdmap.pg_to_up_acting(pool_id, 0)
+        pos_f = next(p for p, o in enumerate(acting) if o != primary)
+        osd_f = acting[pos_f]
+        store = cluster._stores[osd_f]
+        cid = pg_cid(pool_id, 0, pos_f)
+
+        # fabricate a dead write: bump this one shard to v2 (garbage
+        # chunk) and record v2 in ITS log only — as if the primary died
+        # after one sub-write landed
+        old_len = len(store.read(cid, "robj"))
+        old_attrs = store.getattrs(cid, "robj")
+        ee = Encoder(); LogEntry(2, LOG_WRITE, "robj").encode(ee)
+        txn = Transaction()
+        txn.remove(cid, "robj")
+        txn.touch(cid, "robj")
+        txn.write(cid, "robj", 0, os.urandom(old_len))
+        txn.setattr(cid, "robj", "v", (2).to_bytes(8, "little"))
+        txn.setattr(cid, "robj", "sz", old_attrs["sz"])
+        txn.setattr(cid, "robj", "hinfo", old_attrs["hinfo"])
+        txn.touch(cid, PGMETA)
+        txn.omap_set(cid, PGMETA, {
+            "log/" + "2".rjust(16, "0"): ee.getvalue(),
+            "info": PGLog._info_bytes(2, 1)})
+        store.queue_transaction(txn, lambda: None)
+
+        # bounce the shard so the primary re-peers and merges its log
+        cluster.kill_osd(osd_f)
+        cluster.wait_for_osd_down(osd_f, timeout=30)
+        cluster.revive_osd(osd_f)
+        cluster.wait_for_osds_up(timeout=15)
+        cluster.wait_for_clean(timeout=40)      # rollback must converge
+        # the acked v1 content survives, cluster-wide consistent
+        assert io.read("robj") == payload
+        assert cluster.scrub_pool("ecrb")["inconsistent"] == {}
+
+
+def test_trimmed_log_backfill_no_resurrection(fast_death, monkeypatch):
+    """A shard that misses a removal AND whose gap exceeds the bounded
+    log must be backfilled from the authority's listing — merging its
+    stale log would resurrect the acked deletion cluster-wide."""
+    monkeypatch.setattr("ceph_tpu.osd.pg.LOG_MAX", 8)
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_pool("bf", pg_num=1, size=3)
+        io = rados.open_ioctx("bf")
+        io.write_full("ghost", b"g" * 1000)
+        io.write_full("keeper", b"k" * 1000)
+
+        epoch = cluster.epoch()
+        cluster.kill_osd(2)
+        cluster.wait_for_osd_down(2, timeout=30)
+        rados.wait_for_epoch(epoch + 1, timeout=10)
+        io.remove("ghost")
+        # push the removal entry out of every survivor's bounded log
+        for i in range(12):
+            io.write_full(f"fill{i}", f"f{i}".encode() * 50)
+
+        cluster.revive_osd(2)
+        cluster.wait_for_osds_up(timeout=15)
+        assert io.read("keeper") == b"k" * 1000
+        cluster.wait_for_clean(timeout=30)
+        time.sleep(0.5)
+        # the deleted object must not come back on ANY osd
+        for osd_id, store in cluster._stores.items():
+            for cid in store.list_collections():
+                if cid.startswith("pg_"):
+                    assert "ghost" not in store.list_objects(cid), \
+                        (osd_id, cid)
+        # and backfill restored everything else
+        assert io.read("keeper") == b"k" * 1000
+        for i in range(12):
+            assert io.read(f"fill{i}") == f"f{i}".encode() * 50
+
+        # a LATER peering round must not resurrect it either: the
+        # log-sync has to have REPLACED osd.2's stale pgmeta log (not
+        # merged into it), or its pre-gap write entry for ghost would
+        # re-enter the merged log as per-object truth
+        epoch = cluster.epoch()
+        cluster.kill_osd(1)
+        cluster.wait_for_osd_down(1, timeout=30)
+        rados.wait_for_epoch(epoch + 1, timeout=10)
+        assert io.read("keeper") == b"k" * 1000   # re-peer
+        cluster.revive_osd(1)
+        cluster.wait_for_osds_up(timeout=15)
+        assert io.read("keeper") == b"k" * 1000
+        cluster.wait_for_clean(timeout=30)
+        time.sleep(0.5)
+        for osd_id, store in cluster._stores.items():
+            for cid in store.list_collections():
+                if cid.startswith("pg_"):
+                    assert "ghost" not in store.list_objects(cid), \
+                        (osd_id, cid)
