@@ -11,9 +11,11 @@ one kernel contract —
 
 - ``numpy``:  the gf256 reference path (always available, bit-exact oracle);
 - ``native``: C++ host library via ctypes (ISA-L-style nibble-table SIMD);
-- ``jax``:    bit-sliced binary matmul on the TPU MXU (ops/gf_jax.py).
+- ``jax``:    bit-sliced binary matmul on the TPU MXU (ops/gf_jax.py);
+- ``pallas``: fused unpack->MXU->pack kernel (ops/gf_pallas.py; TPU only,
+  several times faster than the plain-XLA path).
 
-``auto`` prefers jax when a device is usable, then native, then numpy.
+``auto`` prefers pallas, then jax, then native, then numpy.
 All paths are bit-identical (enforced by tests/test_gf_jax.py and
 tests/test_native.py — the corpus gate of
 src/test/erasure-code/ceph_erasure_code_non_regression.cc applied across
@@ -31,7 +33,7 @@ from ceph_tpu.ops import gf256
 
 # name -> matvec(mat[m,k] uint8, data[k,N] uint8) -> [m,N] uint8
 _BACKENDS: dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {}
-_AUTO_ORDER = ["jax", "native", "numpy"]
+_AUTO_ORDER = ["pallas", "jax", "native", "numpy"]
 
 
 def register_backend(name: str, fn) -> None:
@@ -57,6 +59,13 @@ def _load_lazy() -> None:
     try:
         from ceph_tpu.ops import gf_jax  # noqa: F401  (self-registers)
     except Exception:  # pragma: no cover - jax always present in this image
+        pass
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            from ceph_tpu.ops import gf_pallas
+            register_backend("pallas", gf_pallas.matvec)
+    except Exception:
         pass
     try:
         from ceph_tpu.ops import native  # noqa: F401  (self-registers)
