@@ -58,8 +58,13 @@ from ceph_tpu.store.object_store import (
     StoreError,
     Transaction,
 )
+from ceph_tpu.utils.admin_socket import (
+    AdminSocket,
+    register_common_commands,
+)
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
+from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
 log = Dout("osd")
@@ -149,6 +154,11 @@ class OSD:
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         self._stopping = False
+        self.op_tracker = OpTracker(
+            complaint_time=g_conf()["osd_op_complaint_time"],
+            history_size=g_conf()["op_history_size"])
+        self.asok = AdminSocket(
+            f"osd.{osd_id}", g_conf()["admin_socket_dir"] or None)
         self._perf_name = f"osd.{osd_id}"
         try:
             self.logger = self._make_perf(self._perf_name)
@@ -172,6 +182,21 @@ class OSD:
     # -- lifecycle ----------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self.store.mount()
+        register_common_commands(self.asok, self.logger)
+        self.asok.register_command(
+            "dump_ops_in_flight",
+            lambda a: self.op_tracker.dump_in_flight(),
+            "ops currently executing (TrackedOp.h:134 role)")
+        self.asok.register_command(
+            "dump_historic_ops",
+            lambda a: self.op_tracker.dump_historic(),
+            "recently finished ops with event timelines")
+        self.asok.register_command(
+            "status", lambda a: self._asok_status(), "daemon status")
+        self.asok.register_command(
+            "dump_pgs", lambda a: self._asok_dump_pgs(),
+            "primary-side pg states")
+        self.asok.start()
         self.addr = self.msgr.bind(host, port)
         self.monc.subscribe()
         self.monc.boot_osd(self.whoami, self.addr)
@@ -192,6 +217,7 @@ class OSD:
         self.reader_wq.drain_stop()
         self.msgr.shutdown()
         self.store.umount()
+        self.asok.stop()
         collection().remove(self._perf_name)
 
     # -- Listener interface (what backends use) -----------------------
@@ -230,6 +256,30 @@ class OSD:
 
     def queue_local_txn(self, txn: Transaction, on_commit) -> None:
         self.store.queue_transaction(txn, on_commit)
+
+    # -- asok backends -------------------------------------------------
+    def _asok_status(self) -> dict:
+        osdmap = self.get_osdmap()
+        with self._pgs_lock:
+            num_pgs = len(self.pgs)
+        return {"whoami": self.whoami, "addr": self.addr,
+                "osdmap_epoch": osdmap.epoch if osdmap else 0,
+                "num_primary_pgs": num_pgs,
+                "slow_ops": len(self.op_tracker.get_slow_ops())}
+
+    def _asok_dump_pgs(self) -> list[dict]:
+        with self._pgs_lock:
+            pgs = list(self.pgs.values())
+        out = []
+        for pg in pgs:
+            with pg.lock:
+                out.append({
+                    "pgid": f"{pg.pool}.{pg.ps}", "state": pg.state,
+                    "acting": list(pg.acting),
+                    "last_version": pg.log.last_version,
+                    "missing": {str(p): len(m) for p, m in
+                                pg.peer_missing.items() if m}})
+        return out
 
     # -- backends ------------------------------------------------------
     def backend_for(self, pool_id: int) -> PGBackend:
@@ -465,16 +515,23 @@ class OSD:
         osdmap = self.get_osdmap()
         t0 = time.perf_counter()
         self.logger.inc("op")
+        track = self.op_tracker.create(
+            f"osd_op(client={msg.client} tid={msg.tid} op={msg.op} "
+            f"oid={msg.oid})")
+        track.mark_event("dequeued")
         cache_key = (msg.client, msg.tid)
         if msg.op in self._MUTATING_OPS:
             with self._op_cache_lock:
                 cached = self._op_cache.get(cache_key)
             if cached is not None:     # client resend of an applied op
+                track.mark_event("dup_op_cached_reply")
+                track.finish()
                 conn.send_message(cached)
                 return
 
         def reply(code: int, data: bytes = b"", version: int = 0) -> None:
             self.logger.tinc("op_latency", time.perf_counter() - t0)
+            track.finish()
             out = M.MOSDOpReply(
                 tid=msg.tid, code=code, epoch=osdmap.epoch, data=data,
                 version=version)
@@ -507,6 +564,8 @@ class OSD:
                 self.pgs[pgid] = pg
         with pg.lock:
             if pg.state != PG.ACTIVE:
+                track.mark_event("waiting_for_active")
+                track.finish()       # the re-run tracks a fresh op
                 pg.waiting_for_active.append((msg, conn, t0))
                 if pg.state == PG.CREATED:
                     pg.acting = list(acting)
@@ -516,8 +575,11 @@ class OSD:
             if not pg.backend.min_size_ok(pg):
                 # park until enough shards return (the reference holds
                 # ops while the PG is below min_size)
+                track.mark_event("waiting_for_min_size")
+                track.finish()
                 pg.waiting_for_active.append((msg, conn, t0))
                 return
+            track.mark_event("reached_pg")
             self._execute_op(pg, msg, reply)
 
     def _flush_waiting(self, pg: PG) -> None:
@@ -1254,6 +1316,7 @@ class OSD:
             now = time.monotonic()
             self._expire_inflight(now)
             self._kick_recovery()
+            self.op_tracker.check_slow()
             for osd, info in osdmap.osds.items():
                 if osd == self.whoami:
                     continue

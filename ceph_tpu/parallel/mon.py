@@ -58,6 +58,9 @@ class Monitor:
         # reports carrying an older epoch were formed before the boot
         # and must not count against the reborn daemon
         self._up_epoch: dict[int, int] = {}
+        from ceph_tpu.utils.admin_socket import AdminSocket
+        self.asok = AdminSocket(
+            f"mon.{name}", g_conf()["admin_socket_dir"] or None)
         self._tick_stop = threading.Event()
         self._tick_thread: threading.Thread | None = None
         self._replay()
@@ -70,6 +73,17 @@ class Monitor:
         for osd, info in self.osdmap.osds.items():
             if info.up:
                 self._last_beacon.setdefault(osd, now)
+        from ceph_tpu.utils.admin_socket import register_common_commands
+        register_common_commands(self.asok)
+        self.asok.register_command(
+            "mon_status",
+            lambda a: {"name": self.name, "addr": self.addr,
+                       "epoch": self.osdmap.epoch,
+                       "osds": {o: {"up": i.up, "in": i.in_cluster,
+                                    "addr": i.addr}
+                                for o, i in self.osdmap.osds.items()}},
+            "monitor + osdmap summary")
+        self.asok.start()
         self.addr = self.msgr.bind(host, port)
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"mon.{self.name}-tick",
@@ -84,6 +98,7 @@ class Monitor:
         if self._tick_thread:
             self._tick_thread.join(timeout=5)
         self.msgr.shutdown()
+        self.asok.stop()
         self.db.close()
 
     # -- paxos-lite commit log ----------------------------------------

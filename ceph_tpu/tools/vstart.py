@@ -1,0 +1,66 @@
+"""vstart — boot a dev cluster in one process (src/vstart.sh role).
+
+    python -m ceph_tpu.tools.vstart [-n N_OSDS] [--store memstore|blockstore]
+        [--data DIR] [--ec k,m] [--prometheus]
+
+Boots one mon + N OSDs, creates a replicated pool ``rbd`` and (with
+--ec) an EC pool ``ecpool``, prints the mon address + asok paths, and
+runs until SIGINT. Drive it with the ``ceph``/``rados`` CLIs:
+
+    python -m ceph_tpu.tools.ceph_cli -m <addr> status
+    python -m ceph_tpu.tools.rados_cli -m <addr> -p rbd bench 5 write
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="vstart")
+    ap.add_argument("-n", "--n-osds", type=int, default=3)
+    ap.add_argument("--store", default="memstore",
+                    choices=("memstore", "blockstore"))
+    ap.add_argument("--data", default=None,
+                    help="data dir (blockstore)")
+    ap.add_argument("--ec", default=None, metavar="K,M",
+                    help="also create EC pool 'ecpool' with k,m")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="serve /metrics on an ephemeral port")
+    args = ap.parse_args(argv)
+
+    from ceph_tpu.qa.cluster import MiniCluster
+
+    cluster = MiniCluster(n_osds=args.n_osds, store=args.store,
+                          data_dir=args.data).start()
+    cluster.create_pool("rbd", pg_num=8, size=min(3, args.n_osds))
+    if args.ec:
+        k, m = (int(x) for x in args.ec.split(","))
+        cluster.create_ec_pool("ecpool", k=k, m=m)
+    info = {
+        "mon_addr": cluster.mon_addr,
+        "mon_asok": cluster.mon.asok.path,
+        "osd_asoks": {i: o.asok.path for i, o in cluster.osds.items()},
+        "pools": ["rbd"] + (["ecpool"] if args.ec else []),
+    }
+    if args.prometheus:
+        from ceph_tpu.utils.prometheus import MetricsServer
+        ms = MetricsServer()
+        info["metrics_url"] = f"http://127.0.0.1:{ms.start()}/metrics"
+    print(json.dumps(info, indent=2), flush=True)
+    print("cluster up — ctrl-c to stop", file=sys.stderr, flush=True)
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        signal.pause()
+    cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -186,8 +186,8 @@ for _o in [
     Option("osd_erasure_code_plugins", str, "jerasure isa shec lrc clay",
            "advanced", "plugins to preload (options.cc:2197)"),
     Option("erasure_code_backend", str, "auto", "advanced",
-           "kernel backend: auto|jax|native|numpy",
-           enum_allowed=("auto", "jax", "native", "numpy")),
+           "kernel backend: auto|pallas|jax|native|numpy",
+           enum_allowed=("auto", "pallas", "jax", "native", "numpy")),
     Option("ec_stripe_batch_flush_bytes", int, 8 << 20, "advanced",
            "device stripe-batch accumulator flush threshold"),
     Option("bluestore_csum_type", str, "crc32c", "advanced",
@@ -222,6 +222,13 @@ for _o in [
            "default per-subsystem log level", min=0, max=30),
     Option("log_ring_size", int, 10000, "advanced",
            "in-memory log ring entries kept for crash dump (Log.cc role)"),
+    Option("osd_op_complaint_time", float, 30.0, "advanced",
+           "seconds before an in-flight op is reported slow "
+           "(options.cc osd_op_complaint_time)"),
+    Option("op_history_size", int, 20, "advanced",
+           "finished ops kept for dump_historic_ops"),
+    Option("admin_socket_dir", str, "", "advanced",
+           "directory for daemon .asok files (empty = per-daemon tmpdir)"),
 ]:
     SCHEMA.add(_o)
 

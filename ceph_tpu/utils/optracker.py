@@ -1,0 +1,102 @@
+"""OpTracker — per-op event timelines and slow-op detection.
+
+Reference: src/common/TrackedOp.{h,cc} + src/osd/OpRequest.h. Every
+client op gets a TrackedOp; code marks named events as the op moves
+through the pipeline (queued -> reached_pg -> sub_op_sent -> commit).
+Ops alive longer than ``osd_op_complaint_time`` are reported as slow;
+finished ops land in a bounded history ring served over the admin
+socket (dump_historic_ops), like the reference's.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("optracker")
+
+
+class TrackedOp:
+    __slots__ = ("seq", "desc", "start", "events", "_tracker")
+
+    def __init__(self, seq: int, desc: str, tracker: "OpTracker") -> None:
+        self.seq = seq
+        self.desc = desc
+        self.start = time.monotonic()
+        self.events: list[tuple[float, str]] = [(self.start, "initiated")]
+        self._tracker = tracker
+
+    def mark_event(self, name: str) -> None:
+        self.events.append((time.monotonic(), name))
+
+    def finish(self) -> None:
+        self.mark_event("done")
+        self._tracker._finish(self)
+
+    @property
+    def age(self) -> float:
+        return time.monotonic() - self.start
+
+    def dump(self) -> dict:
+        return {
+            "seq": self.seq,
+            "desc": self.desc,
+            "age": round(self.age, 6),
+            "events": [{"t": round(t - self.start, 6), "event": e}
+                       for t, e in self.events],
+        }
+
+
+class OpTracker:
+    def __init__(self, complaint_time: float = 30.0,
+                 history_size: int = 20) -> None:
+        self.complaint_time = complaint_time
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._in_flight: dict[int, TrackedOp] = {}
+        self._history: deque[dict] = deque(maxlen=history_size)
+        self._slowest: deque[dict] = deque(maxlen=history_size)
+
+    def create(self, desc: str) -> TrackedOp:
+        op = TrackedOp(next(self._seq), desc, self)
+        with self._lock:
+            self._in_flight[op.seq] = op
+        return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._in_flight.pop(op.seq, None)
+            d = op.dump()
+            self._history.append(d)
+            if not self._slowest or d["age"] >= min(
+                    s["age"] for s in self._slowest):
+                self._slowest.append(d)
+
+    # -- introspection (asok command backends) ------------------------
+    def dump_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._in_flight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic(self) -> dict:
+        with self._lock:
+            return {"num_ops": len(self._history),
+                    "ops": list(self._history)}
+
+    def get_slow_ops(self) -> list[dict]:
+        """Ops in flight longer than the complaint time (the reference
+        logs these as 'slow requests')."""
+        with self._lock:
+            return [op.dump() for op in self._in_flight.values()
+                    if op.age > self.complaint_time]
+
+    def check_slow(self) -> int:
+        slow = self.get_slow_ops()
+        for s in slow:
+            log(1, f"slow request {s['desc']} "
+                f"in flight for {s['age']:.1f}s")
+        return len(slow)

@@ -1,0 +1,85 @@
+"""Prometheus text exposition for perf counters (mgr prometheus role).
+
+Reference: src/pybind/mgr/prometheus — exports every daemon's
+PerfCounters in the Prometheus text format. ``render_text()`` walks the
+process-global collection; ``MetricsServer`` serves it over HTTP
+(GET /metrics) the way the mgr module does.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ceph_tpu.utils.perf_counters import collection
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def render_text() -> str:
+    """All daemons' counters, one metric per counter with a ``daemon``
+    label (the mgr module's layout)."""
+    lines: list[str] = []
+    seen_types: set[str] = set()
+    for daemon, counters in sorted(collection().dump().items()):
+        for key, val in sorted(counters.items()):
+            metric = f"ceph_tpu_{_sanitize(key)}"
+            if isinstance(val, dict):
+                # time-avg: export sum+count (prometheus summary style)
+                for part in ("avgcount", "sum"):
+                    if part in val:
+                        m = f"{metric}_{part}"
+                        if m not in seen_types:
+                            lines.append(f"# TYPE {m} counter")
+                            seen_types.add(m)
+                        lines.append(
+                            f'{m}{{daemon="{daemon}"}} {val[part]}')
+                continue
+            if metric not in seen_types:
+                lines.append(f"# TYPE {metric} counter")
+                seen_types.add(metric)
+            lines.append(f'{metric}{{daemon="{daemon}"}} {val}')
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802  (stdlib API name)
+        if self.path not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = render_text().encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args) -> None:  # silence stdlib logging
+        pass
+
+
+class MetricsServer:
+    """Threaded HTTP /metrics endpoint (mgr prometheus module role)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="prometheus",
+            daemon=True)
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=2)

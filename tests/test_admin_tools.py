@@ -1,0 +1,149 @@
+"""Admin socket, OpTracker, and CLI tools (asok + ceph/rados CLI roles)."""
+
+import io as io_mod
+import json
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.tools import ceph_cli, rados_cli
+from ceph_tpu.utils.admin_socket import asok_command
+from ceph_tpu.utils.optracker import OpTracker
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        rados = c.client()
+        c.create_pool("admpool", pg_num=2, size=3)
+        io = rados.open_ioctx("admpool")
+        io.write_full("obj1", b"x" * 1000)
+        yield c
+
+
+def test_optracker_unit():
+    tr = OpTracker(complaint_time=0.05, history_size=4)
+    op = tr.create("test_op oid=a")
+    op.mark_event("queued")
+    assert tr.dump_in_flight()["num_ops"] == 1
+    time.sleep(0.06)
+    assert len(tr.get_slow_ops()) == 1
+    op.finish()
+    assert tr.dump_in_flight()["num_ops"] == 0
+    hist = tr.dump_historic()
+    assert hist["num_ops"] == 1
+    assert [e["event"] for e in hist["ops"][0]["events"]] == \
+        ["initiated", "queued", "done"]
+
+
+def test_osd_asok_perf_and_ops(cluster):
+    osd = cluster.osds[0]
+    out = asok_command(osd.asok.path, "help")
+    assert "perf dump" in out and "dump_ops_in_flight" in out
+    perf = asok_command(osd.asok.path, "perf dump")
+    assert "op" in perf
+    st = asok_command(osd.asok.path, "status")
+    assert st["whoami"] == 0 and st["osdmap_epoch"] >= 1
+    ops = asok_command(osd.asok.path, "dump_ops_in_flight")
+    assert ops["num_ops"] == 0
+    # some OSD served obj1's write: its history has the op timeline
+    hists = [asok_command(o.asok.path, "dump_historic_ops")
+             for o in cluster.osds.values()]
+    assert any(any("obj1" in op_["desc"] for op_ in h["ops"])
+               for h in hists)
+    pgs = [asok_command(o.asok.path, "dump_pgs")
+           for o in cluster.osds.values()]
+    assert any(p["state"] == "active" for dump in pgs for p in dump)
+
+
+def test_asok_config_roundtrip(cluster):
+    osd = cluster.osds[1]
+    got = asok_command(osd.asok.path, "config get",
+                       key="osd_heartbeat_grace")
+    old = got["osd_heartbeat_grace"]
+    try:
+        out = asok_command(osd.asok.path, "config set",
+                           key="osd_heartbeat_grace", value=9.5)
+        assert out["osd_heartbeat_grace"] == 9.5
+        diff = asok_command(osd.asok.path, "config diff")
+        assert diff["osd_heartbeat_grace"] in (9.5, {"current": 9.5}) or \
+            diff["osd_heartbeat_grace"]
+    finally:
+        asok_command(osd.asok.path, "config set",
+                     key="osd_heartbeat_grace", value=old)
+
+
+def test_mon_asok(cluster):
+    out = asok_command(cluster.mon.asok.path, "mon_status")
+    assert out["epoch"] >= 1 and len(out["osds"]) == 3
+
+
+def test_prometheus_export(cluster):
+    import urllib.request
+
+    from ceph_tpu.utils.prometheus import MetricsServer, render_text
+
+    text = render_text()
+    assert 'ceph_tpu_op{daemon="osd.0"}' in text
+    assert "# TYPE ceph_tpu_op counter" in text
+    srv = MetricsServer()
+    port = srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert 'daemon="osd.0"' in body
+    finally:
+        srv.stop()
+
+
+def test_ceph_cli(cluster, capsys):
+    assert ceph_cli.main(["-m", cluster.mon_addr, "status"]) == 0
+    assert ceph_cli.main(["-m", cluster.mon_addr, "osd", "tree"]) == 0
+    out = capsys.readouterr().out
+    assert "osd" in out
+    assert ceph_cli.main(
+        ["-m", cluster.mon_addr, "osd", "pool", "create",
+         "clipool", "2", "2"]) == 0
+    assert ceph_cli.main(["-m", cluster.mon_addr, "osd", "pool",
+                          "ls"]) == 0
+    assert "clipool" in capsys.readouterr().out
+    # EC profile via CLI
+    assert ceph_cli.main(
+        ["-m", cluster.mon_addr, "osd", "erasure-code-profile", "set",
+         "cliec", "k=2", "m=1"]) == 0
+    assert ceph_cli.main(
+        ["-m", cluster.mon_addr, "osd", "erasure-code-profile",
+         "get", "cliec"]) == 0
+    assert '"k"' in capsys.readouterr().out
+    # daemon passthrough
+    osd = cluster.osds[0]
+    assert ceph_cli.main(["daemon", osd.asok.path, "perf", "dump"]) == 0
+    assert '"op"' in capsys.readouterr().out
+
+
+def test_rados_cli_and_bench(cluster, capsys, tmp_path, monkeypatch):
+    addr = cluster.mon_addr
+    src = tmp_path / "in.bin"
+    src.write_bytes(b"hello rados cli" * 100)
+    assert rados_cli.main(["-m", addr, "-p", "admpool", "put",
+                           "cliobj", str(src)]) == 0
+    dst = tmp_path / "out.bin"
+    assert rados_cli.main(["-m", addr, "-p", "admpool", "get",
+                           "cliobj", str(dst)]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+    assert rados_cli.main(["-m", addr, "-p", "admpool", "ls"]) == 0
+    assert "cliobj" in capsys.readouterr().out
+    assert rados_cli.main(["-m", addr, "-p", "admpool", "stat",
+                           "cliobj"]) == 0
+    assert rados_cli.main(["-m", addr, "lspools"]) == 0
+    # bench: short write+read round with small objects
+    capsys.readouterr()          # drain
+    assert rados_cli.main(["-m", addr, "-p", "admpool", "bench", "1",
+                           "seq", "-b", "8192", "-t", "4"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["objects"] > 0 and rep["bandwidth_MBps"] > 0
+    assert rep["read"]["objects"] == rep["objects"]
+    assert rados_cli.main(["-m", addr, "-p", "admpool", "rm",
+                           "cliobj"]) == 0
