@@ -1,0 +1,154 @@
+"""Client-side striping — Striper / libradosstriper / file_layout_t roles.
+
+Reference: src/osdc/Striper.h (file offset -> object extents math),
+src/include/fs_types.h:86 (``file_layout_t``: stripe_unit su,
+stripe_count sc, object_size), src/libradosstriper (striped object API
+over plain RADOS objects).
+
+A logical byte range maps onto RADOS objects ``{soid}.{objectno:016x}``:
+within each "object set" of ``stripe_count`` objects, stripe units
+round-robin across the objects (su bytes to object 0, su to object 1,
+...), and each object holds at most ``object_size`` bytes. A
+``{soid}.meta`` object records layout + logical size (the reference
+stores these in xattrs of the first object).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FileLayout:
+    """file_layout_t (fs_types.h:86); defaults mirror the reference's
+    4 MiB objects, one stripe unit per object."""
+    stripe_unit: int = 1 << 22
+    stripe_count: int = 1
+    object_size: int = 1 << 22
+
+    def validate(self) -> None:
+        if self.stripe_unit <= 0 or self.stripe_count <= 0 \
+                or self.object_size <= 0:
+            raise ValueError("layout fields must be positive")
+        if self.object_size % self.stripe_unit:
+            raise ValueError("object_size must be a multiple of "
+                             "stripe_unit")
+
+
+def file_to_extents(layout: FileLayout, offset: int, length: int
+                    ) -> list[tuple[int, int, int]]:
+    """Map a logical byte range to [(objectno, obj_off, len), ...] in
+    logical order (Striper::file_to_extents role)."""
+    layout.validate()
+    su = layout.stripe_unit
+    sc = layout.stripe_count
+    spo = layout.object_size // su      # stripe units per object
+    out: list[tuple[int, int, int]] = []
+    pos = offset
+    end = offset + length
+    while pos < end:
+        blockno = pos // su             # global stripe-unit index
+        stripeno = blockno // sc        # which stripe row
+        stripepos = blockno % sc        # which object in the set
+        objectsetno = stripeno // spo   # which object set
+        objectno = objectsetno * sc + stripepos
+        block_off = pos % su
+        obj_off = (stripeno % spo) * su + block_off
+        n = min(su - block_off, end - pos)
+        if out and out[-1][0] == objectno and \
+                out[-1][1] + out[-1][2] == obj_off:
+            out[-1] = (objectno, out[-1][1], out[-1][2] + n)
+        else:
+            out.append((objectno, obj_off, n))
+        pos += n
+    return out
+
+
+class StripedObject:
+    """libradosstriper-style striped read/write over an IoCtx."""
+
+    META_SUFFIX = ".meta"
+
+    def __init__(self, ioctx, soid: str,
+                 layout: FileLayout | None = None) -> None:
+        self.io = ioctx
+        self.soid = soid
+        existing = self._read_meta()
+        if existing is not None:
+            self.layout, self.size = existing
+            if layout is not None and layout != self.layout:
+                raise ValueError(
+                    f"{soid}: layout mismatch with stored layout")
+        else:
+            self.layout = layout or FileLayout()
+            self.layout.validate()
+            self.size = 0
+
+    # -- meta ----------------------------------------------------------
+    def _meta_oid(self) -> str:
+        return self.soid + self.META_SUFFIX
+
+    def _read_meta(self):
+        try:
+            raw = self.io.read(self._meta_oid())
+        except Exception:
+            return None
+        d = json.loads(raw)
+        return (FileLayout(d["su"], d["sc"], d["os"]), d["size"])
+
+    def _write_meta(self) -> None:
+        self.io.write_full(self._meta_oid(), json.dumps({
+            "su": self.layout.stripe_unit,
+            "sc": self.layout.stripe_count,
+            "os": self.layout.object_size,
+            "size": self.size}).encode())
+
+    def _piece(self, objectno: int) -> str:
+        return f"{self.soid}.{objectno:016x}"
+
+    # -- I/O -----------------------------------------------------------
+    def write(self, data: bytes, offset: int = 0) -> None:
+        pos = 0
+        for objectno, obj_off, n in file_to_extents(
+                self.layout, offset, len(data)):
+            self.io.write(self._piece(objectno), data[pos:pos + n],
+                          offset=obj_off)
+            pos += n
+        self.size = max(self.size, offset + len(data))
+        self._write_meta()
+
+    def read(self, length: int | None = None, offset: int = 0) -> bytes:
+        if length is None:
+            length = max(self.size - offset, 0)
+        length = min(length, max(self.size - offset, 0))
+        if length <= 0:
+            return b""
+        out = bytearray(length)
+        pos = 0
+        for objectno, obj_off, n in file_to_extents(
+                self.layout, offset, length):
+            try:
+                piece = self.io.read(self._piece(objectno), n, obj_off)
+            except Exception:
+                piece = b""          # sparse hole reads as zeros
+            out[pos:pos + len(piece)] = piece
+            pos += n
+        return bytes(out)
+
+    def stat(self) -> int:
+        return self.size
+
+    def remove(self) -> None:
+        objectnos = sorted({e[0] for e in file_to_extents(
+            self.layout, 0, self.size)}) if self.size else []
+        for objectno in objectnos:
+            try:
+                self.io.remove(self._piece(objectno))
+            except Exception:
+                pass
+        try:
+            self.io.remove(self._meta_oid())
+        except Exception:
+            pass
+        self.size = 0

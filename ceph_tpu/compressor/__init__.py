@@ -1,0 +1,111 @@
+"""Compression plugin layer (src/compressor/ role).
+
+The reference registers compressor plugins (zlib/snappy/zstd/lz4/
+brotli + QAT offload) through the same dlopen pattern as the EC
+plugins (CompressionPlugin registry). Here plugins self-register in a
+process registry; availability is probed at import (snappy/lz4/brotli
+are not in this image and register only if importable — the plugin-
+missing path behaves like the reference's failed dlopen).
+
+BlueStore-role usage: ``Compressor.create(name)`` then
+``compress()/decompress()``; compressed blobs record the plugin name so
+reads pick the right decompressor (bluestore_compression_algorithm).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+__all__ = ["Compressor", "CompressionPluginRegistry", "registry"]
+
+
+class CompressionError(Exception):
+    pass
+
+
+class Compressor:
+    """One codec instance (CompressionPlugin::compressor role)."""
+
+    def __init__(self, name: str,
+                 compress: Callable[[bytes], bytes],
+                 decompress: Callable[[bytes], bytes]) -> None:
+        self.name = name
+        self._c = compress
+        self._d = decompress
+
+    def compress(self, data: bytes) -> bytes:
+        return self._c(bytes(data))
+
+    def decompress(self, data: bytes) -> bytes:
+        return self._d(bytes(data))
+
+    @classmethod
+    def create(cls, name: str) -> "Compressor":
+        return registry().create(name)
+
+
+class CompressionPluginRegistry:
+    """Singleton registry (same shape as ErasureCodePluginRegistry)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._plugins: dict[str, tuple[Callable, Callable]] = {}
+
+    def register(self, name: str, compress, decompress) -> None:
+        with self._lock:
+            self._plugins[name] = (compress, decompress)
+
+    def plugins(self) -> list[str]:
+        with self._lock:
+            return sorted(self._plugins)
+
+    def create(self, name: str) -> Compressor:
+        with self._lock:
+            entry = self._plugins.get(name)
+        if entry is None:
+            raise CompressionError(
+                f"no compressor plugin {name!r} "
+                f"(have {self.plugins()})")
+        return Compressor(name, *entry)
+
+
+_registry = CompressionPluginRegistry()
+
+
+def registry() -> CompressionPluginRegistry:
+    return _registry
+
+
+def _probe() -> None:
+    import zlib
+    _registry.register(
+        "zlib", lambda d: zlib.compress(d, 6), zlib.decompress)
+
+    import bz2
+    _registry.register("bz2", bz2.compress, bz2.decompress)
+
+    import lzma
+    _registry.register("lzma", lzma.compress, lzma.decompress)
+
+    try:
+        import zstandard
+        _c = zstandard.ZstdCompressor()
+        _registry.register(
+            "zstd", _c.compress,
+            lambda d: zstandard.ZstdDecompressor().decompress(d))
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        import snappy
+        _registry.register("snappy", snappy.compress, snappy.decompress)
+    except ImportError:
+        pass
+    try:
+        import lz4.frame as _lz4
+        _registry.register("lz4", _lz4.compress, _lz4.decompress)
+    except ImportError:
+        pass
+
+
+_probe()

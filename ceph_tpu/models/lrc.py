@@ -159,6 +159,13 @@ class ErasureCodeLrc(ErasureCode):
                 f"mapping positions {holes} are computed by no layer")
         self._profile = profile
         self._profile["mapping"] = mapping
+        # logical chunk i -> raw position: data chunks at the 'D'
+        # positions in order, then coding positions (the reference's
+        # chunk_mapping derived from the mapping string,
+        # ErasureCodeLrc::parse_kml / ErasureCode.cc:260-279 remap)
+        data_pos = [i for i, ch in enumerate(mapping) if ch == "D"]
+        coding_pos = [i for i, ch in enumerate(mapping) if ch != "D"]
+        self.chunk_mapping = data_pos + coding_pos
 
     # -- geometry ----------------------------------------------------------
 

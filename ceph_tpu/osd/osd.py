@@ -53,6 +53,7 @@ from ceph_tpu.parallel.messenger import Connection, Messenger
 from ceph_tpu.parallel.mon_client import MonClient
 from ceph_tpu.parallel.osdmap import OSDMap
 from ceph_tpu.store.object_store import (
+    NoSuchCollection,
     NoSuchObject,
     ObjectStore,
     StoreError,
@@ -607,7 +608,8 @@ class OSD:
                 # machinery; ECBackend.cc start_rmw role)
                 try:
                     cur = bytearray(be.read_object(pg, msg.oid))
-                except NoSuchObject:
+                except (NoSuchObject, NoSuchCollection):
+                    # first write to this object (or to this whole PG)
                     cur = bytearray()
                 off = len(cur) if op == M.OSD_OP_APPEND else msg.offset
                 if off > len(cur):
@@ -637,7 +639,7 @@ class OSD:
                 reply(0, json.dumps(oids).encode())
             else:
                 reply(EINVAL)
-        except NoSuchObject:
+        except (NoSuchObject, NoSuchCollection):
             reply(ENOENT)
         except StoreError as exc:
             log(1, f"op {msg.oid} failed: {exc}")

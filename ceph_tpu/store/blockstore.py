@@ -37,21 +37,34 @@ from ceph_tpu.utils import checksum
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 
+#: on-disk compressor ids (bluestore_compression_algorithm role); the
+#: id is stored per blob so config changes never orphan old blobs
+COMP_NONE = 0
+_COMP_ALGS = {1: "zlib", 2: "zstd", 3: "bz2", 4: "lzma"}
+_COMP_IDS = {v: k for k, v in _COMP_ALGS.items()}
+
+
 class _Extent:
     """A logical range backed by a slice of a crc-protected blob in the
-    data file (BlueStore's lextent -> blob indirection)."""
+    data file (BlueStore's lextent -> blob indirection). ``blob_len``
+    is the blob's UNcompressed length (slice space); ``disk_len`` the
+    stored bytes; ``comp`` the compressor id (0 = stored raw)."""
 
     __slots__ = ("logical_off", "length", "blob_off", "blob_len",
-                 "blob_crc", "slice_off")
+                 "blob_crc", "slice_off", "disk_len", "comp")
 
     def __init__(self, logical_off: int, length: int, blob_off: int,
-                 blob_len: int, blob_crc: int, slice_off: int) -> None:
+                 blob_len: int, blob_crc: int, slice_off: int,
+                 disk_len: int | None = None,
+                 comp: int = COMP_NONE) -> None:
         self.logical_off = logical_off
         self.length = length
         self.blob_off = blob_off      # file offset of the whole blob
         self.blob_len = blob_len
-        self.blob_crc = blob_crc
+        self.blob_crc = blob_crc      # crc of the STORED (disk) bytes
         self.slice_off = slice_off    # this extent's start within the blob
+        self.disk_len = blob_len if disk_len is None else disk_len
+        self.comp = comp
 
     @property
     def end(self) -> int:
@@ -74,7 +87,8 @@ class _Meta:
         e.map(self.omap, Encoder.str, Encoder.bytes)
         e.list(self.extents, lambda en, x: (
             en.u64(x.logical_off), en.u64(x.length), en.u64(x.blob_off),
-            en.u64(x.blob_len), en.u32(x.blob_crc), en.u64(x.slice_off)))
+            en.u64(x.blob_len), en.u32(x.blob_crc), en.u64(x.slice_off),
+            en.u64(x.disk_len), en.u8(x.comp)))
         return e.getvalue()
 
     @classmethod
@@ -85,7 +99,8 @@ class _Meta:
         m.attrs = d.map(Decoder.str, Decoder.bytes)
         m.omap = d.map(Decoder.str, Decoder.bytes)
         m.extents = d.list(lambda dd: _Extent(
-            dd.u64(), dd.u64(), dd.u64(), dd.u64(), dd.u32(), dd.u64()))
+            dd.u64(), dd.u64(), dd.u64(), dd.u64(), dd.u32(), dd.u64(),
+            dd.u64(), dd.u8()))
         return m
 
 
@@ -101,11 +116,12 @@ def _clip(extents: list[_Extent], a: int, b: int) -> list[_Extent]:
         if x.logical_off < a:
             out.append(_Extent(x.logical_off, a - x.logical_off,
                                x.blob_off, x.blob_len, x.blob_crc,
-                               x.slice_off))
+                               x.slice_off, x.disk_len, x.comp))
         if x.end > b:
             cut = b - x.logical_off
             out.append(_Extent(b, x.end - b, x.blob_off, x.blob_len,
-                               x.blob_crc, x.slice_off + cut))
+                               x.blob_crc, x.slice_off + cut,
+                               x.disk_len, x.comp))
     return out
 
 
@@ -154,17 +170,27 @@ class BlockStore(ObjectStore):
     def queue_transaction(self, txn: Transaction,
                           on_commit: Callable[[], None] | None = None) -> None:
         assert self._db is not None, "not mounted"
-        # stage 1: data-file appends for every WRITE op
+        # stage 1: data-file appends for every WRITE op; blobs compress
+        # when the configured algorithm saves enough
+        # (bluestore_compression_* semantics)
+        comp_alg, comp_min, comp_ratio = self._comp_config()
         data_dirty = False
-        blob_at: dict[int, tuple[int, int, int]] = {}  # op idx -> blob
+        # op idx -> (file_off, raw_len, disk_len, crc, comp_id)
+        blob_at: dict[int, tuple[int, int, int, int, int]] = {}
         self._data.seek(0, os.SEEK_END)
         for i, op in enumerate(txn.ops):
             if op[0] == osr.OP_WRITE:
                 payload = op[4]
+                stored, comp_id = payload, COMP_NONE
+                if comp_alg is not None and len(payload) >= comp_min:
+                    packed = comp_alg.compress(payload)
+                    if len(packed) <= len(payload) * comp_ratio:
+                        stored = packed
+                        comp_id = _COMP_IDS[comp_alg.name]
                 file_off = self._data.tell()
-                self._data.write(payload)
-                blob_at[i] = (file_off, len(payload),
-                              checksum.crc32c(payload))
+                self._data.write(stored)
+                blob_at[i] = (file_off, len(payload), len(stored),
+                              checksum.crc32c(stored), comp_id)
                 data_dirty = True
         if data_dirty:
             self._data.flush()
@@ -215,11 +241,12 @@ class BlockStore(ObjectStore):
             elif code == osr.OP_WRITE:
                 m = load(op[1], op[2], create=True)
                 off, payload = op[3], op[4]
-                foff, flen, fcrc = blob_at[i]
-                m.extents = _clip(m.extents, off, off + flen)
-                m.extents.append(_Extent(off, flen, foff, flen, fcrc, 0))
+                foff, raw_len, disk_len, fcrc, comp_id = blob_at[i]
+                m.extents = _clip(m.extents, off, off + raw_len)
+                m.extents.append(_Extent(off, raw_len, foff, raw_len,
+                                         fcrc, 0, disk_len, comp_id))
                 m.extents.sort(key=lambda x: x.logical_off)
-                m.size = max(m.size, off + flen)
+                m.size = max(m.size, off + raw_len)
             elif code == osr.OP_ZERO:
                 m = load(op[1], op[2], create=True)
                 off, ln = op[3], op[4]
@@ -258,12 +285,33 @@ class BlockStore(ObjectStore):
             on_commit()
 
     # -- reads --------------------------------------------------------
+    @staticmethod
+    def _comp_config():
+        """(Compressor|None, min_blob_size, required_ratio) from config."""
+        from ceph_tpu.utils.config import g_conf
+        name = g_conf()["bluestore_compression_algorithm"]
+        if name == "none":
+            return None, 0, 1.0
+        from ceph_tpu.compressor import CompressionError, Compressor
+        try:
+            comp = Compressor.create(name)
+        except CompressionError:
+            return None, 0, 1.0
+        return (comp, g_conf()["bluestore_compression_min_blob_size"],
+                g_conf()["bluestore_compression_required_ratio"])
+
     def _read_blob(self, x: _Extent) -> bytes:
         self._data.seek(x.blob_off)
-        blob = self._data.read(x.blob_len)
-        if len(blob) != x.blob_len or checksum.crc32c(blob) != x.blob_crc:
+        blob = self._data.read(x.disk_len)
+        if len(blob) != x.disk_len or checksum.crc32c(blob) != x.blob_crc:
             raise EIOError(
                 f"checksum mismatch reading blob at {x.blob_off}")
+        if x.comp != COMP_NONE:
+            from ceph_tpu.compressor import Compressor
+            blob = Compressor.create(_COMP_ALGS[x.comp]).decompress(blob)
+            if len(blob) != x.blob_len:
+                raise EIOError(
+                    f"decompressed blob at {x.blob_off} has wrong size")
         return blob
 
     def read(self, cid: str, oid: str, off: int = 0,

@@ -196,6 +196,15 @@ for _o in [
                          "xxhash32", "xxhash64")),
     Option("bluestore_csum_block_size", int, 4096, "advanced",
            "checksum granularity"),
+    Option("bluestore_compression_algorithm", str, "none", "advanced",
+           "blob compression (options.cc bluestore_compression_algorithm)",
+           enum_allowed=("none", "zlib", "zstd", "bz2", "lzma")),
+    Option("bluestore_compression_min_blob_size", int, 4096, "advanced",
+           "blobs below this are stored raw"),
+    Option("bluestore_compression_required_ratio", float, 0.875,
+           "advanced",
+           "store compressed only if size <= raw * ratio "
+           "(options.cc bluestore_compression_required_ratio)"),
     Option("bluestore_debug_inject_read_err", bool, False, "dev",
            "EIO injection on read (options.cc:4343)"),
     Option("bluestore_debug_inject_csum_err_probability", float, 0.0, "dev",

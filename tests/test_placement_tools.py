@@ -1,0 +1,39 @@
+"""crushtool / osdmaptool (src/tools/crushtool, osdmaptool roles)."""
+
+import json
+
+from ceph_tpu.parallel import crush
+from ceph_tpu.tools import crushtool, osdmaptool
+
+
+def test_crushtool_build_test_roundtrip(tmp_path, capsys):
+    out = tmp_path / "map.json"
+    assert crushtool.main(["--build", "12", "--per-host", "4",
+                           "--out", str(out)]) == 0
+    assert crushtool.main(["--map", str(out), "--test",
+                           "--num-rep", "3", "--max-x", "511"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["bad_mappings"] == 0
+    assert len(rep["device_utilization"]) == 12
+    assert rep["spread"]["stddev_pct"] < 20.0
+
+
+def test_crushtool_json_mapping_identical(tmp_path):
+    """A map serialized+reloaded must produce identical placements."""
+    cm = crush.build_flat_map(10, 3)
+    doc = crushtool.map_to_json(cm)
+    cm2 = crushtool.map_from_json(json.loads(json.dumps(doc)))
+    for x in range(200):
+        assert cm.do_rule("data", x, 3) == cm2.do_rule("data", x, 3)
+
+
+def test_osdmaptool_simple_and_ec(capsys):
+    assert osdmaptool.main(["--createsimple", "6", "--pg-num", "32",
+                            "--test-map-pgs"]) == 0
+    capsys.readouterr()
+    assert osdmaptool.main(["--createsimple", "8", "--ec", "4,2",
+                            "--pg-num", "16", "--test-map-pgs"]) == 0
+    out = capsys.readouterr().out
+    rep = json.loads(out[out.index('{\n  "pgs"'):])
+    assert rep["pgs"] == 16 and rep["bad_mappings"] == 0
+    assert len(rep["pgs_per_osd"]) == 8
