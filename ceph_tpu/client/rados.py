@@ -82,13 +82,15 @@ class IoCtx:
 
 
 class RadosClient:
-    def __init__(self, mon_addr: str, name: str | None = None) -> None:
+    def __init__(self, mon_addr: str, name: str | None = None,
+                 auth: tuple[str, bytes] | None = None) -> None:
         if name is None:
             _client_seq[0] += 1
             name = f"client.{_client_seq[0]}"
         self.msgr = Messenger(name)
         self.monc = MonClient(self.msgr, mon_addr)
         self.objecter: Objecter | None = None
+        self._auth = auth          # (entity, secret) for cephx clusters
         self._connected = False
 
     def connect(self, timeout: float = 10.0) -> "RadosClient":
@@ -98,6 +100,10 @@ class RadosClient:
         # arrived on, but map pushes need our listening addr
         self.msgr.bind()
         self.objecter = Objecter(self.msgr, self.monc)
+        if self._auth is not None:
+            # must precede subscribe: an authed cluster drops every
+            # unsigned frame except the MAuth exchange itself
+            self.monc.authenticate(*self._auth, timeout=timeout)
         self.monc.subscribe()
         self.monc.wait_for_map(1, timeout)
         self._connected = True

@@ -119,10 +119,13 @@ class OSD:
     """One OSD daemon (also the backends' Listener)."""
 
     def __init__(self, osd_id: int, store: ObjectStore,
-                 mon_addr: str) -> None:
+                 mon_addr: str, keyring=None) -> None:
         self.whoami = osd_id
         self.store = store
         self.msgr = Messenger(f"osd.{osd_id}")
+        if keyring is not None:
+            from ceph_tpu.parallel import auth as A
+            A.daemon_auth(self.msgr, keyring, f"osd.{osd_id}")
         self.msgr.set_dispatcher(self._dispatch)
         self.monc = MonClient(self.msgr, mon_addr)
         self.monc.add_map_callback(self._on_map)

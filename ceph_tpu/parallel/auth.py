@@ -1,0 +1,227 @@
+"""cephx-lite — ticket auth + per-message signing (src/auth/ role).
+
+Reference: CephX (src/auth/cephx): a client proves identity to the
+mon's auth service, receives a time-limited ticket sealed with the
+service key plus a session key sealed with the client's own secret,
+and then authenticates to every daemon by presenting the ticket and
+signing messages with the session key (CEPHX_SIGN_MESSAGES). Daemons
+validate tickets with the shared service key — no per-connection round
+trip to the mon.
+
+Crypto here is stdlib-only: HMAC-SHA256 for tickets/signatures and an
+HMAC-derived keystream for sealing the session key (the reference uses
+AES via its own CryptoKey). Same trust structure, lighter primitives.
+
+Config: ``auth_cluster_required = cephx`` turns on frame verification;
+``none`` (default) keeps the open behavior.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import struct
+import threading
+import time
+
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("auth")
+
+#: keyring entry every daemon shares; seals tickets (the per-service
+#: keys of real cephx collapsed to one cluster service key)
+SERVICE_ENTITY = "service"
+
+SIG_LEN = 16
+TICKET_TTL = 3600.0
+
+
+class AuthError(Exception):
+    pass
+
+
+def _mac(key: bytes, *parts: bytes) -> bytes:
+    h = hmac.new(key, digestmod=hashlib.sha256)
+    for p in parts:
+        h.update(struct.pack("<I", len(p)))
+        h.update(p)
+    return h.digest()
+
+
+def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+    out = b""
+    ctr = 0
+    while len(out) < n:
+        out += hashlib.sha256(
+            key + nonce + struct.pack("<Q", ctr)).digest()
+        ctr += 1
+    return out[:n]
+
+
+def seal(key: bytes, nonce: bytes, plaintext: bytes) -> bytes:
+    return bytes(a ^ b for a, b in
+                 zip(plaintext, _keystream(key, nonce, len(plaintext))))
+
+
+unseal = seal   # XOR keystream is symmetric
+
+
+class Keyring:
+    """entity -> secret (src/auth keyring file role)."""
+
+    def __init__(self) -> None:
+        self._keys: dict[str, bytes] = {}
+
+    def generate(self, entity: str) -> bytes:
+        self._keys[entity] = os.urandom(32)
+        return self._keys[entity]
+
+    def add(self, entity: str, secret: bytes) -> None:
+        self._keys[entity] = secret
+
+    def get(self, entity: str) -> bytes:
+        try:
+            return self._keys[entity]
+        except KeyError:
+            raise AuthError(f"no key for entity {entity!r}")
+
+    def __contains__(self, entity: str) -> bool:
+        return entity in self._keys
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({e: base64.b64encode(s).decode()
+                       for e, s in self._keys.items()}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "Keyring":
+        kr = cls()
+        with open(path) as f:
+            for e, s in json.load(f).items():
+                kr.add(e, base64.b64decode(s))
+        return kr
+
+
+# -- tickets ----------------------------------------------------------
+
+def grant_ticket(service_key: bytes, entity: str,
+                 ttl: float = TICKET_TTL) -> tuple[bytes, bytes]:
+    """Mon side: returns (ticket_blob, session_key). The blob is
+    readable by any daemon holding the service key and unforgeable
+    without it."""
+    session_key = os.urandom(32)
+    body = json.dumps({
+        "entity": entity,
+        "expires": time.time() + ttl,
+        "session_key": base64.b64encode(session_key).decode(),
+    }).encode()
+    sealed = seal(service_key, b"ticket", body)
+    blob = struct.pack("<I", len(sealed)) + sealed + \
+        _mac(service_key, body)
+    return blob, session_key
+
+
+def verify_ticket(service_key: bytes, blob: bytes
+                  ) -> tuple[str, bytes] | None:
+    """Daemon side: (entity, session_key) or None if invalid/expired."""
+    try:
+        (n,) = struct.unpack_from("<I", blob)
+        sealed = blob[4:4 + n]
+        mac = blob[4 + n:]
+        body = unseal(service_key, b"ticket", sealed)
+        if not hmac.compare_digest(_mac(service_key, body), mac):
+            return None
+        d = json.loads(body)
+        if d["expires"] < time.time():
+            return None
+        return d["entity"], base64.b64decode(d["session_key"])
+    except Exception:
+        return None
+
+
+# -- per-message signing (CEPHX_SIGN_MESSAGES role) -------------------
+
+class AuthSigner:
+    """Installed on a messenger once authenticated: stamps every frame
+    with ticket + HMAC(session_key, payload)."""
+
+    def __init__(self, ticket_blob: bytes, session_key: bytes) -> None:
+        self._ticket_b64 = base64.b64encode(ticket_blob).decode()
+        self._session_key = session_key
+
+    def sign(self, payload: bytes) -> str:
+        sig = _mac(self._session_key, payload)[:SIG_LEN]
+        return self._ticket_b64 + ":" + sig.hex()
+
+
+class AuthVerifier:
+    """Installed on a daemon's messenger: validates the frame stamp.
+    Ticket validation is cached per blob (the reference validates the
+    authorizer once per connection; we key by ticket)."""
+
+    def __init__(self, service_key: bytes) -> None:
+        self._service_key = service_key
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple[str, bytes]] = {}
+
+    def verify(self, auth_field: str, payload: bytes) -> str | None:
+        """Returns the authenticated entity, or None."""
+        if ":" not in auth_field:
+            return None
+        ticket_b64, sig_hex = auth_field.split(":", 1)
+        with self._lock:
+            entry = self._cache.get(ticket_b64)
+        if entry is None:
+            got = verify_ticket(self._service_key,
+                                base64.b64decode(ticket_b64))
+            if got is None:
+                return None
+            entry = got
+            with self._lock:
+                if len(self._cache) > 1024:
+                    self._cache.clear()
+                self._cache[ticket_b64] = entry
+        entity, session_key = entry
+        want = _mac(session_key, payload)[:SIG_LEN].hex()
+        if not hmac.compare_digest(want, sig_hex):
+            return None
+        return entity
+
+
+# -- mon-side auth service (AuthMonitor role) -------------------------
+
+class AuthService:
+    def __init__(self, keyring: Keyring) -> None:
+        self.keyring = keyring
+        self.service_key = keyring.get(SERVICE_ENTITY)
+
+    def handle_request(self, entity: str, nonce_hex: str
+                       ) -> tuple[bytes, bytes] | None:
+        """Returns (ticket_blob, sealed_session_key) or None for an
+        unknown entity. The session key is sealed with the ENTITY's
+        secret, so only the real owner can use the ticket (replaying
+        the request yields a blob the replayer cannot unseal)."""
+        if entity not in self.keyring:
+            return None
+        ticket, session_key = grant_ticket(self.service_key, entity)
+        sealed = seal(self.keyring.get(entity),
+                      bytes.fromhex(nonce_hex), session_key)
+        return ticket, sealed
+
+
+def unseal_session_key(entity_secret: bytes, nonce: bytes,
+                       sealed: bytes) -> bytes:
+    return unseal(entity_secret, nonce, sealed)
+
+
+def daemon_auth(msgr, keyring: Keyring, entity: str) -> None:
+    """Arm a daemon's messenger: daemons hold the service key, so they
+    self-grant a ticket (signer) and validate everyone else's
+    (verifier)."""
+    service_key = keyring.get(SERVICE_ENTITY)
+    ticket, session_key = grant_ticket(service_key, entity)
+    msgr.signer = AuthSigner(ticket, session_key)
+    msgr.verifier = AuthVerifier(service_key)

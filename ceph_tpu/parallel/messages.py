@@ -184,6 +184,21 @@ class MOSDOpReply(Message):
               ("data", "bytes"), ("version", "u64")]
 
 
+# -- auth (MAuth / cephx ticket grant, src/auth role) ------------------
+
+class MAuth(Message):
+    """Client -> mon: request a ticket. ``nonce`` (hex) seals the
+    session key in the reply so only the secret holder can use it."""
+    MSG_TYPE = 38
+    FIELDS = [("entity", "str"), ("nonce", "str"), ("tid", "u64")]
+
+
+class MAuthReply(Message):
+    MSG_TYPE = 39
+    FIELDS = [("code", "i32"), ("ticket", "bytes"),
+              ("sealed_session_key", "bytes"), ("tid", "u64")]
+
+
 # -- EC sub-ops (ECMsgTypes.h ECSubWrite/ECSubRead + replies) ----------
 
 class MECSubWrite(Message):

@@ -40,6 +40,9 @@ log = Dout("ms")
 _MAGIC = 0xCE9FA127
 _HDR = struct.Struct("<IQH")   # magic, seq, msg type
 
+#: message types allowed before authentication (the MAuth exchange)
+_PREAUTH_TYPES = (38, 39)
+
 
 class Connection:
     """One live peer link. ``peer_name`` ("osd.3") and ``peer_addr``
@@ -54,6 +57,7 @@ class Connection:
         self.lock = asyncio.Lock()
         self.peer_name = ""
         self.peer_addr = ""
+        self.auth_entity = ""    # authenticated identity ("" = none)
         self.closed = False
 
     def send_message(self, msg: Message) -> None:
@@ -115,6 +119,11 @@ class Messenger:
         self._throttle: Throttle | None = None
         self._inject_every = g_conf()["ms_inject_socket_failures"]
         self._inject_rng = random.Random(checksum.crc32c(entity_name.encode()))
+        # cephx-lite hooks (parallel/auth.py): ``signer`` stamps every
+        # outgoing frame, ``verifier`` gates every incoming one (except
+        # the pre-auth MAuth exchange)
+        self.signer = None
+        self.verifier = None
         self._running = False
 
     # -- lifecycle ----------------------------------------------------
@@ -198,7 +207,10 @@ class Messenger:
                 (nlen,) = struct.unpack(
                     "<H", await conn.reader.readexactly(2))
                 meta = (await conn.reader.readexactly(nlen)).decode()
-                peer_name, _, peer_addr = meta.partition("|")
+                parts = meta.split("|", 2)
+                peer_name = parts[0]
+                peer_addr = parts[1] if len(parts) > 1 else ""
+                auth_field = parts[2] if len(parts) > 2 else ""
                 conn.peer_name, conn.peer_addr = peer_name, peer_addr
                 plen, crc = struct.unpack(
                     "<II", await conn.reader.readexactly(8))
@@ -214,6 +226,16 @@ class Messenger:
                         log(0, f"message crc mismatch from {peer_name}, "
                             "dropping connection")
                         break
+                    if self.verifier is not None and \
+                            mtype not in _PREAUTH_TYPES:
+                        entity = self.verifier.verify(auth_field,
+                                                      payload)
+                        if entity is None:
+                            log(1, f"unauthenticated {mtype} frame "
+                                f"from {peer_name!r}, dropping "
+                                "connection")
+                            break
+                        conn.auth_entity = entity
                     try:
                         msg = decode_message(mtype, payload)
                         msg.seq = seq
@@ -285,7 +307,8 @@ class Messenger:
             return True   # message silently lost (lossy semantics)
         payload = msg.encode_payload()
         self._seq += 1
-        meta = f"{self.entity_name}|{self.addr}".encode()
+        auth = self.signer.sign(payload) if self.signer else ""
+        meta = f"{self.entity_name}|{self.addr}|{auth}".encode()
         crc = checksum.crc32c(payload) if self._crc_data else 0
         frame = (_HDR.pack(_MAGIC, self._seq, msg.MSG_TYPE)
                  + struct.pack("<H", len(meta)) + meta

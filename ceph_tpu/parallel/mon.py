@@ -42,9 +42,14 @@ log = Dout("mon")
 class Monitor:
     """A single monitor daemon ("mon.a")."""
 
-    def __init__(self, name: str = "a", db: KeyValueDB | None = None) -> None:
+    def __init__(self, name: str = "a", db: KeyValueDB | None = None,
+                 keyring=None) -> None:
         self.name = name
         self.db = db or MemDB()
+        self.auth_service = None
+        if keyring is not None:
+            from ceph_tpu.parallel import auth as A
+            self.auth_service = A.AuthService(keyring)
         self.osdmap = OSDMap()
         self.ec_profiles: dict[str, dict] = {}
         self.msgr = Messenger(f"mon.{name}")
@@ -73,6 +78,10 @@ class Monitor:
         for osd, info in self.osdmap.osds.items():
             if info.up:
                 self._last_beacon.setdefault(osd, now)
+        if self.auth_service is not None:
+            from ceph_tpu.parallel import auth as A
+            A.daemon_auth(self.msgr, self.auth_service.keyring,
+                          f"mon.{self.name}")
         from ceph_tpu.utils.admin_socket import register_common_commands
         register_common_commands(self.asok)
         self.asok.register_command(
@@ -151,7 +160,9 @@ class Monitor:
     # -- dispatch -----------------------------------------------------
     def _dispatch(self, msg: M.Message, conn: Connection) -> None:
         with self._lock:
-            if isinstance(msg, M.MOSDBoot):
+            if isinstance(msg, M.MAuth):
+                self._handle_auth(msg, conn)
+            elif isinstance(msg, M.MOSDBoot):
                 self._handle_boot(msg, conn)
             elif isinstance(msg, M.MOSDAlive):
                 self._last_beacon[msg.osd_id] = time.monotonic()
@@ -166,6 +177,26 @@ class Monitor:
                 code, outs, data = self._handle_command(dict(msg.cmd))
                 conn.send_message(M.MMonCommandReply(
                     tid=msg.tid, code=code, outs=outs, data=data))
+
+    def _handle_auth(self, msg: M.MAuth, conn: Connection) -> None:
+        """AuthMonitor role: grant a ticket. An auth-disabled mon
+        answers success with an empty ticket (client stays unsigned)."""
+        if self.auth_service is None:
+            conn.send_message(M.MAuthReply(
+                code=0, ticket=b"", sealed_session_key=b"",
+                tid=msg.tid))
+            return
+        got = self.auth_service.handle_request(msg.entity, msg.nonce)
+        if got is None:
+            log(1, f"auth: denied unknown entity {msg.entity!r}")
+            conn.send_message(M.MAuthReply(
+                code=-13, ticket=b"", sealed_session_key=b"",
+                tid=msg.tid))
+            return
+        ticket, sealed = got
+        conn.send_message(M.MAuthReply(
+            code=0, ticket=ticket, sealed_session_key=sealed,
+            tid=msg.tid))
 
     def _handle_boot(self, msg: M.MOSDBoot, conn: Connection) -> None:
         osd = msg.osd_id

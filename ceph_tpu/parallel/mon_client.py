@@ -46,7 +46,7 @@ class MonClient:
             for fn in callbacks:
                 fn(newmap)
             return True
-        if isinstance(msg, M.MMonCommandReply):
+        if isinstance(msg, (M.MMonCommandReply, M.MAuthReply)):
             with self._lock:
                 ent = self._pending.pop(msg.tid, None)
             if ent:
@@ -60,6 +60,37 @@ class MonClient:
             self._map_callbacks.append(fn)
 
     # -- outbound -----------------------------------------------------
+    def authenticate(self, entity: str, secret: bytes,
+                     timeout: float = 10.0) -> None:
+        """cephx-lite handshake (MonClient::authenticate role): obtain
+        a ticket + session key from the mon's auth service and install
+        the message signer on our messenger. No-op reply (empty
+        ticket) means the cluster runs auth=none."""
+        import os
+
+        from ceph_tpu.parallel import auth as A
+        nonce = os.urandom(16).hex()
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            ent = [threading.Event(), None]
+            self._pending[tid] = ent
+        self.msgr.send_message(
+            M.MAuth(entity=entity, nonce=nonce, tid=tid), self.mon_addr)
+        if not ent[0].wait(timeout):
+            with self._lock:
+                self._pending.pop(tid, None)
+            raise TimeoutError("authentication timed out")
+        reply: M.MAuthReply = ent[1]
+        if reply.code != 0:
+            raise A.AuthError(f"authentication denied ({reply.code})")
+        if not reply.ticket:
+            return                    # auth disabled cluster-side
+        session_key = A.unseal_session_key(
+            secret, bytes.fromhex(nonce), reply.sealed_session_key)
+        self.msgr.signer = A.AuthSigner(reply.ticket, session_key)
+        log(5, f"{entity}: authenticated, message signing enabled")
+
     def subscribe(self) -> None:
         """Ask for the current map + pushes on every epoch."""
         self.msgr.send_message(

@@ -21,7 +21,7 @@ log = Dout("qa")
 
 class MiniCluster:
     def __init__(self, n_osds: int = 3, store: str = "memstore",
-                 data_dir: str | None = None) -> None:
+                 data_dir: str | None = None, auth: bool = False) -> None:
         self.n_osds = n_osds
         self.store_kind = store
         self.data_dir = data_dir
@@ -30,10 +30,16 @@ class MiniCluster:
         self.osds: dict[int, OSD] = {}
         self._stores: dict[int, object] = {}
         self._clients: list[RadosClient] = []
+        self.keyring = None
+        if auth:
+            from ceph_tpu.parallel import auth as A
+            self.keyring = A.Keyring()
+            self.keyring.generate(A.SERVICE_ENTITY)
+            self.keyring.generate("client.admin")
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "MiniCluster":
-        self.mon = Monitor("a")
+        self.mon = Monitor("a", keyring=self.keyring)
         self.mon_addr = self.mon.start()
         for i in range(self.n_osds):
             self.start_osd(i)
@@ -49,7 +55,7 @@ class MiniCluster:
     def start_osd(self, osd_id: int) -> OSD:
         store = self._stores.get(osd_id) or self._make_store(osd_id)
         self._stores[osd_id] = store
-        osd = OSD(osd_id, store, self.mon_addr)
+        osd = OSD(osd_id, store, self.mon_addr, keyring=self.keyring)
         osd.start()
         self.osds[osd_id] = osd
         return osd
@@ -72,7 +78,10 @@ class MiniCluster:
 
     # -- clients ------------------------------------------------------
     def client(self) -> RadosClient:
-        c = RadosClient(self.mon_addr).connect()
+        auth = None
+        if self.keyring is not None:
+            auth = ("client.admin", self.keyring.get("client.admin"))
+        c = RadosClient(self.mon_addr, auth=auth).connect()
         self._clients.append(c)
         return c
 
