@@ -96,24 +96,34 @@ class Objecter:
                   timeout: float = 30.0) -> M.MOSDOpReply:
         """Synchronous submit (the aio variant is just this on a
         thread); raises ObjecterError on errno replies."""
+        from ceph_tpu.utils.tracing import tracer
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
+        span = tracer().new_trace(f"osd_op(op={op} oid={oid})",
+                                  self.msgr.entity_name)
         msg = M.MOSDOp(tid=tid, client=self.msgr.entity_name, epoch=0,
                        pool=pool, ps=max(ps, 0), oid=oid, op=op,
-                       offset=offset, length=length, data=bytes(data))
+                       offset=offset, length=length, data=bytes(data),
+                       trace=span.wire())
         rec = _Op(tid, msg)
         with self._lock:
             self._pending[tid] = rec
+        span.event("submitted")
         self._send(rec)
-        if not rec.event.wait(timeout):
-            with self._lock:
-                self._pending.pop(tid, None)
-            raise ObjecterError(-110, f"op on {oid!r} timed out")  # ETIMEDOUT
-        reply = rec.reply
-        if reply.code < 0:
-            raise ObjecterError(reply.code)
-        return reply
+        try:
+            if not rec.event.wait(timeout):
+                with self._lock:
+                    self._pending.pop(tid, None)
+                span.event("timeout")
+                raise ObjecterError(-110, f"op on {oid!r} timed out")
+            span.event("reply")
+            reply = rec.reply
+            if reply.code < 0:
+                raise ObjecterError(reply.code)
+            return reply
+        finally:
+            span.finish()
 
     def _send(self, op: _Op) -> None:
         osdmap = self.monc.osdmap

@@ -56,6 +56,7 @@ from ceph_tpu.osd.pg_backend import (
 )
 from ceph_tpu.parallel import messages as M
 from ceph_tpu.store.object_store import EIOError, NoSuchObject, StoreError
+from ceph_tpu.utils import tracing
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("osd")
@@ -132,6 +133,10 @@ class ECBackend(PGBackend):
                            lambda: on_commit(0))
         self.parent.register_write(iw)
         epoch = self.parent.get_osdmap().epoch
+        # dataflow trace: one child span per shard sub-op, carried in
+        # the message (ECBackend.cc:2022-2026 role)
+        op_span = tracing.current()
+        op_span.event("start ec write")
         for pos in positions:
             osd = pg.acting[pos]
             cid = pg_cid(pg.pool, pg.ps, pos)
@@ -144,10 +149,12 @@ class ECBackend(PGBackend):
                     txn,
                     lambda p=pos: iw.complete(p) and iw.on_all_commit())
             else:
+                child = op_span.child(f"ec_sub_write(shard={pos})")
                 self.parent.send_osd(osd, M.MECSubWrite(
                     tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
                     epoch=epoch, oid=oid, version=version,
-                    txn_bytes=txn.encode()))
+                    txn_bytes=txn.encode(), trace=child.wire()))
+                child.finish()
         # a write of every shard supersedes any pending recovery for it
         for missing in pg.peer_missing.values():
             missing.pop(oid, None)

@@ -65,6 +65,7 @@ from ceph_tpu.utils.admin_socket import (
 )
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
+from ceph_tpu.utils import tracing
 from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
@@ -200,6 +201,10 @@ class OSD:
         self.asok.register_command(
             "dump_pgs", lambda a: self._asok_dump_pgs(),
             "primary-side pg states")
+        self.asok.register_command(
+            "dump_traces",
+            lambda a: tracing.tracer().dump(a.get("trace_id")),
+            "finished dataflow-trace spans (blkin role)")
         self.asok.start()
         self.addr = self.msgr.bind(host, port)
         self.monc.subscribe()
@@ -419,8 +424,13 @@ class OSD:
                           ) -> None:
         txn = Transaction.decode(msg.txn_bytes)
         self.logger.inc("subop_w")
+        span = tracing.tracer().from_wire(
+            msg.trace, f"sub_write(shard={msg.shard})",
+            f"osd.{self.whoami}")
 
         def committed() -> None:
+            span.event("committed")
+            span.finish()
             conn.send_message(M.MECSubWriteReply(
                 tid=msg.tid, pool=msg.pool, ps=msg.ps, shard=msg.shard,
                 committed=True, version=msg.version))
@@ -523,6 +533,9 @@ class OSD:
             f"osd_op(client={msg.client} tid={msg.tid} op={msg.op} "
             f"oid={msg.oid})")
         track.mark_event("dequeued")
+        span = tracing.tracer().from_wire(
+            msg.trace, f"handle_osd_op(oid={msg.oid})",
+            f"osd.{self.whoami}")
         cache_key = (msg.client, msg.tid)
         if msg.op in self._MUTATING_OPS:
             with self._op_cache_lock:
@@ -530,12 +543,16 @@ class OSD:
             if cached is not None:     # client resend of an applied op
                 track.mark_event("dup_op_cached_reply")
                 track.finish()
+                span.event("dup_op_cached_reply")
+                span.finish()
                 conn.send_message(cached)
                 return
 
         def reply(code: int, data: bytes = b"", version: int = 0) -> None:
             self.logger.tinc("op_latency", time.perf_counter() - t0)
             track.finish()
+            span.event(f"reply code={code}")
+            span.finish()
             out = M.MOSDOpReply(
                 tid=msg.tid, code=code, epoch=osdmap.epoch, data=data,
                 version=version)
@@ -584,7 +601,12 @@ class OSD:
                 pg.waiting_for_active.append((msg, conn, t0))
                 return
             track.mark_event("reached_pg")
-            self._execute_op(pg, msg, reply)
+            span.event("reached_pg")
+            tracing.set_current(span)
+            try:
+                self._execute_op(pg, msg, reply)
+            finally:
+                tracing.set_current(tracing.NOOP)
 
     def _flush_waiting(self, pg: PG) -> None:
         """Re-run parked ops (caller holds pg.lock, state ACTIVE)."""

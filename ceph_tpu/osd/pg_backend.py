@@ -256,6 +256,8 @@ class ReplicatedBackend(PGBackend):
                            lambda: on_commit(0))
         self.parent.register_write(iw)
         epoch = self.parent.get_osdmap().epoch
+        from ceph_tpu.utils import tracing
+        op_span = tracing.current()
         for pos in positions:
             osd = pg.acting[pos]
             txn = txn_builder(cid)
@@ -265,10 +267,12 @@ class ReplicatedBackend(PGBackend):
                     txn,
                     lambda p=pos: iw.complete(p) and iw.on_all_commit())
             else:
+                child = op_span.child(f"repl_sub_write(pos={pos})")
                 self.parent.send_osd(osd, M.MECSubWrite(
                     tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
                     epoch=epoch, oid=oid, version=entry.version,
-                    txn_bytes=txn.encode()))
+                    txn_bytes=txn.encode(), trace=child.wire()))
+                child.finish()
 
     def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
                      on_commit: Callable[[int], None]) -> None:

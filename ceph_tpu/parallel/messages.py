@@ -171,11 +171,13 @@ OSD_OP_APPEND = 6
 OSD_OP_LIST = 7        # list objects of one PG (PGLS role)
 
 class MOSDOp(Message):
+    """``trace`` carries the dataflow-trace context (Message.h:264
+    ZTracer role); empty when tracing is off."""
     MSG_TYPE = 20
     FIELDS = [("tid", "u64"), ("client", "str"), ("epoch", "u32"),
               ("pool", "i32"), ("ps", "u32"), ("oid", "str"),
               ("op", "u8"), ("offset", "u64"), ("length", "u64"),
-              ("data", "bytes")]
+              ("data", "bytes"), ("trace", "str")]
 
 
 class MOSDOpReply(Message):
@@ -208,7 +210,8 @@ class MECSubWrite(Message):
     MSG_TYPE = 30
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("epoch", "u32"), ("oid", "str"),
-              ("version", "u64"), ("txn_bytes", "bytes")]
+              ("version", "u64"), ("txn_bytes", "bytes"),
+              ("trace", "str")]
 
 
 class MECSubWriteReply(Message):

@@ -238,6 +238,8 @@ for _o in [
            "finished ops kept for dump_historic_ops"),
     Option("admin_socket_dir", str, "", "advanced",
            "directory for daemon .asok files (empty = per-daemon tmpdir)"),
+    Option("trace_all", bool, False, "dev",
+           "dataflow tracing for every op (blkin_trace_all role)"),
 ]:
     SCHEMA.add(_o)
 

@@ -1,0 +1,145 @@
+"""Dataflow tracing — the Blkin/ZTracer role (src/blkin, ZTracer::Trace).
+
+Reference: trace spans ride INSIDE messages (src/msg/Message.h:264) so
+one client op's causality chain is visible across daemons: the EC write
+path opens a span per shard sub-op (ECBackend.cc:1939, 2022-2026).
+
+Here a ``Span`` carries (trace_id, span_id, parent_id); the wire form
+is the ``"trace_id:span_id"`` string stored in a message's ``trace``
+field. Every process has one ``Tracer`` collecting finished spans in a
+bounded ring, served over the admin socket (``dump_traces``). Tracing
+is off unless ``trace_all`` is set (blkin_trace_all role) — spans then
+cost two monotonic reads and a dict append.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+
+_seq = itertools.count(1)
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "service",
+                 "start", "end", "events", "_tracer")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, span_id: int,
+                 parent_id: int, name: str, service: str) -> None:
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.service = service
+        self.start = time.monotonic()
+        self.end = 0.0
+        self.events: list[tuple[float, str]] = []
+
+    def event(self, name: str) -> None:
+        self.events.append((time.monotonic() - self.start, name))
+
+    def child(self, name: str, service: str | None = None) -> "Span":
+        return Span(self._tracer, self.trace_id, next(_seq),
+                    self.span_id, name, service or self.service)
+
+    def wire(self) -> str:
+        """The context string a message carries (Message.h:264 role)."""
+        return f"{self.trace_id}:{self.span_id}"
+
+    def finish(self) -> None:
+        self.end = time.monotonic()
+        self._tracer._record(self)
+
+    def dump(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "service": self.service,
+                "duration": round((self.end or time.monotonic())
+                                  - self.start, 6),
+                "events": [{"t": round(t, 6), "event": e}
+                           for t, e in self.events]}
+
+
+class _NoopSpan:
+    """Returned when tracing is off: every operation is free."""
+    __slots__ = ()
+
+    def event(self, name: str) -> None: ...
+    def finish(self) -> None: ...
+    def wire(self) -> str:
+        return ""
+
+    def child(self, name: str, service: str | None = None) -> "_NoopSpan":
+        return self
+
+
+NOOP = _NoopSpan()
+
+
+class Tracer:
+    def __init__(self, ring_size: int = 2000) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=ring_size)
+
+    @property
+    def enabled(self) -> bool:
+        from ceph_tpu.utils.config import g_conf
+        return bool(g_conf()["trace_all"])
+
+    def new_trace(self, name: str, service: str):
+        if not self.enabled:
+            return NOOP
+        return Span(self, os.urandom(8).hex(), next(_seq), 0, name,
+                    service)
+
+    def from_wire(self, ctx: str, name: str, service: str):
+        """Continue a trace carried in a message; noop when the sender
+        did not trace (empty ctx) or tracing is off here."""
+        if not ctx or not self.enabled:
+            return NOOP
+        trace_id, _, parent = ctx.partition(":")
+        try:
+            parent_id = int(parent)
+        except ValueError:
+            return NOOP
+        return Span(self, trace_id, next(_seq), parent_id, name, service)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span.dump())
+
+    def dump(self, trace_id: str | None = None) -> list[dict]:
+        with self._lock:
+            out = list(self._ring)
+        if trace_id:
+            out = [s for s in out if s["trace_id"] == trace_id]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_tracer = Tracer()
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+# -- per-thread current span (how a backend picks up the op's span
+# without threading it through every call signature) ------------------
+
+_tls = threading.local()
+
+
+def set_current(span) -> None:
+    _tls.span = span
+
+
+def current():
+    return getattr(_tls, "span", NOOP)

@@ -1,0 +1,75 @@
+"""Dataflow tracing (blkin/ZTracer role): spans ride inside messages
+and stitch one client op's causality chain across daemons."""
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.utils import tracing
+from ceph_tpu.utils.config import g_conf
+
+
+@pytest.fixture
+def traced():
+    conf = g_conf()
+    old = conf["trace_all"]
+    conf.set("trace_all", True)
+    tracing.tracer().clear()
+    yield tracing.tracer()
+    conf.set("trace_all", old)
+
+
+def test_noop_when_disabled():
+    assert not tracing.tracer().enabled
+    span = tracing.tracer().new_trace("x", "svc")
+    span.event("e")
+    span.finish()
+    assert span.wire() == ""
+
+
+def test_span_tree(traced):
+    root = traced.new_trace("op", "client")
+    child = root.child("sub", "osd.0")
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    # wire context round-trips into a remote continuation
+    cont = traced.from_wire(child.wire(), "remote", "osd.1")
+    assert cont.trace_id == root.trace_id
+    assert cont.parent_id == child.span_id
+    child.finish(); cont.finish(); root.finish()
+    spans = traced.dump(root.trace_id)
+    assert len(spans) == 3
+
+
+def test_ec_write_traced_across_daemons(traced):
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("trpool", k=2, m=1, pg_num=1)
+        io = rados.open_ioctx("trpool")
+        io.write_full("traced_obj", b"t" * 20_000)
+
+        spans = traced.dump()
+        mine = [s for s in spans if "traced_obj" in s["name"]
+                or s["name"].startswith(("ec_sub_write", "sub_write"))]
+        # client root span for the write
+        roots = [s for s in spans if s["service"].startswith("client")
+                 and "op=1" in s["name"]]
+        assert roots, spans
+        tid = roots[-1]["trace_id"]
+        chain = traced.dump(tid)
+        services = {s["service"] for s in chain}
+        # the op crossed client -> primary osd -> replica shards
+        assert any(sv.startswith("client") for sv in services)
+        assert any(sv.startswith("osd.") for sv in services)
+        names = {s["name"].split("(")[0] for s in chain}
+        assert "handle_osd_op" in names
+        assert "ec_sub_write" in names and "sub_write" in names
+        # parent links form a tree rooted at the client span
+        by_id = {s["span_id"]: s for s in chain}
+        root_id = roots[-1]["span_id"]
+        for s in chain:
+            cur = s
+            for _ in range(10):
+                if cur["span_id"] == root_id:
+                    break
+                cur = by_id.get(cur["parent_id"], by_id[root_id])
+            assert cur["span_id"] == root_id
