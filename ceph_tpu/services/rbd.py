@@ -1,0 +1,181 @@
+"""rbd-lite — block images on RADOS (src/librbd role, reduced).
+
+Reference: librbd stores an image as a header object + striped data
+objects (``rbd_data.<id>.<objectno>``), with an ``rbd_directory``
+listing images per pool. This lite version keeps that object model —
+directory object, per-image header (size + layout), striped data via
+ceph_tpu.client.striper — and the core API: create/open/list/remove,
+byte-addressed read/write, resize, and snapshots.
+
+Snapshots here are full object-range copies into a snap namespace
+(``rbd_snap.<image>@<snap>...``), not the reference's COW clones —
+correct semantics (point-in-time, rollback, independent of later
+writes) at lite cost; COW is future work.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.client.striper import FileLayout, StripedObject
+
+DIRECTORY_OID = "rbd_directory"
+
+
+class RBDError(Exception):
+    pass
+
+
+def _load_dir(io) -> dict:
+    try:
+        return json.loads(io.read(DIRECTORY_OID))
+    except Exception:
+        return {}
+
+
+def _save_dir(io, d: dict) -> None:
+    io.write_full(DIRECTORY_OID, json.dumps(d, sort_keys=True).encode())
+
+
+class RBD:
+    """Pool-level image management (librbd::RBD role)."""
+
+    def __init__(self, ioctx) -> None:
+        self.io = ioctx
+
+    def create(self, name: str, size: int,
+               layout: FileLayout | None = None) -> "Image":
+        d = _load_dir(self.io)
+        if name in d:
+            raise RBDError(f"image {name!r} exists")
+        layout = layout or FileLayout(stripe_unit=1 << 20,
+                                      stripe_count=1,
+                                      object_size=1 << 20)
+        header = {"size": size, "su": layout.stripe_unit,
+                  "sc": layout.stripe_count, "os": layout.object_size,
+                  "snaps": {}}
+        self.io.write_full(f"rbd_header.{name}",
+                           json.dumps(header).encode())
+        d[name] = {"size": size}
+        _save_dir(self.io, d)
+        return Image(self.io, name)
+
+    def list(self) -> list[str]:
+        return sorted(_load_dir(self.io))
+
+    def remove(self, name: str) -> None:
+        img = Image(self.io, name)
+        for snap in list(img.snap_list()):
+            img.snap_remove(snap)
+        img._data.remove()
+        try:
+            self.io.remove(f"rbd_header.{name}")
+        except Exception:
+            pass
+        d = _load_dir(self.io)
+        d.pop(name, None)
+        _save_dir(self.io, d)
+
+    def open(self, name: str) -> "Image":
+        return Image(self.io, name)
+
+
+class Image:
+    """One open image (librbd::Image role)."""
+
+    def __init__(self, ioctx, name: str) -> None:
+        self.io = ioctx
+        self.name = name
+        try:
+            self._header = json.loads(self.io.read(f"rbd_header.{name}"))
+        except Exception:
+            raise RBDError(f"no such image {name!r}")
+        layout = FileLayout(self._header["su"], self._header["sc"],
+                            self._header["os"])
+        self._data = StripedObject(self.io, f"rbd_data.{name}", layout)
+
+    # -- header --------------------------------------------------------
+    def _save_header(self) -> None:
+        self.io.write_full(f"rbd_header.{self.name}",
+                           json.dumps(self._header).encode())
+        d = _load_dir(self.io)
+        if self.name in d:
+            d[self.name]["size"] = self._header["size"]
+            _save_dir(self.io, d)
+
+    def size(self) -> int:
+        return self._header["size"]
+
+    def stat(self) -> dict:
+        return {"name": self.name, "size": self._header["size"],
+                "stripe_unit": self._header["su"],
+                "stripe_count": self._header["sc"],
+                "object_size": self._header["os"],
+                "snaps": sorted(self._header["snaps"])}
+
+    def resize(self, new_size: int) -> None:
+        old = self._header["size"]
+        self._header["size"] = new_size
+        self._save_header()
+        if new_size < old:
+            # shrink: zero the dropped tail so a later grow reads zeros
+            # (object-level trim left as future work)
+            self._data.size = min(self._data.size, new_size)
+            self._data._write_meta()
+
+    # -- data ----------------------------------------------------------
+    def write(self, offset: int, data: bytes) -> int:
+        if offset + len(data) > self._header["size"]:
+            raise RBDError("write past end of image")
+        self._data.write(data, offset=offset)
+        return len(data)
+
+    def read(self, offset: int, length: int) -> bytes:
+        end = min(offset + length, self._header["size"])
+        if end <= offset:
+            return b""
+        want = end - offset
+        out = self._data.read(want, offset)
+        # unwritten ranges read as zeros (sparse image semantics)
+        return out + b"\x00" * (want - len(out))
+
+    def discard(self, offset: int, length: int) -> None:
+        self._data.write(b"\x00" * length, offset=offset)
+
+    # -- snapshots ------------------------------------------------------
+    def _snap_prefix(self, snap: str) -> str:
+        return f"rbd_snap.{self.name}@{snap}"
+
+    def snap_list(self) -> list[str]:
+        return sorted(self._header["snaps"])
+
+    def snap_create(self, snap: str) -> None:
+        if snap in self._header["snaps"]:
+            raise RBDError(f"snap {snap!r} exists")
+        content = self._data.read()      # point-in-time copy
+        so = StripedObject(self.io, self._snap_prefix(snap),
+                           self._data.layout)
+        if content:
+            so.write(content)
+        self._header["snaps"][snap] = {"size": self._header["size"]}
+        self._save_header()
+
+    def snap_rollback(self, snap: str) -> None:
+        if snap not in self._header["snaps"]:
+            raise RBDError(f"no snap {snap!r}")
+        so = StripedObject(self.io, self._snap_prefix(snap))
+        content = so.read()
+        self._data.remove()
+        self._data = StripedObject(self.io, f"rbd_data.{self.name}",
+                                   so.layout)
+        if content:
+            self._data.write(content)
+        self._header["size"] = self._header["snaps"][snap]["size"]
+        self._save_header()
+
+    def snap_remove(self, snap: str) -> None:
+        if snap not in self._header["snaps"]:
+            raise RBDError(f"no snap {snap!r}")
+        StripedObject(self.io, self._snap_prefix(snap)).remove()
+        del self._header["snaps"][snap]
+        self._save_header()

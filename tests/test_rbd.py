@@ -1,0 +1,110 @@
+"""rbd-lite block images (src/librbd role, reduced)."""
+
+import os
+
+import pytest
+
+from ceph_tpu.client.striper import FileLayout
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.services.rbd import RBD, Image, RBDError
+
+
+@pytest.fixture(scope="module")
+def io():
+    with MiniCluster(n_osds=3) as c:
+        rados = c.client()
+        c.create_pool("rbdpool", pg_num=4, size=2)
+        yield rados.open_ioctx("rbdpool")
+
+
+def test_create_list_open_remove(io):
+    rbd = RBD(io)
+    rbd.create("disk0", 1 << 22)
+    rbd.create("disk1", 1 << 20)
+    assert rbd.list() == ["disk0", "disk1"]
+    with pytest.raises(RBDError):
+        rbd.create("disk0", 1)
+    img = rbd.open("disk0")
+    assert img.size() == 1 << 22
+    rbd.remove("disk1")
+    assert rbd.list() == ["disk0"]
+    with pytest.raises(RBDError):
+        rbd.open("disk1")
+    rbd.remove("disk0")
+
+
+def test_block_io_and_sparse_reads(io):
+    rbd = RBD(io)
+    layout = FileLayout(stripe_unit=16384, stripe_count=2,
+                        object_size=32768)
+    img = rbd.create("blk", 1 << 20, layout=layout)
+    # unwritten image reads as zeros
+    assert img.read(0, 4096) == b"\x00" * 4096
+    blob = os.urandom(200_000)
+    img.write(10_000, blob)
+    assert img.read(10_000, len(blob)) == blob
+    assert img.read(0, 10_000) == b"\x00" * 10_000
+    # spans stripe boundaries correctly
+    assert img.read(16_000, 1000) == blob[6000:7000]
+    with pytest.raises(RBDError):
+        img.write((1 << 20) - 10, b"x" * 100)   # past end
+    # pieces are striped across multiple RADOS objects
+    pieces = [o for o in io.list_objects()
+              if o.startswith("rbd_data.blk.")]
+    assert len(pieces) > 3
+    rbd.remove("blk")
+    assert [o for o in io.list_objects()
+            if o.startswith("rbd_data.blk.")] == []
+
+
+def test_resize(io):
+    rbd = RBD(io)
+    img = rbd.create("rz", 100_000)
+    img.write(0, b"a" * 100_000)
+    img.resize(50_000)
+    assert img.size() == 50_000
+    img.resize(150_000)
+    assert img.read(0, 50_000) == b"a" * 50_000
+    # the re-grown tail reads as zeros, not stale data
+    assert img.read(50_000, 100_000) == b"\x00" * 100_000
+    rbd.remove("rz")
+
+
+def test_rbd_cli(io, tmp_path, capsys):
+    from ceph_tpu.tools import rbd_cli
+    addr = io.client.monc.mon_addr
+    src = tmp_path / "img.bin"
+    src.write_bytes(os.urandom(50_000))
+    args = ["-m", addr, "-p", "rbdpool"]
+    assert rbd_cli.main(args + ["import", "cliimg", str(src)]) == 0
+    assert rbd_cli.main(args + ["ls"]) == 0
+    assert "cliimg" in capsys.readouterr().out
+    assert rbd_cli.main(args + ["info", "cliimg"]) == 0
+    assert '"size": 50000' in capsys.readouterr().out
+    dst = tmp_path / "out.bin"
+    assert rbd_cli.main(args + ["export", "cliimg", str(dst)]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+    assert rbd_cli.main(args + ["snap", "create", "cliimg", "s"]) == 0
+    assert rbd_cli.main(args + ["snap", "ls", "cliimg"]) == 0
+    assert "s" in capsys.readouterr().out
+    assert rbd_cli.main(args + ["rm", "cliimg"]) == 0
+
+
+def test_snapshots(io):
+    rbd = RBD(io)
+    img = rbd.create("snapimg", 200_000)
+    v1 = os.urandom(100_000)
+    img.write(0, v1)
+    img.snap_create("s1")
+    v2 = os.urandom(100_000)
+    img.write(0, v2)
+    assert img.read(0, 100_000) == v2
+    assert img.snap_list() == ["s1"]
+    # rollback restores the point-in-time content
+    img.snap_rollback("s1")
+    assert img.read(0, 100_000) == v1
+    img.snap_remove("s1")
+    assert img.snap_list() == []
+    with pytest.raises(RBDError):
+        img.snap_rollback("s1")
+    rbd.remove("snapimg")
