@@ -71,6 +71,12 @@ class IoCtx:
     def remove(self, oid: str) -> None:
         self._submit(oid, M.OSD_OP_REMOVE)
 
+    def execute(self, oid: str, cls: str, method: str,
+                inp: bytes = b"") -> bytes:
+        """Run an in-OSD object-class method (librados exec role)."""
+        return self._submit(oid, M.OSD_OP_CALL, data=inp, cls=cls,
+                            method=method).data
+
     def list_objects(self) -> list[str]:
         """Union of per-PG listings (PGLS role)."""
         osdmap = self.client.monc.osdmap

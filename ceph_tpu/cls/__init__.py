@@ -1,0 +1,180 @@
+"""cls — in-OSD object classes (src/cls/ role, 17 modules there).
+
+Reference: "stored procedures" loaded into the OSD (dlopen'd
+``libcls_*``) and invoked via the CEPH_OSD_OP_CALL op: the method runs
+SERVER-side against the object, atomically with respect to other ops
+on its PG, and librados exposes it as ``ioctx.exec(oid, cls, method,
+input)``.
+
+Here a class method is a pure function over the object's current
+bytes:
+
+    method(input: bytes, obj: bytes | None) -> (code, out, new_obj)
+
+``new_obj is None`` leaves the object untouched; otherwise the OSD
+writes it back through the normal versioned replication path. The PG
+executes ops serially, so read-modify-write methods are atomic exactly
+like the reference's cls handlers. Built-ins mirror reference modules:
+``lock`` (cls_lock: advisory object locks) and ``log`` (cls_log:
+append-only timestamped records).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+#: method(input, obj) -> (code, out, new_obj | None)
+Method = Callable[[bytes, "bytes | None"],
+                  "tuple[int, bytes, bytes | None]"]
+
+_REGISTRY: dict[tuple[str, str], Method] = {}
+
+
+class ClsError(Exception):
+    def __init__(self, code: int, message: str = "") -> None:
+        super().__init__(message or f"cls error {code}")
+        self.code = code
+
+
+def register(cls_name: str, method: str):
+    def deco(fn: Method) -> Method:
+        _REGISTRY[(cls_name, method)] = fn
+        return fn
+    return deco
+
+
+def methods() -> list[str]:
+    return sorted(f"{c}.{m}" for c, m in _REGISTRY)
+
+
+def call(cls_name: str, method: str, inp: bytes,
+         obj: bytes | None) -> tuple[int, bytes, bytes | None]:
+    fn = _REGISTRY.get((cls_name, method))
+    if fn is None:
+        return -8, b"", None          # -ENOEXEC: no such class/method
+    try:
+        return fn(inp, obj)
+    except ClsError as exc:
+        return exc.code, b"", None
+    except Exception:
+        return -22, b"", None
+
+
+# -- cls_lock (src/cls/lock role): advisory object locks --------------
+
+def _lock_state(obj: bytes | None) -> dict:
+    if not obj:
+        return {"lockers": {}}
+    try:
+        return json.loads(obj)
+    except ValueError:
+        return {"lockers": {}}
+
+
+@register("lock", "lock")
+def _lock_lock(inp: bytes, obj: bytes | None):
+    """input: {"name", "cookie", "type": "exclusive"|"shared",
+    "duration": seconds (0 = forever)}"""
+    req = json.loads(inp)
+    st = _lock_state(obj)
+    now = time.time()
+    lockers = {k: v for k, v in st["lockers"].items()
+               if not v["expires"] or v["expires"] > now}
+    excl = any(v["type"] == "exclusive" for v in lockers.values())
+    key = f"{req['name']}/{req['cookie']}"
+    if key not in lockers and (
+            excl or (req["type"] == "exclusive" and lockers)):
+        return -16, b"", None         # -EBUSY
+    lockers[key] = {
+        "type": req["type"],
+        "expires": (now + req["duration"]) if req.get("duration") else 0,
+    }
+    st["lockers"] = lockers
+    return 0, b"", json.dumps(st).encode()
+
+
+@register("lock", "unlock")
+def _lock_unlock(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    st = _lock_state(obj)
+    key = f"{req['name']}/{req['cookie']}"
+    if key not in st["lockers"]:
+        return -2, b"", None          # -ENOENT
+    del st["lockers"][key]
+    return 0, b"", json.dumps(st).encode()
+
+
+@register("lock", "info")
+def _lock_info(inp: bytes, obj: bytes | None):
+    st = _lock_state(obj)
+    now = time.time()
+    st["lockers"] = {k: v for k, v in st["lockers"].items()
+                     if not v["expires"] or v["expires"] > now}
+    return 0, json.dumps(st).encode(), None
+
+
+# -- cls_log (src/cls/log role): append-only timestamped records ------
+
+@register("log", "add")
+def _log_add(inp: bytes, obj: bytes | None):
+    entries = json.loads(obj) if obj else []
+    entries.append({"stamp": time.time(),
+                    "data": inp.decode(errors="replace")})
+    return 0, b"", json.dumps(entries).encode()
+
+
+@register("log", "list")
+def _log_list(inp: bytes, obj: bytes | None):
+    req = json.loads(inp) if inp else {}
+    entries = json.loads(obj) if obj else []
+    n = req.get("max_entries", len(entries))
+    return 0, json.dumps(entries[-n:]).encode(), None
+
+
+@register("log", "trim")
+def _log_trim(inp: bytes, obj: bytes | None):
+    req = json.loads(inp) if inp else {}
+    entries = json.loads(obj) if obj else []
+    keep = req.get("keep", 0)
+    return 0, b"", json.dumps(entries[len(entries) - keep
+                                      if keep else len(entries):]).encode()
+
+
+# -- cls_rgw (src/cls/rgw role): atomic bucket-index ops ---------------
+# The reference's rgw keeps every bucket's index in an omap maintained
+# by cls_rgw methods, so concurrent gateways never race the index.
+
+def _index(obj: bytes | None) -> dict:
+    return json.loads(obj) if obj else {}
+
+
+@register("rgw", "bucket_add")
+def _rgw_bucket_add(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    idx = _index(obj)
+    idx[req["key"]] = {"size": req["size"], "etag": req.get("etag", ""),
+                       "mtime": time.time()}
+    return 0, b"", json.dumps(idx).encode()
+
+
+@register("rgw", "bucket_rm")
+def _rgw_bucket_rm(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    idx = _index(obj)
+    if req["key"] not in idx:
+        return -2, b"", None
+    del idx[req["key"]]
+    return 0, b"", json.dumps(idx).encode()
+
+
+@register("rgw", "bucket_list")
+def _rgw_bucket_list(inp: bytes, obj: bytes | None):
+    req = json.loads(inp) if inp else {}
+    idx = _index(obj)
+    prefix = req.get("prefix", "")
+    keys = sorted(k for k in idx if k.startswith(prefix))
+    n = req.get("max_keys", len(keys))
+    out = {k: idx[k] for k in keys[:n]}
+    return 0, json.dumps(out).encode(), None

@@ -522,7 +522,7 @@ class OSD:
 
     # -- primary-side client op handling ------------------------------
     _MUTATING_OPS = (M.OSD_OP_WRITE_FULL, M.OSD_OP_WRITE,
-                     M.OSD_OP_APPEND, M.OSD_OP_REMOVE)
+                     M.OSD_OP_APPEND, M.OSD_OP_REMOVE, M.OSD_OP_CALL)
     _OP_CACHE_MAX = 10000
 
     def _handle_osd_op(self, msg: M.MOSDOp, conn: Connection) -> None:
@@ -659,6 +659,28 @@ class OSD:
                 version = pg.log.last_version + 1
                 be.submit_remove(pg, msg.oid, version,
                                  lambda code, v=version: reply(code, b"", v))
+            elif op == M.OSD_OP_CALL:
+                # in-OSD object class (src/cls role): the method runs
+                # here on the primary, atomically with respect to other
+                # ops of this PG (we hold pg.lock); a mutation goes
+                # back out through the normal versioned write path
+                from ceph_tpu import cls as cls_mod
+                try:
+                    cur = bytes(be.read_object(pg, msg.oid))
+                except (NoSuchObject, NoSuchCollection):
+                    cur = None
+                code, out, new_obj = cls_mod.call(
+                    msg.cls, msg.method, msg.data, cur)
+                if code < 0:
+                    reply(code)
+                elif new_obj is not None:
+                    self.logger.inc("op_w")
+                    version = pg.log.last_version + 1
+                    be.submit_write(
+                        pg, msg.oid, new_obj, version,
+                        lambda c, v=version, o=out: reply(c, o, v))
+                else:
+                    reply(0, out)
             elif op == M.OSD_OP_LIST:
                 oids = self._list_pg(pg)
                 reply(0, json.dumps(oids).encode())

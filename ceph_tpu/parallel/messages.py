@@ -169,6 +169,7 @@ OSD_OP_STAT = 4
 OSD_OP_WRITE = 5       # offset write (EC: RMW over the full object)
 OSD_OP_APPEND = 6
 OSD_OP_LIST = 7        # list objects of one PG (PGLS role)
+OSD_OP_CALL = 8        # in-OSD object class method (CEPH_OSD_OP_CALL)
 
 class MOSDOp(Message):
     """``trace`` carries the dataflow-trace context (Message.h:264
@@ -177,7 +178,8 @@ class MOSDOp(Message):
     FIELDS = [("tid", "u64"), ("client", "str"), ("epoch", "u32"),
               ("pool", "i32"), ("ps", "u32"), ("oid", "str"),
               ("op", "u8"), ("offset", "u64"), ("length", "u64"),
-              ("data", "bytes"), ("trace", "str")]
+              ("data", "bytes"), ("trace", "str"),
+              ("cls", "str"), ("method", "str")]
 
 
 class MOSDOpReply(Message):

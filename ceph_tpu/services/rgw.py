@@ -1,0 +1,243 @@
+"""rgw-lite — object gateway over RADOS (src/rgw role, reduced).
+
+Reference: radosgw serves S3/Swift over HTTP; every bucket has an
+index object whose entries are maintained ATOMICALLY by in-OSD
+``cls_rgw`` methods, and object data lives in RADOS (striped when
+large). This lite gateway keeps exactly that object model:
+
+- ``.buckets``            — bucket directory (json)
+- ``.bucket.<name>``      — per-bucket index, mutated ONLY via the
+                            ``rgw`` object class (cls/__init__.py), so
+                            concurrent gateways never race the index
+- ``<bucket>/<key>``      — object data through the striper
+
+The HTTP front end is S3-path-shaped (PUT/GET/DELETE /bucket and
+/bucket/key, GET /bucket lists with ?prefix=), answering JSON rather
+than S3's XML and with no request signing — documented reductions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ceph_tpu.client.striper import FileLayout, StripedObject
+
+BUCKETS_OID = ".buckets"
+
+
+class RGWError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class RGWGateway:
+    """Gateway core (the librados-facing half of radosgw)."""
+
+    def __init__(self, ioctx) -> None:
+        self.io = ioctx
+        self._layout = FileLayout(stripe_unit=1 << 20, stripe_count=1,
+                                  object_size=1 << 20)
+
+    # -- buckets -------------------------------------------------------
+    def _buckets(self) -> dict:
+        try:
+            return json.loads(self.io.read(BUCKETS_OID))
+        except Exception:
+            return {}
+
+    def list_buckets(self) -> list[str]:
+        return sorted(self._buckets())
+
+    def create_bucket(self, name: str) -> None:
+        if not name or "/" in name or name.startswith("."):
+            raise RGWError(400, f"invalid bucket name {name!r}")
+        b = self._buckets()
+        if name in b:
+            return                     # S3 PUT bucket is idempotent
+        b[name] = {}
+        self.io.write_full(BUCKETS_OID, json.dumps(b).encode())
+        self.io.write_full(f".bucket.{name}", b"{}")
+
+    def delete_bucket(self, name: str) -> None:
+        b = self._buckets()
+        if name not in b:
+            raise RGWError(404, "NoSuchBucket")
+        if self.list_objects(name):
+            raise RGWError(409, "BucketNotEmpty")
+        del b[name]
+        self.io.write_full(BUCKETS_OID, json.dumps(b).encode())
+        try:
+            self.io.remove(f".bucket.{name}")
+        except Exception:
+            pass
+
+    def _check_bucket(self, bucket: str) -> None:
+        if bucket not in self._buckets():
+            raise RGWError(404, "NoSuchBucket")
+
+    # -- objects -------------------------------------------------------
+    def put_object(self, bucket: str, key: str, data: bytes) -> str:
+        self._check_bucket(bucket)
+        so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
+        so.remove()                    # replace semantics
+        so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
+        if data:
+            so.write(data)
+        etag = hashlib.md5(data).hexdigest()
+        self.io.execute(f".bucket.{bucket}", "rgw", "bucket_add",
+                        json.dumps({"key": key, "size": len(data),
+                                    "etag": etag}).encode())
+        return etag
+
+    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+        self._check_bucket(bucket)
+        idx = self.list_objects(bucket, prefix=key)
+        meta = idx.get(key)
+        if meta is None:
+            raise RGWError(404, "NoSuchKey")
+        so = StripedObject(self.io, f"{bucket}/{key}")
+        return so.read(), meta
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._check_bucket(bucket)
+        from ceph_tpu.client.rados import RadosError
+        try:
+            self.io.execute(f".bucket.{bucket}", "rgw", "bucket_rm",
+                            json.dumps({"key": key}).encode())
+        except RadosError as exc:
+            if exc.code == -2:
+                raise RGWError(404, "NoSuchKey")
+            raise
+        StripedObject(self.io, f"{bucket}/{key}").remove()
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 1000) -> dict:
+        self._check_bucket(bucket)
+        out = self.io.execute(
+            f".bucket.{bucket}", "rgw", "bucket_list",
+            json.dumps({"prefix": prefix, "max_keys": max_keys}).encode())
+        return json.loads(out or b"{}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    gw: RGWGateway = None  # set by server factory
+
+    def _split(self) -> tuple[str, str, dict]:
+        parsed = urllib.parse.urlparse(self.path)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        bucket = urllib.parse.unquote(parts[0])
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        return bucket, key, q
+
+    def _reply(self, status: int, body: bytes = b"",
+               ctype: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _run(self, fn) -> None:
+        try:
+            fn()
+        except RGWError as exc:
+            self._reply(exc.status, json.dumps(
+                {"error": str(exc)}).encode())
+        except Exception as exc:  # pragma: no cover
+            self._reply(500, json.dumps({"error": repr(exc)}).encode())
+
+    def do_GET(self) -> None:  # noqa: N802
+        bucket, key, q = self._split()
+
+        def run() -> None:
+            if not bucket:
+                self._reply(200, json.dumps(
+                    {"buckets": self.gw.list_buckets()}).encode())
+            elif not key:
+                idx = self.gw.list_objects(
+                    bucket, prefix=q.get("prefix", ""),
+                    max_keys=int(q.get("max-keys", 1000)))
+                self._reply(200, json.dumps(
+                    {"bucket": bucket, "objects": idx}).encode())
+            else:
+                data, meta = self.gw.get_object(bucket, key)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("ETag", f'"{meta["etag"]}"')
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.end_headers()
+                self.wfile.write(data)
+        self._run(run)
+
+    def do_PUT(self) -> None:  # noqa: N802
+        bucket, key, _ = self._split()
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n) if n else b""
+
+        def run() -> None:
+            if not key:
+                self.gw.create_bucket(bucket)
+                self._reply(200)
+            else:
+                etag = self.gw.put_object(bucket, key, body)
+                self.send_response(200)
+                self.send_header("ETag", f'"{etag}"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+        self._run(run)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        bucket, key, _ = self._split()
+
+        def run() -> None:
+            if not key:
+                self.gw.delete_bucket(bucket)
+            else:
+                self.gw.delete_object(bucket, key)
+            self._reply(204)
+        self._run(run)
+
+    def do_HEAD(self) -> None:  # noqa: N802
+        bucket, key, _ = self._split()
+
+        def run() -> None:
+            _, meta = self.gw.get_object(bucket, key)
+            self.send_response(200)
+            self.send_header("Content-Length", str(meta["size"]))
+            self.send_header("ETag", f'"{meta["etag"]}"')
+            self.end_headers()
+        self._run(run)
+
+    def log_message(self, *args) -> None:
+        pass
+
+
+class RGWServer:
+    """Threaded HTTP front end (radosgw + civetweb role)."""
+
+    def __init__(self, ioctx, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        gw = RGWGateway(ioctx)
+        handler = type("BoundHandler", (_Handler,), {"gw": gw})
+        self._srv = ThreadingHTTPServer((host, port), handler)
+        self.port = self._srv.server_address[1]
+        self.gateway = gw
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="rgw", daemon=True)
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=2)
