@@ -208,8 +208,26 @@ class OSD:
         self.asok.start()
         self.addr = self.msgr.bind(host, port)
         self.monc.subscribe()
-        self.monc.boot_osd(self.whoami, self.addr)
-        self.osdmap = self.monc.wait_for_map(1)
+        # boot must land on a live (leader-reachable) mon: retry until
+        # a map shows us up at this address (the MonClient rotates
+        # targets underneath us when one is dead)
+        deadline = time.monotonic() + 30
+        while True:
+            self.monc.boot_osd(self.whoami, self.addr)
+            try:
+                m = self.monc.wait_for_map(1, timeout=2.0)
+                info = m.osds.get(self.whoami)
+                if info is not None and info.up \
+                        and info.addr == self.addr:
+                    break
+            except TimeoutError:
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"osd.{self.whoami} failed to boot (no mon "
+                    "acknowledged)")
+            time.sleep(0.2)
+        self.osdmap = self.monc.osdmap
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name=f"osd.{self.whoami}-hb",
             daemon=True)

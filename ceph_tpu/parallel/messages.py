@@ -188,6 +188,35 @@ class MOSDOpReply(Message):
               ("data", "bytes"), ("version", "u64")]
 
 
+# -- mon quorum (Paxos/Elector role, src/mon/Paxos.{h,cc}) -------------
+
+class MMonHB(Message):
+    """Mon <-> mon liveness + progress beacon (Elector probe role):
+    each mon advertises its rank and how far its commit log got;
+    every mon independently derives the leader as the most-advanced,
+    lowest-ranked live peer."""
+    MSG_TYPE = 40
+    FIELDS = [("rank", "i32"), ("name", "str"),
+              ("last_committed", "u64"), ("addr", "str")]
+
+
+class MPaxosCommit(Message):
+    """Leader -> peons on every commit: the full committed state at
+    ``version`` (our states are small full snapshots, so replication
+    and catch-up are the same message — the Paxos commit phase with
+    the reference's incremental machinery collapsed). ``rank`` lets a
+    peon adopt the CURRENT leader's state even at an equal version
+    (split-brain heal)."""
+    MSG_TYPE = 41
+    FIELDS = [("version", "u64"), ("state", "bytes"), ("rank", "i32")]
+
+
+class MPaxosPull(Message):
+    """A lagging mon asks a more advanced peer for its latest commit."""
+    MSG_TYPE = 42
+    FIELDS = [("rank", "i32"), ("from_version", "u64")]
+
+
 # -- auth (MAuth / cephx ticket grant, src/auth role) ------------------
 
 class MAuth(Message):

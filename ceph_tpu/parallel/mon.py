@@ -6,10 +6,19 @@ commands) and ConfigMonitor. Collapsed here to one daemon class with:
 
   - a persisted commit log (MonitorDBStore role, backed by store/kv):
     every map change is a numbered committed value, replayed on
-    restart — the Paxos log discipline with a single mon; the
-    propose/accept quorum round of multi-mon Paxos is not implemented
-    (one mon == one acceptor), but the commit/replay layout matches so
-    quorum can be added at the propose seam.
+    restart — the Paxos log discipline.
+  - quorum-lite (Paxos + Elector roles) when started with a monmap of
+    peers: mons exchange liveness/progress beacons, every mon derives
+    the leader as the most-advanced lowest-ranked live peer (the
+    reference's lowest-rank-wins election, progress-first like raft's
+    log check), ONLY the leader mutates state, commits replicate to
+    peons as full-state snapshots, lagging mons catch up by pulling,
+    and clients are redirected/forwarded to the leader. Reduction vs
+    real Paxos: the leader does not await majority acks before
+    acking a command, so a leader that dies within a replication
+    round-trip of a commit can lose it (documented paxos-lite
+    caveat); a partitioned minority leader's commits are superseded
+    by the majority side's more-advanced log on heal.
   - OSDMonitor logic: MOSDBoot marks OSDs up (new epoch), failure
     reports and beacon-timeout mark them down (OSDMap epochs move
     forward only), pool/EC-profile commands validated by actually
@@ -55,6 +64,11 @@ class Monitor:
         self.msgr = Messenger(f"mon.{name}")
         self.msgr.set_dispatcher(self._dispatch)
         self.addr = ""
+        # quorum state (single-mon default: rank 0, no peers, leader)
+        self.rank = 0
+        self.monmap: dict[int, str] = {}      # rank -> addr (peers+self)
+        self._peer_seen: dict[int, tuple[float, int]] = {}
+        self._leader_rank = 0
         self._lock = threading.RLock()
         self._subscribers: dict[str, Connection] = {}  # peer entity -> conn
         self._last_beacon: dict[int, float] = {}
@@ -71,6 +85,18 @@ class Monitor:
         self._replay()
 
     # -- lifecycle ----------------------------------------------------
+    def prebind(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Bind the messenger before the monmap is known (multi-mon
+        bootstrap: all mons bind, then everyone learns every addr)."""
+        if not self.addr:
+            self.addr = self.msgr.bind(host, port)
+        return self.addr
+
+    def set_monmap(self, monmap: dict[int, str], rank: int) -> None:
+        self.monmap = dict(monmap)
+        self.rank = rank
+        self._leader_rank = min(self.monmap) if self.monmap else rank
+
     def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         # the grace countdown for every replayed-up osd starts now: a
         # dead one that never re-beacons must still time out
@@ -92,8 +118,18 @@ class Monitor:
                                     "addr": i.addr}
                                 for o, i in self.osdmap.osds.items()}},
             "monitor + osdmap summary")
+        self.asok.register_command(
+            "quorum_status",
+            lambda a: {"rank": self.rank, "leader": self._leader_rank,
+                       "is_leader": self.is_leader(),
+                       "monmap": {str(r): a_ for r, a_ in
+                                  self.monmap.items()},
+                       "last_committed": self._last_committed()},
+            "election/quorum state (Elector role)")
         self.asok.start()
-        self.addr = self.msgr.bind(host, port)
+        self.prebind(host, port)
+        if not self.monmap:
+            self.monmap = {self.rank: self.addr}
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"mon.{self.name}-tick",
             daemon=True)
@@ -117,14 +153,99 @@ class Monitor:
 
     def _commit(self) -> None:
         """Commit the current (already mutated) state as the next
-        version, then publish. Caller holds the lock."""
+        version, publish to subscribers, and replicate to peon mons
+        (Paxos commit phase; see paxos-lite caveat in the module
+        docstring). Caller holds the lock."""
         self.osdmap.epoch += 1
         version = self._last_committed() + 1
+        state = self._encode_state()
         batch = WriteBatch()
-        batch.put(f"paxos/{version:016d}", self._encode_state())
+        batch.put(f"paxos/{version:016d}", state)
         batch.put("paxos/last_committed", str(version).encode())
         self.db.submit(batch, sync=True)
         log(10, f"committed version {version} (epoch {self.osdmap.epoch})")
+        self._publish()
+        for rank, addr in self.monmap.items():
+            if rank != self.rank:
+                self.msgr.send_message(
+                    M.MPaxosCommit(version=version, state=state,
+                                   rank=self.rank), addr)
+
+    # -- quorum (Paxos/Elector roles) ---------------------------------
+    def is_leader(self) -> bool:
+        return self._leader_rank == self.rank
+
+    def leader_addr(self) -> str:
+        return self.monmap.get(self._leader_rank, self.addr)
+
+    def _alive_ranks(self, now: float) -> dict[int, int]:
+        """rank -> last_committed for every mon considered alive."""
+        grace = g_conf()["mon_election_timeout"]
+        alive = {self.rank: self._last_committed()}
+        for rank, (ts, lc) in self._peer_seen.items():
+            if now - ts <= grace and rank in self.monmap:
+                alive[rank] = lc
+        return alive
+
+    def _elect(self, now: float) -> None:
+        """Every mon independently derives the leader: most-advanced
+        commit log first (a stale rejoiner must not clobber newer
+        state), lowest rank second (the reference's Elector rule)."""
+        alive = self._alive_ranks(now)
+        new_leader = min(alive, key=lambda r: (-alive[r], r))
+        if new_leader != self._leader_rank:
+            log(1, f"mon.{self.name}: leader mon rank "
+                f"{self._leader_rank} -> {new_leader} "
+                f"(alive={sorted(alive)})")
+            self._leader_rank = new_leader
+            if new_leader == self.rank:
+                # taking over: (a) every up OSD gets a fresh beacon
+                # grace window — as a peon we forwarded beacons instead
+                # of recording them, so whatever is in _last_beacon is
+                # stale and would mark healthy OSDs down instantly;
+                # (b) push our state to every peer so a healed
+                # split-brain twin at an EQUAL version adopts the
+                # elected leader's truth
+                for osd, info in self.osdmap.osds.items():
+                    if info.up:
+                        self._last_beacon[osd] = time.monotonic()
+                state = self._encode_state()
+                for rank, addr in self.monmap.items():
+                    if rank != self.rank:
+                        self.msgr.send_message(M.MPaxosCommit(
+                            version=self._last_committed(),
+                            state=state, rank=self.rank), addr)
+        # lagging behind a live peer: pull its latest commit
+        best = max(alive.values())
+        if best > self._last_committed():
+            ahead = min(r for r, lc in alive.items() if lc == best)
+            if ahead != self.rank:
+                self.msgr.send_message(
+                    M.MPaxosPull(rank=self.rank,
+                                 from_version=self._last_committed()),
+                    self.monmap[ahead])
+
+    def _apply_remote_commit(self, msg: M.MPaxosCommit) -> None:
+        """Peon side: adopt a commit from a more advanced mon. States
+        are full snapshots, so any newer version applies directly. An
+        EQUAL version from the mon we recognize as leader also applies
+        — that heals a split-brain where both sides committed the same
+        version number with different states."""
+        if msg.version < self._last_committed():
+            return
+        if msg.version == self._last_committed() and (
+                self.is_leader() or msg.rank != self._leader_rank):
+            return
+        from ceph_tpu.utils.encoding import Decoder
+        d = Decoder(msg.state)
+        self.osdmap = OSDMap.decode(d.bytes())
+        self.ec_profiles = json.loads(d.str())
+        batch = WriteBatch()
+        batch.put(f"paxos/{msg.version:016d}", msg.state)
+        batch.put("paxos/last_committed", str(msg.version).encode())
+        self.db.submit(batch, sync=True)
+        log(10, f"mon.{self.name}: applied remote commit v{msg.version} "
+            f"(epoch {self.osdmap.epoch})")
         self._publish()
 
     def _encode_state(self) -> bytes:
@@ -160,8 +281,35 @@ class Monitor:
     # -- dispatch -----------------------------------------------------
     def _dispatch(self, msg: M.Message, conn: Connection) -> None:
         with self._lock:
+            if isinstance(msg, M.MMonHB):
+                self._peer_seen[msg.rank] = (time.monotonic(),
+                                             msg.last_committed)
+                if msg.addr:     # revived mons rebind to a new port
+                    self.monmap[msg.rank] = msg.addr
+                return
+            if isinstance(msg, M.MPaxosCommit):
+                # the committer provably has this version: advance our
+                # view of it NOW, or the window between applying its
+                # commit and its next HB makes us think we're the most
+                # advanced mon and flap into competing leadership
+                self._peer_seen[msg.rank] = (time.monotonic(),
+                                             msg.version)
+                self._apply_remote_commit(msg)
+                return
+            if isinstance(msg, M.MPaxosPull):
+                peer = self.monmap.get(msg.rank)
+                if peer and self._last_committed() > msg.from_version:
+                    self.msgr.send_message(M.MPaxosCommit(
+                        version=self._last_committed(),
+                        state=self._encode_state()), peer)
+                return
             if isinstance(msg, M.MAuth):
                 self._handle_auth(msg, conn)
+            elif isinstance(msg, (M.MOSDBoot, M.MOSDFailure,
+                                  M.MOSDAlive)) and not self.is_leader():
+                # only the leader mutates cluster state; relay the
+                # report to it (the reference forwards to the leader)
+                self.msgr.send_message(msg, self.leader_addr())
             elif isinstance(msg, M.MOSDBoot):
                 self._handle_boot(msg, conn)
             elif isinstance(msg, M.MOSDAlive):
@@ -174,6 +322,13 @@ class Monitor:
                     epoch=self.osdmap.epoch,
                     map_bytes=self.osdmap.encode()))
             elif isinstance(msg, M.MMonCommand):
+                if not self.is_leader():
+                    # clients re-target on this redirect
+                    conn.send_message(M.MMonCommandReply(
+                        tid=msg.tid, code=-11,
+                        outs=f"NOTLEADER {self.leader_addr()}",
+                        data=b""))
+                    return
                 code, outs, data = self._handle_command(dict(msg.cmd))
                 conn.send_message(M.MMonCommandReply(
                     tid=msg.tid, code=code, outs=outs, data=data))
@@ -267,6 +422,18 @@ class Monitor:
         grace = g_conf()["osd_heartbeat_grace"] * 2  # mon backstop
         now = time.monotonic()
         with self._lock:
+            # quorum upkeep: beacon peers, re-derive the leader
+            for rank, addr in self.monmap.items():
+                if rank != self.rank:
+                    self.msgr.send_message(M.MMonHB(
+                        rank=self.rank, name=self.name,
+                        last_committed=self._last_committed(),
+                        addr=self.addr), addr)
+            if len(self.monmap) > 1:
+                self._elect(now)
+            if not self.is_leader():
+                return   # peons never mutate (beacon state flows to
+                # the leader via forwarding)
             changed = False
             for osd, info in self.osdmap.osds.items():
                 if info.up and \

@@ -9,11 +9,13 @@ here first:  ``if self.monc.handle_message(msg, conn): return``.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Connection, Messenger
 from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("monc")
@@ -22,17 +24,40 @@ log = Dout("monc")
 class MonClient:
     def __init__(self, msgr: Messenger, mon_addr: str) -> None:
         self.msgr = msgr
-        self.mon_addr = mon_addr
+        # "addr" or "addr1,addr2,..." (multi-mon quorum); the client
+        # talks to one target and rotates on silence or NOTLEADER
+        self.mon_addrs = [a for a in mon_addr.split(",") if a]
+        self._target = 0
         self.osdmap: OSDMap | None = None
         self._map_cond = threading.Condition()
         self._map_callbacks: list[Callable[[OSDMap], None]] = []
         self._next_tid = 1
         self._pending: dict[int, list] = {}   # tid -> [event, reply]
         self._lock = threading.Lock()
+        self._last_rx = time.monotonic()
+        self._last_probe = 0.0
+
+    @property
+    def mon_addr(self) -> str:
+        return self.mon_addrs[self._target % len(self.mon_addrs)]
+
+    def _rotate(self, to_addr: str | None = None) -> None:
+        if to_addr:
+            if to_addr not in self.mon_addrs:
+                # a revived mon rebinds to a fresh port: learn it
+                self.mon_addrs.append(to_addr)
+            self._target = self.mon_addrs.index(to_addr)
+        else:
+            self._target = (self._target + 1) % len(self.mon_addrs)
+        log(1, f"mon target -> {self.mon_addr}")
+        self.subscribe()
 
     # -- inbound ------------------------------------------------------
     def handle_message(self, msg: M.Message, conn: Connection) -> bool:
         """Returns True when the message was mon-plane and consumed."""
+        if isinstance(msg, (M.MOSDMap, M.MMonCommandReply,
+                            M.MAuthReply)):
+            self._last_rx = time.monotonic()
         if isinstance(msg, M.MOSDMap):
             newmap = OSDMap.decode(msg.map_bytes)
             with self._map_cond:
@@ -70,18 +95,28 @@ class MonClient:
 
         from ceph_tpu.parallel import auth as A
         nonce = os.urandom(16).hex()
-        with self._lock:
-            tid = self._next_tid
-            self._next_tid += 1
-            ent = [threading.Event(), None]
-            self._pending[tid] = ent
-        self.msgr.send_message(
-            M.MAuth(entity=entity, nonce=nonce, tid=tid), self.mon_addr)
-        if not ent[0].wait(timeout):
+        deadline = time.monotonic() + timeout
+        reply = None
+        while True:
+            with self._lock:
+                tid = self._next_tid
+                self._next_tid += 1
+                ent = [threading.Event(), None]
+                self._pending[tid] = ent
+            self.msgr.send_message(
+                M.MAuth(entity=entity, nonce=nonce, tid=tid),
+                self.mon_addr)
+            per_try = min(max(timeout / (2 * len(self.mon_addrs)), 0.5),
+                          max(deadline - time.monotonic(), 0.05))
+            if ent[0].wait(per_try):
+                reply = ent[1]
+                break
             with self._lock:
                 self._pending.pop(tid, None)
-            raise TimeoutError("authentication timed out")
-        reply: M.MAuthReply = ent[1]
+            if len(self.mon_addrs) > 1:
+                self._rotate()
+            if time.monotonic() >= deadline:
+                raise TimeoutError("authentication timed out")
         if reply.code != 0:
             raise A.AuthError(f"authentication denied ({reply.code})")
         if not reply.ticket:
@@ -98,20 +133,53 @@ class MonClient:
 
     def wait_for_map(self, min_epoch: int = 1, timeout: float = 10.0
                      ) -> OSDMap:
-        with self._map_cond:
-            ok = self._map_cond.wait_for(
-                lambda: self.osdmap is not None
-                and self.osdmap.epoch >= min_epoch, timeout)
-            if not ok:
+        deadline = time.monotonic() + timeout
+        while True:
+            # wait in slices so a dead target mon rotates instead of
+            # eating the whole timeout (multi-mon failover at boot);
+            # slice small enough that a rotation can still pay off
+            # within this call
+            remaining = max(deadline - time.monotonic(), 0.05)
+            step = min(g_conf()["mon_election_timeout"], remaining)
+            if len(self.mon_addrs) > 1:
+                step = min(step, max(remaining / 2, 0.25))
+            with self._map_cond:
+                ok = self._map_cond.wait_for(
+                    lambda: self.osdmap is not None
+                    and self.osdmap.epoch >= min_epoch, step)
+                if ok:
+                    return self.osdmap
+            if len(self.mon_addrs) > 1:
+                self._rotate()       # before the deadline check: the
+                # NEXT caller retry must not retarget the same corpse
+            if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"no osdmap epoch >= {min_epoch} within {timeout}s")
-            return self.osdmap
 
     def boot_osd(self, osd_id: int, addr: str) -> None:
         self.msgr.send_message(
             M.MOSDBoot(osd_id=osd_id, addr=addr), self.mon_addr)
 
     def beacon(self, osd_id: int, epoch: int) -> None:
+        # failover: a dead target mon would silently eat beacons and
+        # the cluster would call US dead. Steady state has no mon->us
+        # traffic (maps only push on changes), so silence alone is not
+        # death: first PROBE with a re-subscribe — a live mon answers
+        # immediately with the current map — and only rotate if the
+        # probe also goes unanswered.
+        if len(self.mon_addrs) > 1:
+            now = time.monotonic()
+            # rotation must complete well inside the mon's beacon
+            # grace (2 * osd_heartbeat_grace), or a dead target mon
+            # gets every OSD pointed at it marked down first
+            thresh = g_conf()["mon_election_timeout"]
+            silent = now - self._last_rx
+            if silent > 2 * thresh:
+                self._last_rx = now
+                self._rotate()
+            elif silent > thresh and now - self._last_probe > thresh:
+                self._last_probe = now
+                self.subscribe()
         self.msgr.send_message(
             M.MOSDAlive(osd_id=osd_id, epoch=epoch), self.mon_addr)
 
@@ -124,19 +192,39 @@ class MonClient:
 
     def command(self, cmd: dict, timeout: float = 10.0
                 ) -> tuple[int, str, bytes]:
-        """Synchronous admin command; retries ride on the caller."""
-        with self._lock:
-            tid = self._next_tid
-            self._next_tid += 1
-            ent = [threading.Event(), None]
-            self._pending[tid] = ent
-        self.msgr.send_message(
-            M.MMonCommand(tid=tid, cmd={k: str(v)
-                                        for k, v in cmd.items()}),
-            self.mon_addr)
-        if not ent[0].wait(timeout):
+        """Synchronous admin command. Multi-mon: silence rotates to the
+        next mon; a NOTLEADER redirect re-targets the leader."""
+        deadline = time.monotonic() + timeout
+        attempts = max(2 * len(self.mon_addrs), 2)
+        per_try = max(timeout / attempts, 0.5)
+        while True:
             with self._lock:
-                self._pending.pop(tid, None)
-            raise TimeoutError(f"mon command {cmd.get('prefix')!r} timed out")
-        reply: M.MMonCommandReply = ent[1]
-        return reply.code, reply.outs, reply.data
+                tid = self._next_tid
+                self._next_tid += 1
+                ent = [threading.Event(), None]
+                self._pending[tid] = ent
+            self.msgr.send_message(
+                M.MMonCommand(tid=tid, cmd={k: str(v)
+                                            for k, v in cmd.items()}),
+                self.mon_addr)
+            step = min(per_try, max(deadline - time.monotonic(), 0.05))
+            if not ent[0].wait(step):
+                with self._lock:
+                    self._pending.pop(tid, None)
+                if len(self.mon_addrs) > 1:
+                    self._rotate()
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"mon command {cmd.get('prefix')!r} timed out")
+                continue
+            reply: M.MMonCommandReply = ent[1]
+            if reply.code == -11 and reply.outs.startswith("NOTLEADER"):
+                leader = reply.outs.split(" ", 1)[1] \
+                    if " " in reply.outs else ""
+                self._rotate(leader or None)
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"mon command {cmd.get('prefix')!r}: "
+                        "no leader found")
+                continue
+            return reply.code, reply.outs, reply.data

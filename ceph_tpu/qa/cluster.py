@@ -21,11 +21,14 @@ log = Dout("qa")
 
 class MiniCluster:
     def __init__(self, n_osds: int = 3, store: str = "memstore",
-                 data_dir: str | None = None, auth: bool = False) -> None:
+                 data_dir: str | None = None, auth: bool = False,
+                 n_mons: int = 1) -> None:
         self.n_osds = n_osds
+        self.n_mons = n_mons
         self.store_kind = store
         self.data_dir = data_dir
-        self.mon: Monitor | None = None
+        self.mons: dict[int, Monitor] = {}
+        self._mon_dbs: dict[int, object] = {}
         self.mon_addr = ""
         self.osds: dict[int, OSD] = {}
         self._stores: dict[int, object] = {}
@@ -37,10 +40,29 @@ class MiniCluster:
             self.keyring.generate(A.SERVICE_ENTITY)
             self.keyring.generate("client.admin")
 
+    MON_NAMES = "abcdefgh"
+
+    @property
+    def mon(self) -> Monitor | None:
+        """A live mon to inspect — the current leader when one exists."""
+        if not self.mons:
+            return None
+        for m in self.mons.values():
+            if m.is_leader():
+                return m
+        return self.mons[min(self.mons)]
+
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "MiniCluster":
-        self.mon = Monitor("a", keyring=self.keyring)
-        self.mon_addr = self.mon.start()
+        for rank in range(self.n_mons):
+            self.mons[rank] = Monitor(self.MON_NAMES[rank],
+                                      keyring=self.keyring)
+            self._mon_dbs[rank] = self.mons[rank].db
+        monmap = {rank: m.prebind() for rank, m in self.mons.items()}
+        for rank, m in self.mons.items():
+            m.set_monmap(monmap, rank)
+            m.start()
+        self.mon_addr = ",".join(monmap[r] for r in sorted(monmap))
         for i in range(self.n_osds):
             self.start_osd(i)
         self.wait_for_osds_up(timeout=15)
@@ -67,8 +89,9 @@ class MiniCluster:
         for osd in list(self.osds.values()):
             osd.stop()
         self.osds.clear()
-        if self.mon:
-            self.mon.stop()
+        for m in self.mons.values():
+            m.stop()
+        self.mons.clear()
 
     def __enter__(self) -> "MiniCluster":
         return self.start()
@@ -123,6 +146,25 @@ class MiniCluster:
         osd = self.start_osd(osd_id)
         log(1, f"revived osd.{osd_id}")
         return osd
+
+    def kill_mon(self, rank: int) -> None:
+        """Hard-stop a monitor; its commit log survives for revive."""
+        m = self.mons.pop(rank)
+        m.stop()
+        log(1, f"killed mon rank {rank}")
+
+    def revive_mon(self, rank: int) -> Monitor:
+        assert rank not in self.mons
+        m = Monitor(self.MON_NAMES[rank], db=self._mon_dbs[rank],
+                    keyring=self.keyring)
+        addr = m.prebind()
+        monmap = {r: mm.addr for r, mm in self.mons.items()}
+        monmap[rank] = addr
+        m.set_monmap(monmap, rank)
+        m.start()
+        self.mons[rank] = m
+        log(1, f"revived mon rank {rank} at {addr}")
+        return m
 
     def scrub_pool(self, pool_name: str, repair: bool = True) -> dict:
         """Scrub every PG of a pool on its primary (the 'ceph pg scrub'
