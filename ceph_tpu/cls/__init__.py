@@ -178,3 +178,43 @@ def _rgw_bucket_list(inp: bytes, obj: bytes | None):
     n = req.get("max_keys", len(keys))
     out = {k: idx[k] for k in keys[:n]}
     return 0, json.dumps(out).encode(), None
+
+
+# -- cls_fs (cephfs-lite metadata ops; the dirop atomicity the
+# reference gets from the MDS journal, reduced to per-inode-object
+# atomic methods) ------------------------------------------------------
+
+@register("fs", "alloc_ino")
+def _fs_alloc_ino(inp: bytes, obj: bytes | None):
+    st = json.loads(obj) if obj else {"next_ino": 2}   # 1 = root
+    ino = st["next_ino"]
+    st["next_ino"] = ino + 1
+    return 0, json.dumps({"ino": ino}).encode(), json.dumps(st).encode()
+
+
+@register("fs", "dir_link")
+def _fs_dir_link(inp: bytes, obj: bytes | None):
+    """Add one entry to a directory inode; -EEXIST if taken."""
+    req = json.loads(inp)
+    inode = json.loads(obj) if obj else None
+    if inode is None or inode.get("type") != "dir":
+        return -20, b"", None         # -ENOTDIR
+    if req["name"] in inode["entries"]:
+        return -17, b"", None         # -EEXIST
+    inode["entries"][req["name"]] = req["ino"]
+    inode["mtime"] = time.time()
+    return 0, b"", json.dumps(inode).encode()
+
+
+@register("fs", "dir_unlink")
+def _fs_dir_unlink(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    inode = json.loads(obj) if obj else None
+    if inode is None or inode.get("type") != "dir":
+        return -20, b"", None
+    if req["name"] not in inode["entries"]:
+        return -2, b"", None          # -ENOENT
+    ino = inode["entries"].pop(req["name"])
+    inode["mtime"] = time.time()
+    return 0, json.dumps({"ino": ino}).encode(), \
+        json.dumps(inode).encode()

@@ -1,0 +1,221 @@
+"""cephfs-lite — a POSIX-ish filesystem on RADOS (src/mds + src/client
+roles, massively reduced).
+
+Reference: CephFS keeps a metadata tree in the MDS (journaled to RADOS
+via osdc/Journaler) and file data striped over RADOS objects by
+file_layout_t. This lite version drops the separate MDS daemon and
+stores metadata DIRECTLY in RADOS, with the dirop atomicity the MDS
+journal provides coming from in-OSD object-class methods instead:
+
+- ``.fs_super``     — inode allocator (cls fs.alloc_ino)
+- ``inode.<ino>``   — json inode: dirs carry {name: ino} entries
+                      (mutated only via cls fs.dir_link/dir_unlink,
+                      so concurrent clients cannot corrupt a dir),
+                      files carry size/mtime
+- ``fsdata.<ino>``  — file content through the striper
+
+API mirrors libcephfs: mkdir/rmdir/readdir, open/read/write, unlink,
+rename, stat. Reductions (documented): rename of a file is
+link-then-unlink (a crash between the two can leave both names —
+fsck-able, never data loss); no hard links across dirs; no
+permissions/uids; one flat namespace per pool.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import time
+
+from ceph_tpu.client.striper import FileLayout, StripedObject
+
+ROOT_INO = 1
+SUPER_OID = ".fs_super"
+
+
+class FSError(Exception):
+    def __init__(self, err: int, message: str = "") -> None:
+        super().__init__(message or errno.errorcode.get(err, str(err)))
+        self.errno = err
+
+
+class CephFS:
+    """A mounted filesystem (libcephfs ceph_mount role)."""
+
+    def __init__(self, ioctx,
+                 layout: FileLayout | None = None) -> None:
+        self.io = ioctx
+        self.layout = layout or FileLayout(stripe_unit=1 << 20,
+                                           stripe_count=1,
+                                           object_size=1 << 20)
+        # bootstrap the root directory (idempotent)
+        try:
+            self._read_inode(ROOT_INO)
+        except FSError:
+            self._write_inode(ROOT_INO, {
+                "type": "dir", "entries": {}, "mtime": time.time()})
+
+    # -- inode plumbing ------------------------------------------------
+    def _read_inode(self, ino: int) -> dict:
+        try:
+            return json.loads(self.io.read(f"inode.{ino}"))
+        except Exception:
+            raise FSError(errno.ENOENT, f"no inode {ino}")
+
+    def _write_inode(self, ino: int, inode: dict) -> None:
+        self.io.write_full(f"inode.{ino}", json.dumps(inode).encode())
+
+    def _alloc_ino(self) -> int:
+        out = self.io.execute(SUPER_OID, "fs", "alloc_ino")
+        return json.loads(out)["ino"]
+
+    def _resolve(self, path: str) -> tuple[int, dict]:
+        """path -> (ino, inode); raises ENOENT/ENOTDIR."""
+        ino, inode = ROOT_INO, self._read_inode(ROOT_INO)
+        for part in [p for p in path.split("/") if p]:
+            if inode["type"] != "dir":
+                raise FSError(errno.ENOTDIR, path)
+            child = inode["entries"].get(part)
+            if child is None:
+                raise FSError(errno.ENOENT, path)
+            ino, inode = child, self._read_inode(child)
+        return ino, inode
+
+    def _resolve_parent(self, path: str) -> tuple[int, str]:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise FSError(errno.EINVAL, "root has no parent")
+        parent = "/".join(parts[:-1])
+        ino, inode = self._resolve(parent)
+        if inode["type"] != "dir":
+            raise FSError(errno.ENOTDIR, parent)
+        return ino, parts[-1]
+
+    def _dir_link(self, dir_ino: int, name: str, ino: int) -> None:
+        from ceph_tpu.client.rados import RadosError
+        try:
+            self.io.execute(f"inode.{dir_ino}", "fs", "dir_link",
+                            json.dumps({"name": name,
+                                        "ino": ino}).encode())
+        except RadosError as exc:
+            raise FSError(-exc.code) from None
+
+    def _dir_unlink(self, dir_ino: int, name: str) -> int:
+        from ceph_tpu.client.rados import RadosError
+        try:
+            out = self.io.execute(f"inode.{dir_ino}", "fs",
+                                  "dir_unlink",
+                                  json.dumps({"name": name}).encode())
+        except RadosError as exc:
+            raise FSError(-exc.code) from None
+        return json.loads(out)["ino"]
+
+    # -- namespace ops (libcephfs surface) ----------------------------
+    def mkdir(self, path: str) -> None:
+        parent, name = self._resolve_parent(path)
+        ino = self._alloc_ino()
+        self._write_inode(ino, {"type": "dir", "entries": {},
+                                "mtime": time.time()})
+        self._dir_link(parent, name, ino)
+
+    def readdir(self, path: str) -> list[str]:
+        _, inode = self._resolve(path)
+        if inode["type"] != "dir":
+            raise FSError(errno.ENOTDIR, path)
+        return sorted(inode["entries"])
+
+    def stat(self, path: str) -> dict:
+        ino, inode = self._resolve(path)
+        out = {"ino": ino, "type": inode["type"],
+               "mtime": inode["mtime"]}
+        if inode["type"] == "file":
+            out["size"] = inode.get("size", 0)
+        else:
+            out["nentries"] = len(inode["entries"])
+        return out
+
+    def rmdir(self, path: str) -> None:
+        ino, inode = self._resolve(path)
+        if inode["type"] != "dir":
+            raise FSError(errno.ENOTDIR, path)
+        if inode["entries"]:
+            raise FSError(errno.ENOTEMPTY, path)
+        parent, name = self._resolve_parent(path)
+        self._dir_unlink(parent, name)
+        self.io.remove(f"inode.{ino}")
+
+    def create(self, path: str) -> "File":
+        parent, name = self._resolve_parent(path)
+        ino = self._alloc_ino()
+        self._write_inode(ino, {"type": "file", "size": 0,
+                                "mtime": time.time()})
+        self._dir_link(parent, name, ino)
+        return File(self, ino)
+
+    def open(self, path: str, create: bool = False) -> "File":
+        try:
+            ino, inode = self._resolve(path)
+        except FSError as exc:
+            if create and exc.errno == errno.ENOENT:
+                return self.create(path)
+            raise
+        if inode["type"] != "file":
+            raise FSError(errno.EISDIR, path)
+        return File(self, ino)
+
+    def unlink(self, path: str) -> None:
+        ino, inode = self._resolve(path)
+        if inode["type"] == "dir":
+            raise FSError(errno.EISDIR, path)
+        parent, name = self._resolve_parent(path)
+        self._dir_unlink(parent, name)
+        StripedObject(self.io, f"fsdata.{ino}").remove()
+        self.io.remove(f"inode.{ino}")
+
+    def rename(self, old: str, new: str) -> None:
+        """Link under the new name, then unlink the old (the reference
+        does this atomically in the MDS journal; here a crash between
+        the steps leaves both names pointing at the same inode)."""
+        ino, _ = self._resolve(old)
+        new_parent, new_name = self._resolve_parent(new)
+        old_parent, old_name = self._resolve_parent(old)
+        self._dir_link(new_parent, new_name, ino)
+        self._dir_unlink(old_parent, old_name)
+
+
+class File:
+    """An open file handle (libcephfs Fh role)."""
+
+    def __init__(self, fs: CephFS, ino: int) -> None:
+        self.fs = fs
+        self.ino = ino
+        self._data = StripedObject(fs.io, f"fsdata.{ino}", fs.layout)
+
+    def write(self, data: bytes, offset: int = 0) -> int:
+        self._data.write(data, offset=offset)
+        inode = self.fs._read_inode(self.ino)
+        inode["size"] = max(inode.get("size", 0), offset + len(data))
+        inode["mtime"] = time.time()
+        self.fs._write_inode(self.ino, inode)
+        return len(data)
+
+    def read(self, length: int | None = None, offset: int = 0) -> bytes:
+        inode = self.fs._read_inode(self.ino)
+        size = inode.get("size", 0)
+        if length is None:
+            length = max(size - offset, 0)
+        length = min(length, max(size - offset, 0))
+        if length <= 0:
+            return b""
+        out = self._data.read(length, offset)
+        return out + b"\x00" * (length - len(out))
+
+    def truncate(self, size: int) -> None:
+        inode = self.fs._read_inode(self.ino)
+        inode["size"] = size
+        self.fs._write_inode(self.ino, inode)
+        self._data.size = min(self._data.size, size)
+        self._data._write_meta()
+
+    def size(self) -> int:
+        return self.fs._read_inode(self.ino).get("size", 0)

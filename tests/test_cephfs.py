@@ -1,0 +1,109 @@
+"""cephfs-lite (src/mds + src/client roles, reduced): namespace ops,
+file I/O through the striper, dirop atomicity via object classes."""
+
+import errno
+import os
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.services.cephfs import CephFS, FSError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        rados = c.client()
+        c.create_pool("fspool", pg_num=4, size=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    return CephFS(cluster._clients[0].open_ioctx("fspool"))
+
+
+def test_tree_and_readdir(fs):
+    fs.mkdir("/a")
+    fs.mkdir("/a/b")
+    fs.mkdir("/a/b/c")
+    fs.mkdir("/d")
+    assert fs.readdir("/") == ["a", "d"]
+    assert fs.readdir("/a/b") == ["c"]
+    assert fs.stat("/a")["type"] == "dir"
+    with pytest.raises(FSError) as ei:
+        fs.mkdir("/a")                 # exists
+    assert ei.value.errno == errno.EEXIST
+    with pytest.raises(FSError):
+        fs.readdir("/nope")
+
+
+def test_file_io_and_unlink(fs):
+    f = fs.create("/a/hello.txt")
+    f.write(b"hello fs")
+    assert fs.stat("/a/hello.txt")["size"] == 8
+    f2 = fs.open("/a/hello.txt")
+    assert f2.read() == b"hello fs"
+    # big striped file with offset I/O
+    blob = os.urandom(3 << 20)
+    big = fs.open("/a/big.bin", create=True)
+    big.write(blob)
+    assert big.read(4096, 1 << 20) == blob[1 << 20:(1 << 20) + 4096]
+    big.write(b"patch", offset=100)
+    assert big.read(5, 100) == b"patch"
+    # sparse tail reads as zeros after truncate-grow
+    big.truncate(len(blob) + 1000)
+    assert big.read(1000, len(blob)) == b"\x00" * 1000
+    fs.unlink("/a/hello.txt")
+    with pytest.raises(FSError):
+        fs.open("/a/hello.txt")
+    assert "hello.txt" not in fs.readdir("/a")
+
+
+def test_rename(fs):
+    f = fs.open("/d/old.txt", create=True)
+    f.write(b"payload")
+    fs.rename("/d/old.txt", "/a/new.txt")
+    assert "old.txt" not in fs.readdir("/d")
+    assert fs.open("/a/new.txt").read() == b"payload"
+    fs.unlink("/a/new.txt")
+
+
+def test_rmdir_semantics(fs):
+    fs.mkdir("/victim")
+    fs.open("/victim/f", create=True).write(b"x")
+    with pytest.raises(FSError) as ei:
+        fs.rmdir("/victim")
+    assert ei.value.errno == errno.ENOTEMPTY
+    fs.unlink("/victim/f")
+    fs.rmdir("/victim")
+    assert "victim" not in fs.readdir("/")
+    with pytest.raises(FSError):
+        fs.rmdir("/a")                 # still has entries
+
+
+def test_remount_persistence(cluster, fs):
+    f = fs.open("/a/persist.bin", create=True)
+    payload = os.urandom(50_000)
+    f.write(payload)
+    # a second mount (fresh client) sees the same tree and data
+    rados2 = cluster.client()
+    fs2 = CephFS(rados2.open_ioctx("fspool"))
+    assert "persist.bin" in fs2.readdir("/a")
+    assert fs2.open("/a/persist.bin").read() == payload
+
+
+def test_concurrent_dirops_atomic(fs):
+    """Two clients racing dir_link on one directory never lose an
+    entry (the cls-method atomicity the MDS journal provides)."""
+    import concurrent.futures
+    fs.mkdir("/race")
+
+    def worker(i):
+        fs.open(f"/race/f{i}", create=True).write(b"x")
+        return i
+
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(worker, range(24)))
+    assert fs.readdir("/race") == sorted(
+        (f"f{i}" for i in range(24)))
