@@ -55,7 +55,13 @@ from ceph_tpu.osd.pg_backend import (
     object_write_txn,
 )
 from ceph_tpu.parallel import messages as M
-from ceph_tpu.store.object_store import EIOError, NoSuchObject, StoreError
+from ceph_tpu.store.object_store import (
+    EIOError,
+    NoSuchCollection,
+    NoSuchObject,
+    StoreError,
+    Transaction,
+)
 from ceph_tpu.utils import tracing
 from ceph_tpu.utils.dout import Dout
 
@@ -116,16 +122,16 @@ class ECBackend(PGBackend):
         return out[:size]
 
     # -- writes -------------------------------------------------------
-    def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
-                     on_commit: Callable[[int], None]) -> None:
-        padded = self._pad(bytes(data))
-        shards = ec_util.encode(self.sinfo, self.codec, padded)
-        hinfo = HashInfo(self.n)
-        hinfo.append(0, shards)
-        hinfo_raw = json.dumps(hinfo.to_dict()).encode()
-        size_raw = len(data).to_bytes(8, "little")
-
-        entry = LogEntry(version, LOG_WRITE, oid)
+    def _fan_out(self, pg: PG, oid: str, version: int, op: int,
+                 txn_builder: Callable[[int, str], "Transaction"],
+                 on_commit: Callable[[int], None],
+                 span_label: str, supersedes_recovery: bool) -> None:
+        """Shared write fan-out (the try_reads_to_commit dispatch,
+        ECBackend.cc:1986-2048): stage the log entry, build one
+        shard-local txn per up position, apply ours locally, ship the
+        rest as MECSubWrite, ack the client when every position
+        committed."""
+        entry = LogEntry(version, op, oid)
         kv, drop = pg.log.stage(entry)
         positions = self.up_positions(pg)
         tid = self.parent.new_tid()
@@ -136,64 +142,127 @@ class ECBackend(PGBackend):
         # dataflow trace: one child span per shard sub-op, carried in
         # the message (ECBackend.cc:2022-2026 role)
         op_span = tracing.current()
-        op_span.event("start ec write")
+        op_span.event(f"start {span_label}")
         for pos in positions:
             osd = pg.acting[pos]
             cid = pg_cid(pg.pool, pg.ps, pos)
-            txn = object_write_txn(
-                cid, oid, shards[pos].tobytes(), version,
-                attrs={"sz": size_raw, "hinfo": hinfo_raw})
+            txn = txn_builder(pos, cid)
             pg.log.apply_to_txn(txn, cid, kv, drop)
             if osd == self.parent.whoami:
                 self.parent.queue_local_txn(
                     txn,
                     lambda p=pos: iw.complete(p) and iw.on_all_commit())
             else:
-                child = op_span.child(f"ec_sub_write(shard={pos})")
+                child = op_span.child(f"{span_label}(shard={pos})")
                 self.parent.send_osd(osd, M.MECSubWrite(
                     tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
                     epoch=epoch, oid=oid, version=version,
                     txn_bytes=txn.encode(), trace=child.wire()))
                 child.finish()
-        # a write of every shard supersedes any pending recovery for it
-        for missing in pg.peer_missing.values():
-            missing.pop(oid, None)
+        if supersedes_recovery:
+            # a write of every shard supersedes pending recovery for it
+            for missing in pg.peer_missing.values():
+                missing.pop(oid, None)
+
+    def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
+                     on_commit: Callable[[int], None]) -> None:
+        padded = self._pad(bytes(data))
+        shards = ec_util.encode(self.sinfo, self.codec, padded)
+        hinfo = HashInfo(self.n)
+        hinfo.append(0, shards)
+        hinfo_raw = json.dumps(hinfo.to_dict()).encode()
+        size_raw = len(data).to_bytes(8, "little")
+        self._fan_out(
+            pg, oid, version, LOG_WRITE,
+            lambda pos, cid: object_write_txn(
+                cid, oid, shards[pos].tobytes(), version,
+                attrs={"sz": size_raw, "hinfo": hinfo_raw}),
+            on_commit, "ec_sub_write", supersedes_recovery=True)
 
     def submit_remove(self, pg: PG, oid: str, version: int,
                       on_commit: Callable[[int], None]) -> None:
-        entry = LogEntry(version, LOG_REMOVE, oid)
-        kv, drop = pg.log.stage(entry)
-        positions = self.up_positions(pg)
-        tid = self.parent.new_tid()
-        iw = InflightWrite(tid, pg, oid, version, set(positions),
-                           lambda: on_commit(0))
-        self.parent.register_write(iw)
-        epoch = self.parent.get_osdmap().epoch
-        for pos in positions:
-            osd = pg.acting[pos]
-            cid = pg_cid(pg.pool, pg.ps, pos)
-            txn = object_remove_txn(cid, oid)
-            pg.log.apply_to_txn(txn, cid, kv, drop)
-            if osd == self.parent.whoami:
-                self.parent.queue_local_txn(
-                    txn,
-                    lambda p=pos: iw.complete(p) and iw.on_all_commit())
-            else:
-                self.parent.send_osd(osd, M.MECSubWrite(
-                    tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
-                    epoch=epoch, oid=oid, version=version,
-                    txn_bytes=txn.encode()))
-        for missing in pg.peer_missing.values():
-            missing.pop(oid, None)
+        self._fan_out(
+            pg, oid, version, LOG_REMOVE,
+            lambda pos, cid: object_remove_txn(cid, oid),
+            on_commit, "ec_sub_remove", supersedes_recovery=True)
+
+    def submit_partial_write(self, pg: PG, oid: str, offset: int,
+                             data: bytes, version: int,
+                             on_commit: Callable[[int], None],
+                             old_size: int | None = None) -> None:
+        """Partial-stripe overwrite (start_rmw / ECTransaction
+        get_write_plan roles, ECBackend.cc:1800): read only the stripe
+        WINDOW the write touches, splice, re-encode those stripes, and
+        range-write each shard — instead of reconstructing and
+        re-encoding the whole object.
+
+        The cumulative full-shard hinfo cannot survive a range
+        overwrite, so the write drops it; integrity then rests on the
+        store's own blob checksums, exactly as the reference requires
+        bluestore for EC-overwrite pools (ecbackend.rst:7-12).
+
+        Raises StoreError when the object's current state cannot be
+        read (degraded beyond reach): a transient read failure must
+        fail the op, never silently truncate to old_size=0.
+        """
+        sw, cs = self.sinfo.stripe_width, self.sinfo.chunk_size
+        data = bytes(data)
+        end = offset + len(data)
+        if old_size is None:
+            try:
+                old_size = self.stat_object(pg, oid)
+            except (NoSuchObject, NoSuchCollection):
+                old_size = 0           # first write to this object
+        new_size = max(old_size, end)
+        a = (offset // sw) * sw                       # window start
+        b = -(-end // sw) * sw                        # window end
+        window = bytearray(b - a)
+        old_aligned = -(-old_size // sw) * sw
+        if old_size > a and (offset > a or end < min(b, old_aligned)):
+            # edge stripes keep existing bytes: ranged RMW read
+            read_to = min(b, old_aligned)
+            want = list(range(self.k))
+            chunks, _ = self._read_shards(
+                pg, oid, want,
+                chunk_off=(a // sw) * cs,
+                chunk_len=((read_to - a) // sw) * cs)
+            if not all(i in chunks for i in want):
+                chunks = ec_util.decode(self.sinfo, self.codec,
+                                        chunks, want)
+            old_win = self._chunks_to_logical(
+                {i: chunks[i] for i in want}, read_to - a)
+            window[:len(old_win)] = old_win
+        window[offset - a:end - a] = data
+        shards = ec_util.encode(self.sinfo, self.codec, bytes(window))
+        chunk_off = (a // sw) * cs
+        size_raw = new_size.to_bytes(8, "little")
+
+        def build(pos: int, cid: str) -> Transaction:
+            txn = Transaction()
+            txn.create_collection(cid)
+            txn.touch(cid, oid)
+            txn.write(cid, oid, chunk_off, shards[pos].tobytes())
+            txn.setattr(cid, oid, "v", version.to_bytes(8, "little"))
+            txn.setattr(cid, oid, "sz", size_raw)
+            txn.rmattr(cid, oid, "hinfo")
+            return txn
+
+        self._fan_out(pg, oid, version, LOG_WRITE, build, on_commit,
+                      "ec_sub_rmw", supersedes_recovery=False)
 
     # -- shard read fan-out -------------------------------------------
     MAX_READ_ATTEMPTS = 6
 
     def _read_shards(self, pg: PG, oid: str, want_chunks: list[int],
-                     avoid: set[int] | None = None
+                     avoid: set[int] | None = None,
+                     chunk_off: int = 0, chunk_len: int = 0
                      ) -> tuple[dict[int, np.ndarray], dict[str, bytes]]:
         """Read the chunks named by minimum_to_decode over (up - avoid)
         positions; returns ({chunk: bytes}, attrs-from-one-shard).
+        ``chunk_off/chunk_len`` restrict to a range of each shard's
+        chunk stream (the partial-stripe RMW read); short/absent ranges
+        pad with zeros (virtual zero stripes — parity of zeros is
+        zeros, so the code stays consistent).
 
         Retries around shards that time out or answer EIO
         (get_min_avail_to_read_shards + send_all_remaining_reads role),
@@ -224,11 +293,13 @@ class ECBackend(PGBackend):
                 plan = self.codec.minimum_to_decode(
                     want_chunks, available)
             except Exception:
+                if enoent_everywhere and attempt > 0:
+                    # every shard said ENOENT: the object does not
+                    # exist — exit fast, don't burn the retry ladder
+                    raise NoSuchObject(oid)
                 if attempt < self.MAX_READ_ATTEMPTS - 1:
                     time.sleep(0.1 * (attempt + 1))
                     continue
-                if enoent_everywhere and attempt > 0:
-                    raise NoSuchObject(oid)
                 raise ECReadError(
                     f"{oid}: cannot reconstruct chunks {want_chunks} "
                     f"from positions {available}")
@@ -244,12 +315,15 @@ class ECBackend(PGBackend):
                 for pos in remote:
                     self.parent.send_osd(pg.acting[pos], M.MECSubRead(
                         tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
-                        oid=oid, offset=0, length=0, want_attrs=True))
+                        oid=oid, offset=chunk_off, length=chunk_len,
+                        want_attrs=True))
                 if mypos in need:
                     cid = pg_cid(pg.pool, pg.ps, mypos)
                     try:
                         results[mypos] = np.frombuffer(
-                            self.parent.store.read(cid, oid),
+                            self.parent.store.read(
+                                cid, oid, chunk_off,
+                                chunk_len or None),
                             dtype=np.uint8)
                         local_attrs = self.parent.store.getattrs(
                             cid, oid)
@@ -257,7 +331,9 @@ class ECBackend(PGBackend):
                             local_attrs.get("v", b""), "little")
                         attrs = attrs or local_attrs
                         enoent_everywhere = False
-                    except NoSuchObject:
+                    except (NoSuchObject, NoSuchCollection):
+                        # match the remote mapping: a shard whose PG
+                        # collection does not exist yet answers ENOENT
                         base_avoid.add(mypos)
                     except StoreError:
                         enoent_everywhere = False
@@ -289,6 +365,14 @@ class ECBackend(PGBackend):
                     "retrying")
                 time.sleep(0.05 * (attempt + 1))
                 continue
+            if chunk_len:
+                # ranged read: short shards (range beyond their data)
+                # pad with zeros — virtual zero stripes
+                for pos, arr in results.items():
+                    if len(arr) < chunk_len:
+                        results[pos] = np.concatenate(
+                            [arr, np.zeros(chunk_len - len(arr),
+                                           dtype=np.uint8)])
             return results, attrs
         if enoent_everywhere:
             raise NoSuchObject(oid)

@@ -646,21 +646,38 @@ class OSD:
                                 lambda code, v=version: reply(code, b"", v))
             elif op in (M.OSD_OP_WRITE, M.OSD_OP_APPEND):
                 self.logger.inc("op_w")
-                # RMW: reconstruct current object, splice, rewrite
-                # (EC overwrite without the in-place partial-stripe
-                # machinery; ECBackend.cc start_rmw role)
-                try:
-                    cur = bytearray(be.read_object(pg, msg.oid))
-                except (NoSuchObject, NoSuchCollection):
-                    # first write to this object (or to this whole PG)
-                    cur = bytearray()
-                off = len(cur) if op == M.OSD_OP_APPEND else msg.offset
-                if off > len(cur):
-                    cur.extend(b"\x00" * (off - len(cur)))
-                cur[off:off + len(msg.data)] = msg.data
                 version = pg.log.last_version + 1
-                be.submit_write(pg, msg.oid, bytes(cur), version,
-                                lambda code, v=version: reply(code, b"", v))
+                if isinstance(be, ECBackend):
+                    # partial-stripe RMW: only the touched stripe
+                    # window is read, re-encoded, and range-written
+                    # (start_rmw / get_write_plan roles). ENOENT means
+                    # a fresh object; any OTHER stat failure must fail
+                    # the op, or a transient shard outage would make
+                    # this write silently truncate/overwrite from 0.
+                    try:
+                        old_size = be.stat_object(pg, msg.oid)
+                    except (NoSuchObject, NoSuchCollection):
+                        old_size = 0
+                    off = old_size if op == M.OSD_OP_APPEND \
+                        else msg.offset
+                    be.submit_partial_write(
+                        pg, msg.oid, off, msg.data, version,
+                        lambda code, v=version: reply(code, b"", v),
+                        old_size=old_size)
+                else:
+                    # replicated: reconstruct, splice, rewrite
+                    try:
+                        cur = bytearray(be.read_object(pg, msg.oid))
+                    except (NoSuchObject, NoSuchCollection):
+                        cur = bytearray()
+                    off = len(cur) if op == M.OSD_OP_APPEND \
+                        else msg.offset
+                    if off > len(cur):
+                        cur.extend(b"\x00" * (off - len(cur)))
+                    cur[off:off + len(msg.data)] = msg.data
+                    be.submit_write(
+                        pg, msg.oid, bytes(cur), version,
+                        lambda code, v=version: reply(code, b"", v))
             elif op == M.OSD_OP_READ:
                 self.logger.inc("op_r")
                 data = be.read_object(pg, msg.oid)
@@ -1061,12 +1078,19 @@ class OSD:
         auth_version = 0
         if is_ec:
             # each shard carries the full hinfo vector; a shard whose
-            # chunk crc mismatches its OWN stored hinfo is corrupt
+            # chunk crc mismatches its OWN stored hinfo is corrupt. A
+            # shard WITHOUT hinfo (partial-stripe overwrites drop it)
+            # has no app-level self-check — integrity rests on the
+            # store's blob checksums, as the reference's EC-overwrite
+            # pools rest on bluestore csums (surfaced as EIO above).
             clean: dict[int, int] = {}
             for pos, (v, crc, attrs) in obs.items():
+                hraw = attrs.get("hinfo")
+                if not hraw:
+                    clean[pos] = v
+                    continue
                 try:
-                    hinfo = ec_util.HashInfo.from_dict(
-                        json.loads(attrs.get("hinfo", b"")))
+                    hinfo = ec_util.HashInfo.from_dict(json.loads(hraw))
                     ok = crc == hinfo.get_chunk_hash(pos)
                 except (ValueError, KeyError, TypeError):
                     ok = False         # unparseable hinfo: corrupt
