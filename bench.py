@@ -77,7 +77,7 @@ def main() -> None:
     # the tunnel chip is shared: contention only ever slows a run, so
     # take the best slope across several measurement rounds
     slope = float("inf")
-    for round_ in range(8):
+    for round_ in range(12):
         times = {}
         for iters in LOOP_COUNTS:
             best = float("inf")
@@ -90,7 +90,7 @@ def main() -> None:
             LOOP_COUNTS[1] - LOOP_COUNTS[0])
         if s > 0:
             slope = min(slope, s)
-        time.sleep(0.5)   # spread rounds over contention windows
+        time.sleep(1.0)   # spread rounds over contention windows
 
     data_bytes = K * n
     gbps = data_bytes / slope / 1e9
