@@ -1395,6 +1395,34 @@ class OSD:
                 self.op_wq.enqueue(pg.pgid,
                                    lambda p=pg: self._recover(p))
 
+    def _report_pg_stats(self, epoch: int) -> None:
+        """Ship primary-side PG stats to the mon (MgrClient report
+        role; the reference reports to the mgr, which feeds pgmap
+        into 'ceph -s'). Lock-free peek — the mon tolerates slightly
+        stale numbers."""
+        with self._pgs_lock:
+            pgs = list(self.pgs.values())
+        stats = []
+        for pg in pgs:
+            try:
+                missing = sum(len(m) for m in pg.peer_missing.values())
+            except RuntimeError:
+                missing = -1          # mutating right now: report dirty
+            cid = pg.backend.local_cid(pg) if pg.backend else ""
+            try:
+                objects = sum(1 for o in self.store.list_objects(cid)
+                              if o != PGMETA)
+            except StoreError:
+                objects = 0
+            stats.append({"pgid": f"{pg.pool}.{pg.ps}",
+                          "state": pg.state,
+                          "missing": missing, "objects": objects,
+                          "version": pg.log.last_version})
+        self.monc.msgr.send_message(
+            M.MPGStats(osd_id=self.whoami, epoch=epoch,
+                       stats=json.dumps(stats).encode()),
+            self.monc.mon_addr)
+
     # -- heartbeats ----------------------------------------------------
     def _heartbeat_loop(self) -> None:
         interval = g_conf()["osd_heartbeat_interval"]
@@ -1408,6 +1436,7 @@ class OSD:
             self._expire_inflight(now)
             self._kick_recovery()
             self.op_tracker.check_slow()
+            self._report_pg_stats(osdmap.epoch)
             for osd, info in osdmap.osds.items():
                 if osd == self.whoami:
                     continue

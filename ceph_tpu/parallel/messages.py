@@ -188,6 +188,14 @@ class MOSDOpReply(Message):
               ("data", "bytes"), ("version", "u64")]
 
 
+class MPGStats(Message):
+    """OSD -> mon: periodic per-PG stat report (the MgrClient report
+    protocol's role, mgr collapsed into the mon). ``stats`` is a json
+    list of {pgid, state, missing, objects}."""
+    MSG_TYPE = 43
+    FIELDS = [("osd_id", "i32"), ("epoch", "u32"), ("stats", "bytes")]
+
+
 # -- mon quorum (Paxos/Elector role, src/mon/Paxos.{h,cc}) -------------
 
 class MMonHB(Message):

@@ -72,6 +72,9 @@ class Monitor:
         self._lock = threading.RLock()
         self._subscribers: dict[str, Connection] = {}  # peer entity -> conn
         self._last_beacon: dict[int, float] = {}
+        # osd -> (monotonic ts, [pg stat dicts]) — pgmap soft state
+        # (the mgr's aggregation role)
+        self._pg_stats: dict[int, tuple[float, list]] = {}
         self._failure_reports: dict[int, dict[int, float]] = {}
         # epoch at which each osd last booted (up_from role): failure
         # reports carrying an older epoch were formed before the boot
@@ -305,6 +308,16 @@ class Monitor:
                 return
             if isinstance(msg, M.MAuth):
                 self._handle_auth(msg, conn)
+            elif isinstance(msg, M.MPGStats):
+                # soft state: every mon keeps what it hears AND relays
+                # to the leader (whose status answers commands)
+                try:
+                    stats = json.loads(msg.stats)
+                except ValueError:
+                    stats = []
+                self._pg_stats[msg.osd_id] = (time.monotonic(), stats)
+                if not self.is_leader():
+                    self.msgr.send_message(msg, self.leader_addr())
             elif isinstance(msg, (M.MOSDBoot, M.MOSDFailure,
                                   M.MOSDAlive)) and not self.is_leader():
                 # only the leader mutates cluster state; relay the
@@ -551,6 +564,28 @@ class Monitor:
                 for o in self.osdmap.osds.values()],
         }
 
+    def _pgmap(self) -> dict:
+        """Aggregate reported PG stats (the mgr pgmap in 'ceph -s')."""
+        now = time.monotonic()
+        stale_after = 10 * g_conf()["osd_heartbeat_interval"]
+        by_state: dict[str, int] = {}
+        degraded = 0
+        objects = 0
+        seen: set[str] = set()
+        for osd, (ts, stats) in self._pg_stats.items():
+            if now - ts > stale_after:
+                continue
+            for s in stats:
+                if s["pgid"] in seen:
+                    continue
+                seen.add(s["pgid"])
+                by_state[s["state"]] = by_state.get(s["state"], 0) + 1
+                if s["missing"]:
+                    degraded += 1
+                objects += s.get("objects", 0)
+        return {"num_pgs": len(seen), "by_state": by_state,
+                "degraded_pgs": degraded, "num_objects": objects}
+
     def _status(self) -> dict:
         up = sum(1 for o in self.osdmap.osds.values() if o.up)
         inc = sum(1 for o in self.osdmap.osds.values() if o.in_cluster)
@@ -561,10 +596,24 @@ class Monitor:
             "num_up_osds": up,
             "num_in_osds": inc,
             "pools": sorted(self.osdmap.pool_by_name),
+            "pgmap": self._pgmap(),
+            "quorum": {"rank": self.rank,
+                       "leader": self._leader_rank,
+                       "mons": len(self.monmap)},
         }
 
     def _health(self) -> str:
         down = [o.osd_id for o in self.osdmap.osds.values() if not o.up]
+        warns = []
         if down:
-            return f"HEALTH_WARN: {len(down)} osds down: {down}"
+            warns.append(f"{len(down)} osds down: {down}")
+        pgmap = self._pgmap()
+        if pgmap["degraded_pgs"]:
+            warns.append(f"{pgmap['degraded_pgs']} pgs degraded")
+        notactive = sum(n for st, n in pgmap["by_state"].items()
+                        if st != "active")
+        if notactive:
+            warns.append(f"{notactive} pgs not active")
+        if warns:
+            return "HEALTH_WARN: " + "; ".join(warns)
         return "HEALTH_OK"

@@ -43,6 +43,18 @@ COMP_NONE = 0
 _COMP_ALGS = {1: "zlib", 2: "zstd", 3: "bz2", 4: "lzma"}
 _COMP_IDS = {v: k for k, v in _COMP_ALGS.items()}
 
+#: blob checksum algorithms (Checksummer.h:11-19 role); id rides the
+#: extent so csum_type config changes never orphan old blobs. id 0 =
+#: crc32c (the pre-existing default encoding).
+_CSUM_FNS = {
+    0: lambda d: checksum.crc32c(d),
+    1: lambda d: checksum.xxhash32(d),
+    2: lambda d: checksum.xxhash64(d) & 0xFFFFFFFF,
+    3: lambda d: 0,                    # "none"
+}
+_CSUM_IDS = {"crc32c": 0, "xxhash32": 1, "xxhash64": 2, "none": 3,
+             "crc32c_16": 0, "crc32c_8": 0}
+
 
 class _Extent:
     """A logical range backed by a slice of a crc-protected blob in the
@@ -51,20 +63,21 @@ class _Extent:
     stored bytes; ``comp`` the compressor id (0 = stored raw)."""
 
     __slots__ = ("logical_off", "length", "blob_off", "blob_len",
-                 "blob_crc", "slice_off", "disk_len", "comp")
+                 "blob_crc", "slice_off", "disk_len", "comp", "csum")
 
     def __init__(self, logical_off: int, length: int, blob_off: int,
                  blob_len: int, blob_crc: int, slice_off: int,
                  disk_len: int | None = None,
-                 comp: int = COMP_NONE) -> None:
+                 comp: int = COMP_NONE, csum: int = 0) -> None:
         self.logical_off = logical_off
         self.length = length
         self.blob_off = blob_off      # file offset of the whole blob
         self.blob_len = blob_len
-        self.blob_crc = blob_crc      # crc of the STORED (disk) bytes
+        self.blob_crc = blob_crc      # checksum of the STORED bytes
         self.slice_off = slice_off    # this extent's start within the blob
         self.disk_len = blob_len if disk_len is None else disk_len
         self.comp = comp
+        self.csum = csum              # _CSUM_FNS id used for blob_crc
 
     @property
     def end(self) -> int:
@@ -88,7 +101,7 @@ class _Meta:
         e.list(self.extents, lambda en, x: (
             en.u64(x.logical_off), en.u64(x.length), en.u64(x.blob_off),
             en.u64(x.blob_len), en.u32(x.blob_crc), en.u64(x.slice_off),
-            en.u64(x.disk_len), en.u8(x.comp)))
+            en.u64(x.disk_len), en.u8(x.comp), en.u8(x.csum)))
         return e.getvalue()
 
     @classmethod
@@ -100,7 +113,7 @@ class _Meta:
         m.omap = d.map(Decoder.str, Decoder.bytes)
         m.extents = d.list(lambda dd: _Extent(
             dd.u64(), dd.u64(), dd.u64(), dd.u64(), dd.u32(), dd.u64(),
-            dd.u64(), dd.u8()))
+            dd.u64(), dd.u8(), dd.u8()))
         return m
 
 
@@ -116,12 +129,13 @@ def _clip(extents: list[_Extent], a: int, b: int) -> list[_Extent]:
         if x.logical_off < a:
             out.append(_Extent(x.logical_off, a - x.logical_off,
                                x.blob_off, x.blob_len, x.blob_crc,
-                               x.slice_off, x.disk_len, x.comp))
+                               x.slice_off, x.disk_len, x.comp,
+                               x.csum))
         if x.end > b:
             cut = b - x.logical_off
             out.append(_Extent(b, x.end - b, x.blob_off, x.blob_len,
                                x.blob_crc, x.slice_off + cut,
-                               x.disk_len, x.comp))
+                               x.disk_len, x.comp, x.csum))
     return out
 
 
@@ -174,9 +188,12 @@ class BlockStore(ObjectStore):
         # when the configured algorithm saves enough
         # (bluestore_compression_* semantics)
         comp_alg, comp_min, comp_ratio = self._comp_config()
+        from ceph_tpu.utils.config import g_conf
+        csum_id = _CSUM_IDS.get(g_conf()["bluestore_csum_type"], 0)
+        csum_fn = _CSUM_FNS[csum_id]
         data_dirty = False
-        # op idx -> (file_off, raw_len, disk_len, crc, comp_id)
-        blob_at: dict[int, tuple[int, int, int, int, int]] = {}
+        # op idx -> (file_off, raw_len, disk_len, csum, comp_id, csum_id)
+        blob_at: dict[int, tuple[int, int, int, int, int, int]] = {}
         self._data.seek(0, os.SEEK_END)
         for i, op in enumerate(txn.ops):
             if op[0] == osr.OP_WRITE:
@@ -190,7 +207,7 @@ class BlockStore(ObjectStore):
                 file_off = self._data.tell()
                 self._data.write(stored)
                 blob_at[i] = (file_off, len(payload), len(stored),
-                              checksum.crc32c(stored), comp_id)
+                              csum_fn(stored), comp_id, csum_id)
                 data_dirty = True
         if data_dirty:
             self._data.flush()
@@ -241,10 +258,12 @@ class BlockStore(ObjectStore):
             elif code == osr.OP_WRITE:
                 m = load(op[1], op[2], create=True)
                 off, payload = op[3], op[4]
-                foff, raw_len, disk_len, fcrc, comp_id = blob_at[i]
+                foff, raw_len, disk_len, fcrc, comp_id, cs_id = \
+                    blob_at[i]
                 m.extents = _clip(m.extents, off, off + raw_len)
                 m.extents.append(_Extent(off, raw_len, foff, raw_len,
-                                         fcrc, 0, disk_len, comp_id))
+                                         fcrc, 0, disk_len, comp_id,
+                                         cs_id))
                 m.extents.sort(key=lambda x: x.logical_off)
                 m.size = max(m.size, off + raw_len)
             elif code == osr.OP_ZERO:
@@ -303,7 +322,8 @@ class BlockStore(ObjectStore):
     def _read_blob(self, x: _Extent) -> bytes:
         self._data.seek(x.blob_off)
         blob = self._data.read(x.disk_len)
-        if len(blob) != x.disk_len or checksum.crc32c(blob) != x.blob_crc:
+        if len(blob) != x.disk_len or \
+                _CSUM_FNS[x.csum](blob) != x.blob_crc:
             raise EIOError(
                 f"checksum mismatch reading blob at {x.blob_off}")
         if x.comp != COMP_NONE:

@@ -80,6 +80,25 @@ def test_mon_asok(cluster):
     assert out["epoch"] >= 1 and len(out["osds"]) == 3
 
 
+def test_status_pgmap_aggregation(cluster):
+    """'ceph -s' pgmap (MgrClient report role): OSDs ship per-PG stats
+    to the mon, which aggregates counts/states/objects."""
+    code, _, data = cluster.mon_cmd(prefix="status")
+    assert code == 0
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        code, _, data = cluster.mon_cmd(prefix="status")
+        st = json.loads(data)
+        pgmap = st["pgmap"]
+        if pgmap["num_pgs"] >= 1 and pgmap["num_objects"] >= 1:
+            break
+        time.sleep(0.5)
+    assert pgmap["by_state"].get("active", 0) >= 1
+    assert pgmap["degraded_pgs"] == 0
+    assert st["health"] == "HEALTH_OK"
+    assert st["quorum"]["mons"] == 1
+
+
 def test_prometheus_export(cluster):
     import urllib.request
 
