@@ -420,6 +420,11 @@ class ECBackend(PGBackend):
                 version=-version, data=b"", attrs={}, remove=True,
                 tid=tid)
         try:
+            got = self._repair_read(pg, oid, shard)
+            if got is not None:
+                chunk, attrs = got
+                return self._push_from_chunk(pg, oid, shard, version,
+                                             chunk, attrs, tid)
             chunks, attrs = self._read_shards(
                 pg, oid, [shard], avoid={shard})
         except StoreError as exc:
@@ -431,6 +436,12 @@ class ECBackend(PGBackend):
             decoded = ec_util.decode(
                 self.sinfo, self.codec, chunks, [shard])
             chunk = decoded[shard]
+        return self._push_from_chunk(pg, oid, shard, version, chunk,
+                                     attrs, tid)
+
+    def _push_from_chunk(self, pg: PG, oid: str, shard: int,
+                         version: int, chunk, attrs: dict,
+                         tid: int) -> M.MPGPush | None:
         # push the version the surviving shards actually agree on: the
         # wanted version may have been superseded by a later write
         # (actual_v higher) or may never have committed anywhere (every
@@ -452,6 +463,121 @@ class ECBackend(PGBackend):
             pool=pg.pool, ps=pg.ps, shard=shard, oid=oid,
             version=actual_v, data=np.asarray(chunk).tobytes(),
             attrs=push_attrs, remove=False, tid=tid)
+
+    def _repair_read(self, pg: PG, oid: str, shard: int
+                     ) -> tuple[np.ndarray, dict] | None:
+        """Sub-chunk fragmented repair read (ECBackend.cc:978-1002 +
+        the clay repair path): when the codec's minimum_to_decode asks
+        for PARTIAL sub-chunk ranges (a repair-bandwidth-optimal code),
+        read only those byte ranges from each helper and reconstruct
+        per stripe from the fragments. Returns (chunk, attrs) or None
+        when whole-chunk recovery should run instead."""
+        sub = self.codec.get_sub_chunk_count()
+        if sub <= 1:
+            return None
+        with pg.lock:
+            avoid = {p for p, m in pg.peer_missing.items() if oid in m}
+        avoid.add(shard)
+        available = [p for p in self.up_positions(pg) if p not in avoid]
+        try:
+            plan = self.codec.minimum_to_decode([shard], available)
+        except Exception:
+            return None
+        ranges = next(iter(plan.values()))
+        frac = sum(cnt for _, cnt in ranges)
+        if frac >= sub or any(plan[c] != ranges for c in plan):
+            return None               # full-chunk plan (or asymmetric)
+        cs = self.sinfo.chunk_size
+        subsz = cs // sub
+        # need the shard length to know the stripe count: probe attrs
+        try:
+            _, attrs = self._read_shards(pg, oid, [next(iter(plan))],
+                                         chunk_off=0, chunk_len=subsz)
+            size = self._attr_size(attrs)
+        except StoreError:
+            return None
+        padded = size + (-size % self.sinfo.stripe_width) \
+            if size % self.sinfo.stripe_width else size
+        shard_len = max(padded // self.k, cs)
+        n_stripes = shard_len // cs
+        # absolute byte ranges: the plan's sub-chunk ranges replayed in
+        # every stripe of the shard
+        offsets, lengths = [], []
+        for t in range(n_stripes):
+            for off, cnt in ranges:
+                offsets.append(t * cs + off * subsz)
+                lengths.append(cnt * subsz)
+        frag_per_stripe = frac * subsz
+        frags, attrs = self._read_fragments(
+            pg, oid, sorted(plan), offsets, lengths,
+            n_stripes * frag_per_stripe)
+        if frags is None:
+            return None
+        out = np.empty(shard_len, dtype=np.uint8)
+        for t in range(n_stripes):
+            sl = slice(t * frag_per_stripe, (t + 1) * frag_per_stripe)
+            stripe_frags = {c: buf[sl] for c, buf in frags.items()}
+            dec = self.codec.decode([shard], stripe_frags, cs)
+            out[t * cs:(t + 1) * cs] = np.asarray(dec[shard],
+                                                  dtype=np.uint8)
+        log(10, f"repair-read {oid} shard {shard}: {frac}/{sub} "
+            f"sub-chunks from {len(frags)} helpers")
+        logger = getattr(self.parent, "logger", None)
+        if logger is not None:
+            logger.inc("recovery_subchunk_reads")
+        return out, attrs
+
+    def _read_fragments(self, pg: PG, oid: str, positions: list[int],
+                        offsets: list[int], lengths: list[int],
+                        expect_len: int):
+        """Fan a multi-range MECSubRead to ``positions``; returns
+        ({pos: fragment bytes}, attrs) or (None, None)."""
+        mypos = self.my_position(pg)
+        results: dict[int, np.ndarray] = {}
+        attrs: dict = {}
+        vers: dict[int, int] = {}
+        remote = [p for p in positions if p != mypos]
+        tid = self.parent.new_tid()
+        wait = SubOpWait(set(remote))
+        self.parent.register_wait(tid, wait)
+        try:
+            for pos in remote:
+                self.parent.send_osd(pg.acting[pos], M.MECSubRead(
+                    tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
+                    oid=oid, want_attrs=True,
+                    offsets=list(offsets), lengths=list(lengths)))
+            if mypos in positions:
+                cid = pg_cid(pg.pool, pg.ps, mypos)
+                try:
+                    parts = []
+                    for off, ln in zip(offsets, lengths):
+                        piece = self.parent.store.read(cid, oid, off,
+                                                       ln)
+                        parts.append(piece + b"\x00" *
+                                     (ln - len(piece)))
+                    results[mypos] = np.frombuffer(
+                        b"".join(parts), dtype=np.uint8)
+                    local = self.parent.store.getattrs(cid, oid)
+                    vers[mypos] = int.from_bytes(
+                        local.get("v", b""), "little")
+                    attrs = attrs or local
+                except StoreError:
+                    return None, None
+            replies = wait.wait(SUBOP_TIMEOUT) if remote else {}
+        finally:
+            self.parent.unregister_wait(tid)
+        for pos in remote:
+            rep = replies.get(pos)
+            if rep is None or rep.code != 0 or \
+                    len(rep.data) != expect_len:
+                return None, None
+            results[pos] = np.frombuffer(rep.data, dtype=np.uint8)
+            vers[pos] = rep.version
+            if rep.attrs:
+                attrs = dict(rep.attrs)
+        if len(set(vers.values())) > 1:
+            return None, None          # mid-commit: fall back
+        return results, attrs
 
     def recover_rollback(self, pg: PG, oid: str, wanted: int
                          ) -> dict[int, M.MPGPush] | None:
@@ -550,8 +676,19 @@ class ECBackend(PGBackend):
             tid=msg.tid, pool=msg.pool, ps=msg.ps, shard=msg.shard,
             oid=msg.oid, code=0, data=b"", attrs={})
         try:
-            length = msg.length or None
-            data = store.read(cid, msg.oid, msg.offset, length)
+            if msg.offsets:
+                # fragmented sub-chunk read: concatenate the ranges
+                # (short ranges pad zeros — virtual zero stripes)
+                parts = []
+                for off, ln in zip(msg.offsets, msg.lengths):
+                    piece = store.read(cid, msg.oid, off, ln)
+                    if len(piece) < ln:
+                        piece += b"\x00" * (ln - len(piece))
+                    parts.append(piece)
+                data = b"".join(parts)
+            else:
+                length = msg.length or None
+                data = store.read(cid, msg.oid, msg.offset, length)
             attrs = store.getattrs(cid, msg.oid)
             reply.version = int.from_bytes(attrs.get("v", b""), "little")
             if msg.csum_only:
@@ -560,7 +697,8 @@ class ECBackend(PGBackend):
                     reply.attrs = dict(attrs)
                 return reply
             hraw = attrs.get("hinfo")
-            if hraw and msg.offset == 0 and not msg.length:
+            if hraw and msg.offset == 0 and not msg.length \
+                    and not msg.offsets:
                 hinfo = HashInfo.from_dict(json.loads(hraw))
                 crc = checksum.crc32c(data, ec_util.HINFO_SEED)
                 if crc != hinfo.get_chunk_hash(msg.shard):

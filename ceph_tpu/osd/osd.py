@@ -181,6 +181,9 @@ class OSD:
         perf.add_u64_counter("op_r", "client reads")
         perf.add_u64_counter("subop_w", "sub-writes applied")
         perf.add_u64_counter("recovery_ops", "objects recovered/pushed")
+        perf.add_u64_counter("recovery_subchunk_reads",
+                             "repairs served by fragmented sub-chunk "
+                             "reads (clay repair-bandwidth path)")
         perf.add_time_avg("op_latency", "client op latency")
         return perf
 

@@ -261,12 +261,15 @@ class MECSubWriteReply(Message):
 
 class MECSubRead(Message):
     """Primary -> shard: read shard chunk(s) (ECSubRead: offsets +
-    subchunk lists; attrs on request)."""
+    subchunk lists; attrs on request). ``offsets``/``lengths`` carry a
+    fragmented multi-range read (clay sub-chunk repair,
+    ECBackend.cc:978-1002); the reply concatenates the fragments."""
     MSG_TYPE = 32
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("oid", "str"), ("offset", "u64"),
               ("length", "u64"), ("want_attrs", "bool"),
-              ("csum_only", "bool")]
+              ("csum_only", "bool"), ("offsets", "u64_list"),
+              ("lengths", "u64_list")]
 
 
 class MECSubReadReply(Message):
