@@ -27,12 +27,27 @@ sub_chunk_no/q sub-chunks from each of d helpers (repair path,
 ErasureCodeClay.cc:394-644) — optimal repair bandwidth, surfaced through
 ``minimum_to_decode`` returning (offset, count) sub-chunk ranges exactly
 like the reference (ErasureCodeInterface.h:280-300).
+
+TPU execution: the plane-by-plane layered machinery is pure GF(2^8)-linear
+algebra applied byte-position-wise along each sub-chunk, so for any fixed
+erasure signature the whole codec collapses to ONE flat matrix over
+GF(2^8) — encode is ``[m*ssc, k*ssc]``, decode ``[e*ssc, a*ssc]``, repair
+``[ssc, d*ssc/q]`` (ssc = sub_chunk_no). We derive that matrix once per
+signature by probing the host path with basis payloads (a single call:
+sub-chunk payload width = input dimension), cache it LRU-style exactly the
+way the reference caches ISA decode tables per erasure signature
+(ErasureCodeIsa.cc:226-303), and run the hot path as one bit-sliced
+matrix-stripe multiply on the MXU (ops/backend.py: pallas/jax on TPU,
+AVX2 nibble tables on host). The host plane machinery remains the oracle
+(tests/test_clay.py asserts bit-exact equality on every path).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ceph_tpu.ops import backend as backend_mod
+from ceph_tpu.utils.lru import BoundedLRU
 from ceph_tpu.models.base import ErasureCode, SIMD_ALIGN
 from ceph_tpu.models.interface import ErasureCodeError
 from ceph_tpu.models.registry import ErasureCodePlugin
@@ -48,6 +63,10 @@ def _lcm(a: int, b: int) -> int:
 class ErasureCodeClay(ErasureCode):
     DEFAULT_K, DEFAULT_M = 4, 2
 
+    #: linearized-transform cache bound (decode signatures are C(k+m, <=m);
+    #: same role/sizing idea as the ISA decode-table LRU, isa/README:57-62)
+    LIN_CACHE_SIZE = 64
+
     def __init__(self) -> None:
         super().__init__()
         self._k = self._m = self.d = 0
@@ -55,6 +74,9 @@ class ErasureCodeClay(ErasureCode):
         self.sub_chunk_no = 1
         self.mds = None   # scalar MDS over q*t nodes (k+nu data)
         self.pft = None   # pairwise transform: k=2, m=2 codec
+        self.backend = "auto"
+        self.linearize = True
+        self._lin_cache: BoundedLRU = BoundedLRU(self.LIN_CACHE_SIZE)
 
     # -- profile -----------------------------------------------------------
 
@@ -87,11 +109,25 @@ class ErasureCodeClay(ErasureCode):
         self.sub_chunk_no = self.q ** self.t
 
         backend = str(profile.get("backend", "auto"))
+        self.backend = backend
+        self.linearize = self.to_bool("linearize", profile, True)
+        self._lin_cache.clear()
+        # The plane machinery issues thousands of tiny per-sub-chunk solves;
+        # those must run on the host even when the (linearized) hot path
+        # targets the TPU, so pin the inner codecs to a host backend.
+        if backend in ("numpy", "native"):
+            sub_backend = backend
+        else:
+            try:  # direct import: avoid available_backends() pulling in jax
+                from ceph_tpu.ops import native  # noqa: F401
+                sub_backend = "native"
+            except Exception:
+                sub_backend = "numpy"
         mds_profile = {"plugin": scalar_mds, "technique": technique,
                        "k": str(k + self.nu), "m": str(m),
-                       "backend": backend}
+                       "backend": sub_backend}
         pft_profile = {"plugin": scalar_mds, "technique": technique,
-                       "k": "2", "m": "2", "backend": backend}
+                       "k": "2", "m": "2", "backend": sub_backend}
         if scalar_mds == "shec":
             mds_profile["c"] = pft_profile["c"] = "2"
         mds_plugin = mds_profile.pop("plugin")
@@ -156,6 +192,11 @@ class ErasureCodeClay(ErasureCode):
     # -- encode / decode (full-chunk paths) --------------------------------
 
     def encode_chunks(self, want_to_encode, chunks):
+        if self.linearize:
+            return self._encode_chunks_lin(want_to_encode, chunks)
+        return self._encode_chunks_host(want_to_encode, chunks)
+
+    def _encode_chunks_host(self, want_to_encode, chunks):
         n = self.k + self.m
         size = len(next(iter(chunks.values())))
         nodes = {}
@@ -183,6 +224,11 @@ class ErasureCodeClay(ErasureCode):
         return super().decode(want_to_read, chunks, chunk_size)
 
     def decode_chunks(self, want_to_read, chunks):
+        if self.linearize:
+            return self._decode_chunks_lin(want_to_read, chunks)
+        return self._decode_chunks_host(want_to_read, chunks)
+
+    def _decode_chunks_host(self, want_to_read, chunks):
         n = self.k + self.m
         size = len(next(iter(chunks.values())))
         nodes, erased = {}, set()
@@ -348,6 +394,11 @@ class ErasureCodeClay(ErasureCode):
         return minimum
 
     def _repair(self, want_chunk: int, chunks, chunk_size: int):
+        if self.linearize:
+            return self._repair_lin(want_chunk, chunks, chunk_size)
+        return self._repair_host(want_chunk, chunks, chunk_size)
+
+    def _repair_host(self, want_chunk: int, chunks, chunk_size: int):
         """Repair one chunk from d helpers' sub-chunk reads
         (ErasureCodeClay.cc:394-644). Helper buffers hold only the
         repair-plane sub-chunks, concatenated in plane order."""
@@ -440,6 +491,124 @@ class ErasureCodeClay(ErasureCode):
                         out = self._pft_solve([i1], known)
                         recovered[z_sw * sc:(z_sw + 1) * sc] = out[i1]
         return {want_chunk: recovered}
+
+
+    # -- linearized device path (see module docstring) ---------------------
+    #
+    # Every host path above is GF(2^8)-linear and acts byte-position-wise
+    # along the sub-chunk payload: output byte j of any sub-chunk depends
+    # only on byte j of input sub-chunks. So one probe call whose sub-chunk
+    # payload width equals the input dimension D — with input (chunk i,
+    # sub-chunk z) carrying the basis byte-row e_{i*ssc+z} — reads the whole
+    # flat transform matrix out of the host oracle in a single pass.
+
+    @staticmethod
+    def _probe_basis(ids, rows: int):
+        """chunk id -> flat basis payload of ``rows`` sub-chunks, payload
+        width D = len(ids)*rows."""
+        d_in = len(ids) * rows
+        out = {}
+        for idx, cid in enumerate(ids):
+            buf = np.zeros((rows, d_in), dtype=np.uint8)
+            for z in range(rows):
+                buf[z, idx * rows + z] = 1
+            out[cid] = buf.reshape(-1)
+        return out
+
+    @staticmethod
+    def _stack(chunks, ids, rows: int, sc: int) -> np.ndarray:
+        x = np.empty((len(ids) * rows, sc), dtype=np.uint8)
+        for idx, cid in enumerate(ids):
+            x[idx * rows:(idx + 1) * rows] = np.asarray(
+                chunks[cid], dtype=np.uint8).reshape(rows, sc)
+        return x
+
+    def _encode_matrix(self) -> np.ndarray:
+        ssc = self.sub_chunk_no
+        probe = self._probe_basis(range(self.k), ssc)
+        parity = self._encode_chunks_host(
+            list(range(self.k, self.k + self.m)), probe)
+        d_in = self.k * ssc
+        mat = np.empty((self.m * ssc, d_in), dtype=np.uint8)
+        for p in range(self.m):
+            mat[p * ssc:(p + 1) * ssc] = parity[self.k + p].reshape(ssc, d_in)
+        return mat
+
+    def _encode_chunks_lin(self, want_to_encode, chunks):
+        ssc = self.sub_chunk_no
+        size = len(next(iter(chunks.values())))
+        if size % ssc:
+            raise ErasureCodeError(
+                f"clay: chunk size {size} not a multiple of {ssc} sub-chunks")
+        mat = self._lin_cache.get_or_build(("enc",), self._encode_matrix)
+        x = self._stack(chunks, range(self.k), ssc, size // ssc)
+        parity = backend_mod.matvec(mat, x, self.backend)
+        out = {}
+        for pos in want_to_encode:
+            if self.k <= pos < self.k + self.m:
+                p = pos - self.k
+                out[pos] = parity[p * ssc:(p + 1) * ssc].reshape(-1)
+        return out
+
+    def _decode_matrix(self, avail: tuple, erased: tuple) -> np.ndarray:
+        ssc = self.sub_chunk_no
+        probe = self._probe_basis(avail, ssc)
+        rec = self._decode_chunks_host(list(erased), probe)
+        d_in = len(avail) * ssc
+        mat = np.empty((len(erased) * ssc, d_in), dtype=np.uint8)
+        for row, c in enumerate(erased):
+            mat[row * ssc:(row + 1) * ssc] = rec[c].reshape(ssc, d_in)
+        return mat
+
+    def _decode_chunks_lin(self, want_to_read, chunks):
+        n = self.k + self.m
+        ssc = self.sub_chunk_no
+        size = len(next(iter(chunks.values())))
+        if size % ssc:
+            raise ErasureCodeError(
+                f"clay: chunk size {size} not a multiple of {ssc} sub-chunks")
+        avail = tuple(sorted(c for c in chunks if c < n))
+        erased = tuple(c for c in range(n) if c not in chunks)
+        if len(erased) > self.m:
+            raise ErasureCodeError(
+                f"clay: {len(erased)} erasures > m={self.m}", errno_=5)
+        out = {c: np.asarray(chunks[c], dtype=np.uint8)
+               for c in want_to_read if c in chunks}
+        missing = [c for c in want_to_read if c not in chunks]
+        if not missing:
+            return out
+        mat = self._lin_cache.get_or_build(
+            ("dec", avail, erased),
+            lambda: self._decode_matrix(avail, erased))
+        x = self._stack(chunks, avail, ssc, size // ssc)
+        rec = backend_mod.matvec(mat, x, self.backend)
+        for row, c in enumerate(erased):
+            if c in missing:
+                out[c] = rec[row * ssc:(row + 1) * ssc].reshape(-1)
+        return out
+
+    def _repair_matrix(self, want_chunk: int, helpers: tuple) -> np.ndarray:
+        rss = self.sub_chunk_no // self.q
+        probe = self._probe_basis(helpers, rss)
+        d_in = len(helpers) * rss
+        rec = self._repair_host(want_chunk, probe, self.sub_chunk_no * d_in)
+        return rec[want_chunk].reshape(self.sub_chunk_no, d_in)
+
+    def _repair_lin(self, want_chunk: int, chunks, chunk_size: int):
+        rss = self.sub_chunk_no // self.q
+        helper_len = len(next(iter(chunks.values())))
+        if helper_len % rss:
+            raise ErasureCodeError("clay: bad helper buffer size")
+        sc = helper_len // rss
+        if chunk_size != self.sub_chunk_no * sc:
+            raise ErasureCodeError("clay: chunk_size/helper size mismatch")
+        helpers = tuple(sorted(chunks))
+        mat = self._lin_cache.get_or_build(
+            ("rep", want_chunk, helpers),
+            lambda: self._repair_matrix(want_chunk, helpers))
+        x = self._stack(chunks, helpers, rss, sc)
+        rec = backend_mod.matvec(mat, x, self.backend)
+        return {want_chunk: rec.reshape(-1)}
 
 
 class ClayPlugin(ErasureCodePlugin):

@@ -16,13 +16,13 @@ jerasure_matrix_decode). This class implements that machinery once, with:
 
 from __future__ import annotations
 
-from collections import OrderedDict
 from typing import Mapping, Sequence
 
 import numpy as np
 
 from ceph_tpu.models.base import ErasureCode
 from ceph_tpu.models.interface import ErasureCodeError
+from ceph_tpu.utils.lru import BoundedLRU
 from ceph_tpu.ops import backend as backend_mod
 from ceph_tpu.ops import gf256
 
@@ -40,8 +40,7 @@ class MatrixErasureCode(ErasureCode):
         self._m = 0
         self.coding_matrix: np.ndarray | None = None  # [m, k]
         self.backend = "auto"
-        self._decode_cache: OrderedDict[tuple, np.ndarray] = OrderedDict()
-        self._decode_cache_size = DEFAULT_DECODE_CACHE
+        self._decode_cache: BoundedLRU = BoundedLRU(DEFAULT_DECODE_CACHE)
 
     # subclasses call this from init()
     def _setup(self, k: int, m: int, coding_matrix: np.ndarray,
@@ -115,19 +114,14 @@ class MatrixErasureCode(ErasureCode):
         way, keyed by a string of erasure indexes)."""
         # decode semantics are position-space; map storage positions back to
         # encoder space when a chunk_mapping is set
-        key = (present, missing)
-        hit = self._decode_cache.get(key)
-        if hit is not None:
-            self._decode_cache.move_to_end(key)
-            return hit
-        if self.chunk_mapping:
-            to_enc = {pos: i for i, pos in enumerate(self.chunk_mapping)}
-            present_e = [to_enc[p] for p in present]
-            missing_e = [to_enc[p] for p in missing]
-        else:
-            present_e, missing_e = list(present), list(missing)
-        dmat = gf256.decode_matrix(self.generator, present_e, missing_e)
-        self._decode_cache[key] = dmat
-        if len(self._decode_cache) > self._decode_cache_size:
-            self._decode_cache.popitem(last=False)
-        return dmat
+        def build() -> np.ndarray:
+            if self.chunk_mapping:
+                to_enc = {pos: i
+                          for i, pos in enumerate(self.chunk_mapping)}
+                present_e = [to_enc[p] for p in present]
+                missing_e = [to_enc[p] for p in missing]
+            else:
+                present_e, missing_e = list(present), list(missing)
+            return gf256.decode_matrix(self.generator, present_e, missing_e)
+
+        return self._decode_cache.get_or_build((present, missing), build)

@@ -28,11 +28,11 @@ bandwidth.
 from __future__ import annotations
 
 import itertools
-from collections import OrderedDict
 
 import numpy as np
 
 from ceph_tpu.models.interface import ErasureCodeError
+from ceph_tpu.utils.lru import BoundedLRU
 from ceph_tpu.models.matrix_codec import MatrixErasureCode
 from ceph_tpu.models.registry import ErasureCodePlugin
 from ceph_tpu.ops import gf256
@@ -74,7 +74,7 @@ class ErasureCodeShec(MatrixErasureCode):
     def __init__(self) -> None:
         super().__init__()
         self.c = 0
-        self._plan_cache: OrderedDict = OrderedDict()
+        self._plan_cache: BoundedLRU = BoundedLRU(1024)
 
     def init(self, profile):
         profile = dict(profile)
@@ -145,16 +145,8 @@ class ErasureCodeShec(MatrixErasureCode):
     # -- decode plan search (shec_make_decoding_matrix) --------------------
 
     def _decode_plan(self, want: frozenset, avail: frozenset):
-        key = (want, avail)
-        hit = self._plan_cache.get(key)
-        if hit is not None:
-            self._plan_cache.move_to_end(key)
-            return hit
-        plan = self._search_plan(want, avail)
-        self._plan_cache[key] = plan
-        if len(self._plan_cache) > 1024:
-            self._plan_cache.popitem(last=False)
-        return plan
+        return self._plan_cache.get_or_build(
+            (want, avail), lambda: self._search_plan(want, avail))
 
     def _search_plan(self, want: frozenset, avail: frozenset):
         k, m = self._k, self._m

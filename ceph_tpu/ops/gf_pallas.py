@@ -168,6 +168,17 @@ def _round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult
 
 
+#: beyond this the in-VMEM bit-plane expansion and the unrolled pack loop
+#: stop fitting/compiling well; bigger matrices (e.g. Clay's linearized
+#: [m*subchunks, k*subchunks] transforms) take the plain-XLA bit-sliced
+#: path, which tiles arbitrary shapes through the MXU.
+_MAX_M, _MAX_K = 32, 128
+
+
 def matvec(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     """Host-in/host-out wrapper (ops.backend contract)."""
+    m_out, k = mat.shape
+    if m_out > _MAX_M or k > _MAX_K:
+        from ceph_tpu.ops import gf_jax
+        return gf_jax.matvec(mat, data)
     return np.asarray(jax.device_get(matvec_device(mat, data)))

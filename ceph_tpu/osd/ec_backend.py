@@ -496,6 +496,7 @@ class ECBackend(PGBackend):
             size = self._attr_size(attrs)
         except StoreError:
             return None
+        probe_v = int.from_bytes(attrs.get("v", b""), "little")
         padded = size + (-size % self.sinfo.stripe_width) \
             if size % self.sinfo.stripe_width else size
         shard_len = max(padded // self.k, cs)
@@ -508,9 +509,19 @@ class ECBackend(PGBackend):
                 offsets.append(t * cs + off * subsz)
                 lengths.append(cnt * subsz)
         frag_per_stripe = frac * subsz
-        frags, attrs = self._read_fragments(
-            pg, oid, sorted(plan), offsets, lengths,
-            n_stripes * frag_per_stripe)
+        # brief retry before abandoning the bandwidth optimization: a
+        # transient mid-commit version disagreement (a helper's sub-write
+        # still in flight) resolves in one commit round trip, and falling
+        # back costs d full-chunk reads
+        frags = None
+        for attempt in range(3):
+            if attempt:
+                time.sleep(0.05 * attempt)
+            frags, attrs, retryable = self._read_fragments(
+                pg, oid, sorted(plan), offsets, lengths,
+                n_stripes * frag_per_stripe, expect_version=probe_v)
+            if frags is not None or not retryable:
+                break
         if frags is None:
             return None
         out = np.empty(shard_len, dtype=np.uint8)
@@ -520,6 +531,19 @@ class ECBackend(PGBackend):
             dec = self.codec.decode([shard], stripe_frags, cs)
             out[t * cs:(t + 1) * cs] = np.asarray(dec[shard],
                                                   dtype=np.uint8)
+        # fragmented reads bypass the per-helper hinfo gate (the stored
+        # crc covers the whole chunk), so verify the reconstruction
+        # before pushing: helper bit rot must not become recovered state
+        hraw = attrs.get("hinfo")
+        if hraw:
+            from ceph_tpu.utils import checksum
+            hinfo = HashInfo.from_dict(json.loads(hraw))
+            crc = checksum.crc32c(out.tobytes(), ec_util.HINFO_SEED)
+            if crc != hinfo.get_chunk_hash(shard):
+                log(1, f"repair-read {oid} shard {shard}: reconstructed "
+                    f"crc {crc:#x} != hinfo "
+                    f"{hinfo.get_chunk_hash(shard):#x}; falling back")
+                return None
         log(10, f"repair-read {oid} shard {shard}: {frac}/{sub} "
             f"sub-chunks from {len(frags)} helpers")
         logger = getattr(self.parent, "logger", None)
@@ -529,9 +553,19 @@ class ECBackend(PGBackend):
 
     def _read_fragments(self, pg: PG, oid: str, positions: list[int],
                         offsets: list[int], lengths: list[int],
-                        expect_len: int):
+                        expect_len: int, expect_version: int = -1):
         """Fan a multi-range MECSubRead to ``positions``; returns
-        ({pos: fragment bytes}, attrs) or (None, None)."""
+        ({pos: fragment bytes}, attrs) or (None, None).
+
+        ``expect_version``: the version the geometry probe observed; a
+        write landing between probe and fragment read would otherwise
+        pass the internal agreement check while the stripe count (and
+        hence the fragment offsets) are stale.
+
+        Returns (results, attrs, retryable): retryable is True for
+        transient mid-commit disagreement (worth one more try), False
+        for hard failures and for a probe superseded by a newer write
+        (stale geometry — the caller must re-plan, not retry)."""
         mypos = self.my_position(pg)
         results: dict[int, np.ndarray] = {}
         attrs: dict = {}
@@ -562,7 +596,7 @@ class ECBackend(PGBackend):
                         local.get("v", b""), "little")
                     attrs = attrs or local
                 except StoreError:
-                    return None, None
+                    return None, None, False
             replies = wait.wait(SUBOP_TIMEOUT) if remote else {}
         finally:
             self.parent.unregister_wait(tid)
@@ -570,14 +604,17 @@ class ECBackend(PGBackend):
             rep = replies.get(pos)
             if rep is None or rep.code != 0 or \
                     len(rep.data) != expect_len:
-                return None, None
+                return None, None, False
             results[pos] = np.frombuffer(rep.data, dtype=np.uint8)
             vers[pos] = rep.version
             if rep.attrs:
                 attrs = dict(rep.attrs)
         if len(set(vers.values())) > 1:
-            return None, None          # mid-commit: fall back
-        return results, attrs
+            return None, None, True    # mid-commit: retryable
+        if expect_version >= 0 and vers and \
+                next(iter(vers.values())) != expect_version:
+            return None, None, False   # superseded the probe: re-plan
+        return results, attrs, False
 
     def recover_rollback(self, pg: PG, oid: str, wanted: int
                          ) -> dict[int, M.MPGPush] | None:

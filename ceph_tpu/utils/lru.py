@@ -1,0 +1,37 @@
+"""Tiny bounded LRU for codec table/plan caches.
+
+The reference caches ISA decode tables per erasure signature in exactly
+this shape (ErasureCodeIsaTableCache, src/erasure-code/isa/
+ErasureCodeIsa.cc:226-303, LRU sizing notes isa/README:57-62); the matrix
+codecs, SHEC plan search, and the Clay linearized transforms all share it
+here instead of each hand-rolling the pattern.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, TypeVar
+
+V = TypeVar("V")
+
+
+class BoundedLRU(OrderedDict):
+    """OrderedDict with a size bound and a get-or-build accessor.
+
+    ``maxsize`` is a plain attribute so callers (and tests) can retune
+    the bound after construction.
+    """
+
+    def __init__(self, maxsize: int) -> None:
+        super().__init__()
+        self.maxsize = maxsize
+
+    def get_or_build(self, key, build: Callable[[], V]) -> V:
+        hit = self.get(key)
+        if hit is None:
+            hit = self[key] = build()
+            if len(self) > self.maxsize:
+                self.popitem(last=False)
+        else:
+            self.move_to_end(key)
+        return hit

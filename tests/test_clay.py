@@ -114,3 +114,81 @@ def test_too_many_erasures():
     avail = {i: enc[i] for i in range(3)}
     with pytest.raises(ErasureCodeError):
         codec.decode([3, 4, 5], avail, cs)
+
+
+# -- linearized device path vs host plane machinery ------------------------
+# The hot path collapses the layered codec into one flat GF matrix per
+# erasure signature (probed from the host path, LRU-cached like the ISA
+# decode tables). These tests pin bit-exactness of every linearized path
+# against the plane-by-plane oracle.
+
+@pytest.mark.parametrize("profile", [
+    dict(k=4, m=2),                 # q=2, ssc=8
+    dict(k=3, m=3, d=4),            # q=2, t=3
+    dict(k=4, m=3, d=6),            # nu=2 virtual nodes, q=3, ssc=27
+])
+def test_linearized_encode_decode_matches_host(profile):
+    lin = make(**profile)
+    host = make(**profile, linearize="false")
+    assert lin.linearize and not host.linearize
+    k, m = lin.get_data_chunk_count(), lin.get_coding_chunk_count()
+    ssc = lin.get_sub_chunk_count()
+    size = ssc * 13
+    rng = np.random.default_rng(7)
+    data = {i: rng.integers(0, 256, size, dtype=np.uint8) for i in range(k)}
+    want = list(range(k, k + m))
+    enc = lin.encode_chunks(want, data)
+    enc_h = host.encode_chunks(want, data)
+    for i in want:
+        assert np.array_equal(enc[i], enc_h[i])
+    full = dict(data)
+    full.update(enc)
+    for erased in itertools.combinations(range(k + m), m):
+        sub = {i: v for i, v in full.items() if i not in erased}
+        dec = lin.decode_chunks(list(erased), sub)
+        dec_h = host.decode_chunks(list(erased), sub)
+        for i in erased:
+            assert np.array_equal(dec[i], full[i])
+            assert np.array_equal(dec[i], dec_h[i])
+
+
+def test_linearized_repair_matches_host():
+    lin = make(k=4, m=2)
+    host = make(k=4, m=2, linearize="false")
+    k, m = 4, 2
+    ssc, q = lin.get_sub_chunk_count(), lin.q
+    size = ssc * 19
+    sc = size // ssc
+    rng = np.random.default_rng(11)
+    data = {i: rng.integers(0, 256, size, dtype=np.uint8) for i in range(k)}
+    full = dict(data)
+    full.update(lin.encode_chunks(list(range(k, k + m)), data))
+    for lost in range(k + m):
+        avail = [i for i in range(k + m) if i != lost]
+        minimum = lin.minimum_to_decode([lost], avail)
+        helpers = {}
+        for cid, ranges in minimum.items():
+            parts = [full[cid][z * sc:(z + 1) * sc]
+                     for off, cnt in ranges for z in range(off, off + cnt)]
+            helpers[cid] = np.concatenate(parts)
+        got = lin.decode([lost], helpers, size)
+        got_h = host.decode([lost], helpers, size)
+        assert np.array_equal(got[lost], full[lost])
+        assert np.array_equal(got[lost], got_h[lost])
+
+
+def test_linearized_cache_is_bounded_lru():
+    codec = make(k=4, m=2)
+    codec._lin_cache.maxsize = 4
+    ssc = codec.get_sub_chunk_count()
+    size = ssc * 3
+    rng = np.random.default_rng(3)
+    data = {i: rng.integers(0, 256, size, dtype=np.uint8) for i in range(4)}
+    full = dict(data)
+    full.update(codec.encode_chunks([4, 5], data))
+    for erased in itertools.combinations(range(6), 2):
+        sub = {i: v for i, v in full.items() if i not in erased}
+        out = codec.decode_chunks(list(erased), sub)
+        for i in erased:
+            assert np.array_equal(out[i], full[i])
+    assert len(codec._lin_cache) <= 4
