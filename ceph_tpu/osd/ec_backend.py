@@ -554,8 +554,7 @@ class ECBackend(PGBackend):
     def _read_fragments(self, pg: PG, oid: str, positions: list[int],
                         offsets: list[int], lengths: list[int],
                         expect_len: int, expect_version: int = -1):
-        """Fan a multi-range MECSubRead to ``positions``; returns
-        ({pos: fragment bytes}, attrs) or (None, None).
+        """Fan a multi-range MECSubRead to ``positions``.
 
         ``expect_version``: the version the geometry probe observed; a
         write landing between probe and fragment read would otherwise
