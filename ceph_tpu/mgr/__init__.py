@@ -1,0 +1,14 @@
+"""mgr — the metrics/orchestration plane (src/mgr/ + src/pybind/mgr/).
+
+The reference's ceph-mgr hosts Python modules (balancer, progress,
+telemetry, prometheus, ...) with a ``mgr_module.py`` API over aggregated
+cluster state. Here the Mgr daemon (ceph_tpu/mgr/mgr.py) holds a
+RadosClient session to the mon, ticks its modules, and exposes each
+module's commands over its admin socket; per-daemon prometheus export
+lives in ceph_tpu/utils/prometheus.py (the mgr prometheus-module role).
+"""
+
+from ceph_tpu.mgr.mgr import Mgr
+from ceph_tpu.mgr.mgr_module import MgrModule
+
+__all__ = ["Mgr", "MgrModule"]

@@ -355,6 +355,41 @@ class OSD:
             pgids = list(self.pgs)
         for pgid in pgids:
             self.op_wq.enqueue(pgid, lambda p=pgid: self._check_pg(p))
+        # proactively instantiate PGs this OSD just became primary for
+        # (OSD::handle_pg_create / split-from-map role): after a remap —
+        # e.g. a balancer upmap — recovery must start on the new primary
+        # immediately, not when the next client op happens to touch it.
+        # The O(pools * pg_num) CRUSH scan must NOT run on this thread
+        # (the messenger event loop — see the note above): hand it off.
+        threading.Thread(target=self._scan_new_primaries,
+                         args=(newmap,),
+                         name=f"osd.{self.whoami}-pgscan",
+                         daemon=True).start()
+
+    def _scan_new_primaries(self, newmap: OSDMap) -> None:
+        """Instantiate + queue peering for mapped PGs newly primary
+        here (runs off the event loop; stale scans are harmless —
+        _check_pg re-validates against the CURRENT map)."""
+        for pid, pool in newmap.pools.items():
+            for ps in range(pool.pg_num):
+                pgid = (pid, ps)
+                with self._pgs_lock:
+                    if pgid in self.pgs:
+                        continue
+                _, _, primary = newmap.pg_to_up_acting(pid, ps)
+                if primary != self.whoami:
+                    continue
+                try:
+                    backend = self.backend_for(pid)
+                except Exception:
+                    continue     # pool raced away
+                with self._pgs_lock:
+                    if pgid not in self.pgs:
+                        pg = PG(pid, ps)
+                        pg.backend = backend
+                        self.pgs[pgid] = pg
+                self.op_wq.enqueue(pgid,
+                                   lambda p=pgid: self._check_pg(p))
 
     @staticmethod
     def _record_missing(iw: InflightWrite, dropped: list[int]) -> None:

@@ -493,6 +493,17 @@ class Monitor:
                 self.osdmap.crush.reweight(osd, 1.0)
                 self._commit()
                 return 0, f"marked in osd.{osd}", b""
+            if prefix == "osd pg-upmap-items":
+                return self._cmd_pg_upmap_items(cmd)
+            if prefix == "osd rm-pg-upmap-items":
+                pool_id = self._resolve_pool(cmd["pool"])
+                ps = int(cmd["ps"])
+                if self.osdmap.pg_upmap_items.pop((pool_id, ps), None) \
+                        is not None:
+                    self._commit()
+                return 0, f"rm upmap for {pool_id}.{ps}", b""
+            if prefix == "osd dump":
+                return 0, "", json.dumps(self._osd_dump()).encode()
             if prefix == "status":
                 return 0, "", json.dumps(self._status()).encode()
             if prefix == "health":
@@ -523,6 +534,74 @@ class Monitor:
         self.ec_profiles[name] = profile
         self._commit()
         return 0, f"profile {name} set", b""
+
+    def _resolve_pool(self, pool) -> int:
+        """Accept a pool id or name (commands take either)."""
+        try:
+            pid = int(pool)
+        except (TypeError, ValueError):
+            pid = self.osdmap.pool_by_name.get(str(pool), -1)
+        if pid not in self.osdmap.pools:
+            raise ValueError(f"no pool {pool!r}")
+        return pid
+
+    def _cmd_pg_upmap_items(self, cmd: dict) -> tuple[int, str, bytes]:
+        """``osd pg-upmap-items`` (OSDMonitor::prepare_command upmap
+        role): install per-PG (from,to) up-set remaps — the mgr
+        balancer's mechanism. Validates each target exists, is up+in,
+        and is not already a member of the PG's up set."""
+        pool_id = self._resolve_pool(cmd["pool"])
+        ps = int(cmd["ps"])
+        pool = self.osdmap.pools[pool_id]
+        if not 0 <= ps < pool.pg_num:
+            return -22, f"ps {ps} out of range for pool {pool_id}", b""
+        pairs = [(int(f), int(t)) for f, t in json.loads(cmd["items"])]
+        # validate against the RAW CRUSH up set: the command replaces
+        # the PG's whole pair list, so re-sent already-applied pairs
+        # must validate too (checking the post-upmap set would reject
+        # every second balancer round)
+        up = self.osdmap.pg_to_raw_up(pool_id, ps)
+        down = self.osdmap.down_set()
+        froms = [f for f, _ in pairs]
+        tos = [t for _, t in pairs]
+        if len(set(froms)) != len(froms):
+            return -22, f"duplicate 'from' osds in {pairs}", b""
+        if len(set(tos)) != len(tos):
+            return -22, f"duplicate 'to' osds in {pairs}", b""
+        for f, t in pairs:
+            if f == t:
+                return -22, f"osd.{f} mapped to itself", b""
+            if t not in self.osdmap.osds:
+                return -2, f"no osd.{t}", b""
+            if t in down:
+                return -22, f"osd.{t} is down/out", b""
+            if f not in up:
+                return -22, f"osd.{f} not in raw up set {up}", b""
+            if t in up or t in froms:
+                return -22, f"osd.{t} already in up set {up}", b""
+        # the remapped set must stay duplicate-free
+        remap = dict(pairs)
+        mapped = [remap.get(o, o) for o in up]
+        if len(set(mapped)) != len(mapped):
+            return -22, f"upmap {pairs} collapses up set {up}", b""
+        self.osdmap.pg_upmap_items[(pool_id, ps)] = pairs
+        self._commit()
+        return 0, f"upmap {pool_id}.{ps} {pairs}", b""
+
+    def _osd_dump(self) -> dict:
+        """Map details the balancer needs (``osd dump`` role)."""
+        return {
+            "epoch": self.osdmap.epoch,
+            "pools": {str(pid): {"name": p.name, "pg_num": p.pg_num,
+                                 "size": p.size, "rule": p.rule,
+                                 "ec": p.is_ec}
+                      for pid, p in self.osdmap.pools.items()},
+            "pg_upmap_items": [
+                {"pool": pid, "ps": ps,
+                 "items": [list(pair) for pair in pairs]}
+                for (pid, ps), pairs in
+                sorted(self.osdmap.pg_upmap_items.items())],
+        }
 
     def _cmd_pool_create(self, cmd: dict) -> tuple[int, str, bytes]:
         name = cmd["pool"]

@@ -63,6 +63,11 @@ class OSDMap:
         self.pool_by_name: dict[str, int] = {}
         self.crush = crush.CrushMap()
         self.pg_temp: dict[tuple[int, int], list[int]] = {}
+        # balancer overrides (OSDMap::pg_upmap_items role): per-PG list of
+        # (from_osd, to_osd) swaps applied to the CRUSH up set before
+        # pg_temp — how the mgr balancer moves individual PGs
+        self.pg_upmap_items: dict[tuple[int, int],
+                                  list[tuple[int, int]]] = {}
         self._next_pool_id = 1
 
     # -- mutation (mon side) ------------------------------------------
@@ -107,14 +112,25 @@ class OSDMap:
         ps = crush.hash_name(name)
         return crush.stable_mod(ps, pool.pg_num, pg_num_mask(pool.pg_num))
 
+    def pg_to_raw_up(self, pool_id: int, ps: int) -> list[int]:
+        """The CRUSH up set BEFORE pg_upmap_items — what upmap pairs
+        are defined against (OSDMap::pg_to_raw_up role)."""
+        pool = self.pools[pool_id]
+        x = crush.hash2(ps, pool_id)
+        return self.crush.do_rule(pool.rule, x, pool.size,
+                                  down=self.down_set())
+
     def pg_to_up_acting(self, pool_id: int, ps: int
                         ) -> tuple[list[int], list[int], int]:
         """Returns (up, acting, primary). primary = first non-NONE of
         acting, or NONE when the PG is entirely unserviceable."""
-        pool = self.pools[pool_id]
-        x = crush.hash2(ps, pool_id)
-        up = self.crush.do_rule(pool.rule, x, pool.size,
-                                down=self.down_set())
+        up = self.pg_to_raw_up(pool_id, ps)
+        items = self.pg_upmap_items.get((pool_id, ps))
+        if items:
+            down = self.down_set()
+            remap = {f: t for f, t in items
+                     if t not in down and t not in up}
+            up = [remap.get(o, o) for o in up]
         acting = self.pg_temp.get((pool_id, ps), up)
         primary = next((o for o in acting if o != crush.NONE), crush.NONE)
         return up, acting, primary
@@ -153,12 +169,17 @@ class OSDMap:
         body.map(self.pg_temp,
                  lambda en, k: (en.i32(k[0]), en.u32(k[1])),
                  lambda en, v: en.list(v, Encoder.i32))
-        e.section(1, body)
+        # v2: balancer upmap overrides (appended; v1 decoders skip)
+        body.map(self.pg_upmap_items,
+                 lambda en, k: (en.i32(k[0]), en.u32(k[1])),
+                 lambda en, v: en.list(
+                     v, lambda en2, p: (en2.i32(p[0]), en2.i32(p[1]))))
+        e.section(2, body)
         return e.getvalue()
 
     @classmethod
     def decode(cls, buf: bytes) -> "OSDMap":
-        _, d = Decoder(buf).section(1)
+        version, d = Decoder(buf).section(2)
         m = cls()
         m.epoch = d.u32()
 
@@ -196,4 +217,8 @@ class OSDMap:
             m.crush.rules[rname] = crush.Rule(rname, root, fd, mode)
         m.pg_temp = d.map(lambda dd: (dd.i32(), dd.u32()),
                           lambda dd: dd.list(Decoder.i32))
+        if version >= 2:
+            m.pg_upmap_items = d.map(
+                lambda dd: (dd.i32(), dd.u32()),
+                lambda dd: dd.list(lambda d2: (d2.i32(), d2.i32())))
         return m

@@ -82,7 +82,23 @@ class MiniCluster:
         self.osds[osd_id] = osd
         return osd
 
+    def start_mgr(self, name: str = "x", modules=None):
+        """Boot a Mgr daemon against this cluster's mons (run_mgr role
+        of qa/standalone/ceph-helpers.sh)."""
+        from ceph_tpu.mgr import Mgr
+        auth = None
+        if self.keyring is not None:
+            auth = ("client.admin", self.keyring.get("client.admin"))
+        kw = {"auth": auth}
+        if modules is not None:
+            kw["modules"] = tuple(modules)
+        self.mgr = Mgr(self.mon_addr, name=name, **kw).start()
+        return self.mgr
+
     def stop(self) -> None:
+        if getattr(self, "mgr", None) is not None:
+            self.mgr.stop()
+            self.mgr = None
         for client in self._clients:
             client.shutdown()
         self._clients.clear()
@@ -224,6 +240,21 @@ class MiniCluster:
     def _dirty_pgs(self) -> list[str]:
         dirty = []
         osdmap = self.mon.osdmap
+        # every mapped PG must already EXIST on its current primary —
+        # a remap (e.g. a balancer upmap) can land while the new primary
+        # has not yet instantiated the PG, and scanning only existing PG
+        # objects would miss that window entirely
+        from ceph_tpu.parallel import crush as _crush
+        for pid, pool in osdmap.pools.items():
+            for ps in range(pool.pg_num):
+                _, _, primary = osdmap.pg_to_up_acting(pid, ps)
+                if primary == _crush.NONE:
+                    continue
+                posd = next((o for o in self.osds.values()
+                             if o.whoami == primary), None)
+                if posd is not None and (pid, ps) not in posd.pgs:
+                    dirty.append(
+                        f"pg{pid}.{ps} absent on primary osd.{primary}")
         for osd in self.osds.values():
             for pg in list(osd.pgs.values()):
                 if pg.state != pg.ACTIVE:

@@ -1,7 +1,7 @@
 """vstart — boot a dev cluster in one process (src/vstart.sh role).
 
     python -m ceph_tpu.tools.vstart [-n N_OSDS] [--store memstore|blockstore]
-        [--data DIR] [--ec k,m] [--prometheus]
+        [--data DIR] [--ec k,m] [--prometheus] [--mgr]
 
 Boots one mon + N OSDs, creates a replicated pool ``rbd`` and (with
 --ec) an EC pool ``ecpool``, prints the mon address + asok paths, and
@@ -30,6 +30,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="also create EC pool 'ecpool' with k,m")
     ap.add_argument("--prometheus", action="store_true",
                     help="serve /metrics on an ephemeral port")
+    ap.add_argument("--mgr", action="store_true",
+                    help="also boot a mgr (balancer/progress/telemetry)")
     args = ap.parse_args(argv)
 
     from ceph_tpu.qa.cluster import MiniCluster
@@ -46,6 +48,9 @@ def main(argv: list[str] | None = None) -> int:
         "osd_asoks": {i: o.asok.path for i, o in cluster.osds.items()},
         "pools": ["rbd"] + (["ecpool"] if args.ec else []),
     }
+    if args.mgr:
+        mgr = cluster.start_mgr()
+        info["mgr_asok"] = mgr.asok.path
     if args.prometheus:
         from ceph_tpu.utils.prometheus import MetricsServer
         ms = MetricsServer()
