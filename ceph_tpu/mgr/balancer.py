@@ -117,17 +117,20 @@ class Module(MgrModule):
         applied over the RAW CRUSH up set — the same semantics the mon
         validates against."""
         parent = osdmap.crush._parent_index()
+        down = osdmap.down_set()
         for pid, pool in sorted(osdmap.pools.items()):
             domain = osdmap.crush.rules[pool.rule].failure_domain
             lo_dom = self._domain_of(osdmap, lo, domain, parent)
             for ps in range(pool.pg_num):
-                raw_up = osdmap.pg_to_raw_up(pid, ps)
+                raw_up = osdmap.pg_to_raw_up(pid, ps, down=down)
                 items = pending.get((pid, ps))
                 if items is None:
                     items = list(
                         osdmap.pg_upmap_items.get((pid, ps), []))
-                remap = dict(items)
-                up = [remap.get(o, o) for o in raw_up]
+                # the MAP's remap semantics, not a naive dict(items):
+                # pairs with a down target are ignored by the mapping
+                # and must be ignored here too
+                up = osdmap.apply_upmap(raw_up, items, down)
                 if hi not in up or lo in up:
                     continue
                 # failure-domain check: lo's bucket must not already be
@@ -149,6 +152,10 @@ class Module(MgrModule):
                         new_items.append((f, t))
                 if not rewritten:
                     new_items.append((hi, lo))
+                # never emit a plan the mon would reject — same
+                # validator the command handler runs
+                if osdmap.validate_upmap_items(pid, ps, new_items):
+                    continue
                 pending[(pid, ps)] = new_items
                 counts[hi] -= 1
                 counts[lo] += 1

@@ -135,6 +135,9 @@ class OSD:
         self._map_lock = threading.RLock()
         self.pgs: dict[tuple[int, int], PG] = {}
         self._pgs_lock = threading.RLock()
+        self._pgscan_lock = threading.Lock()
+        self._pgscan_pending = False
+        self._pgscan_running = False
         self._backends: dict[int, PGBackend] = {}
         self._tid = 0
         self._tid_lock = threading.Lock()
@@ -360,11 +363,30 @@ class OSD:
         # e.g. a balancer upmap — recovery must start on the new primary
         # immediately, not when the next client op happens to touch it.
         # The O(pools * pg_num) CRUSH scan must NOT run on this thread
-        # (the messenger event loop — see the note above): hand it off.
-        threading.Thread(target=self._scan_new_primaries,
-                         args=(newmap,),
+        # (the messenger event loop — see the note above), and a burst
+        # of epochs must coalesce into one scan of the newest map.
+        self._kick_pgscan()
+
+    def _kick_pgscan(self) -> None:
+        """Request a primary-PG scan; bursts of map epochs coalesce
+        into one scan (which always reads the current map)."""
+        with self._pgscan_lock:
+            self._pgscan_pending = True
+            if self._pgscan_running:
+                return
+            self._pgscan_running = True
+        threading.Thread(target=self._pgscan_worker,
                          name=f"osd.{self.whoami}-pgscan",
                          daemon=True).start()
+
+    def _pgscan_worker(self) -> None:
+        while True:
+            with self._pgscan_lock:
+                if not self._pgscan_pending:
+                    self._pgscan_running = False
+                    return
+                self._pgscan_pending = False
+            self._scan_new_primaries(self.get_osdmap())
 
     def _scan_new_primaries(self, newmap: OSDMap) -> None:
         """Instantiate + queue peering for mapped PGs newly primary

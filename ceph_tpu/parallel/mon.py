@@ -511,7 +511,9 @@ class Monitor:
             return -22, f"unknown command {prefix!r}", b""
         except KeyError as exc:
             return -22, f"missing argument: {exc}", b""
-        except ValueError as exc:   # bad ints, malformed JSON, ...
+        except (ValueError, TypeError) as exc:
+            # bad ints, malformed JSON, wrong shapes — the client must
+            # get a reply, not a timeout
             return -22, f"invalid argument: {exc}", b""
 
     def _cmd_profile_set(self, cmd: dict) -> tuple[int, str, bytes]:
@@ -555,35 +557,20 @@ class Monitor:
         pool = self.osdmap.pools[pool_id]
         if not 0 <= ps < pool.pg_num:
             return -22, f"ps {ps} out of range for pool {pool_id}", b""
-        pairs = [(int(f), int(t)) for f, t in json.loads(cmd["items"])]
-        # validate against the RAW CRUSH up set: the command replaces
+        raw_items = json.loads(cmd["items"])
+        if not isinstance(raw_items, list) or not all(
+                isinstance(p, (list, tuple)) and len(p) == 2
+                for p in raw_items):
+            return -22, f"items must be [[from,to],...]: {raw_items}", b""
+        pairs = [(int(f), int(t)) for f, t in raw_items]
+        # validated against the RAW CRUSH up set: the command replaces
         # the PG's whole pair list, so re-sent already-applied pairs
         # must validate too (checking the post-upmap set would reject
         # every second balancer round)
-        up = self.osdmap.pg_to_raw_up(pool_id, ps)
-        down = self.osdmap.down_set()
-        froms = [f for f, _ in pairs]
-        tos = [t for _, t in pairs]
-        if len(set(froms)) != len(froms):
-            return -22, f"duplicate 'from' osds in {pairs}", b""
-        if len(set(tos)) != len(tos):
-            return -22, f"duplicate 'to' osds in {pairs}", b""
-        for f, t in pairs:
-            if f == t:
-                return -22, f"osd.{f} mapped to itself", b""
-            if t not in self.osdmap.osds:
-                return -2, f"no osd.{t}", b""
-            if t in down:
-                return -22, f"osd.{t} is down/out", b""
-            if f not in up:
-                return -22, f"osd.{f} not in raw up set {up}", b""
-            if t in up or t in froms:
-                return -22, f"osd.{t} already in up set {up}", b""
-        # the remapped set must stay duplicate-free
-        remap = dict(pairs)
-        mapped = [remap.get(o, o) for o in up]
-        if len(set(mapped)) != len(mapped):
-            return -22, f"upmap {pairs} collapses up set {up}", b""
+        err = self.osdmap.validate_upmap_items(pool_id, ps, pairs)
+        if err is not None:
+            code = -2 if err.startswith("no osd.") else -22
+            return code, err, b""
         self.osdmap.pg_upmap_items[(pool_id, ps)] = pairs
         self._commit()
         return 0, f"upmap {pool_id}.{ps} {pairs}", b""

@@ -112,28 +112,73 @@ class OSDMap:
         ps = crush.hash_name(name)
         return crush.stable_mod(ps, pool.pg_num, pg_num_mask(pool.pg_num))
 
-    def pg_to_raw_up(self, pool_id: int, ps: int) -> list[int]:
+    def pg_to_raw_up(self, pool_id: int, ps: int,
+                     down: set[int] | None = None) -> list[int]:
         """The CRUSH up set BEFORE pg_upmap_items — what upmap pairs
         are defined against (OSDMap::pg_to_raw_up role)."""
         pool = self.pools[pool_id]
         x = crush.hash2(ps, pool_id)
-        return self.crush.do_rule(pool.rule, x, pool.size,
-                                  down=self.down_set())
+        if down is None:
+            down = self.down_set()
+        return self.crush.do_rule(pool.rule, x, pool.size, down=down)
+
+    @staticmethod
+    def apply_upmap(raw_up: list[int],
+                    items: list[tuple[int, int]] | None,
+                    down: set[int]) -> list[int]:
+        """Apply pg_upmap_items pairs to a raw up set — the single
+        definition of the remap semantics (pairs whose target is down
+        or already a raw member are ignored). The mon validator and the
+        balancer planner both call this so they can never diverge from
+        the mapping."""
+        if not items:
+            return raw_up
+        remap = {f: t for f, t in items
+                 if t not in down and t not in raw_up}
+        return [remap.get(o, o) for o in raw_up]
 
     def pg_to_up_acting(self, pool_id: int, ps: int
                         ) -> tuple[list[int], list[int], int]:
         """Returns (up, acting, primary). primary = first non-NONE of
         acting, or NONE when the PG is entirely unserviceable."""
-        up = self.pg_to_raw_up(pool_id, ps)
-        items = self.pg_upmap_items.get((pool_id, ps))
-        if items:
-            down = self.down_set()
-            remap = {f: t for f, t in items
-                     if t not in down and t not in up}
-            up = [remap.get(o, o) for o in up]
+        down = self.down_set()
+        raw = self.pg_to_raw_up(pool_id, ps, down=down)
+        up = self.apply_upmap(
+            raw, self.pg_upmap_items.get((pool_id, ps)), down)
         acting = self.pg_temp.get((pool_id, ps), up)
         primary = next((o for o in acting if o != crush.NONE), crush.NONE)
         return up, acting, primary
+
+    def validate_upmap_items(self, pool_id: int, ps: int,
+                             pairs: list[tuple[int, int]]
+                             ) -> str | None:
+        """Why ``pairs`` cannot be installed for the PG, or None when
+        legal. Shared by the mon command (authoritative) and the mgr
+        balancer planner (so plans are rejected at plan time, never at
+        execute time)."""
+        down = self.down_set()
+        up = self.pg_to_raw_up(pool_id, ps, down=down)
+        froms = [f for f, _ in pairs]
+        tos = [t for _, t in pairs]
+        if len(set(froms)) != len(froms):
+            return f"duplicate 'from' osds in {pairs}"
+        if len(set(tos)) != len(tos):
+            return f"duplicate 'to' osds in {pairs}"
+        for f, t in pairs:
+            if f == t:
+                return f"osd.{f} mapped to itself"
+            if t not in self.osds:
+                return f"no osd.{t}"
+            if t in down:
+                return f"osd.{t} is down/out"
+            if f not in up:
+                return f"osd.{f} not in raw up set {up}"
+            if t in up or t in froms:
+                return f"osd.{t} already in up set {up}"
+        mapped = self.apply_upmap(up, pairs, down)
+        if len(set(mapped)) != len(mapped):
+            return f"upmap {pairs} collapses up set {up}"
+        return None
 
     def object_locator(self, pool_id: int, name: str
                        ) -> tuple[int, list[int], int]:

@@ -67,10 +67,14 @@ class _FakeMgr:
 
     def mon_command(self, **cmd):
         self.commands.append(cmd)
-        # apply like the mon would, so planning sees its own moves
+        # apply like the mon would — including its validation, so any
+        # planner/mon semantic divergence fails the test
         key = (int(cmd["pool"]), int(cmd["ps"]))
-        self.osdmap.pg_upmap_items[key] = [
-            (int(f), int(t)) for f, t in json.loads(cmd["items"])]
+        pairs = [(int(f), int(t)) for f, t in json.loads(cmd["items"])]
+        err = self.osdmap.validate_upmap_items(key[0], key[1], pairs)
+        if err is not None:
+            return -22, err, b""
+        self.osdmap.pg_upmap_items[key] = pairs
         return 0, "ok", b""
 
 
@@ -175,3 +179,31 @@ def test_mgr_daemon_in_cluster():
         assert code != 0 and "duplicate" in msg, (code, msg)
         for o, b in blobs.items():
             assert io.read(o) == b
+
+
+def test_balancer_second_round_and_down_target():
+    """Regression: plans must use the map's remap semantics (pairs with
+    a down target are ignored) and must validate exactly as the mon
+    does, so a second optimize round after installed upmaps — or after
+    a remap target died — still converges instead of erroring."""
+    from ceph_tpu.mgr import balancer
+    m = make_map(n_osds=6, pg_num=32, size=2)
+    mgr = _FakeMgr(m)
+    mod = balancer.Module(mgr)
+    for _ in range(3):                       # several rounds must apply
+        plan = mod.optimize(max_optimizations=16)
+        if not plan:
+            break
+        code, msg = mod.execute(plan)
+        assert code == 0, msg
+    assert mod.eval()["spread"] <= 1
+    # kill a remap target: the mapping ignores its pairs; planning must
+    # keep working against the surviving topology
+    targets = {t for items in m.pg_upmap_items.values()
+               for _, t in items}
+    if targets:
+        dead = sorted(targets)[0]
+        m.mark_down(dead)
+        plan = mod.optimize(max_optimizations=16)
+        code, msg = mod.execute(plan)
+        assert code == 0, msg
