@@ -125,8 +125,16 @@ class Module(MgrModule):
                 raw_up = osdmap.pg_to_raw_up(pid, ps, down=down)
                 items = pending.get((pid, ps))
                 if items is None:
-                    items = list(
-                        osdmap.pg_upmap_items.get((pid, ps), []))
+                    # seed from the installed list, PRUNING pairs the
+                    # mapping ignores (down target, or endpoints no
+                    # longer in the raw up set): carrying a dead pair
+                    # forward would make every future plan for this PG
+                    # fail validation — the stale pair would never heal
+                    items = [
+                        (f, t) for f, t in
+                        osdmap.pg_upmap_items.get((pid, ps), [])
+                        if t not in down and t not in raw_up
+                        and f in raw_up]
                 # the MAP's remap semantics, not a naive dict(items):
                 # pairs with a down target are ignored by the mapping
                 # and must be ignored here too
@@ -153,8 +161,11 @@ class Module(MgrModule):
                 if not rewritten:
                     new_items.append((hi, lo))
                 # never emit a plan the mon would reject — same
-                # validator the command handler runs
-                if osdmap.validate_upmap_items(pid, ps, new_items):
+                # validator the command handler runs (down/raw_up
+                # passed through: no second CRUSH evaluation)
+                if osdmap.validate_upmap_items(pid, ps, new_items,
+                                               down=down,
+                                               raw_up=raw_up):
                     continue
                 pending[(pid, ps)] = new_items
                 counts[hi] -= 1

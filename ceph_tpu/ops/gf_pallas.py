@@ -121,22 +121,40 @@ def _matvec_padded(bmat: jax.Array, data: jax.Array,
     )(bmat, data)
 
 
-class _PermMatrixCache:
-    def __init__(self) -> None:
-        self._cache: dict[bytes, jax.Array] = {}
+def _tracing() -> bool:
+    try:
+        return not jax.core.trace_state_clean()
+    except AttributeError:      # jax moved/renamed it: be conservative
+        return True
 
-    def get(self, mat: np.ndarray, g: int) -> jax.Array:
+
+class _PermMatrixCache:
+    """Caches the block-diagonal bit matrix: host-side always, plus a
+    device copy used only OUTSIDE tracing. Under an outer jit the
+    numpy constant is embedded per-trace (handing out a cached device
+    array there would leak a tracer); on the eager hot path the device
+    copy avoids re-uploading the matrix every call."""
+
+    def __init__(self) -> None:
+        self._host: dict[bytes, np.ndarray] = {}
+        self._dev: dict[bytes, jax.Array] = {}
+
+    def get(self, mat: np.ndarray, g: int):
         key = (mat.shape[0].to_bytes(2, "little") +
                g.to_bytes(2, "little") + mat.tobytes())
-        dev = self._cache.get(key)
-        if dev is None:
+        big = self._host.get(key)
+        if big is None:
             perm = _permute_bitmatrix(mat).astype(np.int32)
             r, c = perm.shape
             big = np.zeros((g * r, g * c), dtype=np.int32)
             for q in range(g):
                 big[q * r:(q + 1) * r, q * c:(q + 1) * c] = perm
-            dev = jnp.asarray(big)
-            self._cache[key] = dev
+            self._host[key] = big
+        if _tracing():
+            return jnp.asarray(big)
+        dev = self._dev.get(key)
+        if dev is None:
+            dev = self._dev[key] = jnp.asarray(big)
         return dev
 
 

@@ -569,8 +569,7 @@ class Monitor:
         # every second balancer round)
         err = self.osdmap.validate_upmap_items(pool_id, ps, pairs)
         if err is not None:
-            code = -2 if err.startswith("no osd.") else -22
-            return code, err, b""
+            return err[0], err[1], b""
         self.osdmap.pg_upmap_items[(pool_id, ps)] = pairs
         self._commit()
         return 0, f"upmap {pool_id}.{ps} {pairs}", b""

@@ -150,34 +150,40 @@ class OSDMap:
         return up, acting, primary
 
     def validate_upmap_items(self, pool_id: int, ps: int,
-                             pairs: list[tuple[int, int]]
-                             ) -> str | None:
-        """Why ``pairs`` cannot be installed for the PG, or None when
-        legal. Shared by the mon command (authoritative) and the mgr
-        balancer planner (so plans are rejected at plan time, never at
-        execute time)."""
-        down = self.down_set()
-        up = self.pg_to_raw_up(pool_id, ps, down=down)
+                             pairs: list[tuple[int, int]],
+                             down: set[int] | None = None,
+                             raw_up: list[int] | None = None
+                             ) -> tuple[int, str] | None:
+        """Why ``pairs`` cannot be installed for the PG — a (errno,
+        message) tuple, or None when legal. Shared by the mon command
+        (authoritative) and the mgr balancer planner (so plans are
+        rejected at plan time, never at execute time). Callers that
+        already computed ``down``/``raw_up`` pass them in (the balancer
+        scan runs this per candidate)."""
+        if down is None:
+            down = self.down_set()
+        up = (self.pg_to_raw_up(pool_id, ps, down=down)
+              if raw_up is None else raw_up)
         froms = [f for f, _ in pairs]
         tos = [t for _, t in pairs]
         if len(set(froms)) != len(froms):
-            return f"duplicate 'from' osds in {pairs}"
+            return -22, f"duplicate 'from' osds in {pairs}"
         if len(set(tos)) != len(tos):
-            return f"duplicate 'to' osds in {pairs}"
+            return -22, f"duplicate 'to' osds in {pairs}"
         for f, t in pairs:
             if f == t:
-                return f"osd.{f} mapped to itself"
+                return -22, f"osd.{f} mapped to itself"
             if t not in self.osds:
-                return f"no osd.{t}"
+                return -2, f"no osd.{t}"
             if t in down:
-                return f"osd.{t} is down/out"
+                return -22, f"osd.{t} is down/out"
             if f not in up:
-                return f"osd.{f} not in raw up set {up}"
+                return -22, f"osd.{f} not in raw up set {up}"
             if t in up or t in froms:
-                return f"osd.{t} already in up set {up}"
+                return -22, f"osd.{t} already in up set {up}"
         mapped = self.apply_upmap(up, pairs, down)
         if len(set(mapped)) != len(mapped):
-            return f"upmap {pairs} collapses up set {up}"
+            return -22, f"upmap {pairs} collapses up set {up}"
         return None
 
     def object_locator(self, pool_id: int, name: str

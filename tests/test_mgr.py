@@ -73,7 +73,7 @@ class _FakeMgr:
         pairs = [(int(f), int(t)) for f, t in json.loads(cmd["items"])]
         err = self.osdmap.validate_upmap_items(key[0], key[1], pairs)
         if err is not None:
-            return -22, err, b""
+            return err[0], err[1], b""
         self.osdmap.pg_upmap_items[key] = pairs
         return 0, "ok", b""
 
