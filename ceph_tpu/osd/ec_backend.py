@@ -137,6 +137,10 @@ class ECBackend(PGBackend):
         tid = self.parent.new_tid()
         iw = InflightWrite(tid, pg, oid, version, set(positions),
                            lambda: on_commit(0))
+        # an abandoned write must still drop its extent-cache pin:
+        # a leaked entry would make covers()/overlay() feed stale
+        # content to every later RMW on the object
+        iw.on_expire = lambda: pg.extent_cache.unpin(oid, version)
         self.parent.register_write(iw)
         epoch = self.parent.get_osdmap().epoch
         # dataflow trace: one child span per shard sub-op, carried in
@@ -164,27 +168,41 @@ class ECBackend(PGBackend):
             for missing in pg.peer_missing.values():
                 missing.pop(oid, None)
 
+    def _unpin_on_commit(self, pg: PG, oid: str, version: int,
+                         on_commit: Callable[[int], None]
+                         ) -> Callable[[int], None]:
+        def done(code: int) -> None:
+            pg.extent_cache.unpin(oid, version)
+            on_commit(code)
+        return done
+
     def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
                      on_commit: Callable[[int], None]) -> None:
-        padded = self._pad(bytes(data))
+        data = bytes(data)
+        padded = self._pad(data)
         shards = ec_util.encode(self.sinfo, self.codec, padded)
         hinfo = HashInfo(self.n)
         hinfo.append(0, shards)
         hinfo_raw = json.dumps(hinfo.to_dict()).encode()
         size_raw = len(data).to_bytes(8, "little")
+        pg.extent_cache.pin(oid, version, 0, data, len(data), full=True)
         self._fan_out(
             pg, oid, version, LOG_WRITE,
             lambda pos, cid: object_write_txn(
                 cid, oid, shards[pos].tobytes(), version,
                 attrs={"sz": size_raw, "hinfo": hinfo_raw}),
-            on_commit, "ec_sub_write", supersedes_recovery=True)
+            self._unpin_on_commit(pg, oid, version, on_commit),
+            "ec_sub_write", supersedes_recovery=True)
 
     def submit_remove(self, pg: PG, oid: str, version: int,
                       on_commit: Callable[[int], None]) -> None:
+        pg.extent_cache.pin(oid, version, 0, b"", 0, full=True,
+                            remove=True)
         self._fan_out(
             pg, oid, version, LOG_REMOVE,
             lambda pos, cid: object_remove_txn(cid, oid),
-            on_commit, "ec_sub_remove", supersedes_recovery=True)
+            self._unpin_on_commit(pg, oid, version, on_commit),
+            "ec_sub_remove", supersedes_recovery=True)
 
     def submit_partial_write(self, pg: PG, oid: str, offset: int,
                              data: bytes, version: int,
@@ -213,26 +231,63 @@ class ECBackend(PGBackend):
                 old_size = self.stat_object(pg, oid)
             except (NoSuchObject, NoSuchCollection):
                 old_size = 0           # first write to this object
+        # fold in in-flight writes (idempotent if the local stat
+        # already reflects them; required when the stat fell back to a
+        # degraded read of committed-only shard attrs)
+        old_size = pg.extent_cache.effective_size(oid, old_size, -1)
         new_size = max(old_size, end)
         a = (offset // sw) * sw                       # window start
         b = -(-end // sw) * sw                        # window end
         window = bytearray(b - a)
         old_aligned = -(-old_size // sw) * sw
         if old_size > a and (offset > a or end < min(b, old_aligned)):
-            # edge stripes keep existing bytes: ranged RMW read
+            # edge stripes keep existing bytes: ranged RMW read.
+            # The shards can only answer with COMMITTED state — an
+            # earlier write to this object may still be in flight (no
+            # shard committed it yet, so the version-agreement check
+            # cannot see it). Overlay every in-flight entry newer than
+            # the version the read agreed on (ExtentCache role,
+            # src/osd/ExtentCache.h:37-45) or the re-encode would
+            # write pre-overwrite bytes back (lost update).
             read_to = min(b, old_aligned)
             want = list(range(self.k))
-            chunks, _ = self._read_shards(
-                pg, oid, want,
-                chunk_off=(a // sw) * cs,
-                chunk_len=((read_to - a) // sw) * cs)
-            if not all(i in chunks for i in want):
-                chunks = ec_util.decode(self.sinfo, self.codec,
-                                        chunks, want)
-            old_win = self._chunks_to_logical(
-                {i: chunks[i] for i in want}, read_to - a)
-            window[:len(old_win)] = old_win
+            base_ver = 0
+            # ONE snapshot drives covers/versions/overlay: an entry
+            # unpinned mid-compose (its commit landing on the store
+            # thread) must still contribute its bytes here — its
+            # content is the committed content in that case
+            snap = pg.extent_cache.snapshot(oid)
+            if snap.covers(a, read_to):
+                # in-flight windows alone determine every needed byte:
+                # no shard read at all (the pure pipelined case)
+                chunks = None
+            else:
+                try:
+                    chunks, rattrs = self._read_shards(
+                        pg, oid, want,
+                        chunk_off=(a // sw) * cs,
+                        chunk_len=((read_to - a) // sw) * cs,
+                        accept_versions=snap.versions())
+                except NoSuchObject:
+                    # committed state doesn't exist yet: the whole
+                    # object is in flight — the overlay reconstructs it
+                    chunks, rattrs = None, {}
+            if chunks is not None:
+                base_ver = int.from_bytes(rattrs.get("v", b""),
+                                          "little")
+                if not all(i in chunks for i in want):
+                    chunks = ec_util.decode(self.sinfo, self.codec,
+                                            chunks, want)
+                old_win = self._chunks_to_logical(
+                    {i: chunks[i] for i in want}, read_to - a)
+                window[:len(old_win)] = old_win
+            snap.overlay(window, a, base_ver)
         window[offset - a:end - a] = data
+        # pin the WHOLE spliced window, not just the written bytes: a
+        # later overlapping RMW that reads a mixed-version shard set
+        # must be able to replace every stripe this write re-encodes
+        pg.extent_cache.pin(oid, version, a, bytes(window), new_size,
+                            full=False)
         shards = ec_util.encode(self.sinfo, self.codec, bytes(window))
         chunk_off = (a // sw) * cs
         size_raw = new_size.to_bytes(8, "little")
@@ -247,7 +302,8 @@ class ECBackend(PGBackend):
             txn.rmattr(cid, oid, "hinfo")
             return txn
 
-        self._fan_out(pg, oid, version, LOG_WRITE, build, on_commit,
+        self._fan_out(pg, oid, version, LOG_WRITE, build,
+                      self._unpin_on_commit(pg, oid, version, on_commit),
                       "ec_sub_rmw", supersedes_recovery=False)
 
     # -- shard read fan-out -------------------------------------------
@@ -255,7 +311,8 @@ class ECBackend(PGBackend):
 
     def _read_shards(self, pg: PG, oid: str, want_chunks: list[int],
                      avoid: set[int] | None = None,
-                     chunk_off: int = 0, chunk_len: int = 0
+                     chunk_off: int = 0, chunk_len: int = 0,
+                     accept_versions: frozenset[int] | None = None
                      ) -> tuple[dict[int, np.ndarray], dict[str, bytes]]:
         """Read the chunks named by minimum_to_decode over (up - avoid)
         positions; returns ({chunk: bytes}, attrs-from-one-shard).
@@ -272,6 +329,15 @@ class ECBackend(PGBackend):
         decode would produce silent garbage, so the read backs off and
         retries until the shards agree (the ordering guarantee the
         reference gets from the ECBackend rmw pipeline + ExtentCache).
+
+        ``accept_versions`` (the RMW pipelining mode): versions whose
+        full window content the caller holds in the extent cache. A
+        mixed-version read is then accepted as long as every version
+        above the floor is in this set — stripes those in-flight
+        writes touched get REPLACED by cache overlay, and stripes they
+        did not touch are byte-identical across the versions, so the
+        mix is safe. attrs returned are the FLOOR shard's (the overlay
+        base version).
         """
         base_avoid = set(avoid or ())
         mypos = self.my_position(pg)
@@ -307,6 +373,7 @@ class ECBackend(PGBackend):
             results: dict[int, np.ndarray] = {}
             vers: dict[int, int] = {}
             attrs: dict[str, bytes] = {}
+            attrs_by_pos: dict[int, dict] = {}
             remote = {p for p in need if p != mypos}
             tid = self.parent.new_tid()
             wait = SubOpWait(set(remote))
@@ -330,6 +397,7 @@ class ECBackend(PGBackend):
                         vers[mypos] = int.from_bytes(
                             local_attrs.get("v", b""), "little")
                         attrs = attrs or local_attrs
+                        attrs_by_pos[mypos] = local_attrs
                         enoent_everywhere = False
                     except (NoSuchObject, NoSuchCollection):
                         # match the remote mapping: a shard whose PG
@@ -354,17 +422,30 @@ class ECBackend(PGBackend):
                 vers[pos] = rep.version
                 if rep.attrs:
                     attrs = dict(rep.attrs)
+                    attrs_by_pos[pos] = dict(rep.attrs)
             missing_reads = set(need) - set(results)
             if missing_reads:
                 base_avoid |= failed | missing_reads
                 continue
             if len(set(vers.values())) > 1:
-                # a shard is mid-commit: back off and re-read; do NOT
-                # avoid it — it is catching up, not failing
-                log(10, f"{oid}: shard versions disagree {vers}, "
-                    "retrying")
-                time.sleep(0.05 * (attempt + 1))
-                continue
+                floor = min(vers.values())
+                if accept_versions is not None and all(
+                        v == floor or v in accept_versions
+                        for v in vers.values()):
+                    # RMW pipelining: the newer versions are in-flight
+                    # writes whose windows the caller overlays; pick
+                    # the floor shard's attrs as the overlay base
+                    for pos, v in vers.items():
+                        if v == floor and pos in attrs_by_pos:
+                            attrs = attrs_by_pos[pos]
+                            break
+                else:
+                    # a shard is mid-commit: back off and re-read; do
+                    # NOT avoid it — it is catching up, not failing
+                    log(10, f"{oid}: shard versions disagree {vers}, "
+                        "retrying")
+                    time.sleep(0.05 * (attempt + 1))
+                    continue
             if chunk_len:
                 # ranged read: short shards (range beyond their data)
                 # pad with zeros — virtual zero stripes

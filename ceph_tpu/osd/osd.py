@@ -718,6 +718,13 @@ class OSD:
                         old_size = be.stat_object(pg, msg.oid)
                     except (NoSuchObject, NoSuchCollection):
                         old_size = 0
+                    # fold in-flight writes into the size BEFORE
+                    # choosing the append offset: with pipelined
+                    # overwrites, the committed stat lags and two
+                    # back-to-back appends would land on the same
+                    # offset (losing the first)
+                    old_size = pg.extent_cache.effective_size(
+                        msg.oid, old_size, -1)
                     off = old_size if op == M.OSD_OP_APPEND \
                         else msg.offset
                     be.submit_partial_write(

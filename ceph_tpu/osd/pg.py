@@ -175,6 +175,9 @@ class PG:
         # (rollback hysteresis: one failed round may just be a write
         # mid-commit; two means the write is dead)
         self.rollback_pending: dict[str, int] = {}
+        # in-flight write content for overlapping RMW (ExtentCache role)
+        from ceph_tpu.osd.extent_cache import ExtentCache
+        self.extent_cache = ExtentCache()
         self.backend = None       # set by the OSD when instantiated
 
     def missing_dirty(self) -> bool:

@@ -89,6 +89,11 @@ class InflightWrite:
         self.acting = list(pg.acting)     # snapshot at submit time
         self.pending = set(pending)
         self.on_all_commit = on_all_commit
+        #: fired (once) when the write is abandoned by the expiry
+        #: sweep instead of completing — cleanup that must not wait
+        #: for a commit that will never be confirmed (e.g. extent-
+        #: cache unpin; a leaked pin would poison later RMWs forever)
+        self.on_expire: Callable[[], None] | None = None
         self.created_at = time.monotonic()
         self._lock = threading.Lock()
         self._done = False
@@ -129,9 +134,12 @@ class InflightWrite:
         end-to-end completion: it times out and resends, and the dup-op
         cache makes the resend safe."""
         with self._lock:
+            already = self._done
             self._done = True
             dropped = sorted(self.pending)
             self.pending.clear()
+        if not already and self.on_expire is not None:
+            self.on_expire()
         return dropped
 
 
