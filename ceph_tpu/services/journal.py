@@ -1,0 +1,182 @@
+"""journal — append-only event journal on RADOS (src/journal/ role).
+
+Reference: src/journal/ (Journaler, JournalMetadata, ObjectRecorder):
+librbd journaling appends every image mutation to a journal backed by
+RADOS objects before applying it; rbd-mirror tails that journal from a
+per-client commit position and replays onto the peer. This lite
+version keeps the object model: entries are length-prefixed records
+appended to chunk objects (``<name>.<chunk>``, SPLAY entries per chunk
+— the object-set rotation of the reference), per-client commit
+positions are tracked, and trim removes chunks every client has fully
+committed.
+
+Single-writer by design (the image holds the exclusive lock in the
+reference; our writer is the opened primary image). Writer and reader
+state are SEPARATE objects — the writer owns the header ({entries}),
+each reader owns its commit-position object, and the trimmer owns the
+floor object — so a replayer running concurrently with the writer
+never read-modify-writes the other side's state.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+#: entries per chunk object (object-set rotation granularity)
+SPLAY = 64
+
+
+class JournalError(Exception):
+    pass
+
+
+class Journaler:
+    def __init__(self, ioctx, name: str) -> None:
+        self.io = ioctx
+        self.name = name
+        self.header_oid = f"journal.{name}"
+
+    # -- header --------------------------------------------------------
+    def _load(self) -> dict:
+        try:
+            return json.loads(self.io.read(self.header_oid))
+        except Exception:
+            raise JournalError(f"no journal {self.name!r}") from None
+
+    def _save(self, h: dict) -> None:
+        self.io.write_full(self.header_oid,
+                           json.dumps(h, sort_keys=True).encode())
+
+    def _client_oid(self, client: str) -> str:
+        return f"{self.header_oid}.client.{client}"
+
+    @property
+    def _trim_oid(self) -> str:
+        return f"{self.header_oid}.trimmed"
+
+    def _trimmed_to(self) -> int:
+        try:
+            return int.from_bytes(self.io.read(self._trim_oid),
+                                  "little")
+        except Exception:
+            return 0
+
+    def create(self) -> None:
+        self._save({"entries": 0})
+        self.io.write_full(self._trim_oid, (0).to_bytes(8, "little"))
+
+    def exists(self) -> bool:
+        try:
+            self._load()
+            return True
+        except JournalError:
+            return False
+
+    def remove(self) -> None:
+        h = self._load()
+        for chunk in range(self._trimmed_to() // SPLAY,
+                           -(-h["entries"] // SPLAY) + 1):
+            try:
+                self.io.remove(self._chunk_oid(chunk))
+            except Exception:
+                pass
+        for oid in list(self.io.list_objects()):
+            if oid.startswith(f"{self.header_oid}.client.") or \
+                    oid == self._trim_oid:
+                try:
+                    self.io.remove(oid)
+                except Exception:
+                    pass
+        self.io.remove(self.header_oid)
+
+    def _chunk_oid(self, chunk: int) -> str:
+        return f"{self.header_oid}.{chunk:08x}"
+
+    # -- writer --------------------------------------------------------
+    def append(self, payload: bytes) -> int:
+        """Append one entry; returns its position. The entry is durable
+        (RADOS-committed) before the header advances, so a reader never
+        sees a position without its entry."""
+        h = self._load()
+        pos = h["entries"]
+        e = Encoder()
+        e.u64(pos)
+        e.bytes(payload)
+        self.io.append(self._chunk_oid(pos // SPLAY), e.getvalue())
+        h["entries"] = pos + 1
+        self._save(h)
+        return pos
+
+    def end_position(self) -> int:
+        return self._load()["entries"]
+
+    # -- readers -------------------------------------------------------
+    def read_from(self, pos: int):
+        """Yield (position, payload) for every entry >= pos, in order."""
+        h = self._load()
+        end = h["entries"]
+        floor = self._trimmed_to()
+        if pos < floor:
+            raise JournalError(
+                f"position {pos} already trimmed (floor {floor})")
+        chunk = pos // SPLAY
+        while chunk * SPLAY < end:
+            try:
+                raw = self.io.read(self._chunk_oid(chunk))
+            except Exception:
+                break
+            d = Decoder(raw)
+            while not d.eof():
+                epos = d.u64()
+                payload = d.bytes()
+                if pos <= epos < end:
+                    yield epos, payload
+            chunk += 1
+
+    # -- commit positions / trim ---------------------------------------
+    def commit(self, client: str, pos: int) -> None:
+        """Advance (monotonically) this client's commit position. Each
+        client owns its position object — no shared header RMW with
+        the writer's append path."""
+        pos = max(pos, self.committed(client))
+        self.io.write_full(self._client_oid(client),
+                           pos.to_bytes(8, "little"))
+
+    def committed(self, client: str) -> int:
+        try:
+            return int.from_bytes(
+                self.io.read(self._client_oid(client)), "little")
+        except Exception:
+            return 0
+
+    def clients(self) -> dict[str, int]:
+        prefix = f"{self.header_oid}.client."
+        out = {}
+        for oid in self.io.list_objects():
+            if oid.startswith(prefix):
+                out[oid[len(prefix):]] = int.from_bytes(
+                    self.io.read(oid), "little")
+        return out
+
+    def trim(self) -> int:
+        """Remove chunk objects every registered client has fully
+        consumed; returns the new floor position. Single trimmer by
+        design (the mirror daemon)."""
+        clients = self.clients()
+        trimmed = self._trimmed_to()
+        if not clients:
+            return trimmed
+        floor = min(clients.values())
+        new_floor_chunk = floor // SPLAY
+        for chunk in range(trimmed // SPLAY, new_floor_chunk):
+            try:
+                self.io.remove(self._chunk_oid(chunk))
+            except Exception:
+                pass
+        new_floor = new_floor_chunk * SPLAY
+        if new_floor > trimmed:
+            self.io.write_full(self._trim_oid,
+                               new_floor.to_bytes(8, "little"))
+        return max(new_floor, trimmed)

@@ -11,6 +11,14 @@ Snapshots here are full object-range copies into a snap namespace
 (``rbd_snap.<image>@<snap>...``), not the reference's COW clones —
 correct semantics (point-in-time, rollback, independent of later
 writes) at lite cost; COW is future work.
+
+Journaling (librbd journaling feature, src/journal/ role): an image
+created with ``journaling=True`` appends an event record to its
+journal (services/journal.py) BEFORE applying each mutation — the
+write-ahead ordering rbd-mirror replay depends on. Non-primary images
+(mirror targets, ``primary=False``) refuse client mutations; the
+replayer applies through the internal ``_apply_event`` path
+(services/rbd_mirror.py).
 """
 
 from __future__ import annotations
@@ -18,6 +26,8 @@ from __future__ import annotations
 import json
 
 from ceph_tpu.client.striper import FileLayout, StripedObject
+from ceph_tpu.services.journal import Journaler
+from ceph_tpu.utils.encoding import Decoder, Encoder
 
 DIRECTORY_OID = "rbd_directory"
 
@@ -44,7 +54,9 @@ class RBD:
         self.io = ioctx
 
     def create(self, name: str, size: int,
-               layout: FileLayout | None = None) -> "Image":
+               layout: FileLayout | None = None,
+               journaling: bool = False,
+               primary: bool = True) -> "Image":
         d = _load_dir(self.io)
         if name in d:
             raise RBDError(f"image {name!r} exists")
@@ -53,7 +65,10 @@ class RBD:
                                       object_size=1 << 20)
         header = {"size": size, "su": layout.stripe_unit,
                   "sc": layout.stripe_count, "os": layout.object_size,
-                  "snaps": {}}
+                  "snaps": {}, "journaling": journaling,
+                  "primary": primary}
+        if journaling:
+            Journaler(self.io, f"rbd.{name}").create()
         self.io.write_full(f"rbd_header.{name}",
                            json.dumps(header).encode())
         d[name] = {"size": size}
@@ -66,7 +81,11 @@ class RBD:
     def remove(self, name: str) -> None:
         img = Image(self.io, name)
         for snap in list(img.snap_list()):
-            img.snap_remove(snap)
+            # direct apply: removing a NON-PRIMARY (mirror-target)
+            # image must not trip the writability check or journal
+            img._snap_remove_apply(snap)
+        if img.journal is not None:
+            img.journal.remove()
         img._data.remove()
         try:
             self.io.remove(f"rbd_header.{name}")
@@ -93,6 +112,8 @@ class Image:
         layout = FileLayout(self._header["su"], self._header["sc"],
                             self._header["os"])
         self._data = StripedObject(self.io, f"rbd_data.{name}", layout)
+        self.journal = Journaler(self.io, f"rbd.{name}") \
+            if self._header.get("journaling") else None
 
     # -- header --------------------------------------------------------
     def _save_header(self) -> None:
@@ -113,7 +134,45 @@ class Image:
                 "object_size": self._header["os"],
                 "snaps": sorted(self._header["snaps"])}
 
+    # -- journaling / mirroring roles ----------------------------------
+    def is_primary(self) -> bool:
+        return self._header.get("primary", True)
+
+    def promote(self) -> None:
+        self._header["primary"] = True
+        self._save_header()
+
+    def demote(self) -> None:
+        self._header["primary"] = False
+        self._save_header()
+
+    def _journal_event(self, kind: str, offset: int = 0,
+                       data: bytes = b"", arg: str = "") -> None:
+        if self.journal is None:
+            return
+        e = Encoder()
+        e.str(kind)
+        e.u64(offset)
+        e.bytes(data)
+        e.str(arg)
+        self.journal.append(e.getvalue())
+
+    @staticmethod
+    def decode_event(payload: bytes) -> tuple[str, int, bytes, str]:
+        d = Decoder(payload)
+        return d.str(), d.u64(), d.bytes(), d.str()
+
+    def _check_writable(self) -> None:
+        if not self._header.get("primary", True):
+            raise RBDError(
+                f"image {self.name!r} is non-primary (mirror target)")
+
     def resize(self, new_size: int) -> None:
+        self._check_writable()
+        self._journal_event("resize", new_size)
+        self._resize_apply(new_size)
+
+    def _resize_apply(self, new_size: int) -> None:
         old = self._header["size"]
         self._header["size"] = new_size
         self._save_header()
@@ -125,8 +184,10 @@ class Image:
 
     # -- data ----------------------------------------------------------
     def write(self, offset: int, data: bytes) -> int:
+        self._check_writable()
         if offset + len(data) > self._header["size"]:
             raise RBDError("write past end of image")
+        self._journal_event("write", offset, bytes(data))
         self._data.write(data, offset=offset)
         return len(data)
 
@@ -140,6 +201,9 @@ class Image:
         return out + b"\x00" * (want - len(out))
 
     def discard(self, offset: int, length: int) -> None:
+        self._check_writable()
+        self._journal_event("discard", offset,
+                            length.to_bytes(8, "little"))
         self._data.write(b"\x00" * length, offset=offset)
 
     # -- snapshots ------------------------------------------------------
@@ -150,8 +214,13 @@ class Image:
         return sorted(self._header["snaps"])
 
     def snap_create(self, snap: str) -> None:
+        self._check_writable()
         if snap in self._header["snaps"]:
             raise RBDError(f"snap {snap!r} exists")
+        self._journal_event("snap_create", arg=snap)
+        self._snap_create_apply(snap)
+
+    def _snap_create_apply(self, snap: str) -> None:
         content = self._data.read()      # point-in-time copy
         so = StripedObject(self.io, self._snap_prefix(snap),
                            self._data.layout)
@@ -161,8 +230,13 @@ class Image:
         self._save_header()
 
     def snap_rollback(self, snap: str) -> None:
+        self._check_writable()
         if snap not in self._header["snaps"]:
             raise RBDError(f"no snap {snap!r}")
+        self._journal_event("snap_rollback", arg=snap)
+        self._snap_rollback_apply(snap)
+
+    def _snap_rollback_apply(self, snap: str) -> None:
         so = StripedObject(self.io, self._snap_prefix(snap))
         content = so.read()
         self._data.remove()
@@ -174,8 +248,40 @@ class Image:
         self._save_header()
 
     def snap_remove(self, snap: str) -> None:
+        self._check_writable()
         if snap not in self._header["snaps"]:
             raise RBDError(f"no snap {snap!r}")
+        self._journal_event("snap_remove", arg=snap)
+        self._snap_remove_apply(snap)
+
+    def _snap_remove_apply(self, snap: str) -> None:
         StripedObject(self.io, self._snap_prefix(snap)).remove()
         del self._header["snaps"][snap]
         self._save_header()
+
+    # -- replay-side application (rbd-mirror ImageReplayer) -------------
+    def _apply_event(self, kind: str, offset: int, data: bytes,
+                     arg: str) -> None:
+        """Apply one journal event WITHOUT writability checks or
+        re-journaling — the mirror target's replay path."""
+        if kind == "write":
+            self._data.write(data, offset=offset)
+            if offset + len(data) > self._header["size"]:
+                self._header["size"] = offset + len(data)
+                self._save_header()
+        elif kind == "discard":
+            length = int.from_bytes(data, "little")
+            self._data.write(b"\x00" * length, offset=offset)
+        elif kind == "resize":
+            self._resize_apply(offset)
+        elif kind == "snap_create":
+            if arg not in self._header["snaps"]:
+                self._snap_create_apply(arg)
+        elif kind == "snap_remove":
+            if arg in self._header["snaps"]:
+                self._snap_remove_apply(arg)
+        elif kind == "snap_rollback":
+            if arg in self._header["snaps"]:
+                self._snap_rollback_apply(arg)
+        else:
+            raise RBDError(f"unknown journal event {kind!r}")

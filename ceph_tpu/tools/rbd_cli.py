@@ -9,6 +9,8 @@
     rbd -m HOST:PORT -p POOL export NAME FILE   (or - for stdout)
     rbd -m HOST:PORT -p POOL snap create|rollback|rm NAME SNAP
     rbd -m HOST:PORT -p POOL snap ls NAME
+    rbd -m ADDR -p POOL mirror enable|disable|promote|demote|ls IMG
+    rbd -m ADDR -p SRC mirror sync DSTPOOL
 """
 
 from __future__ import annotations
@@ -39,7 +41,9 @@ def main(argv: list[str] | None = None) -> int:
     try:
         rbd = RBD(client.open_ioctx(pool))
         if cmd == "create":
-            rbd.create(rest[0], int(rest[1]))
+            journaling = "--journaling" in rest
+            rest = [r for r in rest if r != "--journaling"]
+            rbd.create(rest[0], int(rest[1]), journaling=journaling)
         elif cmd == "ls":
             for name in rbd.list():
                 print(name)
@@ -76,6 +80,29 @@ def main(argv: list[str] | None = None) -> int:
                     print(s)
             else:
                 print(f"unknown snap command {sub!r}", file=sys.stderr)
+                return 22
+        elif cmd == "mirror":
+            from ceph_tpu.services import rbd_mirror as rm
+            sub = rest[0]
+            if sub == "enable":
+                rm.mirror_image_enable(rbd.io, rest[1])
+            elif sub == "disable":
+                rm.mirror_image_disable(rbd.io, rest[1])
+            elif sub == "promote":
+                rm.promote(rbd.io, rest[1])
+            elif sub == "demote":
+                rm.demote(rbd.io, rest[1])
+            elif sub == "ls":
+                for name in rm.mirror_images(rbd.io):
+                    print(name)
+            elif sub == "sync":
+                # one-shot pool replication: rbd ... mirror sync DSTPOOL
+                dst = client.open_ioctx(rest[1])
+                out = rm.MirrorDaemon(rbd.io, dst).sync_once()
+                print(json.dumps(out, sort_keys=True))
+            else:
+                print(f"unknown mirror command {sub!r}",
+                      file=sys.stderr)
                 return 22
         else:
             print(f"unknown command {cmd!r}", file=sys.stderr)

@@ -1,0 +1,172 @@
+"""RBD journaling + rbd-mirror-lite (src/journal/ + rbd_mirror roles)."""
+
+import os
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.services.journal import SPLAY, JournalError, Journaler
+from ceph_tpu.services.rbd import RBD, Image, RBDError
+from ceph_tpu.services import rbd_mirror
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        c.create_pool("src", pg_num=4, size=2)
+        c.create_pool("dst", pg_num=4, size=2)
+        yield c
+
+
+@pytest.fixture
+def ios(cluster):
+    rados = cluster.client()
+    return rados.open_ioctx("src"), rados.open_ioctx("dst")
+
+
+def test_journaler_append_read_commit_trim(ios):
+    io, _ = ios
+    j = Journaler(io, "t1")
+    j.create()
+    n = SPLAY * 2 + 10
+    for i in range(n):
+        assert j.append(f"e{i}".encode()) == i
+    assert j.end_position() == n
+    got = list(j.read_from(0))
+    assert [p for p, _ in got] == list(range(n))
+    assert got[SPLAY][1] == f"e{SPLAY}".encode()
+    # partial tail read
+    assert [p for p, _ in j.read_from(n - 3)] == [n - 3, n - 2, n - 1]
+    # commit + trim drops fully-consumed chunks
+    j.commit("a", SPLAY + 5)
+    j.commit("b", n)
+    assert j.trim() == SPLAY          # floor = min(clients) chunk
+    assert [p for p, _ in j.read_from(SPLAY)][0] == SPLAY
+    with pytest.raises(JournalError):
+        list(j.read_from(0))          # below the trim floor
+
+
+def test_journaled_image_writes_events(ios):
+    io, _ = ios
+    rbd = RBD(io)
+    img = rbd.create("jimg", 1 << 20, journaling=True)
+    img.write(0, b"abc")
+    img.resize(2 << 20)
+    img.snap_create("s1")
+    events = [Image.decode_event(p)[0]
+              for _, p in img.journal.read_from(0)]
+    assert events == ["write", "resize", "snap_create"]
+    kind, off, data, _ = Image.decode_event(
+        next(iter(img.journal.read_from(0)))[1])
+    assert (kind, off, data) == ("write", 0, b"abc")
+
+
+def test_mirror_bootstrap_and_incremental_replay(ios):
+    src_io, dst_io = ios
+    rbd = RBD(src_io)
+    img = rbd.create("mimg", 1 << 20, journaling=True)
+    img.write(0, os.urandom(8000))
+    img.write(500_000, b"hello-mirror")
+    rbd_mirror.mirror_image_enable(src_io, "mimg")
+
+    daemon = rbd_mirror.MirrorDaemon(src_io, dst_io)
+    out = daemon.sync_once()
+    assert out["mimg"] >= 0
+    dst = Image(dst_io, "mimg")
+    assert dst.read(0, 1 << 20) == img.read(0, 1 << 20)
+    assert not dst.is_primary()
+    # target refuses client writes
+    with pytest.raises(RBDError):
+        dst.write(0, b"nope")
+
+    # incremental: new writes + a snapshot + resize replay over
+    img.write(100_000, os.urandom(4096))
+    img.snap_create("s1")
+    img.resize(3 << 20)
+    img.write((2 << 20) + 5, b"tail")
+    applied = daemon.sync_once()["mimg"]
+    assert applied == 4
+    dst = Image(dst_io, "mimg")
+    assert dst.size() == 3 << 20
+    assert dst.read(0, 3 << 20) == img.read(0, 3 << 20)
+    assert dst.snap_list() == ["s1"]
+    # replay is idempotent: nothing new -> nothing applied
+    assert daemon.sync_once()["mimg"] == 0
+
+
+def test_mirror_failover_promote(ios):
+    src_io, dst_io = ios
+    rbd = RBD(src_io)
+    img = rbd.create("fimg", 1 << 20, journaling=True)
+    img.write(0, b"primary-data")
+    rbd_mirror.mirror_image_enable(src_io, "fimg")
+    rbd_mirror.MirrorDaemon(src_io, dst_io).sync_once()
+    # site failover: demote source, promote target
+    rbd_mirror.demote(src_io, "fimg")
+    rbd_mirror.promote(dst_io, "fimg")
+    with pytest.raises(RBDError):
+        Image(src_io, "fimg").write(0, b"x")
+    dst = Image(dst_io, "fimg")
+    dst.write(0, b"failover")
+    assert dst.read(0, 8) == b"failover"
+
+
+def test_mirror_daemon_background(ios):
+    import time
+    src_io, dst_io = ios
+    rbd = RBD(src_io)
+    img = rbd.create("bimg", 1 << 20, journaling=True)
+    rbd_mirror.mirror_image_enable(src_io, "bimg")
+    daemon = rbd_mirror.MirrorDaemon(src_io, dst_io,
+                                     interval=0.05).start()
+    try:
+        img.write(0, b"background-sync")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if Image(dst_io, "bimg").read(0, 15) == \
+                        b"background-sync":
+                    break
+            except RBDError:
+                pass
+            time.sleep(0.05)
+        else:
+            raise AssertionError("daemon never replicated the write")
+    finally:
+        daemon.stop()
+
+
+def test_bootstrap_copies_snapshot_content_not_current(ios):
+    """Regression: the dst snapshot must hold the SOURCE snapshot's
+    point-in-time bytes, so a replayed snap_rollback converges both
+    sides (re-snapshotting dst's current content diverged them)."""
+    src_io, dst_io = ios
+    rbd = RBD(src_io)
+    img = rbd.create("simg", 1 << 20, journaling=True)
+    img.write(0, b"AAAA-original")
+    img.snap_create("pit")
+    img.write(0, b"BBBB-newer---")
+    rbd_mirror.mirror_image_enable(src_io, "simg")
+    daemon = rbd_mirror.MirrorDaemon(src_io, dst_io)
+    daemon.sync_once()
+    # rollback on the source, replay the event
+    img.snap_rollback("pit")
+    daemon.sync_once()
+    dst = Image(dst_io, "simg")
+    assert img.read(0, 13) == b"AAAA-original"
+    assert dst.read(0, 13) == b"AAAA-original", \
+        "dst snapshot held post-snap content"
+
+
+def test_removed_source_image_is_pruned(ios):
+    src_io, dst_io = ios
+    rbd = RBD(src_io)
+    rbd.create("gone", 1 << 16, journaling=True)
+    rbd_mirror.mirror_image_enable(src_io, "gone")
+    rbd.remove("gone")
+    daemon = rbd_mirror.MirrorDaemon(src_io, dst_io)
+    out = daemon.sync_once()
+    assert out["gone"] == -1
+    assert "gone" not in rbd_mirror.mirror_images(src_io)
+    # pruned: never retried (other module-scope images may still sync)
+    assert "gone" not in daemon.sync_once()
