@@ -53,6 +53,16 @@ class Journaler:
         return f"{self.header_oid}.client.{client}"
 
     @property
+    def _registry_oid(self) -> str:
+        return f"{self.header_oid}.clients"
+
+    def _registry(self) -> list[str]:
+        try:
+            return json.loads(self.io.read(self._registry_oid))
+        except Exception:
+            return []
+
+    @property
     def _trim_oid(self) -> str:
         return f"{self.header_oid}.trimmed"
 
@@ -82,13 +92,16 @@ class Journaler:
                 self.io.remove(self._chunk_oid(chunk))
             except Exception:
                 pass
-        for oid in list(self.io.list_objects()):
-            if oid.startswith(f"{self.header_oid}.client.") or \
-                    oid == self._trim_oid:
-                try:
-                    self.io.remove(oid)
-                except Exception:
-                    pass
+        for client in self._registry():
+            try:
+                self.io.remove(self._client_oid(client))
+            except Exception:
+                pass
+        for oid in (self._registry_oid, self._trim_oid):
+            try:
+                self.io.remove(oid)
+            except Exception:
+                pass
         self.io.remove(self.header_oid)
 
     def _chunk_oid(self, chunk: int) -> str:
@@ -139,7 +152,13 @@ class Journaler:
     def commit(self, client: str, pos: int) -> None:
         """Advance (monotonically) this client's commit position. Each
         client owns its position object — no shared header RMW with
-        the writer's append path."""
+        the writer's append path. First commit registers the client id
+        (registry RMW happens once per client, not per commit)."""
+        reg = self._registry()
+        if client not in reg:
+            reg.append(client)
+            self.io.write_full(self._registry_oid,
+                               json.dumps(sorted(reg)).encode())
         pos = max(pos, self.committed(client))
         self.io.write_full(self._client_oid(client),
                            pos.to_bytes(8, "little"))
@@ -152,13 +171,7 @@ class Journaler:
             return 0
 
     def clients(self) -> dict[str, int]:
-        prefix = f"{self.header_oid}.client."
-        out = {}
-        for oid in self.io.list_objects():
-            if oid.startswith(prefix):
-                out[oid[len(prefix):]] = int.from_bytes(
-                    self.io.read(oid), "little")
-        return out
+        return {c: self.committed(c) for c in self._registry()}
 
     def trim(self) -> int:
         """Remove chunk objects every registered client has fully

@@ -17,7 +17,7 @@ import json
 import threading
 import time
 
-from ceph_tpu.services.journal import Journaler
+from ceph_tpu.services.journal import JournalError, Journaler
 from ceph_tpu.services.rbd import RBD, Image, RBDError
 from ceph_tpu.utils.dout import Dout
 
@@ -78,8 +78,12 @@ class ImageReplayer:
         may replay again — every event is idempotent against content
         that already includes it (writes/resizes rewrite the same
         bytes, snap events check existence)."""
-        src = Image(self.src_io, self.name)
+        # pos0 FIRST: any mutation after this position replays; the
+        # header/content copied below may already include some of
+        # those events (replay is idempotent), but an event between a
+        # header load and a later pos0 would be lost on both sides
         pos0 = self.journal.end_position()
+        src = Image(self.src_io, self.name)
         rbd_dst = RBD(self.dst_io)
         if self.name not in rbd_dst.list():
             rbd_dst.create(self.name, src.size(),
@@ -157,8 +161,9 @@ class MirrorDaemon:
                 out[name] = ImageReplayer(
                     self.src_io, self.dst_io, name,
                     self.client_id).sync()
-            except RBDError as exc:
-                if "no such image" in str(exc):
+            except (RBDError, JournalError) as exc:
+                if "no such image" in str(exc) or \
+                        "no journal" in str(exc):
                     # source image removed while still registered:
                     # prune, or every pass fails for it forever
                     log(1, f"rbd-mirror: pruning removed {name!r}")

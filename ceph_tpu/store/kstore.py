@@ -1,0 +1,327 @@
+"""KStore — object store entirely inside the key-value DB.
+
+Role of src/os/kstore/: everything (data, attrs, omap) lives as kv
+records — no separate data file or allocator. Simpler and slower than
+BlueStore for big objects, but a distinct durability/layout point the
+reference ships; here it exercises the same ``KeyValueDB`` the
+blockstore uses for metadata (src/kv/ role), with object data chunked
+into fixed-size stripe records (kstore_default_stripe_size).
+
+Key layout (all under one namespace per collection):
+    C/<cid>                      collection marker
+    O/<cid>/<oid>                object meta {size}
+    D/<cid>/<oid>/<n:08x>        data stripe n
+    A/<cid>/<oid>/<name>         attr
+    M/<cid>/<oid>/<key>          omap
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable
+
+from ceph_tpu.store import object_store as osr
+from ceph_tpu.store.kv import FileDB, MemDB, WriteBatch
+from ceph_tpu.store.object_store import (
+    EIOError,
+    NoSuchCollection,
+    NoSuchObject,
+    ObjectStore,
+    Transaction,
+)
+
+#: data stripe record size (kstore_default_stripe_size is 64K in the
+#: reference; smaller here keeps partial-write RMW cheap in tests)
+STRIPE = 65536
+
+
+class KStore(ObjectStore):
+    def __init__(self, path: str | None = None) -> None:
+        self._path = path
+        self._db = None
+        self._lock = threading.RLock()
+        self._eio: set[tuple[str, str]] = set()
+
+    # -- lifecycle ----------------------------------------------------
+    def mount(self) -> None:
+        self._db = FileDB(self._path) if self._path else MemDB()
+
+    def umount(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+
+    # -- key helpers --------------------------------------------------
+    @staticmethod
+    def _meta_key(cid: str, oid: str) -> str:
+        return f"O/{cid}/{oid}"
+
+    @staticmethod
+    def _data_key(cid: str, oid: str, n: int) -> str:
+        return f"D/{cid}/{oid}/{n:08x}"
+
+    def _meta(self, cid: str, oid: str) -> dict:
+        if self._db.get(f"C/{cid}") is None:
+            raise NoSuchCollection(cid)
+        raw = self._db.get(self._meta_key(cid, oid))
+        if raw is None:
+            raise NoSuchObject(f"{cid}/{oid}")
+        return json.loads(raw)
+
+    # -- transactions -------------------------------------------------
+    def _validate(self, txn: Transaction) -> None:
+        """All-or-nothing (memstore._validate semantics): reject the
+        whole txn before staging anything. Point lookups only — a txn
+        must not cost a scan of the whole keyspace."""
+        made, gone = set(), set()            # txn-local deltas
+        obj_made, obj_gone = set(), set()
+
+        def coll_exists(cid: str) -> bool:
+            if cid in made:
+                return True
+            if cid in gone:
+                return False
+            return self._db.get(f"C/{cid}") is not None
+
+        def obj_exists(cid: str, oid: str) -> bool:
+            if (cid, oid) in obj_made:
+                return True
+            if (cid, oid) in obj_gone or cid in gone:
+                return False
+            return self._db.get(self._meta_key(cid, oid)) is not None
+
+        for op in txn.ops:
+            code = op[0]
+            if code == osr.OP_MKCOLL:
+                made.add(op[1])
+                gone.discard(op[1])
+            elif code == osr.OP_RMCOLL:
+                gone.add(op[1])
+                made.discard(op[1])
+                obj_made = {k for k in obj_made if k[0] != op[1]}
+            else:
+                cid, oid = op[1], op[2]
+                if not coll_exists(cid):
+                    raise NoSuchCollection(cid)
+                if code in (osr.OP_RMATTR, osr.OP_OMAP_RM) and \
+                        not obj_exists(cid, oid):
+                    raise NoSuchObject(f"{cid}/{oid}")
+                if code == osr.OP_REMOVE:
+                    obj_gone.add((cid, oid))
+                    obj_made.discard((cid, oid))
+                else:
+                    obj_made.add((cid, oid))
+                    obj_gone.discard((cid, oid))
+
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Callable[[], None] | None = None
+                          ) -> None:
+        assert self._db is not None, "not mounted"
+        with self._lock:
+            self._validate(txn)
+            batch = WriteBatch()
+            for op in txn.ops:
+                self._apply_op(batch, op)
+            self._db.submit(batch, sync=True)
+        if on_commit:
+            on_commit()
+
+    def _apply_op(self, batch: WriteBatch, op: tuple) -> None:
+        code = op[0]
+        if code == osr.OP_MKCOLL:
+            batch.put(f"C/{op[1]}", b"1")
+        elif code == osr.OP_RMCOLL:
+            cid = op[1]
+            prefixes = (f"O/{cid}/", f"D/{cid}/", f"A/{cid}/",
+                        f"M/{cid}/")
+            # earlier ops in THIS txn under the collection must not
+            # survive (a same-txn ghost write would resurrect)
+            batch.ops = [
+                (kind, k, v) for kind, k, v in batch.ops
+                if not (k == f"C/{cid}" or k.startswith(prefixes))]
+            for key, _ in list(self._db.iterate("")):
+                if key == f"C/{cid}" or key.startswith(prefixes):
+                    batch.delete(key)
+        elif code == osr.OP_TOUCH:
+            cid, oid = op[1], op[2]
+            if self._pending_get(batch,
+                                 self._meta_key(cid, oid)) is None:
+                batch.put(self._meta_key(cid, oid),
+                          json.dumps({"size": 0}).encode())
+        elif code == osr.OP_WRITE:
+            self._write(batch, op[1], op[2], op[3], op[4])
+        elif code == osr.OP_ZERO:
+            self._write(batch, op[1], op[2], op[3], b"\x00" * op[4])
+        elif code == osr.OP_TRUNCATE:
+            self._truncate(batch, op[1], op[2], op[3])
+        elif code == osr.OP_REMOVE:
+            cid, oid = op[1], op[2]
+            meta = self._pending_get(batch, self._meta_key(cid, oid))
+            if meta is not None:
+                size = json.loads(meta)["size"]
+                for n in range(-(-size // STRIPE)):
+                    batch.delete(self._data_key(cid, oid, n))
+            # drop same-txn pending records too (a ghost attr/omap put
+            # earlier in this txn must not survive the remove)
+            prefixes = (f"A/{cid}/{oid}/", f"M/{cid}/{oid}/",
+                        f"D/{cid}/{oid}/")
+            batch.ops = [
+                (kind, k, v) for kind, k, v in batch.ops
+                if not k.startswith(prefixes)]
+            for key, _ in list(self._db.iterate(f"A/{cid}/{oid}/")):
+                batch.delete(key)
+            for key, _ in list(self._db.iterate(f"M/{cid}/{oid}/")):
+                batch.delete(key)
+            batch.delete(self._meta_key(cid, oid))
+            # a rewrite replaces the data; injected read errors do not
+            # survive it (memstore/blockstore semantics)
+            self._eio.discard((cid, oid))
+        elif code == osr.OP_SETATTR:
+            self._ensure_obj(batch, op[1], op[2])
+            batch.put(f"A/{op[1]}/{op[2]}/{op[3]}", op[4])
+        elif code == osr.OP_RMATTR:
+            batch.delete(f"A/{op[1]}/{op[2]}/{op[3]}")
+        elif code == osr.OP_OMAP_SET:
+            self._ensure_obj(batch, op[1], op[2])
+            for k, v in op[3].items():
+                batch.put(f"M/{op[1]}/{op[2]}/{k}", v)
+        elif code == osr.OP_OMAP_RM:
+            for k in op[3]:
+                batch.delete(f"M/{op[1]}/{op[2]}/{k}")
+        elif code == osr.OP_OMAP_RMRANGE:
+            for key, _ in list(self._db.iterate(
+                    f"M/{op[1]}/{op[2]}/{op[3]}")):
+                batch.delete(key)
+        else:
+            raise ValueError(f"kstore: unknown op {code}")
+
+    def _ensure_obj(self, batch: WriteBatch, cid: str,
+                    oid: str) -> None:
+        """setattr/omap on a fresh oid creates the object (memstore
+        _get_or_create / blockstore load(create=True) semantics)."""
+        if self._pending_get(batch, self._meta_key(cid, oid)) is None:
+            batch.put(self._meta_key(cid, oid),
+                      json.dumps({"size": 0}).encode())
+
+    def _pending_get(self, batch: WriteBatch, key: str) -> bytes | None:
+        """Value as the batch would leave it: later ops in one
+        transaction must see earlier ops' writes (txn atomicity)."""
+        for kind, k, v in reversed(batch.ops):
+            if k == key:
+                return v if kind == 1 else None
+        return self._db.get(key)
+
+    def _stripe_get(self, batch: WriteBatch, cid: str, oid: str,
+                    n: int) -> bytes:
+        return self._pending_get(batch,
+                                 self._data_key(cid, oid, n)) or b""
+
+    def _write(self, batch: WriteBatch, cid: str, oid: str,
+               off: int, data: bytes) -> None:
+        raw = self._pending_get(batch, self._meta_key(cid, oid))
+        meta = json.loads(raw) if raw is not None else {"size": 0}
+        end = off + len(data)
+        pos = off
+        while pos < end:
+            n = pos // STRIPE
+            s_off = pos - n * STRIPE
+            take = min(STRIPE - s_off, end - pos)
+            stripe = bytearray(self._stripe_get(batch, cid, oid, n))
+            if len(stripe) < s_off + take:
+                stripe.extend(b"\x00" * (s_off + take - len(stripe)))
+            stripe[s_off:s_off + take] = data[pos - off:pos - off + take]
+            batch.put(self._data_key(cid, oid, n), bytes(stripe))
+            pos += take
+        meta["size"] = max(meta["size"], end)
+        batch.put(self._meta_key(cid, oid), json.dumps(meta).encode())
+
+    def _truncate(self, batch: WriteBatch, cid: str, oid: str,
+                  size: int) -> None:
+        raw = self._pending_get(batch, self._meta_key(cid, oid))
+        meta = json.loads(raw) if raw is not None else {"size": 0}
+        old = meta["size"]
+        if size < old:
+            first_gone = -(-size // STRIPE)
+            for n in range(first_gone, -(-old // STRIPE)):
+                batch.delete(self._data_key(cid, oid, n))
+            if size % STRIPE:
+                n = size // STRIPE
+                stripe = self._stripe_get(batch, cid, oid, n)
+                batch.put(self._data_key(cid, oid, n),
+                          stripe[:size % STRIPE])
+        meta["size"] = size
+        batch.put(self._meta_key(cid, oid), json.dumps(meta).encode())
+
+    # -- reads --------------------------------------------------------
+    def read(self, cid: str, oid: str, off: int = 0,
+             length: int | None = None) -> bytes:
+        with self._lock:
+            if (cid, oid) in self._eio:
+                raise EIOError(f"injected EIO on {cid}/{oid}")
+            meta = self._meta(cid, oid)
+            size = meta["size"]
+            end = size if length is None else min(off + length, size)
+            if end <= off:
+                return b""
+            parts = []
+            pos = off
+            while pos < end:
+                n = pos // STRIPE
+                s_off = pos - n * STRIPE
+                take = min(STRIPE - s_off, end - pos)
+                stripe = self._db.get(self._data_key(cid, oid, n)) \
+                    or b""
+                piece = stripe[s_off:s_off + take]
+                parts.append(piece + b"\x00" * (take - len(piece)))
+                pos += take
+            return b"".join(parts)
+
+    def stat(self, cid: str, oid: str) -> int:
+        with self._lock:
+            return self._meta(cid, oid)["size"]
+
+    def getattr(self, cid: str, oid: str, name: str) -> bytes:
+        with self._lock:
+            self._meta(cid, oid)
+            raw = self._db.get(f"A/{cid}/{oid}/{name}")
+            if raw is None:
+                raise NoSuchObject(f"no attr {name} on {cid}/{oid}")
+            return raw
+
+    def getattrs(self, cid: str, oid: str) -> dict[str, bytes]:
+        with self._lock:
+            self._meta(cid, oid)
+            prefix = f"A/{cid}/{oid}/"
+            return {k[len(prefix):]: v
+                    for k, v in self._db.iterate(prefix)}
+
+    def omap_get(self, cid: str, oid: str) -> dict[str, bytes]:
+        with self._lock:
+            self._meta(cid, oid)
+            prefix = f"M/{cid}/{oid}/"
+            return {k[len(prefix):]: v
+                    for k, v in self._db.iterate(prefix)}
+
+    def list_collections(self) -> list[str]:
+        with self._lock:
+            return sorted(k[2:] for k, _ in self._db.iterate("C/"))
+
+    def list_objects(self, cid: str) -> list[str]:
+        with self._lock:
+            if self._db.get(f"C/{cid}") is None:
+                raise NoSuchCollection(cid)
+            prefix = f"O/{cid}/"
+            return sorted(k[len(prefix):]
+                          for k, _ in self._db.iterate(prefix))
+
+    def exists(self, cid: str, oid: str) -> bool:
+        with self._lock:
+            return self._db.get(self._meta_key(cid, oid)) is not None
+
+    # -- fault injection ----------------------------------------------
+    def inject_data_error(self, cid: str, oid: str) -> None:
+        self._eio.add((cid, oid))
+
+    def clear_data_error(self, cid: str, oid: str) -> None:
+        self._eio.discard((cid, oid))

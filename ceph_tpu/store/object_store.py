@@ -225,6 +225,7 @@ class ObjectStore:
 def create_store(kind: str, path: str | None = None) -> ObjectStore:
     """Factory (ObjectStore::create role, src/os/ObjectStore.cc:62-95)."""
     from ceph_tpu.store.blockstore import BlockStore
+    from ceph_tpu.store.kstore import KStore
     from ceph_tpu.store.memstore import MemStore
     if kind == "memstore":
         return MemStore()
@@ -232,4 +233,6 @@ def create_store(kind: str, path: str | None = None) -> ObjectStore:
         if path is None:
             raise ValueError("blockstore requires a path")
         return BlockStore(path)
+    if kind == "kstore":
+        return KStore(path)          # kv-only; path optional (MemDB)
     raise ValueError(f"unknown store kind {kind!r}")

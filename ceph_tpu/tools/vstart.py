@@ -23,7 +23,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="vstart")
     ap.add_argument("-n", "--n-osds", type=int, default=3)
     ap.add_argument("--store", default="memstore",
-                    choices=("memstore", "blockstore"))
+                    choices=("memstore", "blockstore", "kstore"))
     ap.add_argument("--data", default=None,
                     help="data dir (blockstore)")
     ap.add_argument("--ec", default=None, metavar="K,M",

@@ -19,7 +19,7 @@ from ceph_tpu.store.kv import FileDB, WriteBatch
 from ceph_tpu.store.object_store import NoSuchCollection, NoSuchObject
 
 
-@pytest.fixture(params=["memstore", "blockstore"])
+@pytest.fixture(params=["memstore", "blockstore", "kstore"])
 def store(request, tmp_path):
     s = create_store(request.param, str(tmp_path / "store"))
     s.mount()
@@ -237,3 +237,37 @@ def test_filedb_compact_and_iterate(tmp_path):
     db2 = FileDB(str(tmp_path / "db"))
     assert db2.get("b/1") == b"z"
     db2.close()
+
+
+def test_kstore_remount_preserves_state(tmp_path):
+    """kv-only store durability: data/attrs/omap survive remount via
+    the FileDB log (src/os/kstore role)."""
+    from ceph_tpu.store.kstore import STRIPE
+    path = str(tmp_path / "ks")
+    s = create_store("kstore", path)
+    s.mount()
+    t = Transaction()
+    t.create_collection(CID)
+    t.touch(CID, "o")
+    big = bytes(range(256)) * ((STRIPE * 2 + 999) // 256)
+    t.write(CID, "o", 0, big)                 # spans 3 stripe records
+    t.setattr(CID, "o", "v", b"\x07")
+    t.omap_set(CID, "o", {"k": b"v"})
+    done = []
+    s.queue_transaction(t, on_commit=lambda: done.append(1))
+    assert done
+    # partial overwrite + truncate in one txn sees its own writes
+    t2 = Transaction()
+    t2.write(CID, "o", STRIPE - 10, b"X" * 20)
+    t2.truncate(CID, "o", STRIPE + 5)
+    s.queue_transaction(t2)
+    expect = bytearray(big[:STRIPE + 5])
+    expect[STRIPE - 10:STRIPE + 5] = b"X" * 15
+    assert s.read(CID, "o") == bytes(expect)
+    s.umount()
+    s2 = create_store("kstore", path)
+    s2.mount()
+    assert s2.read(CID, "o") == bytes(expect)
+    assert s2.getattr(CID, "o", "v") == b"\x07"
+    assert s2.omap_get(CID, "o") == {"k": b"v"}
+    s2.umount()
