@@ -225,6 +225,14 @@ class MPaxosPull(Message):
     FIELDS = [("rank", "i32"), ("from_version", "u64")]
 
 
+class MPaxosCommitAck(Message):
+    """Peon -> leader: commit ``version`` is durable here (the Paxos
+    accept ack; commands are answered only once a majority holds the
+    commit)."""
+    MSG_TYPE = 44
+    FIELDS = [("version", "u64"), ("rank", "i32")]
+
+
 # -- auth (MAuth / cephx ticket grant, src/auth role) ------------------
 
 class MAuth(Message):

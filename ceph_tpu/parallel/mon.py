@@ -13,12 +13,16 @@ commands) and ConfigMonitor. Collapsed here to one daemon class with:
     reference's lowest-rank-wins election, progress-first like raft's
     log check), ONLY the leader mutates state, commits replicate to
     peons as full-state snapshots, lagging mons catch up by pulling,
-    and clients are redirected/forwarded to the leader. Reduction vs
-    real Paxos: the leader does not await majority acks before
-    acking a command, so a leader that dies within a replication
-    round-trip of a commit can lose it (documented paxos-lite
-    caveat); a partitioned minority leader's commits are superseded
-    by the majority side's more-advanced log on heal.
+    and clients are redirected/forwarded to the leader. Mutating
+    commands are answered only once a MAJORITY of the monmap has
+    acked the commit (MPaxosCommitAck — the Paxos accept phase), so
+    a leader dying inside one replication round trip cannot have
+    acked a commit the survivors lack; unacked commands time out
+    with -110 after mon_commit_timeout. Remaining reduction vs real
+    Paxos: commits replicate as full-state snapshots (no per-value
+    log/lease machinery), and a partitioned minority leader's
+    commits are superseded by the majority side's more-advanced log
+    on heal.
   - OSDMonitor logic: MOSDBoot marks OSDs up (new epoch), failure
     reports and beacon-timeout mark them down (OSDMap epochs move
     forward only), pool/EC-profile commands validated by actually
@@ -85,6 +89,15 @@ class Monitor:
             f"mon.{name}", g_conf()["admin_socket_dir"] or None)
         self._tick_stop = threading.Event()
         self._tick_thread: threading.Thread | None = None
+        # version -> {"acks": set[rank], "cbs": [fn], "ts": float} —
+        # commands are answered only when a majority of the monmap
+        # holds the commit (Paxos accept acks; single-mon = immediate)
+        self._pending_commits: dict[int, dict] = {}
+        # (client, tid) -> executed command state: a client retry of a
+        # deferred/lost reply must attach to the ORIGINAL execution,
+        # never re-run the mutation (the reference's session dedup)
+        from ceph_tpu.utils.lru import BoundedLRU
+        self._cmd_dedup: BoundedLRU = BoundedLRU(1024)
         self._replay()
 
     # -- lifecycle ----------------------------------------------------
@@ -168,11 +181,15 @@ class Monitor:
         self.db.submit(batch, sync=True)
         log(10, f"committed version {version} (epoch {self.osdmap.epoch})")
         self._publish()
+        if len(self.monmap) > 1:
+            self._pending_commits[version] = {
+                "acks": {self.rank}, "cbs": [], "ts": time.monotonic()}
         for rank, addr in self.monmap.items():
             if rank != self.rank:
                 self.msgr.send_message(
                     M.MPaxosCommit(version=version, state=state,
                                    rank=self.rank), addr)
+        return version
 
     # -- quorum (Paxos/Elector roles) ---------------------------------
     def is_leader(self) -> bool:
@@ -282,6 +299,45 @@ class Monitor:
             conn.send_message(msg)
 
     # -- dispatch -----------------------------------------------------
+    def _majority(self) -> int:
+        return len(self.monmap) // 2 + 1
+
+    def _on_commit_ack(self, version: int, rank: int) -> None:
+        """Acks are cumulative (states are full snapshots): rank
+        acking V holds every commit <= V. Fires deferred command
+        replies whose commit reached majority. Caller holds the
+        lock."""
+        for v in sorted(self._pending_commits):
+            if v > version:
+                break
+            pend = self._pending_commits[v]
+            pend["acks"].add(rank)
+            if len(pend["acks"]) >= self._majority():
+                for cb in pend["cbs"]:
+                    cb(True)
+                del self._pending_commits[v]
+
+    def _expire_pending_commits(self, now: float) -> None:
+        timeout = g_conf()["mon_commit_timeout"]
+        for v in [v for v, p in self._pending_commits.items()
+                  if now - p["ts"] > timeout]:
+            pend = self._pending_commits.pop(v)
+            log(1, f"mon.{self.name}: commit v{v} gathered "
+                f"{len(pend['acks'])}/{self._majority()} acks in "
+                f"{timeout}s; failing {len(pend['cbs'])} commands")
+            for cb in pend["cbs"]:
+                cb(False)
+
+    def _defer_until_majority(self, version: int, cb) -> bool:
+        """Register ``cb(acked: bool)`` to fire when ``version`` is
+        majority-held; returns False when it already is (single mon or
+        acks raced ahead). Caller holds the lock."""
+        pend = self._pending_commits.get(version)
+        if pend is None:
+            return False
+        pend["cbs"].append(cb)
+        return True
+
     def _dispatch(self, msg: M.Message, conn: Connection) -> None:
         with self._lock:
             if isinstance(msg, M.MMonHB):
@@ -298,13 +354,24 @@ class Monitor:
                 self._peer_seen[msg.rank] = (time.monotonic(),
                                              msg.version)
                 self._apply_remote_commit(msg)
+                # accept ack: we durably hold everything <= max(ours,
+                # sender's version) now
+                peer = self.monmap.get(msg.rank)
+                if peer is not None and msg.rank != self.rank:
+                    self.msgr.send_message(M.MPaxosCommitAck(
+                        version=self._last_committed(),
+                        rank=self.rank), peer)
+                return
+            if isinstance(msg, M.MPaxosCommitAck):
+                self._on_commit_ack(msg.version, msg.rank)
                 return
             if isinstance(msg, M.MPaxosPull):
                 peer = self.monmap.get(msg.rank)
                 if peer and self._last_committed() > msg.from_version:
                     self.msgr.send_message(M.MPaxosCommit(
                         version=self._last_committed(),
-                        state=self._encode_state()), peer)
+                        state=self._encode_state(),
+                        rank=self.rank), peer)
                 return
             if isinstance(msg, M.MAuth):
                 self._handle_auth(msg, conn)
@@ -342,7 +409,48 @@ class Monitor:
                         outs=f"NOTLEADER {self.leader_addr()}",
                         data=b""))
                     return
+                key = (conn.peer_name, msg.tid)
+                ent = self._cmd_dedup.get(key)
+                if ent is not None:
+                    if ent["state"] == "done":
+                        code, outs, data = ent["reply"]
+                        conn.send_message(M.MMonCommandReply(
+                            tid=msg.tid, code=code, outs=outs,
+                            data=data))
+                    else:          # still awaiting majority: attach
+                        ent["conns"].append((conn, msg.tid))
+                    return
+                pre = self._last_committed()
                 code, outs, data = self._handle_command(dict(msg.cmd))
+                version = self._last_committed()
+                if code == 0 and version > pre:
+                    # mutating command: answer only once a MAJORITY of
+                    # the monmap durably holds the commit (the real
+                    # Paxos contract — a leader dying inside one
+                    # replication round trip must not have acked)
+                    ent = {"state": "pending",
+                           "reply": (code, outs, data),
+                           "conns": [(conn, msg.tid)]}
+
+                    def reply(acked: bool, ent=ent, v=version,
+                              key=key):
+                        if not acked:
+                            ent["reply"] = (
+                                -110,
+                                f"commit v{v} not acknowledged by a "
+                                "monitor majority", b"")
+                        ent["state"] = "done"
+                        rcode, routs, rdata = ent["reply"]
+                        for c, t in ent.pop("conns", []):
+                            c.send_message(M.MMonCommandReply(
+                                tid=t, code=rcode, outs=routs,
+                                data=rdata))
+                        ent["conns"] = []
+                    if self._defer_until_majority(version, reply):
+                        self._cmd_dedup[key] = ent
+                        return
+                self._cmd_dedup[key] = {"state": "done",
+                                        "reply": (code, outs, data)}
                 conn.send_message(M.MMonCommandReply(
                     tid=msg.tid, code=code, outs=outs, data=data))
 
@@ -444,6 +552,7 @@ class Monitor:
                         addr=self.addr), addr)
             if len(self.monmap) > 1:
                 self._elect(now)
+            self._expire_pending_commits(now)
             if not self.is_leader():
                 return   # peons never mutate (beacon state flows to
                 # the leader via forwarding)

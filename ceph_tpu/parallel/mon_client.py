@@ -197,10 +197,15 @@ class MonClient:
         deadline = time.monotonic() + timeout
         attempts = max(2 * len(self.mon_addrs), 2)
         per_try = max(timeout / attempts, 0.5)
+        # ONE tid for the logical command, reused across retries: the
+        # mon dedups on (client, tid), so a retry of a command whose
+        # reply is deferred (majority-ack wait) or lost attaches to
+        # the original execution instead of re-running the mutation
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
         while True:
             with self._lock:
-                tid = self._next_tid
-                self._next_tid += 1
                 ent = [threading.Event(), None]
                 self._pending[tid] = ent
             self.msgr.send_message(

@@ -57,10 +57,22 @@ class Journaler:
         return f"{self.header_oid}.clients"
 
     def _registry(self) -> list[str]:
+        """Registered client ids. The registry is a cls_log object:
+        registration appends server-side ATOMICALLY (the method runs
+        under the PG lock on the OSD), so two clients' concurrent
+        first commits cannot lose each other — a lost registration
+        would let trim() drop chunks the missing client still needs."""
         try:
-            return json.loads(self.io.read(self._registry_oid))
+            out = self.io.execute(self._registry_oid, "log", "list",
+                                  b"")
         except Exception:
             return []
+        seen = []
+        for entry in json.loads(out):
+            cid = entry.get("data", "")
+            if cid and cid not in seen:
+                seen.append(cid)
+        return seen
 
     @property
     def _trim_oid(self) -> str:
@@ -154,11 +166,9 @@ class Journaler:
         client owns its position object — no shared header RMW with
         the writer's append path. First commit registers the client id
         (registry RMW happens once per client, not per commit)."""
-        reg = self._registry()
-        if client not in reg:
-            reg.append(client)
-            self.io.write_full(self._registry_oid,
-                               json.dumps(sorted(reg)).encode())
+        if client not in self._registry():
+            self.io.execute(self._registry_oid, "log", "add",
+                            client.encode())
         pos = max(pos, self.committed(client))
         self.io.write_full(self._client_oid(client),
                            pos.to_bytes(8, "little"))

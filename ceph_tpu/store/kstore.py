@@ -13,6 +13,9 @@ Key layout (all under one namespace per collection):
     D/<cid>/<oid>/<n:08x>        data stripe n
     A/<cid>/<oid>/<name>         attr
     M/<cid>/<oid>/<key>          omap
+cid/oid are %%-escaped ('%%' then '/'): an oid containing '/' (rgw
+names objects "<bucket>/<key>") must not make one object's prefix a
+prefix of a sibling's, or prefix delete/iterate would cross objects.
 """
 
 from __future__ import annotations
@@ -54,15 +57,35 @@ class KStore(ObjectStore):
 
     # -- key helpers --------------------------------------------------
     @staticmethod
-    def _meta_key(cid: str, oid: str) -> str:
-        return f"O/{cid}/{oid}"
+    def _esc(part: str) -> str:
+        return part.replace("%", "%25").replace("/", "%2F")
 
-    @staticmethod
-    def _data_key(cid: str, oid: str, n: int) -> str:
-        return f"D/{cid}/{oid}/{n:08x}"
+    @classmethod
+    def _ckey(cls, cid: str) -> str:
+        return f"C/{cls._esc(cid)}"
+
+    @classmethod
+    def _meta_key(cls, cid: str, oid: str) -> str:
+        return f"O/{cls._esc(cid)}/{cls._esc(oid)}"
+
+    @classmethod
+    def _meta_prefix(cls, cid: str) -> str:
+        return f"O/{cls._esc(cid)}/"
+
+    @classmethod
+    def _data_key(cls, cid: str, oid: str, n: int) -> str:
+        return f"D/{cls._esc(cid)}/{cls._esc(oid)}/{n:08x}"
+
+    @classmethod
+    def _attr_prefix(cls, cid: str, oid: str) -> str:
+        return f"A/{cls._esc(cid)}/{cls._esc(oid)}/"
+
+    @classmethod
+    def _omap_prefix(cls, cid: str, oid: str) -> str:
+        return f"M/{cls._esc(cid)}/{cls._esc(oid)}/"
 
     def _meta(self, cid: str, oid: str) -> dict:
-        if self._db.get(f"C/{cid}") is None:
+        if self._db.get(self._ckey(cid)) is None:
             raise NoSuchCollection(cid)
         raw = self._db.get(self._meta_key(cid, oid))
         if raw is None:
@@ -82,7 +105,7 @@ class KStore(ObjectStore):
                 return True
             if cid in gone:
                 return False
-            return self._db.get(f"C/{cid}") is not None
+            return self._db.get(self._ckey(cid)) is not None
 
         def obj_exists(cid: str, oid: str) -> bool:
             if (cid, oid) in obj_made:
@@ -130,25 +153,24 @@ class KStore(ObjectStore):
     def _apply_op(self, batch: WriteBatch, op: tuple) -> None:
         code = op[0]
         if code == osr.OP_MKCOLL:
-            batch.put(f"C/{op[1]}", b"1")
+            batch.put(self._ckey(op[1]), b"1")
         elif code == osr.OP_RMCOLL:
             cid = op[1]
-            prefixes = (f"O/{cid}/", f"D/{cid}/", f"A/{cid}/",
-                        f"M/{cid}/")
+            e = self._esc(cid)
+            prefixes = (f"O/{e}/", f"D/{e}/", f"A/{e}/", f"M/{e}/")
             # earlier ops in THIS txn under the collection must not
             # survive (a same-txn ghost write would resurrect)
             batch.ops = [
                 (kind, k, v) for kind, k, v in batch.ops
-                if not (k == f"C/{cid}" or k.startswith(prefixes))]
-            for key, _ in list(self._db.iterate("")):
-                if key == f"C/{cid}" or key.startswith(prefixes):
+                if not (k == self._ckey(cid) or k.startswith(prefixes))]
+            # per-prefix iteration: rmcoll must cost the collection's
+            # keys, not the whole keyspace
+            for prefix in prefixes:
+                for key, _ in list(self._db.iterate(prefix)):
                     batch.delete(key)
+            batch.delete(self._ckey(cid))
         elif code == osr.OP_TOUCH:
-            cid, oid = op[1], op[2]
-            if self._pending_get(batch,
-                                 self._meta_key(cid, oid)) is None:
-                batch.put(self._meta_key(cid, oid),
-                          json.dumps({"size": 0}).encode())
+            self._ensure_obj(batch, op[1], op[2])
         elif code == osr.OP_WRITE:
             self._write(batch, op[1], op[2], op[3], op[4])
         elif code == osr.OP_ZERO:
@@ -164,14 +186,17 @@ class KStore(ObjectStore):
                     batch.delete(self._data_key(cid, oid, n))
             # drop same-txn pending records too (a ghost attr/omap put
             # earlier in this txn must not survive the remove)
-            prefixes = (f"A/{cid}/{oid}/", f"M/{cid}/{oid}/",
-                        f"D/{cid}/{oid}/")
+            prefixes = (self._attr_prefix(cid, oid),
+                        self._omap_prefix(cid, oid),
+                        f"D/{self._esc(cid)}/{self._esc(oid)}/")
             batch.ops = [
                 (kind, k, v) for kind, k, v in batch.ops
                 if not k.startswith(prefixes)]
-            for key, _ in list(self._db.iterate(f"A/{cid}/{oid}/")):
+            for key, _ in list(self._db.iterate(
+                    self._attr_prefix(cid, oid))):
                 batch.delete(key)
-            for key, _ in list(self._db.iterate(f"M/{cid}/{oid}/")):
+            for key, _ in list(self._db.iterate(
+                    self._omap_prefix(cid, oid))):
                 batch.delete(key)
             batch.delete(self._meta_key(cid, oid))
             # a rewrite replaces the data; injected read errors do not
@@ -179,19 +204,19 @@ class KStore(ObjectStore):
             self._eio.discard((cid, oid))
         elif code == osr.OP_SETATTR:
             self._ensure_obj(batch, op[1], op[2])
-            batch.put(f"A/{op[1]}/{op[2]}/{op[3]}", op[4])
+            batch.put(self._attr_prefix(op[1], op[2]) + op[3], op[4])
         elif code == osr.OP_RMATTR:
-            batch.delete(f"A/{op[1]}/{op[2]}/{op[3]}")
+            batch.delete(self._attr_prefix(op[1], op[2]) + op[3])
         elif code == osr.OP_OMAP_SET:
             self._ensure_obj(batch, op[1], op[2])
             for k, v in op[3].items():
-                batch.put(f"M/{op[1]}/{op[2]}/{k}", v)
+                batch.put(self._omap_prefix(op[1], op[2]) + k, v)
         elif code == osr.OP_OMAP_RM:
             for k in op[3]:
-                batch.delete(f"M/{op[1]}/{op[2]}/{k}")
+                batch.delete(self._omap_prefix(op[1], op[2]) + k)
         elif code == osr.OP_OMAP_RMRANGE:
             for key, _ in list(self._db.iterate(
-                    f"M/{op[1]}/{op[2]}/{op[3]}")):
+                    self._omap_prefix(op[1], op[2]) + op[3])):
                 batch.delete(key)
         else:
             raise ValueError(f"kstore: unknown op {code}")
@@ -284,7 +309,7 @@ class KStore(ObjectStore):
     def getattr(self, cid: str, oid: str, name: str) -> bytes:
         with self._lock:
             self._meta(cid, oid)
-            raw = self._db.get(f"A/{cid}/{oid}/{name}")
+            raw = self._db.get(self._attr_prefix(cid, oid) + name)
             if raw is None:
                 raise NoSuchObject(f"no attr {name} on {cid}/{oid}")
             return raw
@@ -292,27 +317,32 @@ class KStore(ObjectStore):
     def getattrs(self, cid: str, oid: str) -> dict[str, bytes]:
         with self._lock:
             self._meta(cid, oid)
-            prefix = f"A/{cid}/{oid}/"
+            prefix = self._attr_prefix(cid, oid)
             return {k[len(prefix):]: v
                     for k, v in self._db.iterate(prefix)}
 
     def omap_get(self, cid: str, oid: str) -> dict[str, bytes]:
         with self._lock:
             self._meta(cid, oid)
-            prefix = f"M/{cid}/{oid}/"
+            prefix = self._omap_prefix(cid, oid)
             return {k[len(prefix):]: v
                     for k, v in self._db.iterate(prefix)}
 
+    @staticmethod
+    def _unesc(part: str) -> str:
+        return part.replace("%2F", "/").replace("%25", "%")
+
     def list_collections(self) -> list[str]:
         with self._lock:
-            return sorted(k[2:] for k, _ in self._db.iterate("C/"))
+            return sorted(self._unesc(k[2:])
+                          for k, _ in self._db.iterate("C/"))
 
     def list_objects(self, cid: str) -> list[str]:
         with self._lock:
-            if self._db.get(f"C/{cid}") is None:
+            if self._db.get(self._ckey(cid)) is None:
                 raise NoSuchCollection(cid)
-            prefix = f"O/{cid}/"
-            return sorted(k[len(prefix):]
+            prefix = self._meta_prefix(cid)
+            return sorted(self._unesc(k[len(prefix):])
                           for k, _ in self._db.iterate(prefix))
 
     def exists(self, cid: str, oid: str) -> bool:

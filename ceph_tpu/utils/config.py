@@ -225,6 +225,9 @@ for _o in [
            "seconds between peer pings (scaled down from the reference's 6)"),
     Option("osd_heartbeat_grace", float, 4.0, "advanced",
            "seconds before a silent peer is reported failed"),
+    Option("mon_commit_timeout", float, 10.0, "advanced",
+           "fail a command whose commit gathers no majority ack "
+           "within this many seconds"),
     Option("mon_election_timeout", float, 2.0, "advanced",
            "mon election timeout seconds"),
     Option("debug_default_level", int, 1, "advanced",

@@ -92,3 +92,46 @@ def test_quorum_asok_status(fast):
         st = asok_command(cluster.mons[1].asok.path, "quorum_status")
         assert st["rank"] == 1 and st["is_leader"] is False
         assert st["leader"] == 0 and len(st["monmap"]) == 3
+
+
+def test_commit_requires_majority_ack(fast):
+    """A mutating command must not be acked while no monitor majority
+    holds the commit (the Paxos accept contract): with both peons
+    dead, the surviving leader times the command out with -110; after
+    a peon revives, commands succeed again."""
+    conf = g_conf()
+    old_timeout = conf["mon_commit_timeout"]
+    conf.set("mon_commit_timeout", 1.0)
+    try:
+        with MiniCluster(n_osds=2, n_mons=3) as cluster:
+            leader = _wait_leader(cluster)
+            # happy path: majority alive -> command acked
+            code, _, _ = cluster.mon_cmd(prefix="osd pool create",
+                                         pool="q1", pg_num="4",
+                                         size="2")
+            assert code == 0
+            # kill BOTH peons: commits can never reach a majority
+            for rank in list(cluster.mons):
+                if rank != leader.rank:
+                    cluster.kill_mon(rank)
+            t0 = time.monotonic()
+            code, outs, _ = cluster.mon_cmd(prefix="osd pool create",
+                                            pool="q2", pg_num="4",
+                                            size="2")
+            assert code == -110, (code, outs)
+            assert "majority" in outs
+            assert time.monotonic() - t0 >= 0.9  # waited for the ack
+            # revive one peon: majority restored, commands ack again
+            dead = [r for r in (0, 1, 2) if r != leader.rank]
+            cluster.revive_mon(dead[0])
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                code, outs, _ = cluster.mon_cmd(
+                    prefix="osd pool create", pool="q3", pg_num="4",
+                    size="2")
+                if code == 0:
+                    break
+                time.sleep(0.25)
+            assert code == 0, (code, outs)
+    finally:
+        conf.set("mon_commit_timeout", old_timeout)

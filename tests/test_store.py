@@ -271,3 +271,26 @@ def test_kstore_remount_preserves_state(tmp_path):
     assert s2.getattr(CID, "o", "v") == b"\x07"
     assert s2.omap_get(CID, "o") == {"k": b"v"}
     s2.umount()
+
+
+def test_kstore_slash_oids_do_not_cross(tmp_path):
+    """Regression: rgw-style oids containing '/' ('b/k' vs 'b/k/s')
+    must not share key prefixes — removing one object's attrs/omap
+    must not touch the other's."""
+    s = create_store("kstore", str(tmp_path / "ks2"))
+    s.mount()
+    t = Transaction().create_collection(CID)
+    for oid in ("b/k", "b/k/s"):
+        t.touch(CID, oid)
+        t.write(CID, oid, 0, oid.encode())
+        t.setattr(CID, oid, "tag", oid.encode())
+        t.omap_set(CID, oid, {"m": oid.encode()})
+    s.queue_transaction(t)
+    assert sorted(s.list_objects(CID)) == ["b/k", "b/k/s"]
+    assert s.getattrs(CID, "b/k") == {"tag": b"b/k"}
+    s.queue_transaction(Transaction().remove(CID, "b/k"))
+    assert s.list_objects(CID) == ["b/k/s"]
+    assert s.read(CID, "b/k/s") == b"b/k/s"
+    assert s.getattrs(CID, "b/k/s") == {"tag": b"b/k/s"}
+    assert s.omap_get(CID, "b/k/s") == {"m": b"b/k/s"}
+    s.umount()
