@@ -91,8 +91,12 @@ class RadosClient:
     def __init__(self, mon_addr: str, name: str | None = None,
                  auth: tuple[str, bytes] | None = None) -> None:
         if name is None:
+            import uuid
             _client_seq[0] += 1
-            name = f"client.{_client_seq[0]}"
+            # globally unique across processes: the mon dedups commands
+            # on (client name, tid), so two CLI invocations must never
+            # share a name (both would start tids at 1)
+            name = f"client.{uuid.uuid4().hex[:8]}.{_client_seq[0]}"
         self.msgr = Messenger(name)
         self.monc = MonClient(self.msgr, mon_addr)
         self.objecter: Objecter | None = None

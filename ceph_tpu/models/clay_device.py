@@ -1,0 +1,339 @@
+"""Clay layered codec as a staged TPU pipeline.
+
+The linearized flat matrix (models/clay.py) is bit-exact but dense:
+for k=8,m=4 it spends ~20x the necessary FLOPs (density ~5%). The
+layered algorithm itself is MXU/VPU-friendly when expressed over whole
+planes instead of per-sub-chunk host loops:
+
+  - the pairwise coupling transforms (C<->U) are 2x2 GF-constant maps
+    applied elementwise across lanes — VPU work (8 masked XORs per GF
+    constant multiply, fused by XLA);
+  - each plane's MDS solve is ONE small GF matrix multiply batched
+    over (planes-in-level x lanes) — the same bit-sliced MXU matmul
+    every other codec uses;
+  - the score-level ordering of ErasureCodeClay.cc:644-709 becomes a
+    short static chain (<= m+1 stages) inside one jit.
+
+``trace_layered`` symbolically executes the host algorithm's control
+flow (which depends only on (q, t, erased)) and records vectorizable
+op groups; ``build_transform`` compiles them into a jitted function
+``C[q*t, ssc, L] -> C'`` with recovered nodes filled in. Signatures
+are cached, so encode (erased = parity nodes) compiles once per
+profile. Bit-exactness vs the host plane machinery is asserted in
+tests/test_clay_device.py.
+
+Measured (v5e, k=8,m=4,d=11 encode, 64 MiB batches): 4.7 GB/s — the
+score-level chain inherently sweeps the full [q*t, ssc, L] working
+set ~6x per level (permuted gathers + masked selects), so the DENSE
+linearized signature matrix (models/clay.py, one [m*ssc, k*ssc]
+matmul, ~9 GB/s despite 20x FLOP waste) remains the production device
+path; this module is the faithful staged expression of the algorithm,
+kept as the validated alternative and the basis for a future
+plane-blocked kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu.ops import bitmatrix, gf256
+
+
+# -- static trace ------------------------------------------------------
+
+@dataclass
+class LevelOps:
+    """Vectorizable op groups for one score level (all index arrays)."""
+    # phase 1: U for intact nodes
+    ident: list = field(default_factory=list)      # (node, z)
+    pair_a: dict = field(default_factory=dict)     # variant -> [(nxy, z, nsw, zsw)]
+    # per-plane MDS decode of erased U
+    planes: list = field(default_factory=list)     # [z, ...]
+    # phase 2: C for erased nodes
+    ident2: list = field(default_factory=list)     # (node, z)
+    type_c: dict = field(default_factory=dict)     # variant -> [(nxy, z, nsw, zsw)]
+    pair_b: list = field(default_factory=list)     # (nxy, z, nsw, zsw)
+
+
+def trace_layered(codec, erased: frozenset[int]) -> list[LevelOps]:
+    """Replay _decode_layered's control flow (ErasureCodeClay.cc:
+    644-709) recording ops instead of computing bytes. ``erased`` is
+    the PADDED node-id set (virtual/parity fill to m, as the host path
+    builds it)."""
+    q, t = codec.q, codec.t
+    ssc = codec.sub_chunk_no
+    zvecs = [codec.get_plane_vector(z) for z in range(ssc)]
+    order = [sum(1 for i in erased if i % q == zvecs[z][i // q])
+             for z in range(ssc)]
+    max_score = max(order) if erased else 0
+    levels = []
+    for score in range(max_score + 1):
+        ops = LevelOps()
+        planes = [z for z in range(ssc) if order[z] == score]
+        for z in planes:
+            zv = zvecs[z]
+            for y in range(t):
+                for x in range(q):
+                    node_xy = q * y + x
+                    if node_xy in erased:
+                        continue
+                    node_sw = q * y + zv[y]
+                    if zv[y] == x:
+                        ops.ident.append((node_xy, z))
+                    elif zv[y] < x or node_sw in erased:
+                        z_sw = codec._z_sw(z, x, zv[y], y)
+                        variant = 1 if zv[y] > x else 0
+                        ops.pair_a.setdefault(variant, []).append(
+                            (node_xy, z, node_sw, z_sw))
+        ops.planes = planes
+        for z in planes:
+            zv = zvecs[z]
+            for node_xy in sorted(erased):
+                x, y = node_xy % q, node_xy // q
+                node_sw = q * y + zv[y]
+                if zv[y] == x:
+                    ops.ident2.append((node_xy, z))
+                elif node_sw not in erased:
+                    z_sw = codec._z_sw(z, x, zv[y], y)
+                    variant = 1 if zv[y] > x else 0
+                    ops.type_c.setdefault(variant, []).append(
+                        (node_xy, z, node_sw, z_sw))
+                elif zv[y] < x:
+                    z_sw = codec._z_sw(z, x, zv[y], y)
+                    ops.pair_b.append((node_xy, z, node_sw, z_sw))
+        levels.append(ops)
+    return levels
+
+
+# -- pft coefficient extraction ----------------------------------------
+
+def _pft_matrix(codec, want: list[int], known_slots: list[int]
+                ) -> np.ndarray:
+    """2x2 (or 1x2) GF matrix of one pairwise-transform solve, probed
+    from the pft codec (GF-linear)."""
+    rows = []
+    for basis in range(len(known_slots)):
+        known = {s: np.array([1 if i == basis else 0], dtype=np.uint8)
+                 for i, s in enumerate(known_slots)}
+        out = codec.pft.decode_chunks(want, known)
+        rows.append([int(np.asarray(out[w])[0]) for w in want])
+    return np.array(rows, dtype=np.uint8).T   # [len(want), len(known)]
+
+
+def pft_coefficients(codec) -> dict:
+    """All coefficient matrices the trace can reference, per slot
+    variant (slot order (i0,i1,i2,i3) = (1,0,3,2) when zy > x)."""
+    coeffs = {}
+    for variant, slots in ((0, (0, 1, 2, 3)), (1, (1, 0, 3, 2))):
+        i0, i1, i2, i3 = slots
+        # pair_a: (U_xy, U_sw) from (C_xy, C_sw)
+        m = _pft_matrix(codec, [i2, i3], [i0, i1])
+        coeffs[("a", variant)] = m                      # [2, 2]
+        # type_c: C_xy from (C_sw, U_xy)
+        m = _pft_matrix(codec, [i0], [i1, i2])
+        coeffs[("c", variant)] = m                      # [1, 2]
+    # pair_b: (C_xy, C_sw) from (U_xy, U_sw); called with zv[y] < x
+    # only, so slot order is fixed at variant 0
+    coeffs[("b", 0)] = _pft_matrix(codec, [0, 1], [2, 3])
+    return coeffs
+
+
+# -- device execution ---------------------------------------------------
+
+def _gf_scale(x, c: int):
+    """x (*) c over GF(2^8), elementwise, for a static constant c:
+    XOR of up-to-8 masked constant selects (VPU work XLA fuses)."""
+    import jax.numpy as jnp
+    if c == 0:
+        return jnp.zeros_like(x)
+    if c == 1:
+        return x
+    y = None
+    for b in range(8):
+        t = int(gf256.gf_mul(c, 1 << b))
+        if t == 0:
+            continue
+        term = jnp.where((x >> b) & 1 == 1,
+                         jnp.uint8(t), jnp.uint8(0))
+        y = term if y is None else y ^ term
+    return y
+
+
+def _combine2(m: np.ndarray, a, b):
+    """[out0, out1] = m @ [a, b] over GF, m a small host matrix."""
+    outs = []
+    for row in m:
+        acc = _gf_scale(a, int(row[0])) ^ _gf_scale(b, int(row[1]))
+        outs.append(acc)
+    return outs
+
+
+def _varmul_tables(coef: np.ndarray) -> list[tuple[int, np.ndarray]]:
+    """Bit tables for an elementwise multiply by VARYING constants:
+    y[e] = coef[e] (*) x[e] = XOR_b ((x>>b)&1) * gf_mul(coef, 2^b)[e].
+    Returns only the bit planes with a nonzero table."""
+    out = []
+    for b in range(8):
+        tab = gf256.gf_mul(coef, 1 << b)
+        if tab.any():
+            out.append((b, tab))
+    return out
+
+
+def _varmul(x, tables, jnp):
+    """Apply _varmul_tables to x [qt, ssc, L] (tables broadcast over
+    lanes). One fused XOR chain — no scatters, no per-pair gathers."""
+    y = None
+    for b, tab in tables:
+        t = jnp.asarray(tab[:, :, None])
+        term = jnp.where((x >> b) & 1 == 1, t, jnp.uint8(0))
+        y = term if y is None else y ^ term
+    if y is None:
+        return jnp.zeros_like(x)
+    return y
+
+
+def build_transform(codec, erased: frozenset[int]):
+    """Jitted ``C[q*t, ssc, L] uint8 -> C'`` filling erased nodes.
+    ``erased``: padded node-id set, |erased| <= m.
+
+    Executor shape: per level, phase 1 is ONE whole-array masked pass
+    ``U' = sel(mask, a1(*)C + a2(*)C[perm], U)`` (a1/a2/perm are
+    static [qt, ssc] tables), the MDS solve is one bit-sliced matmul
+    over (planes-in-level x lanes), and phase 2 is one more masked
+    pass over C — a handful of fused HBM passes per level instead of
+    per-op-group scatters."""
+    import jax
+    import jax.numpy as jnp
+
+    levels = trace_layered(codec, erased)
+    coeffs = pft_coefficients(codec)
+    qt = codec.q * codec.t
+    ssc = codec.sub_chunk_no
+    intact = [i for i in range(qt) if i not in erased]
+    er = sorted(erased)
+    probe = {i: np.zeros(len(intact), dtype=np.uint8) for i in intact}
+    for idx, i in enumerate(intact):
+        probe[i][idx] = 1
+    sol = codec.mds.decode_chunks(er, probe)
+    dmat = np.stack([np.asarray(sol[i], dtype=np.uint8) for i in er])
+    dbmat = bitmatrix.expand_bitmatrix(dmat).astype(np.int8)
+
+    from ceph_tpu.ops.gf_jax import _bitsliced_matvec_device
+
+    static = []
+    for ops in levels:
+        # phase 1 tables: U[n,z] = a1[n,z](*)C[n,z] ^ a2[n,z](*)C[perm]
+        a1 = np.zeros((qt, ssc), dtype=np.uint8)
+        a2 = np.zeros((qt, ssc), dtype=np.uint8)
+        pn = np.tile(np.arange(qt, dtype=np.int32)[:, None], (1, ssc))
+        pz = np.tile(np.arange(ssc, dtype=np.int32)[None, :], (qt, 1))
+        mask_u = np.zeros((qt, ssc), dtype=bool)
+        for n, z in ops.ident:
+            a1[n, z] = 1
+            mask_u[n, z] = True
+        for v, lst in ops.pair_a.items():
+            m = coeffs[("a", v)]
+            for nxy, z, nsw, zsw in lst:
+                # target (nxy, z): self C + partner C
+                a1[nxy, z], a2[nxy, z] = int(m[0][0]), int(m[0][1])
+                pn[nxy, z], pz[nxy, z] = nsw, zsw
+                mask_u[nxy, z] = True
+                # target (nsw, zsw): its self is C[nsw, zsw]
+                a1[nsw, zsw], a2[nsw, zsw] = int(m[1][1]), int(m[1][0])
+                pn[nsw, zsw], pz[nsw, zsw] = nxy, z
+                mask_u[nsw, zsw] = True
+        # phase 2 tables:
+        #   C[n,z] = b1(*)C[perm2] ^ b2(*)U[n,z] ^ b3(*)U[perm2]
+        b1 = np.zeros((qt, ssc), dtype=np.uint8)
+        b2 = np.zeros((qt, ssc), dtype=np.uint8)
+        b3 = np.zeros((qt, ssc), dtype=np.uint8)
+        p2n = np.tile(np.arange(qt, dtype=np.int32)[:, None],
+                      (1, ssc))
+        p2z = np.tile(np.arange(ssc, dtype=np.int32)[None, :],
+                      (qt, 1))
+        mask_c = np.zeros((qt, ssc), dtype=bool)
+        for n, z in ops.ident2:
+            b2[n, z] = 1
+            mask_c[n, z] = True
+        for v, lst in ops.type_c.items():
+            m = coeffs[("c", v)]
+            for nxy, z, nsw, zsw in lst:
+                b1[nxy, z] = int(m[0][0])
+                b2[nxy, z] = int(m[0][1])
+                p2n[nxy, z], p2z[nxy, z] = nsw, zsw
+                mask_c[nxy, z] = True
+        mb = coeffs[("b", 0)]
+        for nxy, z, nsw, zsw in ops.pair_b:
+            b2[nxy, z], b3[nxy, z] = int(mb[0][0]), int(mb[0][1])
+            p2n[nxy, z], p2z[nxy, z] = nsw, zsw
+            mask_c[nxy, z] = True
+            b2[nsw, zsw], b3[nsw, zsw] = int(mb[1][1]), int(mb[1][0])
+            p2n[nsw, zsw], p2z[nsw, zsw] = nxy, z
+            mask_c[nsw, zsw] = True
+        static.append({
+            "planes": np.asarray(ops.planes, dtype=np.int32),
+            "t_a1": _varmul_tables(a1), "t_a2": _varmul_tables(a2),
+            "perm": (pn, pz), "mask_u": mask_u,
+            "t_b1": _varmul_tables(b1), "t_b2": _varmul_tables(b2),
+            "t_b3": _varmul_tables(b3),
+            "perm2": (p2n, p2z), "mask_c": mask_c,
+        })
+
+    intact_idx = jnp.asarray(np.asarray(intact, dtype=np.int32))
+    er_idx = jnp.asarray(np.asarray(er, dtype=np.int32))
+
+    @jax.jit
+    def transform(c_in):
+        C = c_in
+        U = jnp.zeros_like(C)
+        L = C.shape[-1]
+        for entry in static:
+            # phase 1: one masked whole-array pass
+            pn, pz = entry["perm"]
+            cp = C[jnp.asarray(pn), jnp.asarray(pz)]
+            cand = _varmul(C, entry["t_a1"], jnp) ^ \
+                _varmul(cp, entry["t_a2"], jnp)
+            U = jnp.where(jnp.asarray(entry["mask_u"])[:, :, None],
+                          cand, U)
+            # MDS decode of erased U on this level's planes
+            if len(entry["planes"]):
+                planes = jnp.asarray(entry["planes"])
+                x = U[intact_idx][:, planes, :].reshape(
+                    len(intact), -1)
+                y = _bitsliced_matvec_device(jnp.asarray(dbmat), x)
+                y = y.reshape(len(er), len(entry["planes"]), L)
+                U = U.at[er_idx[:, None], planes[None, :]].set(y)
+            # phase 2: one masked whole-array pass
+            p2n, p2z = entry["perm2"]
+            cp2 = C[jnp.asarray(p2n), jnp.asarray(p2z)]
+            up2 = U[jnp.asarray(p2n), jnp.asarray(p2z)]
+            cand = _varmul(cp2, entry["t_b1"], jnp) ^ \
+                _varmul(U, entry["t_b2"], jnp) ^ \
+                _varmul(up2, entry["t_b3"], jnp)
+            C = jnp.where(jnp.asarray(entry["mask_c"])[:, :, None],
+                          cand, C)
+        return C
+
+    return transform
+
+
+class ClayDeviceCodec:
+    """Per-codec cache of compiled layered transforms, keyed by the
+    padded erased-node signature (bounded: C(k+m, m) signatures exist
+    and each holds a compiled executable)."""
+
+    def __init__(self, codec) -> None:
+        from ceph_tpu.utils.lru import BoundedLRU
+        self.codec = codec
+        self._fns: BoundedLRU = BoundedLRU(64)
+
+    def transform(self, erased: frozenset[int], c_in: np.ndarray):
+        """c_in: [q*t, ssc, L] uint8 (numpy or device array); returns
+        the completed node array (device)."""
+        import jax.numpy as jnp
+        fn = self._fns.get_or_build(
+            erased, lambda: build_transform(self.codec, erased))
+        return fn(jnp.asarray(c_in))

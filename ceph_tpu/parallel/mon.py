@@ -447,10 +447,10 @@ class Monitor:
                                 data=rdata))
                         ent["conns"] = []
                     if self._defer_until_majority(version, reply):
-                        self._cmd_dedup[key] = ent
+                        self._cmd_dedup.put(key, ent)
                         return
-                self._cmd_dedup[key] = {"state": "done",
-                                        "reply": (code, outs, data)}
+                self._cmd_dedup.put(key, {"state": "done",
+                                          "reply": (code, outs, data)})
                 conn.send_message(M.MMonCommandReply(
                     tid=msg.tid, code=code, outs=outs, data=data))
 

@@ -65,11 +65,14 @@ class Journaler:
         try:
             out = self.io.execute(self._registry_oid, "log", "list",
                                   b"")
+            entries = json.loads(out)
         except Exception:
             return []
         seen = []
-        for entry in json.loads(out):
-            cid = entry.get("data", "")
+        for entry in entries:
+            # dict = cls_log entry; tolerate plain strings (a registry
+            # object written by an older format must not crash commit)
+            cid = entry.get("data", "") if isinstance(entry, dict)                 else str(entry)
             if cid and cid not in seen:
                 seen.append(cid)
         return seen

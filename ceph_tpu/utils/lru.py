@@ -26,6 +26,13 @@ class BoundedLRU(OrderedDict):
         super().__init__()
         self.maxsize = maxsize
 
+    def put(self, key, value) -> None:
+        """Bounded insert (plain ``self[key] =`` does NOT evict)."""
+        self[key] = value
+        self.move_to_end(key)
+        if len(self) > self.maxsize:
+            self.popitem(last=False)
+
     def get_or_build(self, key, build: Callable[[], V]) -> V:
         hit = self.get(key)
         if hit is None:
