@@ -1,0 +1,157 @@
+"""dashboard — web status UI (src/pybind/mgr/dashboard role, reduced).
+
+The reference dashboard is a full SPA; this lite module serves one
+self-refreshing HTML page plus the JSON endpoints it reads, straight
+from the mgr's cluster view:
+
+    GET /             HTML overview (health, OSDs, pools, PGs, balancer)
+    GET /api/health   {"status": ...}
+    GET /api/status   full mon status JSON
+    GET /api/osds     per-OSD up/in table
+    GET /api/pools    pool table (type, pg_num, size)
+
+Commands: ``dashboard status|on|off`` over the mgr asok; ``on`` binds
+an ephemeral port (reported by status) on 127.0.0.1.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ceph_tpu.mgr.mgr_module import MgrModule
+
+_PAGE = """<!doctype html>
+<html><head><title>ceph_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; margin: 1em 0; }}
+ td, th {{ border: 1px solid #999; padding: 0.3em 0.8em; }}
+ .ok {{ color: #070; }} .warn {{ color: #b50; }}
+</style></head><body>
+<h2>ceph_tpu cluster</h2>
+<p class="{hclass}">{health}</p>
+<h3>osds ({n_up}/{n_osds} up, {n_in} in)</h3>
+<table><tr><th>osd</th><th>up</th><th>in</th></tr>{osd_rows}</table>
+<h3>pools</h3>
+<table><tr><th>pool</th><th>type</th><th>pg_num</th><th>size</th></tr>
+{pool_rows}</table>
+<h3>pgs</h3><p>{pgs}</p>
+<h3>balancer</h3><p>{balancer}</p>
+</body></html>"""
+
+
+class Module(MgrModule):
+    NAME = "dashboard"
+
+    COMMANDS = ("status", "on", "off")
+
+    def __init__(self, mgr) -> None:
+        super().__init__(mgr)
+        self._srv: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port = 0
+
+    # -- content -------------------------------------------------------
+    def _api(self, path: str) -> tuple[int, str, bytes]:
+        status = self.get_status()
+        osdmap = self.get_osdmap()
+        if path == "/api/health":
+            return 200, "application/json", json.dumps(
+                {"status": status.get("health", "unknown")}).encode()
+        if path == "/api/status":
+            return 200, "application/json", json.dumps(status).encode()
+        if path == "/api/osds":
+            return 200, "application/json", json.dumps(
+                {str(o): {"up": i.up, "in": i.in_cluster,
+                          "addr": i.addr}
+                 for o, i in sorted(osdmap.osds.items())}).encode()
+        if path == "/api/pools":
+            return 200, "application/json", json.dumps(
+                {p.name: {"pool": pid, "pg_num": p.pg_num,
+                          "size": p.size,
+                          "type": "erasure" if p.is_ec
+                          else "replicated"}
+                 for pid, p in sorted(osdmap.pools.items())}).encode()
+        if path == "/":
+            return 200, "text/html", self._page(status, osdmap)
+        return 404, "text/plain", b"not found"
+
+    def _page(self, status: dict, osdmap) -> bytes:
+        health = status.get("health", "unknown")
+        osd_rows = "".join(
+            f"<tr><td>osd.{o}</td><td>{'up' if i.up else 'DOWN'}</td>"
+            f"<td>{'in' if i.in_cluster else 'out'}</td></tr>"
+            for o, i in sorted(osdmap.osds.items()))
+        pool_rows = "".join(
+            f"<tr><td>{html.escape(p.name)}</td>"
+            f"<td>{'erasure' if p.is_ec else 'replicated'}</td>"
+            f"<td>{p.pg_num}</td><td>{p.size}</td></tr>"
+            for _, p in sorted(osdmap.pools.items()))
+        bal = self.mgr.modules.get("balancer")
+        return _PAGE.format(
+            health=html.escape(health),
+            hclass="ok" if health.startswith("HEALTH_OK") else "warn",
+            n_osds=len(osdmap.osds),
+            n_up=sum(1 for i in osdmap.osds.values() if i.up),
+            n_in=sum(1 for i in osdmap.osds.values() if i.in_cluster),
+            osd_rows=osd_rows, pool_rows=pool_rows,
+            pgs=json.dumps(status.get("pgmap", {})),
+            balancer="active" if bal is not None and bal.active
+            else "idle",
+        ).encode()
+
+    # -- server --------------------------------------------------------
+    def _serve_on(self) -> int:
+        module = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):                      # noqa: N802
+                try:
+                    code, ctype, body = module._api(self.path)
+                except Exception as exc:           # render errors, not 500s
+                    code, ctype = 500, "text/plain"
+                    body = repr(exc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):             # quiet
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="mgr-dashboard",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def _serve_off(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._thread.join(timeout=2)
+            self._srv = None
+            self.port = 0
+
+    def handle_command(self, cmd: dict) -> tuple[int, str, bytes]:
+        sub = cmd.get("prefix", "status")
+        if sub == "status":
+            return 0, "", json.dumps(
+                {"serving": self._srv is not None,
+                 "url": f"http://127.0.0.1:{self.port}/"
+                 if self.port else ""}).encode()
+        if sub == "on":
+            if self._srv is None:
+                self._serve_on()
+            return 0, f"dashboard at http://127.0.0.1:{self.port}/", b""
+        if sub == "off":
+            self._serve_off()
+            return 0, "dashboard off", b""
+        return super().handle_command(cmd)

@@ -25,7 +25,8 @@ from ceph_tpu.utils.perf_counters import PerfCounters
 log = Dout("mgr")
 
 #: default module set (the reference's always-on + default-on modules)
-DEFAULT_MODULES = ("balancer", "progress", "telemetry")
+DEFAULT_MODULES = ("balancer", "progress", "telemetry",
+                   "dashboard")
 
 
 class Mgr:

@@ -299,6 +299,19 @@ class Monitor:
             conn.send_message(msg)
 
     # -- dispatch -----------------------------------------------------
+    def _dedup_put(self, key, ent: dict) -> None:
+        """Bounded insert that only evicts COMPLETED entries: evicting
+        a still-deferred command would let a client retry re-run the
+        mutation — the exact thing the dedup exists to prevent."""
+        self._cmd_dedup[key] = ent
+        self._cmd_dedup.move_to_end(key)
+        while len(self._cmd_dedup) > self._cmd_dedup.maxsize:
+            victim = next((k for k, v in self._cmd_dedup.items()
+                           if v.get("state") == "done"), None)
+            if victim is None:
+                break          # all pending: overflow beats re-running
+            del self._cmd_dedup[victim]
+
     def _majority(self) -> int:
         return len(self.monmap) // 2 + 1
 
@@ -447,10 +460,10 @@ class Monitor:
                                 data=rdata))
                         ent["conns"] = []
                     if self._defer_until_majority(version, reply):
-                        self._cmd_dedup.put(key, ent)
+                        self._dedup_put(key, ent)
                         return
-                self._cmd_dedup.put(key, {"state": "done",
-                                          "reply": (code, outs, data)})
+                self._dedup_put(key, {"state": "done",
+                                      "reply": (code, outs, data)})
                 conn.send_message(M.MMonCommandReply(
                     tid=msg.tid, code=code, outs=outs, data=data))
 

@@ -72,7 +72,10 @@ class Journaler:
         for entry in entries:
             # dict = cls_log entry; tolerate plain strings (a registry
             # object written by an older format must not crash commit)
-            cid = entry.get("data", "") if isinstance(entry, dict)                 else str(entry)
+            if isinstance(entry, dict):
+                cid = entry.get("data", "")
+            else:
+                cid = str(entry)
             if cid and cid not in seen:
                 seen.append(cid)
         return seen

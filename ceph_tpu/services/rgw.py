@@ -12,13 +12,18 @@ large). This lite gateway keeps exactly that object model:
 - ``<bucket>/<key>``      — object data through the striper
 
 The HTTP front end is S3-path-shaped (PUT/GET/DELETE /bucket and
-/bucket/key, GET /bucket lists with ?prefix=), answering JSON rather
-than S3's XML and with no request signing — documented reductions.
+/bucket/key, GET /bucket lists with ?prefix=) and answers S3 XML
+(ListAllMyBucketsResult / ListBucketResult / Error documents). With
+``RGWServer(..., auth={access_key: secret})`` every request must carry
+an AWS Signature Version 4 Authorization header; ``sign_request``
+below is the matching client-side signer (the shape boto3 emits for
+path-style requests).
 """
 
 from __future__ import annotations
 
 import hashlib
+import hmac
 import json
 import threading
 import urllib.parse
@@ -124,8 +129,161 @@ class RGWGateway:
         return json.loads(out or b"{}")
 
 
+def _xml_escape(v: str) -> str:
+    return (v.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def _xml_buckets(names: list[str]) -> bytes:
+    items = "".join(
+        f"<Bucket><Name>{_xml_escape(n)}</Name></Bucket>"
+        for n in names)
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<ListAllMyBucketsResult><Owner><ID>ceph-tpu</ID></Owner>"
+            f"<Buckets>{items}</Buckets>"
+            f"</ListAllMyBucketsResult>").encode()
+
+
+def _xml_listing(bucket: str, prefix: str, max_keys: int,
+                 idx: dict, truncated: bool) -> bytes:
+    items = "".join(
+        f"<Contents><Key>{_xml_escape(k)}</Key>"
+        f"<Size>{m['size']}</Size>"
+        f"<ETag>&quot;{m['etag']}&quot;</ETag></Contents>"
+        for k, m in sorted(idx.items()))
+    flag = "true" if truncated else "false"
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<ListBucketResult><Name>{_xml_escape(bucket)}</Name>"
+            f"<Prefix>{_xml_escape(prefix)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<IsTruncated>{flag}</IsTruncated>{items}"
+            f"</ListBucketResult>").encode()
+
+
+def _xml_error(code: str, message: str) -> bytes:
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<Error><Code>{_xml_escape(code)}</Code>"
+            f"<Message>{_xml_escape(message)}</Message>"
+            f"</Error>").encode()
+
+
+# -- AWS Signature Version 4 (S3 request signing) ----------------------
+
+def _sigv4_key(secret: str, date: str, region: str,
+               service: str) -> bytes:
+    k = hmac.new(("AWS4" + secret).encode(), date.encode(),
+                 hashlib.sha256).digest()
+    for part in (region, service, "aws4_request"):
+        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    return k
+
+
+def _canonical_query(query: str) -> str:
+    pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    return "&".join(
+        f"{urllib.parse.quote(k, safe='')}="
+        f"{urllib.parse.quote(v, safe='')}"
+        for k, v in sorted(pairs))
+
+
+def sign_request(method: str, path: str, query: str,
+                 headers: dict[str, str], payload: bytes,
+                 access_key: str, secret: str,
+                 region: str = "default") -> dict[str, str]:
+    """Client-side SigV4: returns the headers to add (Authorization,
+    x-amz-date, x-amz-content-sha256). ``headers`` must already hold
+    Host."""
+    import time as _t
+    amz_date = _t.strftime("%Y%m%dT%H%M%SZ", _t.gmtime())
+    date = amz_date[:8]
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    all_h = {k.lower(): v.strip() for k, v in headers.items()}
+    all_h["x-amz-date"] = amz_date
+    all_h["x-amz-content-sha256"] = payload_hash
+    signed = ";".join(sorted(all_h))
+    canonical = "\n".join([
+        method,
+        urllib.parse.quote(path),
+        _canonical_query(query),
+        "".join(f"{k}:{all_h[k]}\n" for k in sorted(all_h)),
+        signed,
+        payload_hash,
+    ])
+    scope = f"{date}/{region}/s3/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+    sig = hmac.new(_sigv4_key(secret, date, region, "s3"),
+                   to_sign.encode(), hashlib.sha256).hexdigest()
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed}, Signature={sig}"),
+    }
+
+
+def verify_sigv4(handler, auth: dict[str, str],
+                 payload: bytes) -> None:
+    """Server side: recompute the signature from the request and the
+    stored secret; raises RGWError(403) on any mismatch."""
+    hdr = handler.headers.get("Authorization", "")
+    if not hdr.startswith("AWS4-HMAC-SHA256 "):
+        raise RGWError(403, "AccessDenied")
+    try:
+        fields = dict(
+            part.strip().split("=", 1)
+            for part in hdr[len("AWS4-HMAC-SHA256 "):].split(","))
+        access, date, region, service, _ = \
+            fields["Credential"].split("/")
+        signed = fields["SignedHeaders"].split(";")
+        given_sig = fields["Signature"]
+    except (KeyError, ValueError):
+        raise RGWError(403, "AccessDenied") from None
+    secret = auth.get(access)
+    if secret is None:
+        raise RGWError(403, "InvalidAccessKeyId")
+    amz_date = handler.headers.get("x-amz-date", "")
+    import calendar
+    import time as _t
+    try:
+        ts = calendar.timegm(_t.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    except ValueError:
+        raise RGWError(403, "AccessDenied") from None
+    if abs(_t.time() - ts) > 900:
+        # AWS's ~15-minute skew window: without it every captured
+        # signed request (incl. DELETEs) replays forever
+        raise RGWError(403, "RequestTimeTooSkewed")
+    payload_hash = handler.headers.get("x-amz-content-sha256", "")
+    if hashlib.sha256(payload).hexdigest() != payload_hash:
+        raise RGWError(403, "XAmzContentSHA256Mismatch")
+    parsed = urllib.parse.urlparse(handler.path)
+    canon_h = ""
+    for k in signed:
+        v = handler.headers.get(k, "")
+        canon_h += f"{k}:{v.strip()}\n"
+    canonical = "\n".join([
+        handler.command,
+        urllib.parse.quote(urllib.parse.unquote(parsed.path)),
+        _canonical_query(parsed.query),
+        canon_h,
+        ";".join(signed),
+        payload_hash,
+    ])
+    scope = f"{date}/{region}/{service}/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+    want = hmac.new(_sigv4_key(secret, date, region, service),
+                    to_sign.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, given_sig):
+        raise RGWError(403, "SignatureDoesNotMatch")
+
+
 class _Handler(BaseHTTPRequestHandler):
-    gw: RGWGateway = None  # set by server factory
+    gw: RGWGateway = None          # set by server factory
+    auth: dict[str, str] | None = None   # access_key -> secret
 
     def _split(self) -> tuple[str, str, dict]:
         parsed = urllib.parse.urlparse(self.path)
@@ -136,7 +294,7 @@ class _Handler(BaseHTTPRequestHandler):
         return bucket, key, q
 
     def _reply(self, status: int, body: bytes = b"",
-               ctype: str = "application/json") -> None:
+               ctype: str = "application/xml") -> None:
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
@@ -144,28 +302,42 @@ class _Handler(BaseHTTPRequestHandler):
         if body:
             self.wfile.write(body)
 
-    def _run(self, fn) -> None:
+    def _run(self, fn, payload: bytes = b"") -> None:
         try:
+            if self.auth is not None:
+                verify_sigv4(self, self.auth, payload)
             fn()
         except RGWError as exc:
-            self._reply(exc.status, json.dumps(
-                {"error": str(exc)}).encode())
+            # S3 Error document; the message doubles as the Code when
+            # it is one (NoSuchBucket/NoSuchKey/BucketNotEmpty/...)
+            msg = str(exc)
+            code = msg if msg.isalnum() else {
+                400: "InvalidRequest", 403: "AccessDenied",
+                404: "NoSuchKey", 409: "Conflict",
+            }.get(exc.status, "InternalError")
+            self._reply(exc.status, _xml_error(code, msg))
         except Exception as exc:  # pragma: no cover
-            self._reply(500, json.dumps({"error": repr(exc)}).encode())
+            self._reply(500, _xml_error("InternalError", repr(exc)))
 
     def do_GET(self) -> None:  # noqa: N802
         bucket, key, q = self._split()
 
         def run() -> None:
             if not bucket:
-                self._reply(200, json.dumps(
-                    {"buckets": self.gw.list_buckets()}).encode())
+                self._reply(200, _xml_buckets(self.gw.list_buckets()))
             elif not key:
-                idx = self.gw.list_objects(
-                    bucket, prefix=q.get("prefix", ""),
-                    max_keys=int(q.get("max-keys", 1000)))
-                self._reply(200, json.dumps(
-                    {"bucket": bucket, "objects": idx}).encode())
+                prefix = q.get("prefix", "")
+                max_keys = int(q.get("max-keys", 1000))
+                # probe one past the page so IsTruncated is honest —
+                # a client that stops paginating must not miss keys
+                idx = self.gw.list_objects(bucket, prefix=prefix,
+                                           max_keys=max_keys + 1)
+                truncated = len(idx) > max_keys
+                if truncated:
+                    idx = dict(sorted(idx.items())[:max_keys])
+                self._reply(200, _xml_listing(bucket, prefix,
+                                              max_keys, idx,
+                                              truncated))
             else:
                 data, meta = self.gw.get_object(bucket, key)
                 self.send_response(200)
@@ -192,7 +364,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("ETag", f'"{etag}"')
                 self.send_header("Content-Length", "0")
                 self.end_headers()
-        self._run(run)
+        self._run(run, payload=body)
 
     def do_DELETE(self) -> None:  # noqa: N802
         bucket, key, _ = self._split()
@@ -221,12 +393,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class RGWServer:
-    """Threaded HTTP front end (radosgw + civetweb role)."""
+    """Threaded HTTP front end (radosgw + civetweb role). ``auth``
+    maps S3 access keys to secrets; when given, every request must be
+    SigV4-signed."""
 
     def __init__(self, ioctx, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0,
+                 auth: dict[str, str] | None = None) -> None:
         gw = RGWGateway(ioctx)
-        handler = type("BoundHandler", (_Handler,), {"gw": gw})
+        handler = type("BoundHandler", (_Handler,),
+                       {"gw": gw, "auth": auth})
         self._srv = ThreadingHTTPServer((host, port), handler)
         self.port = self._srv.server_address[1]
         self.gateway = gw

@@ -207,3 +207,29 @@ def test_balancer_second_round_and_down_target():
         plan = mod.optimize(max_optimizations=16)
         code, msg = mod.execute(plan)
         assert code == 0, msg
+
+
+def test_dashboard_module_serves_cluster_state():
+    """dashboard role (pybind/mgr/dashboard, reduced): HTML overview +
+    JSON API over the mgr's cluster view."""
+    import urllib.request
+    with MiniCluster(n_osds=3) as c:
+        c.create_pool("dash", pg_num=4, size=2)
+        mgr = c.start_mgr()
+        out = asok_command(mgr.asok.path, "dashboard on")
+        assert out["code"] == 0
+        st = asok_command(mgr.asok.path, "dashboard status")
+        url = st["data"]["url"]
+        assert st["data"]["serving"] and url
+        health = json.loads(urllib.request.urlopen(
+            url + "api/health", timeout=10).read())
+        assert health["status"].startswith("HEALTH")
+        osds = json.loads(urllib.request.urlopen(
+            url + "api/osds", timeout=10).read())
+        assert len(osds) == 3 and all(v["up"] for v in osds.values())
+        pools = json.loads(urllib.request.urlopen(
+            url + "api/pools", timeout=10).read())
+        assert pools["dash"]["type"] == "replicated"
+        page = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "ceph_tpu cluster" in page and "osd.0" in page
+        assert asok_command(mgr.asok.path, "dashboard off")["code"] == 0

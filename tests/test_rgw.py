@@ -62,11 +62,17 @@ def test_http_s3_path_flow(setup):
     body = os.urandom(100_000)
     r = _req(f"{base}/webdata/docs/readme.bin", "PUT", data=body)
     etag = r.headers["ETag"]
-    # bucket listing
-    listing = json.loads(_req(f"{base}/webdata").read())
-    assert "docs/readme.bin" in listing["objects"]
-    # root listing
-    assert "webdata" in json.loads(_req(base + "/").read())["buckets"]
+    # bucket listing (S3 ListBucketResult XML)
+    import xml.etree.ElementTree as ET
+    doc = ET.fromstring(_req(f"{base}/webdata").read())
+    assert doc.tag == "ListBucketResult"
+    keys = [c.findtext("Key") for c in doc.findall("Contents")]
+    assert "docs/readme.bin" in keys
+    # root listing (ListAllMyBucketsResult XML)
+    doc = ET.fromstring(_req(base + "/").read())
+    names = [b.findtext("Name")
+             for b in doc.find("Buckets").findall("Bucket")]
+    assert "webdata" in names
     # GET round trip + etag
     r = _req(f"{base}/webdata/docs/readme.bin")
     assert r.read() == body and r.headers["ETag"] == etag
@@ -85,3 +91,116 @@ def test_http_s3_path_flow(setup):
     _req(f"{base}/webdata", "DELETE")
     with pytest.raises(urllib.error.HTTPError):
         _req(f"{base}/webdata")
+
+
+def test_error_documents_are_s3_xml(setup):
+    import xml.etree.ElementTree as ET
+    _, _, base = setup
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(f"{base}/nosuchbucket-xml/")
+    doc = ET.fromstring(ei.value.read())
+    assert doc.tag == "Error"
+    assert doc.findtext("Code") == "NoSuchBucket"
+
+
+def test_sigv4_signed_requests(setup):
+    """SigV4 auth: signed requests succeed, unsigned/forged get 403
+    with S3 error codes."""
+    import xml.etree.ElementTree as ET
+    from ceph_tpu.services.rgw import RGWServer, sign_request
+    io, _, _ = setup
+    creds = {"AKIATEST": "sekrit"}
+    srv = RGWServer(io, auth=creds)
+    port = srv.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        def signed(path, method="GET", data=b"", query=""):
+            url = f"{base}{path}" + (f"?{query}" if query else "")
+            headers = {"Host": f"127.0.0.1:{port}"}
+            headers.update(sign_request(
+                method, path, query, headers, data,
+                "AKIATEST", "sekrit"))
+            req = urllib.request.Request(url, data=data or None,
+                                         method=method,
+                                         headers=headers)
+            return urllib.request.urlopen(req, timeout=10)
+
+        # unsigned -> 403 AccessDenied
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(f"{base}/")
+        assert ei.value.code == 403
+        assert ET.fromstring(ei.value.read()).findtext("Code") == \
+            "AccessDenied"
+        # signed flow: create bucket, put, list with query, get
+        signed("/sbucket", "PUT")
+        body = os.urandom(30_000)
+        signed("/sbucket/a/b.bin", "PUT", data=body)
+        doc = ET.fromstring(signed("/sbucket", query="prefix=a%2F")
+                            .read())
+        assert [c.findtext("Key") for c in doc.findall("Contents")] \
+            == ["a/b.bin"]
+        assert signed("/sbucket/a/b.bin").read() == body
+        # wrong secret -> SignatureDoesNotMatch
+        headers = {"Host": f"127.0.0.1:{port}"}
+        headers.update(sign_request("GET", "/sbucket", "", headers,
+                                    b"", "AKIATEST", "wrong"))
+        req = urllib.request.Request(f"{base}/sbucket",
+                                     headers=headers)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ET.fromstring(ei.value.read()).findtext("Code") == \
+            "SignatureDoesNotMatch"
+        # tampered payload -> content hash mismatch
+        headers = {"Host": f"127.0.0.1:{port}"}
+        headers.update(sign_request("PUT", "/sbucket/t", "", headers,
+                                    b"payload-A", "AKIATEST",
+                                    "sekrit"))
+        req = urllib.request.Request(f"{base}/sbucket/t",
+                                     data=b"payload-B",
+                                     method="PUT", headers=headers)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 403
+    finally:
+        srv.stop()
+
+
+def test_listing_pagination_is_truncated_honest(setup):
+    import xml.etree.ElementTree as ET
+    io, gw, base = setup
+    gw.create_bucket("pager")
+    for i in range(7):
+        gw.put_object("pager", f"k{i:02d}", b"x")
+    doc = ET.fromstring(_req(f"{base}/pager?max-keys=5").read())
+    keys = [c.findtext("Key") for c in doc.findall("Contents")]
+    assert len(keys) == 5
+    assert doc.findtext("IsTruncated") == "true"
+    doc = ET.fromstring(_req(f"{base}/pager?max-keys=10").read())
+    assert doc.findtext("IsTruncated") == "false"
+    assert len(doc.findall("Contents")) == 7
+
+
+def test_sigv4_rejects_stale_date(setup):
+    """Replay protection: a signed request older than the skew window
+    is refused (RequestTimeTooSkewed)."""
+    import xml.etree.ElementTree as ET
+    from unittest import mock
+    from ceph_tpu.services.rgw import RGWServer, sign_request
+    io, _, _ = setup
+    srv = RGWServer(io, auth={"AK": "sec"})
+    port = srv.start()
+    try:
+        headers = {"Host": f"127.0.0.1:{port}"}
+        import time as _t
+        old = _t.gmtime(_t.time() - 3600)
+        with mock.patch("time.gmtime", return_value=old):
+            headers.update(sign_request("GET", "/", "", headers, b"",
+                                        "AK", "sec"))
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/",
+                                     headers=headers)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ET.fromstring(ei.value.read()).findtext("Code") == \
+            "RequestTimeTooSkewed"
+    finally:
+        srv.stop()
