@@ -174,7 +174,9 @@ def _rgw_bucket_list(inp: bytes, obj: bytes | None):
     req = json.loads(inp) if inp else {}
     idx = _index(obj)
     prefix = req.get("prefix", "")
-    keys = sorted(k for k in idx if k.startswith(prefix))
+    marker = req.get("marker", "")
+    keys = sorted(k for k in idx if k.startswith(prefix)
+                  and (not marker or k > marker))
     n = req.get("max_keys", len(keys))
     out = {k: idx[k] for k in keys[:n]}
     return 0, json.dumps(out).encode(), None

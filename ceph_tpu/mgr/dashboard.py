@@ -140,6 +140,9 @@ class Module(MgrModule):
             self._srv = None
             self.port = 0
 
+    def shutdown(self) -> None:
+        self._serve_off()
+
     def handle_command(self, cmd: dict) -> tuple[int, str, bytes]:
         sub = cmd.get("prefix", "status")
         if sub == "status":

@@ -74,6 +74,11 @@ class Mgr:
         self._stop.set()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=5)
+        for name, mod in self.modules.items():
+            try:
+                mod.shutdown()
+            except Exception as exc:
+                log(1, f"mgr module {name} shutdown failed: {exc!r}")
         self.asok.stop()
         self.rados.shutdown()
 

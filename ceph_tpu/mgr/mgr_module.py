@@ -38,6 +38,9 @@ class MgrModule:
     def tick(self) -> None:
         """Periodic work; called from the mgr tick thread."""
 
+    def shutdown(self) -> None:
+        """Called by Mgr.stop(); modules release servers/threads."""
+
     def handle_command(self, cmd: dict) -> tuple[int, str, bytes]:
         """CLI/asok commands addressed to this module. ``cmd["prefix"]``
         is the sub-command (e.g. "status" for ``balancer status``)."""

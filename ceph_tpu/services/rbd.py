@@ -7,10 +7,16 @@ directory object, per-image header (size + layout), striped data via
 ceph_tpu.client.striper — and the core API: create/open/list/remove,
 byte-addressed read/write, resize, and snapshots.
 
-Snapshots here are full object-range copies into a snap namespace
-(``rbd_snap.<image>@<snap>...``), not the reference's COW clones —
-correct semantics (point-in-time, rollback, independent of later
-writes) at lite cost; COW is future work.
+Snapshots are copy-on-write at data-object granularity (the
+reference's object-clone model, reduced): ``snap_create`` is O(1) —
+it records a layer; the FIRST head write touching a data object after
+the snapshot copies that object into the newest snap's layer
+(``rbd_snap.<image>@<snap>.<objno>``). A snap read resolves each
+object through its own layer, then newer snaps' layers, then the
+head (objects never written since the snap are shared, not copied);
+``snap_remove`` merges the layer into the next-older snapshot so
+older point-in-time views stay intact. Legacy full-copy snapshots
+(pre-COW format) remain readable.
 
 Journaling (librbd journaling feature, src/journal/ role): an image
 created with ``journaling=True`` appends an event record to its
@@ -25,7 +31,11 @@ from __future__ import annotations
 
 import json
 
-from ceph_tpu.client.striper import FileLayout, StripedObject
+from ceph_tpu.client.striper import (
+    FileLayout,
+    StripedObject,
+    file_to_extents,
+)
 from ceph_tpu.services.journal import Journaler
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
@@ -80,10 +90,23 @@ class RBD:
 
     def remove(self, name: str) -> None:
         img = Image(self.io, name)
-        for snap in list(img.snap_list()):
-            # direct apply: removing a NON-PRIMARY (mirror-target)
-            # image must not trip the writability check or journal
-            img._snap_remove_apply(snap)
+        # bulk teardown: delete every snapshot layer piece directly —
+        # the merge-preserving removal path would copy data down into
+        # older layers that are about to be deleted anyway
+        for snap, meta in list(img._header["snaps"].items()):
+            if meta.get("cow"):
+                for key, marker in meta.get("objects", {}).items():
+                    if marker == "data":
+                        try:
+                            self.io.remove(
+                                img._snap_piece(snap, int(key, 16)))
+                        except Exception:
+                            pass
+            else:
+                StripedObject(self.io,
+                              img._snap_prefix(snap)).remove()
+        img._header["snaps"].clear()
+        img._header.pop("snap_order", None)
         if img.journal is not None:
             img.journal.remove()
         img._data.remove()
@@ -188,6 +211,7 @@ class Image:
         if offset + len(data) > self._header["size"]:
             raise RBDError("write past end of image")
         self._journal_event("write", offset, bytes(data))
+        self._cow_protect(self._touched_objnos(offset, len(data)))
         self._data.write(data, offset=offset)
         return len(data)
 
@@ -204,14 +228,129 @@ class Image:
         self._check_writable()
         self._journal_event("discard", offset,
                             length.to_bytes(8, "little"))
+        self._cow_protect(self._touched_objnos(offset, length))
         self._data.write(b"\x00" * length, offset=offset)
 
-    # -- snapshots ------------------------------------------------------
+    # -- snapshots (COW object-clone model) -----------------------------
     def _snap_prefix(self, snap: str) -> str:
         return f"rbd_snap.{self.name}@{snap}"
 
+    def _snap_piece(self, snap: str, objno: int) -> str:
+        return f"{self._snap_prefix(snap)}.{objno:016x}"
+
+    def _snap_order(self) -> list[str]:
+        return self._header.setdefault("snap_order", [])
+
     def snap_list(self) -> list[str]:
         return sorted(self._header["snaps"])
+
+    def _objnos(self, size: int) -> list[int]:
+        return self._touched_objnos(0, size)
+
+    def _cow_protect(self, objnos) -> None:
+        """Before a head data object changes, copy its CURRENT content
+        into the newest snapshot's layer (first-write copy; objects a
+        snap already holds — or that were protected earlier — are
+        shared and skipped)."""
+        order = self._snap_order()
+        if not order:
+            return
+        snap = order[-1]
+        meta = self._header["snaps"].get(snap)
+        if meta is None or not meta.get("cow"):
+            return
+        dirty = False
+        for objno in objnos:
+            key = f"{objno:x}"
+            if key in meta["objects"]:
+                continue
+            try:
+                content = self.io.read(self._data._piece(objno))
+            except Exception as exc:
+                # ONLY absence is shareable-as-hole; a real I/O error
+                # (EIO etc.) must fail the write, or an 'absent'
+                # marker would silently zero the snapshot's only copy
+                if getattr(exc, "code", None) != -2:
+                    raise
+                content = None
+            if content is None:
+                meta["objects"][key] = "absent"
+            else:
+                self.io.write_full(self._snap_piece(snap, objno),
+                                   content)
+                meta["objects"][key] = "data"
+            dirty = True
+        if dirty:
+            self._save_header()
+
+    def _touched_objnos(self, offset: int, length: int) -> list[int]:
+        if length <= 0:
+            return []
+        return sorted({e[0] for e in file_to_extents(
+            self._data.layout, offset, length)})
+
+    def _resolve_piece(self, snap: str, objno: int) -> bytes:
+        """Object content as of ``snap``: own layer, else newer snaps'
+        layers (oldest-first), else the head object (shared)."""
+        order = self._snap_order()
+        start = order.index(snap)
+        key = f"{objno:x}"
+        for s in order[start:]:
+            marker = self._header["snaps"][s].get("objects",
+                                                  {}).get(key)
+            if marker == "absent":
+                return b""
+            if marker == "data":
+                return self.io.read(self._snap_piece(s, objno))
+        try:
+            return self.io.read(self._data._piece(objno))
+        except Exception as exc:
+            if getattr(exc, "code", None) != -2:
+                raise
+            return b""            # sparse hole
+
+    def snap_read(self, snap: str) -> bytes:
+        """Full point-in-time content of a snapshot."""
+        meta = self._header["snaps"].get(snap)
+        if meta is None:
+            raise RBDError(f"no snap {snap!r}")
+        if not meta.get("cow"):        # legacy full-copy snapshot
+            return StripedObject(self.io,
+                                 self._snap_prefix(snap)).read()
+        size = meta["size"]
+        pieces = {objno: self._resolve_piece(snap, objno)
+                  for objno in self._objnos(size)}
+        out = bytearray(size)
+        pos = 0
+        for objno, obj_off, n in file_to_extents(self._data.layout,
+                                                 0, size):
+            piece = pieces[objno][obj_off:obj_off + n]
+            out[pos:pos + len(piece)] = piece
+            pos += n
+        return bytes(out)
+
+    def _snap_ingest(self, snap: str, content: bytes,
+                     size: int) -> None:
+        """Mirror bootstrap: materialize a PEER snapshot's point-in-
+        time content as a full local layer (the dst head may already
+        be newer, so sharing-with-head is not an option)."""
+        meta = {"size": size, "cow": True, "objects": {}}
+        pieces: dict[int, bytearray] = {}
+        pos = 0
+        for objno, obj_off, n in file_to_extents(self._data.layout,
+                                                 0, size):
+            buf = pieces.setdefault(objno, bytearray())
+            if len(buf) < obj_off + n:
+                buf.extend(b"\x00" * (obj_off + n - len(buf)))
+            buf[obj_off:obj_off + n] = content[pos:pos + n]
+            pos += n
+        for objno, buf in pieces.items():
+            self.io.write_full(self._snap_piece(snap, objno),
+                               bytes(buf))
+            meta["objects"][f"{objno:x}"] = "data"
+        self._header["snaps"][snap] = meta
+        self._snap_order().append(snap)
+        self._save_header()
 
     def snap_create(self, snap: str) -> None:
         self._check_writable()
@@ -221,12 +360,11 @@ class Image:
         self._snap_create_apply(snap)
 
     def _snap_create_apply(self, snap: str) -> None:
-        content = self._data.read()      # point-in-time copy
-        so = StripedObject(self.io, self._snap_prefix(snap),
-                           self._data.layout)
-        if content:
-            so.write(content)
-        self._header["snaps"][snap] = {"size": self._header["size"]}
+        # O(1): record the layer; data objects are copied lazily on
+        # the first post-snapshot write (librbd object-clone role)
+        self._header["snaps"][snap] = {
+            "size": self._header["size"], "cow": True, "objects": {}}
+        self._snap_order().append(snap)
         self._save_header()
 
     def snap_rollback(self, snap: str) -> None:
@@ -237,11 +375,14 @@ class Image:
         self._snap_rollback_apply(snap)
 
     def _snap_rollback_apply(self, snap: str) -> None:
-        so = StripedObject(self.io, self._snap_prefix(snap))
-        content = so.read()
+        content = self.snap_read(snap)
+        # newer snapshots must keep their views: protect every head
+        # object they might still share before clobbering the head
+        self._cow_protect(self._objnos(
+            max(self._header["size"], len(content))))
         self._data.remove()
         self._data = StripedObject(self.io, f"rbd_data.{self.name}",
-                                   so.layout)
+                                   self._data.layout)
         if content:
             self._data.write(content)
         self._header["size"] = self._header["snaps"][snap]["size"]
@@ -255,7 +396,34 @@ class Image:
         self._snap_remove_apply(snap)
 
     def _snap_remove_apply(self, snap: str) -> None:
-        StripedObject(self.io, self._snap_prefix(snap)).remove()
+        meta = self._header["snaps"][snap]
+        if not meta.get("cow"):        # legacy full-copy snapshot
+            StripedObject(self.io, self._snap_prefix(snap)).remove()
+            del self._header["snaps"][snap]
+            self._save_header()
+            return
+        order = self._snap_order()
+        idx = order.index(snap)
+        older = order[idx - 1] if idx > 0 else None
+        for key, marker in meta.get("objects", {}).items():
+            objno = int(key, 16)
+            if older is not None:
+                ometa = self._header["snaps"][older]
+                if key not in ometa["objects"]:
+                    # the older snapshot shared this object THROUGH
+                    # this layer: the content moves down a level
+                    if marker == "data":
+                        self.io.write_full(
+                            self._snap_piece(older, objno),
+                            self.io.read(self._snap_piece(snap,
+                                                          objno)))
+                    ometa["objects"][key] = marker
+            if marker == "data":
+                try:
+                    self.io.remove(self._snap_piece(snap, objno))
+                except Exception:
+                    pass
+        order.remove(snap)
         del self._header["snaps"][snap]
         self._save_header()
 
@@ -265,12 +433,14 @@ class Image:
         """Apply one journal event WITHOUT writability checks or
         re-journaling — the mirror target's replay path."""
         if kind == "write":
+            self._cow_protect(self._touched_objnos(offset, len(data)))
             self._data.write(data, offset=offset)
             if offset + len(data) > self._header["size"]:
                 self._header["size"] = offset + len(data)
                 self._save_header()
         elif kind == "discard":
             length = int.from_bytes(data, "little")
+            self._cow_protect(self._touched_objnos(offset, length))
             self._data.write(b"\x00" * length, offset=offset)
         elif kind == "resize":
             self._resize_apply(offset)

@@ -95,20 +95,16 @@ class ImageReplayer:
             dst._data.write(content)
         dst._header["size"] = src.size()
         dst._header["primary"] = False
-        # copy the SOURCE snapshots' point-in-time content, not a
-        # re-snapshot of current dst data: a later replayed
-        # snap_rollback must restore the same bytes on both sides
-        from ceph_tpu.client.striper import StripedObject
-        for snap, meta in src._header["snaps"].items():
-            sso = StripedObject(self.src_io,
-                                f"rbd_snap.{self.name}@{snap}")
-            scontent = sso.read()
-            dso = StripedObject(self.dst_io,
-                                f"rbd_snap.{self.name}@{snap}",
-                                sso.layout)
-            if scontent:
-                dso.write(scontent)
-            dst._header["snaps"][snap] = dict(meta)
+        # copy the SOURCE snapshots' point-in-time content (resolved
+        # through the COW chain), not a re-snapshot of current dst
+        # data: a later replayed snap_rollback must restore the same
+        # bytes on both sides. Chain order is preserved.
+        order = list(src._header.get("snap_order", []))
+        order += [s for s in sorted(src._header["snaps"])
+                  if s not in order]
+        for snap in order:
+            meta = src._header["snaps"][snap]
+            dst._snap_ingest(snap, src.snap_read(snap), meta["size"])
         dst._save_header()
         self.journal.commit(self.client_id, pos0)
         log(1, f"rbd-mirror: bootstrapped {self.name} at pos {pos0}")

@@ -121,11 +121,12 @@ class RGWGateway:
         StripedObject(self.io, f"{bucket}/{key}").remove()
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     max_keys: int = 1000) -> dict:
+                     max_keys: int = 1000, marker: str = "") -> dict:
         self._check_bucket(bucket)
         out = self.io.execute(
             f".bucket.{bucket}", "rgw", "bucket_list",
-            json.dumps({"prefix": prefix, "max_keys": max_keys}).encode())
+            json.dumps({"prefix": prefix, "max_keys": max_keys,
+                        "marker": marker}).encode())
         return json.loads(out or b"{}")
 
 
@@ -145,18 +146,21 @@ def _xml_buckets(names: list[str]) -> bytes:
 
 
 def _xml_listing(bucket: str, prefix: str, max_keys: int,
-                 idx: dict, truncated: bool) -> bytes:
+                 idx: dict, truncated: bool, marker: str) -> bytes:
     items = "".join(
         f"<Contents><Key>{_xml_escape(k)}</Key>"
         f"<Size>{m['size']}</Size>"
         f"<ETag>&quot;{m['etag']}&quot;</ETag></Contents>"
         for k, m in sorted(idx.items()))
     flag = "true" if truncated else "false"
+    next_marker = (f"<NextMarker>{_xml_escape(max(idx))}</NextMarker>"
+                   if truncated and idx else "")
     return (f'<?xml version="1.0" encoding="UTF-8"?>'
             f"<ListBucketResult><Name>{_xml_escape(bucket)}</Name>"
             f"<Prefix>{_xml_escape(prefix)}</Prefix>"
+            f"<Marker>{_xml_escape(marker)}</Marker>"
             f"<MaxKeys>{max_keys}</MaxKeys>"
-            f"<IsTruncated>{flag}</IsTruncated>{items}"
+            f"<IsTruncated>{flag}</IsTruncated>{next_marker}{items}"
             f"</ListBucketResult>").encode()
 
 
@@ -327,17 +331,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, _xml_buckets(self.gw.list_buckets()))
             elif not key:
                 prefix = q.get("prefix", "")
-                max_keys = int(q.get("max-keys", 1000))
+                marker = q.get("marker", "")
+                try:
+                    max_keys = int(q.get("max-keys", 1000))
+                    if max_keys < 0:
+                        raise ValueError
+                except ValueError:
+                    raise RGWError(400, "InvalidArgument") from None
                 # probe one past the page so IsTruncated is honest —
                 # a client that stops paginating must not miss keys
                 idx = self.gw.list_objects(bucket, prefix=prefix,
-                                           max_keys=max_keys + 1)
+                                           max_keys=max_keys + 1,
+                                           marker=marker)
                 truncated = len(idx) > max_keys
                 if truncated:
                     idx = dict(sorted(idx.items())[:max_keys])
                 self._reply(200, _xml_listing(bucket, prefix,
                                               max_keys, idx,
-                                              truncated))
+                                              truncated, marker))
             else:
                 data, meta = self.gw.get_object(bucket, key)
                 self.send_response(200)

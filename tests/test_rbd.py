@@ -108,3 +108,53 @@ def test_snapshots(io):
     with pytest.raises(RBDError):
         img.snap_rollback("s1")
     rbd.remove("snapimg")
+
+
+def test_cow_snapshots_share_until_write(io):
+    """COW object-clone model: snap_create is O(1) (no data copied);
+    the first post-snap write copies only the touched objects; chain
+    reads resolve through newer layers to the head."""
+    from ceph_tpu.services.rbd import RBD
+    rbd = RBD(io)
+    layout = __import__("ceph_tpu.client.striper",
+                        fromlist=["FileLayout"]).FileLayout(
+        stripe_unit=4096, stripe_count=1, object_size=4096)
+    img = rbd.create("cow", 4 * 4096, layout=layout)
+    base = bytes(range(256)) * 64          # 16K = 4 objects
+    img.write(0, base)
+    img.snap_create("s1")
+    assert img._header["snaps"]["s1"]["objects"] == {}  # nothing copied
+    # write one object: exactly that object is copied into the layer
+    img.write(4096, b"B" * 4096)
+    assert set(img._header["snaps"]["s1"]["objects"]) == {"1"}
+    assert img.snap_read("s1") == base
+    # second snap; a write after it copies into s2 only
+    img.snap_create("s2")
+    img.write(0, b"C" * 4096)
+    assert set(img._header["snaps"]["s2"]["objects"]) == {"0"}
+    assert set(img._header["snaps"]["s1"]["objects"]) == {"1"}
+    after_s1 = bytearray(base)
+    after_s1[4096:8192] = b"B" * 4096
+    assert img.snap_read("s2") == bytes(after_s1)
+    assert img.snap_read("s1") == base      # resolved THROUGH s2's layer
+    # remove the middle snapshot: s1's view must survive via merge
+    img.snap_remove("s2")
+    assert img.snap_read("s1") == base
+    # rollback to s1 and verify newer... content restored
+    img.snap_rollback("s1")
+    assert img.read(0, 4 * 4096) == base
+
+
+def test_cow_rollback_preserves_other_snaps(io):
+    from ceph_tpu.services.rbd import RBD
+    rbd = RBD(io)
+    img = rbd.create("cow2", 1 << 20)
+    img.write(0, b"one")
+    img.snap_create("a")
+    img.write(0, b"two")
+    img.snap_create("b")
+    img.write(0, b"thr")
+    img.snap_rollback("a")
+    assert img.read(0, 3) == b"one"
+    assert img.snap_read("b")[:3] == b"two"   # b's view intact
+    assert img.snap_read("a")[:3] == b"one"
