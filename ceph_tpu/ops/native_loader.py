@@ -32,8 +32,10 @@ def get_lib():
         if _lib is not None or _failed:
             return _lib
         try:
-            if not _SO.exists() or _SO.stat().st_mtime < (
-                    _DIR / "gf256.cc").stat().st_mtime:
+            srcs = [_DIR / "gf256.cc", _DIR / "io_engine.cc"]
+            if not _SO.exists() or any(
+                    _SO.stat().st_mtime < src.stat().st_mtime
+                    for src in srcs if src.exists()):
                 subprocess.run(
                     ["make", "-s", "-C", str(_DIR)],
                     check=True, capture_output=True, timeout=300)
@@ -58,6 +60,21 @@ def _bind(lib) -> None:
     lib.ceph_crc32c.argtypes = [ctypes.c_uint32, u8p, ctypes.c_uint64]
     lib.ceph_xxhash64.restype = ctypes.c_uint64
     lib.ceph_xxhash64.argtypes = [ctypes.c_uint64, u8p, ctypes.c_uint64]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.ioeng_open.restype = ctypes.c_int
+    lib.ioeng_open.argtypes = [ctypes.c_char_p]
+    lib.ioeng_size.restype = ctypes.c_int64
+    lib.ioeng_size.argtypes = [ctypes.c_int]
+    lib.ioeng_append.restype = ctypes.c_int64
+    lib.ioeng_append.argtypes = [ctypes.c_int, u8p, ctypes.c_uint64,
+                                 ctypes.c_uint32, u32p]
+    lib.ioeng_read.restype = ctypes.c_int64
+    lib.ioeng_read.argtypes = [ctypes.c_int, ctypes.c_uint64, u8p,
+                               ctypes.c_uint64, ctypes.c_uint32, u32p]
+    lib.ioeng_sync.restype = ctypes.c_int
+    lib.ioeng_sync.argtypes = [ctypes.c_int]
+    lib.ioeng_close.restype = ctypes.c_int
+    lib.ioeng_close.argtypes = [ctypes.c_int]
     lib.ceph_xxhash32.restype = ctypes.c_uint32
     lib.ceph_xxhash32.argtypes = [ctypes.c_uint32, u8p, ctypes.c_uint64]
 

@@ -247,6 +247,15 @@ class Image:
     def _objnos(self, size: int) -> list[int]:
         return self._touched_objnos(0, size)
 
+    def _piece_limit(self, objno: int, size: int) -> int:
+        """Valid byte prefix of data object ``objno`` when the logical
+        data extends to ``size`` (raw piece reads must clamp here, or
+        stale bytes beyond a shrink would resurrect in snapshots)."""
+        if size <= 0:
+            return 0
+        return max((off + n for o, off, n in file_to_extents(
+            self._data.layout, 0, size) if o == objno), default=0)
+
     def _cow_protect(self, objnos) -> None:
         """Before a head data object changes, copy its CURRENT content
         into the newest snapshot's layer (first-write copy; objects a
@@ -259,25 +268,31 @@ class Image:
         meta = self._header["snaps"].get(snap)
         if meta is None or not meta.get("cow"):
             return
+        snap_dsize = meta.get("data_size", meta["size"])
         dirty = False
         for objno in objnos:
             key = f"{objno:x}"
             if key in meta["objects"]:
                 continue
-            try:
-                content = self.io.read(self._data._piece(objno))
-            except Exception as exc:
-                # ONLY absence is shareable-as-hole; a real I/O error
-                # (EIO etc.) must fail the write, or an 'absent'
-                # marker would silently zero the snapshot's only copy
-                if getattr(exc, "code", None) != -2:
-                    raise
-                content = None
-            if content is None:
+            limit = self._piece_limit(objno, snap_dsize)
+            content = None
+            if limit > 0:
+                try:
+                    content = self.io.read(self._data._piece(objno))
+                except Exception as exc:
+                    # ONLY absence is shareable-as-hole; a real I/O
+                    # error (EIO etc.) must fail the write, or an
+                    # 'absent' marker would silently zero the
+                    # snapshot's only copy
+                    if getattr(exc, "code", None) != -2:
+                        raise
+            if content is None or limit == 0:
                 meta["objects"][key] = "absent"
             else:
+                # clamp to the snapshot-time valid prefix: bytes past
+                # a shrink are logically zeros, not stale data
                 self.io.write_full(self._snap_piece(snap, objno),
-                                   content)
+                                   content[:limit])
                 meta["objects"][key] = "data"
             dirty = True
         if dirty:
@@ -296,14 +311,21 @@ class Image:
         start = order.index(snap)
         key = f"{objno:x}"
         for s in order[start:]:
-            marker = self._header["snaps"][s].get("objects",
-                                                  {}).get(key)
+            smeta = self._header["snaps"].get(s)
+            if smeta is None:
+                continue          # stale order entry
+            marker = smeta.get("objects", {}).get(key)
             if marker == "absent":
                 return b""
             if marker == "data":
                 return self.io.read(self._snap_piece(s, objno))
+        meta = self._header["snaps"][snap]
+        limit = self._piece_limit(objno,
+                                  meta.get("data_size", meta["size"]))
+        if limit <= 0:
+            return b""
         try:
-            return self.io.read(self._data._piece(objno))
+            return self.io.read(self._data._piece(objno))[:limit]
         except Exception as exc:
             if getattr(exc, "code", None) != -2:
                 raise
@@ -334,7 +356,12 @@ class Image:
         """Mirror bootstrap: materialize a PEER snapshot's point-in-
         time content as a full local layer (the dst head may already
         be newer, so sharing-with-head is not an option)."""
-        meta = {"size": size, "cow": True, "objects": {}}
+        if snap in self._header["snaps"]:
+            # forced resync: replace the layer, never duplicate the
+            # chain (a duplicate order entry breaks removal/resolution)
+            self._snap_remove_apply(snap)
+        meta = {"size": size, "cow": True, "objects": {},
+                "data_size": size}
         pieces: dict[int, bytearray] = {}
         pos = 0
         for objno, obj_off, n in file_to_extents(self._data.layout,
@@ -363,7 +390,8 @@ class Image:
         # O(1): record the layer; data objects are copied lazily on
         # the first post-snapshot write (librbd object-clone role)
         self._header["snaps"][snap] = {
-            "size": self._header["size"], "cow": True, "objects": {}}
+            "size": self._header["size"], "cow": True, "objects": {},
+            "data_size": self._data.size}
         self._snap_order().append(snap)
         self._save_header()
 

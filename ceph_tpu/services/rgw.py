@@ -338,14 +338,22 @@ class _Handler(BaseHTTPRequestHandler):
                         raise ValueError
                 except ValueError:
                     raise RGWError(400, "InvalidArgument") from None
-                # probe one past the page so IsTruncated is honest —
-                # a client that stops paginating must not miss keys
-                idx = self.gw.list_objects(bucket, prefix=prefix,
-                                           max_keys=max_keys + 1,
-                                           marker=marker)
-                truncated = len(idx) > max_keys
-                if truncated:
-                    idx = dict(sorted(idx.items())[:max_keys])
+                if max_keys == 0:
+                    # AWS: max-keys=0 answers an empty, NON-truncated
+                    # listing (truncated-with-no-marker would loop a
+                    # paginating client forever)
+                    idx, truncated = {}, False
+                    self.gw._check_bucket(bucket)
+                else:
+                    # probe one past the page so IsTruncated is
+                    # honest — a client that stops paginating must
+                    # not miss keys
+                    idx = self.gw.list_objects(
+                        bucket, prefix=prefix, max_keys=max_keys + 1,
+                        marker=marker)
+                    truncated = len(idx) > max_keys
+                    if truncated:
+                        idx = dict(sorted(idx.items())[:max_keys])
                 self._reply(200, _xml_listing(bucket, prefix,
                                               max_keys, idx,
                                               truncated, marker))

@@ -139,6 +139,36 @@ def _clip(extents: list[_Extent], a: int, b: int) -> list[_Extent]:
     return out
 
 
+class _PyDataFile:
+    """Pure-python twin of store/native_io.NativeDataFile (same raw
+    concatenated-blob format; returns None for crc so callers hash
+    via the configured csum fn)."""
+
+    def __init__(self, path: str) -> None:
+        self._f = open(path, "a+b")
+
+    def size(self) -> int:
+        self._f.seek(0, os.SEEK_END)
+        return self._f.tell()
+
+    def append(self, data: bytes):
+        self._f.seek(0, os.SEEK_END)
+        off = self._f.tell()
+        self._f.write(data)
+        return off, None
+
+    def read(self, off: int, length: int):
+        self._f.seek(off)
+        return self._f.read(length), None
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fdatasync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
 class BlockStore(ObjectStore):
     def __init__(self, path: str) -> None:
         self.path = path
@@ -150,7 +180,13 @@ class BlockStore(ObjectStore):
     # -- lifecycle ----------------------------------------------------
     def mount(self) -> None:
         self._db = FileDB(os.path.join(self.path, "db"))
-        self._data = open(os.path.join(self.path, "data"), "a+b")
+        data_path = os.path.join(self.path, "data")
+        # native data-plane engine (KernelDevice/aio role: one-pass
+        # append+crc32c, lock-free pread) with a pure-python fallback;
+        # both write the same raw-blob format
+        from ceph_tpu.store.native_io import NativeDataFile
+        self._data = NativeDataFile.open(data_path) \
+            or _PyDataFile(data_path)
 
     def umount(self) -> None:
         if self._db:
@@ -194,7 +230,6 @@ class BlockStore(ObjectStore):
         data_dirty = False
         # op idx -> (file_off, raw_len, disk_len, csum, comp_id, csum_id)
         blob_at: dict[int, tuple[int, int, int, int, int, int]] = {}
-        self._data.seek(0, os.SEEK_END)
         for i, op in enumerate(txn.ops):
             if op[0] == osr.OP_WRITE:
                 payload = op[4]
@@ -204,14 +239,16 @@ class BlockStore(ObjectStore):
                     if len(packed) <= len(payload) * comp_ratio:
                         stored = packed
                         comp_id = _COMP_IDS[comp_alg.name]
-                file_off = self._data.tell()
-                self._data.write(stored)
+                file_off, ncrc = self._data.append(bytes(stored))
+                # the native engine computed crc32c in the same pass;
+                # other csum types (or the python engine) hash here
+                csum = ncrc if (csum_id == 0 and ncrc is not None) \
+                    else csum_fn(stored)
                 blob_at[i] = (file_off, len(payload), len(stored),
-                              csum_fn(stored), comp_id, csum_id)
+                              csum, comp_id, csum_id)
                 data_dirty = True
         if data_dirty:
-            self._data.flush()
-            os.fdatasync(self._data.fileno())
+            self._data.sync()
 
         # stage 2: one kv batch for all metadata effects
         batch = WriteBatch()
@@ -320,10 +357,10 @@ class BlockStore(ObjectStore):
                 g_conf()["bluestore_compression_required_ratio"])
 
     def _read_blob(self, x: _Extent) -> bytes:
-        self._data.seek(x.blob_off)
-        blob = self._data.read(x.disk_len)
-        if len(blob) != x.disk_len or \
-                _CSUM_FNS[x.csum](blob) != x.blob_crc:
+        blob, ncrc = self._data.read(x.blob_off, x.disk_len)
+        got = ncrc if (x.csum == 0 and ncrc is not None) \
+            else _CSUM_FNS[x.csum](blob)
+        if len(blob) != x.disk_len or got != x.blob_crc:
             raise EIOError(
                 f"checksum mismatch reading blob at {x.blob_off}")
         if x.comp != COMP_NONE:

@@ -158,3 +158,40 @@ def test_cow_rollback_preserves_other_snaps(io):
     assert img.read(0, 3) == b"one"
     assert img.snap_read("b")[:3] == b"two"   # b's view intact
     assert img.snap_read("a")[:3] == b"one"
+
+
+def test_cow_snapshot_does_not_resurrect_shrunk_data(io):
+    """Regression: raw piece reads must clamp at the snapshot-time
+    valid prefix — bytes logically discarded by a shrink must stay
+    zeros in snapshots taken after the shrink."""
+    from ceph_tpu.services.rbd import RBD
+    from ceph_tpu.client.striper import FileLayout
+    rbd = RBD(io)
+    layout = FileLayout(stripe_unit=4096, stripe_count=1,
+                        object_size=4096)
+    img = rbd.create("shrinky", 2 * 4096, layout=layout)
+    img.write(0, b"A" * 8192)
+    img.resize(4096)                 # logical tail discarded
+    img.resize(8192)                 # regrow: tail must read zeros
+    assert img.read(4096, 4096) == b"\x00" * 4096
+    img.snap_create("s")
+    assert img.snap_read("s")[4096:] == b"\x00" * 4096
+    # write after the snap: COW copy must also honor the clamp
+    img.write(4096, b"B" * 4096)
+    assert img.snap_read("s")[4096:] == b"\x00" * 4096
+    assert img.snap_read("s")[:4096] == b"A" * 4096
+
+
+def test_snap_ingest_resync_does_not_duplicate_chain(io):
+    from ceph_tpu.services.rbd import RBD
+    rbd = RBD(io)
+    img = rbd.create("resync", 1 << 16)
+    img.write(0, b"data")
+    img._snap_ingest("a", b"data", 4)
+    img._snap_ingest("b", b"datb", 4)
+    img._snap_ingest("a", b"datc", 4)       # forced resync
+    assert img._snap_order() == ["b", "a"]
+    assert img.snap_read("a") == b"datc"
+    img._snap_remove_apply("a")
+    img._snap_remove_apply("b")
+    assert img._snap_order() == []
