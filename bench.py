@@ -74,9 +74,19 @@ def main() -> None:
         return int(jnp.sum(out[:, ::4096].astype(jnp.uint32)))
 
     force(chained(ddata, 2))  # warmup / compile
-    # the tunnel chip is shared: contention only ever slows a run, so
-    # take the best slope across several measurement rounds
-    slope = float("inf")
+    # the tunnel chip is shared: contention only ever slows a run — but
+    # it can also slow the SHORT run disproportionately, inflating one
+    # slope to a physically impossible number. Guard both ways: collect
+    # many slopes, discard any implying more than the chip's HBM
+    # bandwidth (the kernel moves at least data+parity through HBM, so
+    # > ~820 GB/s is measurement noise, not throughput), and report the
+    # best surviving slope.
+    data_bytes = K * n
+    hbm_ceiling_gbps = 820.0
+    # per-iteration HBM traffic is at least data-in + parity-out
+    min_traffic = data_bytes * (K + M) // K
+    min_slope = min_traffic / (hbm_ceiling_gbps * 1e9)
+    slopes = []
     for round_ in range(12):
         times = {}
         for iters in LOOP_COUNTS:
@@ -88,11 +98,12 @@ def main() -> None:
             times[iters] = best
         s = (times[LOOP_COUNTS[1]] - times[LOOP_COUNTS[0]]) / (
             LOOP_COUNTS[1] - LOOP_COUNTS[0])
-        if s > 0:
-            slope = min(slope, s)
+        if s >= min_slope:
+            slopes.append(s)
         time.sleep(1.0)   # spread rounds over contention windows
-
-    data_bytes = K * n
+    if not slopes:        # every round was noise-dominated: be honest
+        slopes = [times[max(LOOP_COUNTS)] / max(LOOP_COUNTS)]
+    slope = min(slopes)
     gbps = data_bytes / slope / 1e9
     print(json.dumps({
         "metric": "ec_encode_rs_k8m3_device_GBps",

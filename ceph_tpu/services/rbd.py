@@ -250,11 +250,23 @@ class Image:
     def _piece_limit(self, objno: int, size: int) -> int:
         """Valid byte prefix of data object ``objno`` when the logical
         data extends to ``size`` (raw piece reads must clamp here, or
-        stale bytes beyond a shrink would resurrect in snapshots)."""
+        stale bytes beyond a shrink would resurrect in snapshots).
+        O(1) layout arithmetic — enumerating the whole extent list
+        would make rollback/copy-up quadratic in object count."""
         if size <= 0:
             return 0
-        return max((off + n for o, off, n in file_to_extents(
-            self._data.layout, 0, size) if o == objno), default=0)
+        lay = self._data.layout
+        su, sc, osz = (lay.stripe_unit, lay.stripe_count,
+                       lay.object_size)
+        set_idx, pos = objno // sc, objno % sc
+        set_bytes = osz * sc
+        if size >= (set_idx + 1) * set_bytes:
+            return osz                 # object fully inside the data
+        rem = size - set_idx * set_bytes
+        if rem <= 0:
+            return 0                   # object set beyond the data
+        full_rounds, extra = divmod(rem, su * sc)
+        return full_rounds * su + min(max(extra - pos * su, 0), su)
 
     def _cow_protect(self, objnos) -> None:
         """Before a head data object changes, copy its CURRENT content
@@ -286,7 +298,7 @@ class Image:
                     # snapshot's only copy
                     if getattr(exc, "code", None) != -2:
                         raise
-            if content is None or limit == 0:
+            if content is None:
                 meta["objects"][key] = "absent"
             else:
                 # clamp to the snapshot-time valid prefix: bytes past
@@ -356,9 +368,15 @@ class Image:
         """Mirror bootstrap: materialize a PEER snapshot's point-in-
         time content as a full local layer (the dst head may already
         be newer, so sharing-with-head is not an option)."""
+        order = self._snap_order()
+        insert_at = len(order)
         if snap in self._header["snaps"]:
-            # forced resync: replace the layer, never duplicate the
-            # chain (a duplicate order entry breaks removal/resolution)
+            # forced resync: replace the layer IN PLACE — appending
+            # would move this snap past chronologically newer ones,
+            # and their unshared objects would then wrongly resolve
+            # through this older layer
+            if snap in order:
+                insert_at = order.index(snap)
             self._snap_remove_apply(snap)
         meta = {"size": size, "cow": True, "objects": {},
                 "data_size": size}
@@ -376,7 +394,7 @@ class Image:
                                bytes(buf))
             meta["objects"][f"{objno:x}"] = "data"
         self._header["snaps"][snap] = meta
-        self._snap_order().append(snap)
+        self._snap_order().insert(insert_at, snap)
         self._save_header()
 
     def snap_create(self, snap: str) -> None:

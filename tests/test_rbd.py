@@ -190,8 +190,11 @@ def test_snap_ingest_resync_does_not_duplicate_chain(io):
     img._snap_ingest("a", b"data", 4)
     img._snap_ingest("b", b"datb", 4)
     img._snap_ingest("a", b"datc", 4)       # forced resync
-    assert img._snap_order() == ["b", "a"]
+    # the chain POSITION is preserved: appending would move 'a' past
+    # chronologically newer snaps, corrupting their resolution
+    assert img._snap_order() == ["a", "b"]
     assert img.snap_read("a") == b"datc"
+    assert img.snap_read("b") == b"datb"
     img._snap_remove_apply("a")
     img._snap_remove_apply("b")
     assert img._snap_order() == []
