@@ -63,47 +63,17 @@ def main() -> None:
     bmat = gf_pallas._perm_cache.get(mat, g)
     tile = gf_pallas.DEFAULT_TILE // g
 
-    @functools.partial(jax.jit, static_argnums=1)
-    def chained(d, iters):
-        def body(i, dd):
-            p = gf_pallas._matvec_padded(bmat, dd, K, M, g, tile)
-            return dd.at[0:1].set(p[0:1])  # data dependency between iters
-        return jax.lax.fori_loop(0, iters, body, d)
+    from ceph_tpu.bench.measure import chained_slope
 
-    def force(out):
-        return int(jnp.sum(out[:, ::4096].astype(jnp.uint32)))
+    def step(dd):
+        p = gf_pallas._matvec_padded(bmat, dd, K, M, g, tile)
+        return dd.at[0:1].set(p[0:1])  # data dependency between iters
 
-    force(chained(ddata, 2))  # warmup / compile
-    # the tunnel chip is shared: contention only ever slows a run — but
-    # it can also slow the SHORT run disproportionately, inflating one
-    # slope to a physically impossible number. Guard both ways: collect
-    # many slopes, discard any implying more than the chip's HBM
-    # bandwidth (the kernel moves at least data+parity through HBM, so
-    # > ~820 GB/s is measurement noise, not throughput), and report the
-    # best surviving slope.
     data_bytes = K * n
-    hbm_ceiling_gbps = 820.0
-    # per-iteration HBM traffic is at least data-in + parity-out
-    min_traffic = data_bytes * (K + M) // K
-    min_slope = min_traffic / (hbm_ceiling_gbps * 1e9)
-    slopes = []
-    for round_ in range(12):
-        times = {}
-        for iters in LOOP_COUNTS:
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                force(chained(ddata, iters))
-                best = min(best, time.perf_counter() - t0)
-            times[iters] = best
-        s = (times[LOOP_COUNTS[1]] - times[LOOP_COUNTS[0]]) / (
-            LOOP_COUNTS[1] - LOOP_COUNTS[0])
-        if s >= min_slope:
-            slopes.append(s)
-        time.sleep(1.0)   # spread rounds over contention windows
-    if not slopes:        # every round was noise-dominated: be honest
-        slopes = [times[max(LOOP_COUNTS)] / max(LOOP_COUNTS)]
-    slope = min(slopes)
+    slope = chained_slope(
+        step, ddata, counts=LOOP_COUNTS, rounds=20,
+        # per-iteration HBM traffic is at least data-in + parity-out
+        min_traffic_bytes=data_bytes * (K + M) // K)
     gbps = data_bytes / slope / 1e9
     print(json.dumps({
         "metric": "ec_encode_rs_k8m3_device_GBps",

@@ -45,6 +45,9 @@ def parse_args(argv):
                     choices=("random", "exhaustive"))
     ap.add_argument("--batch", type=int, default=1,
                     help="objects per kernel launch (device batching)")
+    ap.add_argument("--device-resident", action="store_true",
+                    help="keep buffers in HBM between iterations and "
+                         "measure by chained slope (TPU only)")
     ap.add_argument("--backend", default="auto")
     ap.add_argument("--seed", type=int, default=42)
     return ap.parse_args(argv)
@@ -66,6 +69,11 @@ class ErasureCodeBench:
         self.n = self.codec.get_chunk_count()
 
     def run(self) -> tuple[float, int]:
+        if self.args.device_resident:
+            if self.args.workload != "encode":
+                raise SystemExit(
+                    "--device-resident supports encode only")
+            return self.encode_device_resident()
         if self.args.workload == "encode":
             return self.encode()
         return self.decode()
@@ -89,6 +97,44 @@ class ErasureCodeBench:
                 self.codec.encode(want, data)
                 total += len(data)
         elapsed = time.perf_counter() - begin
+        return elapsed, total // 1024
+
+    def encode_device_resident(self) -> tuple[float, int]:
+        """Device-resident chained-slope encode (shared machinery in
+        bench/measure.py): the stripe batch stays in HBM between
+        iterations the way the OSD stripe accumulator feeds the chip.
+        Matrix codecs on a TPU backend only."""
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() == "cpu":
+            raise SystemExit("--device-resident needs a TPU backend")
+        mat = getattr(self.codec, "coding_matrix", None)
+        if mat is None:
+            raise SystemExit(
+                "--device-resident needs a matrix codec "
+                "(jerasure/isa/shec)")
+        from ceph_tpu.bench.measure import chained_slope
+        from ceph_tpu.ops import gf_pallas
+        mat = np.asarray(mat, dtype=np.uint8)
+        total_bytes = self.args.size * self.args.batch
+        n_lanes = max(total_bytes // self.k, 1)
+        rng = np.random.default_rng(self.args.seed)
+        data = jnp.asarray(rng.integers(
+            0, 256, size=(self.k, n_lanes), dtype=np.uint8))
+        m_out = mat.shape[0]
+
+        def step(dd):
+            # matvec_device pads/tiles arbitrary lane counts — a raw
+            # _matvec_padded call silently skips tail lanes
+            p = gf_pallas.matvec_device(mat, dd)
+            return dd.at[0:1].set(p[0:1])
+
+        slope = chained_slope(
+            step, data,
+            min_traffic_bytes=n_lanes * (self.k + m_out))
+        elapsed = slope * self.args.iterations
+        total = n_lanes * self.k * self.args.iterations
         return elapsed, total // 1024
 
     def _erasure_patterns(self):

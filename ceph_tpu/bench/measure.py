@@ -1,0 +1,66 @@
+"""Shared device-resident measurement machinery.
+
+The axon tunnel to the chip has ~10^2 ms RTT and contention from other
+users, so wall-timing one launch is wrong in both directions. Both
+bench harnesses (bench.py, ec_bench --device-resident) measure the
+same way: run the kernel inside a jitted ``fori_loop`` with a real
+data dependency between iterations, take the slope between two
+iteration counts (dispatch/fetch overhead cancels), collect many
+slopes across contention windows, and discard any implying more HBM
+traffic than the chip can move (a contended SHORT run inflates the
+slope to physically impossible numbers — observed TB/s).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+#: v5e HBM bandwidth ceiling used by the noise guard
+HBM_CEILING_GBPS = 820.0
+
+
+def chained_slope(step_fn, x0, *, min_traffic_bytes: int,
+                  counts: tuple[int, int] = (5, 25), rounds: int = 12,
+                  sleep: float = 1.0) -> float:
+    """Seconds per iteration of ``step_fn`` (device-resident).
+
+    ``step_fn(x) -> x'`` must carry a data dependency through its
+    return value. ``min_traffic_bytes``: the least HBM traffic one
+    iteration can possibly move — slopes implying more than
+    HBM_CEILING_GBPS for that traffic are rejected as noise.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def loop(x, iters):
+        def body(i, xx):
+            return step_fn(xx)
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    def force(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return int(jnp.sum(leaf.reshape(-1)[::4096]
+                           .astype(jnp.uint32)))
+
+    force(loop(x0, 2))                   # warmup / compile
+    min_slope = min_traffic_bytes / (HBM_CEILING_GBPS * 1e9)
+    slopes = []
+    times = {}
+    for _ in range(rounds):
+        for iters in counts:
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                force(loop(x0, iters))
+                best = min(best, time.perf_counter() - t0)
+            times[iters] = best
+        s = (times[counts[1]] - times[counts[0]]) / (
+            counts[1] - counts[0])
+        if s >= min_slope:
+            slopes.append(s)
+        time.sleep(sleep)                # spread contention windows
+    if not slopes:                       # all noise-dominated: honest
+        slopes = [times[counts[1]] / counts[1]]
+    return min(slopes)

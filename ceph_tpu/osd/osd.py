@@ -138,6 +138,12 @@ class OSD:
         self._pgscan_lock = threading.Lock()
         self._pgscan_pending = False
         self._pgscan_running = False
+        # recovery reservation (recovery_reservation.rst role): bound
+        # concurrent recovery rounds per OSD so a mass failure does
+        # not fan out unbounded push traffic; throttled PGs are
+        # requeued by the heartbeat tick's _kick_recovery
+        self._recovery_res_lock = threading.Lock()
+        self._recovery_active = 0
         self._backends: dict[int, PGBackend] = {}
         self._tid = 0
         self._tid_lock = threading.Lock()
@@ -1234,6 +1240,18 @@ class OSD:
         timer.start()
 
     # -- recovery (continue_recovery_op role) -------------------------
+    def _reserve_recovery(self) -> bool:
+        limit = g_conf()["osd_max_backfills"]
+        with self._recovery_res_lock:
+            if self._recovery_active >= limit:
+                return False
+            self._recovery_active += 1
+            return True
+
+    def _unreserve_recovery(self) -> None:
+        with self._recovery_res_lock:
+            self._recovery_active -= 1
+
     def _recover(self, pg: PG) -> dict[int, list[str]]:
         acked_by_pos: dict[int, list[str]] = {}
         with pg.lock:
@@ -1243,6 +1261,10 @@ class OSD:
                 del pg.peer_missing[pos]
             if pg.state != PG.ACTIVE or not pg.peer_missing \
                     or pg.recovery_in_flight:
+                return acked_by_pos
+            if not self._reserve_recovery():
+                # over the per-OSD reservation budget: leave the PG
+                # dirty; the tick requeues it when a slot frees
                 return acked_by_pos
             pg.recovery_in_flight = True
             work = {pos: dict(missing)
@@ -1258,6 +1280,7 @@ class OSD:
         finally:
             with pg.lock:
                 pg.recovery_in_flight = False
+            self._unreserve_recovery()
         return acked_by_pos
 
     def _recover_work(self, pg: PG, work: dict[int, dict[str, int]],

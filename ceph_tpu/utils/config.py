@@ -225,6 +225,10 @@ for _o in [
            "seconds between peer pings (scaled down from the reference's 6)"),
     Option("osd_heartbeat_grace", float, 4.0, "advanced",
            "seconds before a silent peer is reported failed"),
+    Option("osd_max_backfills", int, 2, "advanced",
+           "max concurrent recovery/backfill rounds per OSD "
+           "(recovery-reservation throttle; reference default 1, "
+           "src/common/options.cc osd_max_backfills)"),
     Option("mon_commit_timeout", float, 10.0, "advanced",
            "fail a command whose commit gathers no majority ack "
            "within this many seconds"),

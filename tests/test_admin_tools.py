@@ -166,3 +166,16 @@ def test_rados_cli_and_bench(cluster, capsys, tmp_path, monkeypatch):
     assert rep["read"]["objects"] == rep["objects"]
     assert rados_cli.main(["-m", addr, "-p", "admpool", "rm",
                            "cliobj"]) == 0
+
+
+def test_ec_bench_device_resident_flag_cpu_errors_cleanly(monkeypatch):
+    """--device-resident is a TPU-only mode; without one it must
+    refuse with a clear message, not crash (backend forced so the
+    test is deterministic even on accelerator-attached hosts)."""
+    import jax
+    import pytest
+    from ceph_tpu.bench import ec_bench
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    with pytest.raises(SystemExit, match="TPU backend"):
+        ec_bench.main(["-p", "isa", "-P", "k=2", "-P", "m=1",
+                       "--device-resident"])

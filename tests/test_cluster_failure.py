@@ -228,3 +228,44 @@ def test_trimmed_log_backfill_no_resurrection(fast_death, monkeypatch):
                 if cid.startswith("pg_"):
                     assert "ghost" not in store.list_objects(cid), \
                         (osd_id, cid)
+
+
+def test_recovery_converges_under_reservation_throttle():
+    """osd_max_backfills=1 (recovery-reservation role): with many dirty
+    PGs and one recovery slot per OSD, throttled PGs are requeued by
+    the tick and the cluster still converges to clean."""
+    import os
+
+    from ceph_tpu.utils.config import g_conf
+    conf = g_conf()
+    old = {k: conf[k] for k in ("osd_max_backfills",
+                                "osd_heartbeat_interval",
+                                "osd_heartbeat_grace")}
+    conf.set("osd_max_backfills", 1)
+    conf.set("osd_heartbeat_interval", 0.25)
+    conf.set("osd_heartbeat_grace", 1.0)
+    try:
+        with MiniCluster(n_osds=3) as c:
+            rados = c.client()
+            c.create_pool("thr", pg_num=8, size=2)
+            io = rados.open_ioctx("thr")
+            blobs = {f"o{i}": os.urandom(20_000) for i in range(24)}
+            for o, b in blobs.items():
+                io.write_full(o, b)
+            victim = 1
+            epoch = c.epoch()
+            c.kill_osd(victim)
+            c.wait_for_osd_down(victim, timeout=30)
+            rados.wait_for_epoch(epoch + 1, timeout=10)
+            for o, b in blobs.items():
+                io.write_full(o, b[::-1])     # dirty every PG degraded
+            c.revive_osd(victim)
+            c.wait_for_osds_up(timeout=15)
+            c.wait_for_clean(timeout=60)
+            for o, b in blobs.items():
+                assert io.read(o) == b[::-1]
+            for osd in c.osds.values():
+                assert osd._recovery_active == 0, "leaked reservation"
+    finally:
+        for k, v in old.items():
+            conf.set(k, v)
