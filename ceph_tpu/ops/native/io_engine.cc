@@ -36,6 +36,10 @@ int64_t ioeng_size(int fd) {
 
 // append the blob; returns its file offset (or -errno). *crc_out gets
 // crc32c(seed, blob) computed while the buffer is hot.
+// CONCURRENCY CONTRACT: the offset is derived from fstat(st_size), so
+// concurrent appends to one fd would alias offsets — callers must
+// serialize appends (BlockStore holds its append lock); preads need
+// no lock.
 int64_t ioeng_append(int fd, const uint8_t *buf, uint64_t len,
                      uint32_t seed, uint32_t *crc_out) {
   struct stat st;

@@ -23,8 +23,8 @@ mark the OSD down (OSDMonitor semantics). Beacons ride MOSDAlive.
 
 from __future__ import annotations
 
+import collections
 import json
-import queue
 import threading
 import time
 
@@ -79,30 +79,90 @@ ESTALE = -116
 EINVAL = -22
 
 
+#: QoS classes of the sharded queue (the reference's op classes:
+#: client ops vs recovery vs scrub, src/osd/OSD.cc:2095 + dmclock)
+QOS_CLIENT = "client"
+QOS_RECOVERY = "recovery"
+QOS_SCRUB = "scrub"
+
+
+class _WQShard:
+    """One worker's weighted-priority queues (the WPQ seat of the
+    reference's mClock/WPQ sharded queue)."""
+
+    __slots__ = ("cv", "queues", "credits")
+
+    def __init__(self, weights: dict[str, int]) -> None:
+        self.cv = threading.Condition()
+        self.queues = {cls: collections.deque() for cls in weights}
+        self.credits = dict(weights)
+
+
 class ShardedOpWQ:
     """The sharded op queue (OSD.cc:2095): work is hashed by pgid onto
     one of N worker threads, giving per-PG ordering with cross-PG
-    parallelism."""
+    parallelism. Within a shard, classes share the worker by weighted
+    round-robin (WPQ semantics, options.cc osd_client_op_priority=63
+    vs osd_recovery_op_priority=3): under client load recovery still
+    trickles (never starves) but cannot crowd out client latency —
+    the property the reference gets from its mClock/WPQ queue."""
 
-    def __init__(self, name: str, num_shards: int) -> None:
-        self._queues = [queue.Queue() for _ in range(num_shards)]
-        self._threads = [
-            threading.Thread(target=self._worker, args=(q,),
-                             name=f"{name}-wq-{i}", daemon=True)
-            for i, q in enumerate(self._queues)]
+    def __init__(self, name: str, num_shards: int,
+                 weights: dict[str, int] | None = None) -> None:
+        conf = g_conf()
+        self._weights = weights or {
+            QOS_CLIENT: max(1, conf["osd_client_op_priority"]),
+            QOS_RECOVERY: max(1, conf["osd_recovery_op_priority"]),
+            QOS_SCRUB: max(1, conf["osd_scrub_priority"]),
+        }
+        self._shards = [_WQShard(self._weights)
+                        for _ in range(num_shards)]
         self._running = True
+        self._threads = [
+            threading.Thread(target=self._worker, args=(sh,),
+                             name=f"{name}-wq-{i}", daemon=True)
+            for i, sh in enumerate(self._shards)]
         for t in self._threads:
             t.start()
 
-    def enqueue(self, key, fn) -> None:
-        if self._running:
-            self._queues[hash(key) % len(self._queues)].put(fn)
+    def enqueue(self, key, fn, qos: str = QOS_CLIENT) -> None:
+        if not self._running:
+            return
+        sh = self._shards[hash(key) % len(self._shards)]
+        with sh.cv:
+            sh.queues.get(qos, sh.queues[QOS_CLIENT]).append(fn)
+            sh.cv.notify()
 
-    def _worker(self, q: queue.Queue) -> None:
+    def _dequeue(self, sh: _WQShard):
+        """Weighted round-robin pick (caller holds sh.cv): serve each
+        class up to its weight per cycle; refill when every non-empty
+        class is out of credit. Strict priority would starve recovery
+        outright; WRR bounds it to weight_r/(sum weights) of slots."""
         while True:
-            fn = q.get()
-            if fn is None:
-                return
+            any_waiting = False
+            for cls, q in sh.queues.items():
+                if q and sh.credits[cls] > 0:
+                    sh.credits[cls] -= 1
+                    return q.popleft()
+                if q:
+                    any_waiting = True
+            if any_waiting:
+                sh.credits.update(self._weights)   # new WRR cycle
+                continue
+            return None
+
+    def _worker(self, sh: _WQShard) -> None:
+        while True:
+            with sh.cv:
+                fn = self._dequeue(sh)
+                while fn is None:
+                    # queues fully drained (every class): exit only
+                    # then, so no queued recovery/scrub item is
+                    # abandoned on shutdown
+                    if not self._running:
+                        return
+                    sh.cv.wait()
+                    fn = self._dequeue(sh)
             try:
                 fn()
             except Exception as exc:
@@ -110,8 +170,9 @@ class ShardedOpWQ:
 
     def drain_stop(self) -> None:
         self._running = False
-        for q in self._queues:
-            q.put(None)
+        for sh in self._shards:
+            with sh.cv:
+                sh.cv.notify_all()
         for t in self._threads:
             t.join(timeout=5)
 
@@ -499,7 +560,8 @@ class OSD:
                 pgid, lambda: self._handle_pg_query(msg, conn))
         elif isinstance(msg, M.MPGPush):
             self.op_wq.enqueue(pgid,
-                               lambda: self._handle_pg_push(msg, conn))
+                               lambda: self._handle_pg_push(msg, conn),
+                               qos=QOS_RECOVERY)
         else:
             log(5, f"unhandled message {msg!r}")
 
@@ -966,7 +1028,8 @@ class OSD:
             f"missing={ {p: len(m) for p, m in pg.peer_missing.items()} }")
         self._flush_waiting(pg)
         if pg.peer_missing:
-            self.op_wq.enqueue(pg.pgid, lambda: self._recover(pg))
+            self.op_wq.enqueue(pg.pgid, lambda: self._recover(pg),
+                               qos=QOS_RECOVERY)
 
     # -- scrub (PGBackend::be_compare_scrubmaps role) -----------------
     def scrub_pg(self, pgid: tuple[int, int], repair: bool = True,
@@ -1267,8 +1330,22 @@ class OSD:
                 # dirty; the tick requeues it when a slot frees
                 return acked_by_pos
             pg.recovery_in_flight = True
-            work = {pos: dict(missing)
-                    for pos, missing in pg.peer_missing.items()}
+            # cap the round (osd_recovery_max_single_start role): a
+            # queue item pushes at most this many objects PER POSITION
+            # then yields the wq shard back — the granularity the WPQ
+            # needs to keep client latency bounded during recovery
+            cap = max(1, g_conf()["osd_recovery_max_single_start"])
+            work: dict[int, dict[str, int]] = {}
+            truncated_pos: set[int] = set()
+            for pos, missing in pg.peer_missing.items():
+                take = dict(list(missing.items())[:cap])
+                if len(take) < len(missing):
+                    # THIS position has more beyond the cap; others
+                    # that fit fully may still log-sync this round
+                    truncated_pos.add(pos)
+                if take:
+                    work[pos] = take
+            truncated = bool(truncated_pos)
             # snapshot: a peering mid-round swaps which OSD holds a
             # position and recomputes peer_missing; a stale round must
             # neither push to the new holder as if it were the old one
@@ -1276,16 +1353,25 @@ class OSD:
             acting = list(pg.acting)
             epoch = pg.epoch
         try:
-            self._recover_work(pg, work, acked_by_pos, acting, epoch)
+            self._recover_work(pg, work, acked_by_pos, acting, epoch,
+                               truncated_pos=truncated_pos)
         finally:
             with pg.lock:
                 pg.recovery_in_flight = False
             self._unreserve_recovery()
+            if truncated:
+                # more missing objects remain: continue as a NEW
+                # recovery-class item (client ops interleave between
+                # chunks via the WPQ credits)
+                self.op_wq.enqueue(pg.pgid,
+                                   lambda: self._recover(pg),
+                                   qos=QOS_RECOVERY)
         return acked_by_pos
 
     def _recover_work(self, pg: PG, work: dict[int, dict[str, int]],
                       acked_by_pos: dict[int, list[str]],
-                      acting: list[int], epoch: int) -> None:
+                      acting: list[int], epoch: int,
+                      truncated_pos: set[int] | None = None) -> None:
         unrebuildable: dict[str, int] = {}    # oid -> wanted version
         for pos, missing in work.items():
             osd = acting[pos] if pos < len(acting) else -1
@@ -1323,8 +1409,11 @@ class OSD:
             acked_by_pos[pos] = acked
             # the shard's pgmeta only advances once every pushed object
             # is acked durable — a lost push leaves it visibly behind,
-            # so the next peering retries instead of trusting it
-            if set(acked) == set(missing):
+            # so the next peering retries instead of trusting it.
+            # A position truncated by the round cap can never
+            # log-sync yet: objects beyond the cap are still missing.
+            if set(acked) == set(missing) and \
+                    pos not in (truncated_pos or ()):
                 self._log_sync_shard(pg, pos, acked, acting, epoch)
             elif acked:
                 with pg.lock:
@@ -1461,13 +1550,21 @@ class OSD:
             for iw in stale:
                 del self._inflight[iw.tid]
         for iw in stale:
-            dropped = iw.expire()
+            dropped, fire = iw.expire()
             if dropped:
                 log(1, f"write tid {iw.tid} ({iw.oid}) expired with "
                     f"positions {dropped} unheard")
-                self.op_wq.enqueue(
-                    iw.pg.pgid,
-                    lambda w=iw, d=dropped: self._record_missing(w, d))
+            if dropped or fire is not None:
+                # one wq job, ordered with the PG's client ops: record
+                # the dropped shards missing BEFORE the extent-cache
+                # unpin fires, or a racing RMW could snapshot a cache
+                # lacking the expired version yet still read the stale
+                # shard as its floor (lost update)
+                def _expired(w=iw, d=dropped, f=fire):
+                    self._record_missing(w, d)
+                    if f is not None:
+                        f()
+                self.op_wq.enqueue(iw.pg.pgid, _expired)
 
     def _kick_recovery(self) -> None:
         """Retry recovery for PGs whose missing set persists (a push
@@ -1483,7 +1580,8 @@ class OSD:
             if pg.state == PG.ACTIVE and not pg.recovery_in_flight \
                     and pg.missing_dirty():
                 self.op_wq.enqueue(pg.pgid,
-                                   lambda p=pg: self._recover(p))
+                                   lambda p=pg: self._recover(p),
+                                   qos=QOS_RECOVERY)
 
     def _report_pg_stats(self, epoch: int) -> None:
         """Ship primary-side PG stats to the mon (MgrClient report

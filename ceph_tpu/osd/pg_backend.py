@@ -128,19 +128,25 @@ class InflightWrite:
                 finished = True
         return finished, dropped
 
-    def expire(self) -> list[int]:
-        """Timeout sweep: abandon the write, returning the positions
-        never heard from (caller records them missing). The client owns
+    def expire(self) -> "tuple[list[int], Callable[[], None] | None]":
+        """Timeout sweep: abandon the write, returning (positions never
+        heard from, deferred on_expire-or-None). The client owns
         end-to-end completion: it times out and resends, and the dup-op
-        cache makes the resend safe."""
+        cache makes the resend safe.
+
+        on_expire is NOT fired here: the caller must record the dropped
+        positions in pg.peer_missing FIRST, then invoke it — firing the
+        extent-cache unpin before the missing bookkeeping would let an
+        RMW racing in that window snapshot a cache lacking the expired
+        version and read the stale shard (not yet avoided) as its
+        floor: a lost update."""
         with self._lock:
             already = self._done
             self._done = True
             dropped = sorted(self.pending)
             self.pending.clear()
-        if not already and self.on_expire is not None:
-            self.on_expire()
-        return dropped
+        fire = None if already else self.on_expire
+        return dropped, fire
 
 
 class Listener(Protocol):

@@ -32,11 +32,23 @@ class JournalError(Exception):
     pass
 
 
+class JournalTrimmedError(JournalError):
+    """The requested position was trimmed away — the events are gone
+    for good (distinct from a transient read failure, which a reader
+    must NOT treat as end-of-journal)."""
+
+
 class Journaler:
     def __init__(self, ioctx, name: str) -> None:
         self.io = ioctx
         self.name = name
         self.header_oid = f"journal.{name}"
+        # per-instance caches (each client id is single-writer for its
+        # own position, so commit() need not re-read the registry and
+        # position objects on every call — three round trips saved per
+        # image mutation)
+        self._registered: set[str] = set()
+        self._commit_cache: dict[str, int] = {}
 
     # -- header --------------------------------------------------------
     def _load(self) -> dict:
@@ -145,19 +157,27 @@ class Journaler:
 
     # -- readers -------------------------------------------------------
     def read_from(self, pos: int):
-        """Yield (position, payload) for every entry >= pos, in order."""
+        """Yield (position, payload) for every entry >= pos, in order.
+
+        Raises JournalTrimmedError when ``pos`` is below the trim
+        floor, and JournalError when a chunk below ``end`` cannot be
+        read — a transient failure must surface, not silently end the
+        stream (a replayer that mistook it for end-of-journal would
+        advance its commit position past events it never applied)."""
         h = self._load()
         end = h["entries"]
         floor = self._trimmed_to()
         if pos < floor:
-            raise JournalError(
+            raise JournalTrimmedError(
                 f"position {pos} already trimmed (floor {floor})")
         chunk = pos // SPLAY
         while chunk * SPLAY < end:
             try:
                 raw = self.io.read(self._chunk_oid(chunk))
-            except Exception:
-                break
+            except Exception as exc:
+                raise JournalError(
+                    f"journal chunk {chunk} unreadable: {exc}") \
+                    from exc
             d = Decoder(raw)
             while not d.eof():
                 epos = d.u64()
@@ -172,12 +192,19 @@ class Journaler:
         client owns its position object — no shared header RMW with
         the writer's append path. First commit registers the client id
         (registry RMW happens once per client, not per commit)."""
-        if client not in self._registry():
-            self.io.execute(self._registry_oid, "log", "add",
-                            client.encode())
-        pos = max(pos, self.committed(client))
-        self.io.write_full(self._client_oid(client),
-                           pos.to_bytes(8, "little"))
+        if client not in self._registered:
+            if client not in self._registry():
+                self.io.execute(self._registry_oid, "log", "add",
+                                client.encode())
+            self._registered.add(client)
+        prev = self._commit_cache.get(client)
+        if prev is None:
+            prev = self.committed(client)
+        pos = max(pos, prev)
+        if pos != prev or prev == 0:
+            self.io.write_full(self._client_oid(client),
+                               pos.to_bytes(8, "little"))
+        self._commit_cache[client] = pos
 
     def committed(self, client: str) -> int:
         try:

@@ -36,10 +36,14 @@ from ceph_tpu.client.striper import (
     StripedObject,
     file_to_extents,
 )
-from ceph_tpu.services.journal import Journaler
+from ceph_tpu.services.journal import Journaler, JournalError
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 DIRECTORY_OID = "rbd_directory"
+
+#: the writer's own journal-client id: tracks which events the PRIMARY
+#: image has actually applied (mirror targets use their own client ids)
+LOCAL_CLIENT = "local"
 
 
 class RBDError(Exception):
@@ -118,14 +122,19 @@ class RBD:
         d.pop(name, None)
         _save_dir(self.io, d)
 
-    def open(self, name: str) -> "Image":
-        return Image(self.io, name)
+    def open(self, name: str, read_only: bool = False) -> "Image":
+        """Open an image. The writing open (default) replays any
+        journaled-but-unapplied tail; ``read_only`` skips replay —
+        required for opens that may run concurrently with the live
+        writer (admin inspection, mirror bootstrap), which must not
+        mutate the image or its commit watermark."""
+        return Image(self.io, name, replay=not read_only)
 
 
 class Image:
     """One open image (librbd::Image role)."""
 
-    def __init__(self, ioctx, name: str) -> None:
+    def __init__(self, ioctx, name: str, replay: bool = False) -> None:
         self.io = ioctx
         self.name = name
         try:
@@ -137,6 +146,16 @@ class Image:
         self._data = StripedObject(self.io, f"rbd_data.{name}", layout)
         self.journal = Journaler(self.io, f"rbd.{name}") \
             if self._header.get("journaling") else None
+        #: next journal position the WRITER expects to commit; advances
+        #: only contiguously (see _journal_committed)
+        self._local_pos = 0
+        # replay is for the WRITING opener only (RBD.open): the journal
+        # is single-writer, and a read-side construction (rbd-mirror's
+        # bootstrap open, admin helpers) replaying concurrently with
+        # the live writer would race its header/COW updates
+        if replay and self.journal is not None and \
+                self._header.get("primary", True):
+            self._replay_local_tail()
 
     # -- header --------------------------------------------------------
     def _save_header(self) -> None:
@@ -169,16 +188,68 @@ class Image:
         self._header["primary"] = False
         self._save_header()
 
+    def _replay_local_tail(self) -> None:
+        """Close the write-ahead window on open: mutations journal
+        BEFORE applying, so a crash (or an EIO raised mid-apply, e.g.
+        in _cow_protect) can leave appended events the source never
+        applied — while rbd-mirror replays them on the target, a
+        silent permanent divergence. The reference replays the journal
+        on image open (librbd Journal<I>::replay); we do the same from
+        the writer's own commit position. Replaying an in-order SUFFIX
+        that includes already-applied events is convergent (the events
+        are deterministic and _apply_event guards creations/removals),
+        so a commit position that lags an applied event is safe."""
+        from ceph_tpu.services.journal import JournalTrimmedError
+        try:
+            end = self.journal.end_position()
+        except JournalError:
+            return                    # journal object not created yet
+        pos = self.journal.committed(LOCAL_CLIENT)
+        applied = min(pos, end)
+        try:
+            for epos, payload in self.journal.read_from(applied):
+                self._apply_event(*self.decode_event(payload))
+                applied = epos + 1
+        except JournalTrimmedError:
+            # pre-replay-era image whose tail was trimmed: the lost
+            # events cannot be replayed — adopt the tip and move on
+            applied = end
+        except JournalError:
+            # a chunk read failed MID-tail: only the prefix that
+            # actually applied may be committed — advancing to `end`
+            # would mark never-applied events as applied (the silent
+            # divergence this replay exists to close); the remainder
+            # replays on the next open
+            pass
+        self._local_pos = applied
+        self.journal.commit(LOCAL_CLIENT, applied)
+
     def _journal_event(self, kind: str, offset: int = 0,
-                       data: bytes = b"", arg: str = "") -> None:
+                       data: bytes = b"", arg: str = "") -> int | None:
         if self.journal is None:
-            return
+            return None
         e = Encoder()
         e.str(kind)
         e.u64(offset)
         e.bytes(data)
         e.str(arg)
-        self.journal.append(e.getvalue())
+        return self.journal.append(e.getvalue())
+
+    def _journal_committed(self, pos: int | None) -> None:
+        """Advance the writer's commit position once the mutation it
+        journaled has fully applied (write-ahead completion marker).
+
+        Advances CONTIGUOUSLY only: if event N's apply failed (its
+        commit never ran), a later event N+1 completing must NOT move
+        the high-watermark past N — replay-on-open would then skip N
+        forever while mirror targets still apply it (the divergence
+        this machinery exists to close). Leaving the watermark at N
+        makes the next open re-apply N, N+1, ... in order, which
+        converges."""
+        if self.journal is not None and pos is not None \
+                and pos == self._local_pos:
+            self._local_pos = pos + 1
+            self.journal.commit(LOCAL_CLIENT, pos + 1)
 
     @staticmethod
     def decode_event(payload: bytes) -> tuple[str, int, bytes, str]:
@@ -192,8 +263,9 @@ class Image:
 
     def resize(self, new_size: int) -> None:
         self._check_writable()
-        self._journal_event("resize", new_size)
+        pos = self._journal_event("resize", new_size)
         self._resize_apply(new_size)
+        self._journal_committed(pos)
 
     def _resize_apply(self, new_size: int) -> None:
         old = self._header["size"]
@@ -210,9 +282,10 @@ class Image:
         self._check_writable()
         if offset + len(data) > self._header["size"]:
             raise RBDError("write past end of image")
-        self._journal_event("write", offset, bytes(data))
+        pos = self._journal_event("write", offset, bytes(data))
         self._cow_protect(self._touched_objnos(offset, len(data)))
         self._data.write(data, offset=offset)
+        self._journal_committed(pos)
         return len(data)
 
     def read(self, offset: int, length: int) -> bytes:
@@ -226,10 +299,11 @@ class Image:
 
     def discard(self, offset: int, length: int) -> None:
         self._check_writable()
-        self._journal_event("discard", offset,
-                            length.to_bytes(8, "little"))
+        pos = self._journal_event("discard", offset,
+                                  length.to_bytes(8, "little"))
         self._cow_protect(self._touched_objnos(offset, length))
         self._data.write(b"\x00" * length, offset=offset)
+        self._journal_committed(pos)
 
     # -- snapshots (COW object-clone model) -----------------------------
     def _snap_prefix(self, snap: str) -> str:
@@ -401,8 +475,9 @@ class Image:
         self._check_writable()
         if snap in self._header["snaps"]:
             raise RBDError(f"snap {snap!r} exists")
-        self._journal_event("snap_create", arg=snap)
+        pos = self._journal_event("snap_create", arg=snap)
         self._snap_create_apply(snap)
+        self._journal_committed(pos)
 
     def _snap_create_apply(self, snap: str) -> None:
         # O(1): record the layer; data objects are copied lazily on
@@ -417,8 +492,9 @@ class Image:
         self._check_writable()
         if snap not in self._header["snaps"]:
             raise RBDError(f"no snap {snap!r}")
-        self._journal_event("snap_rollback", arg=snap)
+        pos = self._journal_event("snap_rollback", arg=snap)
         self._snap_rollback_apply(snap)
+        self._journal_committed(pos)
 
     def _snap_rollback_apply(self, snap: str) -> None:
         content = self.snap_read(snap)
@@ -438,8 +514,9 @@ class Image:
         self._check_writable()
         if snap not in self._header["snaps"]:
             raise RBDError(f"no snap {snap!r}")
-        self._journal_event("snap_remove", arg=snap)
+        pos = self._journal_event("snap_remove", arg=snap)
         self._snap_remove_apply(snap)
+        self._journal_committed(pos)
 
     def _snap_remove_apply(self, snap: str) -> None:
         meta = self._header["snaps"][snap]

@@ -118,11 +118,18 @@ class ImageReplayer:
         dst = Image(self.dst_io, self.name)
         applied = 0
         last = start - 1
-        for pos, payload in self.journal.read_from(start):
-            kind, offset, data, arg = Image.decode_event(payload)
-            dst._apply_event(kind, offset, data, arg)
-            last = pos
-            applied += 1
+        try:
+            for pos, payload in self.journal.read_from(start):
+                kind, offset, data, arg = Image.decode_event(payload)
+                dst._apply_event(kind, offset, data, arg)
+                last = pos
+                applied += 1
+        except JournalError as exc:
+            # commit only the applied prefix; the rest replays next
+            # pass (advancing past unread events would skip them on
+            # the target forever)
+            log(1, f"rbd-mirror: replay of {self.name} stopped "
+                f"early: {exc}")
         if applied:
             self.journal.commit(self.client_id, last + 1)
             self.journal.trim()

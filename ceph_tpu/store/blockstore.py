@@ -22,6 +22,7 @@ the trigger for EC repair upstream.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable
 
 from ceph_tpu.store import object_store as osr
@@ -145,24 +146,33 @@ class _PyDataFile:
     via the configured csum fn)."""
 
     def __init__(self, path: str) -> None:
-        self._f = open(path, "a+b")
+        # unbuffered: appends hit the fd directly, so concurrent preads
+        # never observe a python-level buffer, and there is no shared
+        # seek position between readers (os.pread is positionless)
+        self._f = open(path, "a+b", buffering=0)
 
     def size(self) -> int:
-        self._f.seek(0, os.SEEK_END)
-        return self._f.tell()
+        return os.fstat(self._f.fileno()).st_size
 
     def append(self, data: bytes):
-        self._f.seek(0, os.SEEK_END)
-        off = self._f.tell()
-        self._f.write(data)
+        # O_APPEND ("a" mode) writes at EOF atomically; the returned
+        # offset is only meaningful under the store's append lock,
+        # which serializes the size probe with the write. Unbuffered
+        # FileIO.write can return short (e.g. ENOSPC mid-blob) —
+        # loop to completion or raise, mirroring ioeng_append
+        off = os.fstat(self._f.fileno()).st_size
+        view = memoryview(data)
+        while view:
+            n = self._f.write(view)
+            if not n:
+                raise OSError("short write appending blob")
+            view = view[n:]
         return off, None
 
     def read(self, off: int, length: int):
-        self._f.seek(off)
-        return self._f.read(length), None
+        return os.pread(self._f.fileno(), length, off), None
 
     def sync(self) -> None:
-        self._f.flush()
         os.fdatasync(self._f.fileno())
 
     def close(self) -> None:
@@ -176,6 +186,12 @@ class BlockStore(ObjectStore):
         self._db: FileDB | None = None
         self._data = None
         self._eio: set[tuple[str, str]] = set()
+        # serializes the append stage: the data engines derive each
+        # blob's offset from the current file size, so two concurrent
+        # queue_transaction calls (different PGs on different op-shard
+        # threads) must not interleave size-probe and write — they
+        # would record the same offset for different blobs
+        self._append_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------
     def mount(self) -> None:
@@ -230,6 +246,15 @@ class BlockStore(ObjectStore):
         data_dirty = False
         # op idx -> (file_off, raw_len, disk_len, csum, comp_id, csum_id)
         blob_at: dict[int, tuple[int, int, int, int, int, int]] = {}
+        # compress and hash outside the lock (CPU-bound), append inside
+        # it: the engines derive blob offsets from file size, so
+        # interleaved appends from two op-shard threads would alias
+        # offsets. The native engine still computes crc32c in its own
+        # single pass over the hot buffer (inside the lock, but that
+        # pass IS the write path); only non-crc32c types / the python
+        # engine need the explicit hash, done here.
+        native = not isinstance(self._data, _PyDataFile)
+        staged: list[tuple[int, bytes, bytes, int, int | None]] = []
         for i, op in enumerate(txn.ops):
             if op[0] == osr.OP_WRITE:
                 payload = op[4]
@@ -239,14 +264,17 @@ class BlockStore(ObjectStore):
                     if len(packed) <= len(payload) * comp_ratio:
                         stored = packed
                         comp_id = _COMP_IDS[comp_alg.name]
-                file_off, ncrc = self._data.append(bytes(stored))
-                # the native engine computed crc32c in the same pass;
-                # other csum types (or the python engine) hash here
-                csum = ncrc if (csum_id == 0 and ncrc is not None) \
+                pre = None if (csum_id == 0 and native) \
                     else csum_fn(stored)
-                blob_at[i] = (file_off, len(payload), len(stored),
-                              csum, comp_id, csum_id)
-                data_dirty = True
+                staged.append((i, payload, bytes(stored), comp_id, pre))
+        if staged:
+            with self._append_lock:
+                for i, payload, stored, comp_id, pre in staged:
+                    file_off, ncrc = self._data.append(stored)
+                    csum = pre if pre is not None else ncrc
+                    blob_at[i] = (file_off, len(payload), len(stored),
+                                  csum, comp_id, csum_id)
+            data_dirty = True
         if data_dirty:
             self._data.sync()
 

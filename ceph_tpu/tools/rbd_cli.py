@@ -48,7 +48,9 @@ def main(argv: list[str] | None = None) -> int:
             for name in rbd.list():
                 print(name)
         elif cmd == "info":
-            print(json.dumps(rbd.open(rest[0]).stat(), indent=2))
+            # read-only open: must not replay (may race a live writer)
+            print(json.dumps(
+                rbd.open(rest[0], read_only=True).stat(), indent=2))
         elif cmd == "rm":
             rbd.remove(rest[0])
         elif cmd == "resize":
@@ -59,7 +61,7 @@ def main(argv: list[str] | None = None) -> int:
             img = rbd.create(rest[0], len(data))
             img.write(0, data)
         elif cmd == "export":
-            img = rbd.open(rest[0])
+            img = rbd.open(rest[0], read_only=True)
             data = img.read(0, img.size())
             if rest[1] == "-":
                 sys.stdout.buffer.write(data)
@@ -68,7 +70,7 @@ def main(argv: list[str] | None = None) -> int:
                     f.write(data)
         elif cmd == "snap":
             sub, name = rest[0], rest[1]
-            img = rbd.open(name)
+            img = rbd.open(name, read_only=(rest[0] == "ls"))
             if sub == "create":
                 img.snap_create(rest[2])
             elif sub == "rollback":

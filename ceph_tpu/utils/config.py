@@ -219,6 +219,20 @@ for _o in [
            "(Messenger policy throttler)"),
     Option("osd_op_num_shards", int, 4, "advanced",
            "worker shards of the OSD op queue (op_shardedwq role)"),
+    Option("osd_client_op_priority", int, 63, "advanced",
+           "WPQ weight of client ops in the sharded op queue "
+           "(options.cc osd_client_op_priority)"),
+    Option("osd_recovery_op_priority", int, 3, "advanced",
+           "WPQ weight of recovery work in the sharded op queue "
+           "(options.cc osd_recovery_op_priority — what keeps "
+           "recovery from starving client I/O)"),
+    Option("osd_scrub_priority", int, 1, "advanced",
+           "WPQ weight of scrub/repair work "
+           "(options.cc osd_scrub_priority)"),
+    Option("osd_recovery_max_single_start", int, 4, "advanced",
+           "objects pushed per recovery queue item before yielding "
+           "the wq shard back to client ops (options.cc "
+           "osd_recovery_max_single_start role)"),
     Option("objecter_resend_interval", float, 2.0, "advanced",
            "client op resend period over the lossy messenger"),
     Option("osd_heartbeat_interval", float, 1.0, "advanced",

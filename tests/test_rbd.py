@@ -198,3 +198,26 @@ def test_snap_ingest_resync_does_not_duplicate_chain(io):
     img._snap_remove_apply("a")
     img._snap_remove_apply("b")
     assert img._snap_order() == []
+
+
+def test_journal_replay_on_open_closes_write_ahead_window(io):
+    """Mutations journal BEFORE applying; a crash in that window leaves
+    an appended event the source never applied (while rbd-mirror would
+    replay it on the target). Opening the image must replay the
+    un-committed tail (librbd Journal<I>::replay role)."""
+    from ceph_tpu.services.rbd import LOCAL_CLIENT
+    rbd = RBD(io)
+    img = rbd.create("jrnl", 1 << 16, journaling=True)
+    img.write(0, b"A" * 4096)
+    # simulate the crash window: append a write event straight to the
+    # journal without applying it (what a death after _journal_event
+    # but before _data.write leaves behind)
+    img._journal_event("write", 4096, b"B" * 4096)
+    assert img.read(4096, 4096) == b"\x00" * 4096   # not applied yet
+    img2 = rbd.open("jrnl")                         # replay on open
+    assert img2.read(0, 4096) == b"A" * 4096
+    assert img2.read(4096, 4096) == b"B" * 4096
+    # the writer's commit position reached the journal tip
+    assert img2.journal.committed(LOCAL_CLIENT) == \
+        img2.journal.end_position()
+    rbd.remove("jrnl")
