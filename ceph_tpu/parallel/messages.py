@@ -225,12 +225,46 @@ class MPaxosPull(Message):
     FIELDS = [("rank", "i32"), ("from_version", "u64")]
 
 
-class MPaxosCommitAck(Message):
-    """Peon -> leader: commit ``version`` is durable here (the Paxos
-    accept ack; commands are answered only once a majority holds the
-    commit)."""
-    MSG_TYPE = 44
-    FIELDS = [("version", "u64"), ("rank", "i32")]
+class MPaxosCollect(Message):
+    """New leader -> peers: phase-1 prepare (Paxos::collect,
+    src/mon/Paxos.cc). ``pn`` is the proposal number the leader will
+    lead with; peers that promise it reveal their commit progress and
+    any durably ACCEPTED-but-uncommitted value so the leader can
+    complete its predecessor's in-flight proposal."""
+    MSG_TYPE = 45
+    FIELDS = [("pn", "u64"), ("rank", "i32"), ("last_committed", "u64")]
+
+
+class MPaxosCollectReply(Message):
+    """Peer -> collecting leader (Paxos::handle_collect). ``ok`` = the
+    peer promised ``pn`` (it had no higher accepted_pn). ``state``
+    carries the peer's latest committed snapshot when it is ahead of
+    the collector (leader catch-up); ``pending_*`` carry the peer's
+    uncommitted accepted value, if any."""
+    MSG_TYPE = 46
+    FIELDS = [("ok", "bool"), ("pn", "u64"), ("accepted_pn", "u64"),
+              ("rank", "i32"), ("last_committed", "u64"),
+              ("state", "bytes"), ("pending_pn", "u64"),
+              ("pending_version", "u64"), ("pending_state", "bytes")]
+
+
+class MPaxosBegin(Message):
+    """Leader -> peers: phase-2 accept request (Paxos::begin). The
+    value (a full-state snapshot at ``version``) must be persisted as
+    PENDING before the peer acks — that durability is what lets a new
+    leader's collect recover it."""
+    MSG_TYPE = 47
+    FIELDS = [("pn", "u64"), ("version", "u64"), ("state", "bytes"),
+              ("rank", "i32")]
+
+
+class MPaxosAccept(Message):
+    """Peer -> leader: phase-2 accept ack (Paxos::handle_accept), or a
+    refusal (``ok``=False) when the peer promised a HIGHER pn — the
+    fence that stops a deposed/minority leader from committing."""
+    MSG_TYPE = 48
+    FIELDS = [("ok", "bool"), ("pn", "u64"), ("version", "u64"),
+              ("rank", "i32"), ("accepted_pn", "u64")]
 
 
 # -- auth (MAuth / cephx ticket grant, src/auth role) ------------------

@@ -119,6 +119,11 @@ class Messenger:
         self._throttle: Throttle | None = None
         self._inject_every = g_conf()["ms_inject_socket_failures"]
         self._inject_rng = random.Random(checksum.crc32c(entity_name.encode()))
+        # partition injection (the qa suites' partition-thrashing role,
+        # alongside "ms inject socket failures"): frames to AND from
+        # these listening addresses are silently dropped, simulating a
+        # symmetric network partition for quorum tests
+        self.blocked_peers: set[str] = set()
         # cephx-lite hooks (parallel/auth.py): ``signer`` stamps every
         # outgoing frame, ``verifier`` gates every incoming one (except
         # the pre-auth MAuth exchange)
@@ -239,7 +244,10 @@ class Messenger:
                     try:
                         msg = decode_message(mtype, payload)
                         msg.seq = seq
-                        if self._dispatcher:
+                        if peer_addr in self.blocked_peers:
+                            log(5, f"partition: dropping {mtype} from "
+                                f"{peer_name}")
+                        elif self._dispatcher:
                             self._dispatcher(msg, conn)
                     except Exception as exc:  # dispatcher bugs can't kill IO
                         log(0, f"dispatch error for type {mtype}: {exc!r}")
@@ -298,6 +306,10 @@ class Messenger:
                 self._out.pop(dest_addr, None)
 
     async def _send_on(self, conn: Connection, msg: Message) -> bool:
+        if conn.peer_addr in self.blocked_peers:
+            log(5, f"partition: dropping {msg.MSG_TYPE} to "
+                f"{conn.peer_addr}")
+            return True     # silently lost (lossy semantics)
         if self._inject_every and \
                 self._inject_rng.randrange(self._inject_every) == 0:
             log(5, f"injected socket failure to {conn.peer_addr}")

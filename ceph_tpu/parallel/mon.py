@@ -7,22 +7,28 @@ commands) and ConfigMonitor. Collapsed here to one daemon class with:
   - a persisted commit log (MonitorDBStore role, backed by store/kv):
     every map change is a numbered committed value, replayed on
     restart — the Paxos log discipline.
-  - quorum-lite (Paxos + Elector roles) when started with a monmap of
-    peers: mons exchange liveness/progress beacons, every mon derives
-    the leader as the most-advanced lowest-ranked live peer (the
-    reference's lowest-rank-wins election, progress-first like raft's
-    log check), ONLY the leader mutates state, commits replicate to
-    peons as full-state snapshots, lagging mons catch up by pulling,
-    and clients are redirected/forwarded to the leader. Mutating
-    commands are answered only once a MAJORITY of the monmap has
-    acked the commit (MPaxosCommitAck — the Paxos accept phase), so
-    a leader dying inside one replication round trip cannot have
-    acked a commit the survivors lack; unacked commands time out
-    with -110 after mon_commit_timeout. Remaining reduction vs real
-    Paxos: commits replicate as full-state snapshots (no per-value
-    log/lease machinery), and a partitioned minority leader's
-    commits are superseded by the majority side's more-advanced log
-    on heal.
+  - Paxos (src/mon/Paxos.{h,cc} collect/begin/accept/commit) when
+    started with a monmap of peers. Election (Elector role): mons
+    exchange liveness/progress beacons and derive the leader as the
+    most-advanced lowest-ranked live peer. A new leader then runs the
+    COLLECT phase (phase 1): it picks a proposal number above every
+    pn it has seen, gathers promises from a quorum, catches up to the
+    most advanced committed state revealed, and COMPLETES any
+    predecessor's accepted-but-uncommitted value (Paxos.cc collect/
+    handle_last). Mutations run on a SCRATCH copy of the state and
+    fan out as a BEGIN (phase 2): peers that promised no higher pn
+    persist the value as pending and ack; on a quorum of accepts the
+    leader commits (durable + visible + published) and replicates the
+    commit. A minority or deposed leader can never commit: its begin
+    is fenced by higher promised pns (or simply starves of acks) and
+    the proposal times out with -110, leaving state untouched.
+    Command replies for committed mutations ride IN the replicated
+    state (the (client, tid) -> reply dedup survives leader
+    failover, so a client retry attaches to the original execution).
+    Remaining reduction vs the reference: values are full-state
+    snapshots (no per-value log transfer; catch-up and commit are
+    the same message) and there is no lease machinery — peons serve
+    reads from their last committed state.
   - OSDMonitor logic: MOSDBoot marks OSDs up (new epoch), failure
     reports and beacon-timeout mark them down (OSDMap epochs move
     forward only), pool/EC-profile commands validated by actually
@@ -89,13 +95,33 @@ class Monitor:
             f"mon.{name}", g_conf()["admin_socket_dir"] or None)
         self._tick_stop = threading.Event()
         self._tick_thread: threading.Thread | None = None
-        # version -> {"acks": set[rank], "cbs": [fn], "ts": float} —
-        # commands are answered only when a majority of the monmap
-        # holds the commit (Paxos accept acks; single-mon = immediate)
-        self._pending_commits: dict[int, dict] = {}
-        # (client, tid) -> executed command state: a client retry of a
-        # deferred/lost reply must attach to the ORIGINAL execution,
-        # never re-run the mutation (the reference's session dedup)
+        # -- paxos machine state (Paxos.h:174 roles) --
+        #: the pn this mon leads with (0 = not established; set by a
+        #: completed collect phase)
+        self._leader_pn = 0
+        #: in-flight phase-1: {"pn", "ts", "replies": {rank: (lc,
+        #: state, (pending_pn, pending_v, pending_state))}}
+        self._collect: dict | None = None
+        #: in-flight phase-2: {"pn", "version", "state", "scratch",
+        #: "entries", "acks", "ts"} — one proposal at a time
+        self._proposal: dict | None = None
+        #: queued mutations [{"fn", "done", "ts"}] folded into the
+        #: next proposal (PaxosService pending role)
+        self._mut_queue: list[dict] = []
+        #: scratch-dirty marker set by _commit() during mutation runs
+        self._dirty = False
+        #: dedup for the tick's beacon-timeout mutation: while a
+        #: proposal stalls, every tick would otherwise queue another
+        #: identical osdmap scan
+        self._beacon_check_queued = False
+        # "client|tid" -> [code, outs, data_hex]: REPLICATED command
+        # dedup — part of the committed state, so a retry after leader
+        # failover attaches to the original execution instead of
+        # re-running the mutation (the reference's session dedup,
+        # made durable)
+        self._cmd_replies: dict[str, list] = {}
+        # in-memory dedup for commands still awaiting their proposal
+        # (holds the waiting connections) + completed-reply LRU
         from ceph_tpu.utils.lru import BoundedLRU
         self._cmd_dedup: BoundedLRU = BoundedLRU(1024)
         self._replay()
@@ -162,34 +188,56 @@ class Monitor:
         self.asok.stop()
         self.db.close()
 
-    # -- paxos-lite commit log ----------------------------------------
+    # -- paxos durable state (Paxos.h:174) ----------------------------
     def _last_committed(self) -> int:
         raw = self.db.get("paxos/last_committed")
         return int(raw.decode()) if raw else 0
 
-    def _commit(self) -> None:
-        """Commit the current (already mutated) state as the next
-        version, publish to subscribers, and replicate to peon mons
-        (Paxos commit phase; see paxos-lite caveat in the module
-        docstring). Caller holds the lock."""
-        self.osdmap.epoch += 1
-        version = self._last_committed() + 1
-        state = self._encode_state()
+    def _accepted_pn(self) -> int:
+        """Highest proposal number this mon has promised (persisted —
+        the promise must survive restart or a deposed leader could be
+        re-accepted)."""
+        raw = self.db.get("paxos/accepted_pn")
+        return int(raw.decode()) if raw else 0
+
+    def _promise(self, pn: int) -> None:
         batch = WriteBatch()
-        batch.put(f"paxos/{version:016d}", state)
-        batch.put("paxos/last_committed", str(version).encode())
+        batch.put("paxos/accepted_pn", str(pn).encode())
         self.db.submit(batch, sync=True)
-        log(10, f"committed version {version} (epoch {self.osdmap.epoch})")
-        self._publish()
-        if len(self.monmap) > 1:
-            self._pending_commits[version] = {
-                "acks": {self.rank}, "cbs": [], "ts": time.monotonic()}
-        for rank, addr in self.monmap.items():
-            if rank != self.rank:
-                self.msgr.send_message(
-                    M.MPaxosCommit(version=version, state=state,
-                                   rank=self.rank), addr)
-        return version
+
+    def _pending(self) -> tuple[int, int, bytes] | None:
+        """The durably ACCEPTED but uncommitted value (pn, version,
+        state) — what a new leader's collect phase recovers."""
+        raw = self.db.get("paxos/pending")
+        if not raw:
+            return None
+        from ceph_tpu.utils.encoding import Decoder
+        d = Decoder(raw)
+        return d.u64(), d.u64(), d.bytes()
+
+    def _set_pending(self, pn: int, version: int, state: bytes) -> None:
+        """Durably accept a value (peon side of begin; leader
+        self-accept). MUST hit disk before the accept ack goes out —
+        that durability is exactly what collect recovery relies on."""
+        from ceph_tpu.utils.encoding import Encoder
+        e = Encoder()
+        e.u64(pn)
+        e.u64(version)
+        e.bytes(state)
+        batch = WriteBatch()
+        batch.put("paxos/pending", e.getvalue())
+        batch.put("paxos/accepted_pn", str(pn).encode())
+        self.db.submit(batch, sync=True)
+
+    def _commit(self) -> None:
+        """Called by command/boot/failure handlers after mutating the
+        map. Under real Paxos those handlers run against a SCRATCH
+        copy inside _pump_proposals; this merely advances the epoch
+        and marks the scratch dirty — visibility and durability happen
+        in _commit_proposal once a quorum accepts (the reference's
+        PaxosService::propose_pending seam)."""
+        self.osdmap.epoch += 1
+        self._dirty = True
 
     # -- quorum (Paxos/Elector roles) ---------------------------------
     def is_leader(self) -> bool:
@@ -212,12 +260,29 @@ class Monitor:
         commit log first (a stale rejoiner must not clobber newer
         state), lowest rank second (the reference's Elector rule)."""
         alive = self._alive_ranks(now)
+        if len(alive) < self._majority():
+            # no quorum visible: nobody may (re-)elect — a freshly
+            # revived or partitioned-minority mon seeing only itself
+            # must not take over and start collecting (the reference
+            # mon drops to probing without a quorum). An existing
+            # leader keeps its seat, but its proposals can never
+            # gather a quorum, so safety holds either way.
+            return
         new_leader = min(alive, key=lambda r: (-alive[r], r))
         if new_leader != self._leader_rank:
             log(1, f"mon.{self.name}: leader mon rank "
                 f"{self._leader_rank} -> {new_leader} "
                 f"(alive={sorted(alive)})")
+            was_leader = self._leader_rank == self.rank
             self._leader_rank = new_leader
+            if was_leader and new_leader != self.rank:
+                # deposed: any in-flight proposal cannot be OUR
+                # commit any more (the successor may still complete
+                # it via collect; the replicated dedup then answers
+                # client retries)
+                self._fail_proposal()
+                self._leader_pn = 0
+                self._collect = None
             if new_leader == self.rank:
                 # taking over: (a) every up OSD gets a fresh beacon
                 # grace window — as a peon we forwarded beacons instead
@@ -225,7 +290,9 @@ class Monitor:
                 # stale and would mark healthy OSDs down instantly;
                 # (b) push our state to every peer so a healed
                 # split-brain twin at an EQUAL version adopts the
-                # elected leader's truth
+                # elected leader's truth; (c) run the collect phase to
+                # establish a pn and recover the predecessor's
+                # in-flight proposal (Paxos leader takeover)
                 for osd, info in self.osdmap.osds.items():
                     if info.up:
                         self._last_beacon[osd] = time.monotonic()
@@ -235,6 +302,8 @@ class Monitor:
                         self.msgr.send_message(M.MPaxosCommit(
                             version=self._last_committed(),
                             state=state, rank=self.rank), addr)
+                self._leader_pn = 0
+                self._start_collect(now)
         # lagging behind a live peer: pull its latest commit
         best = max(alive.values())
         if best > self._last_committed():
@@ -245,45 +314,283 @@ class Monitor:
                                  from_version=self._last_committed()),
                     self.monmap[ahead])
 
+    # -- phase 1: collect (Paxos::collect / handle_collect) -----------
+    def _next_pn(self) -> int:
+        """A pn above everything seen, unique per mon (counter<<8 |
+        rank — the reference's get_new_proposal_number shape)."""
+        base = max(self._accepted_pn(), self._leader_pn) >> 8
+        return ((base + 1) << 8) | (self.rank & 0xFF)
+
+    def _start_collect(self, now: float) -> None:
+        pn = self._next_pn()
+        self._promise(pn)          # self-promise
+        self._leader_pn = 0
+        mine = self._pending() or (0, 0, b"")
+        self._collect = {
+            "pn": pn, "ts": now,
+            "replies": {self.rank: (self._last_committed(), b"", mine)}}
+        log(1, f"mon.{self.name}: collect phase, pn {pn}")
+        for rank, addr in self.monmap.items():
+            if rank != self.rank:
+                self.msgr.send_message(M.MPaxosCollect(
+                    pn=pn, rank=self.rank,
+                    last_committed=self._last_committed()), addr)
+        self._maybe_finish_collect()
+
+    def _handle_collect(self, msg: M.MPaxosCollect) -> None:
+        ok = msg.pn > self._accepted_pn()
+        if ok:
+            self._promise(msg.pn)
+            # a higher pn is live: any proposal WE lead is fenced now
+            self._leader_pn = 0
+        lc = self._last_committed()
+        state = self._encode_state() if lc > msg.last_committed else b""
+        pend = self._pending() or (0, 0, b"")
+        addr = self.monmap.get(msg.rank)
+        if addr:
+            self.msgr.send_message(M.MPaxosCollectReply(
+                ok=ok, pn=msg.pn, accepted_pn=self._accepted_pn(),
+                rank=self.rank, last_committed=lc, state=state,
+                pending_pn=pend[0], pending_version=pend[1],
+                pending_state=pend[2]), addr)
+
+    def _handle_collect_reply(self, msg: M.MPaxosCollectReply) -> None:
+        col = self._collect
+        if col is None or msg.pn != col["pn"]:
+            return
+        if not msg.ok:
+            # someone promised higher: stand down; election + a later
+            # collect with a fresh pn sort it out
+            log(1, f"mon.{self.name}: collect pn {col['pn']} refused "
+                f"by rank {msg.rank} (accepted_pn {msg.accepted_pn})")
+            self._collect = None
+            return
+        col["replies"][msg.rank] = (
+            msg.last_committed, msg.state,
+            (msg.pending_pn, msg.pending_version, msg.pending_state))
+        self._maybe_finish_collect()
+
+    def _maybe_finish_collect(self) -> None:
+        col = self._collect
+        if col is None or len(col["replies"]) < self._majority():
+            return
+        self._collect = None
+        # catch up to the most advanced committed state a peer revealed
+        best_lc, best_state = self._last_committed(), b""
+        for lc, state, _pend in col["replies"].values():
+            if lc > best_lc and state:
+                best_lc, best_state = lc, state
+        if best_state:
+            self._adopt_state(best_lc, best_state)
+        self._leader_pn = col["pn"]
+        log(1, f"mon.{self.name}: leading with pn {col['pn']} "
+            f"at v{self._last_committed()}")
+        # complete the predecessor's in-flight value, if one survives:
+        # among uncommitted accepted values, highest pn wins (the
+        # Paxos recovery rule, Paxos.cc handle_last)
+        cand = None
+        for _lc, _state, pend in col["replies"].values():
+            if pend[2] and pend[1] > self._last_committed():
+                if cand is None or pend[0] > cand[0]:
+                    cand = pend
+        if cand is not None:
+            log(1, f"mon.{self.name}: completing predecessor's "
+                f"uncommitted proposal v{cand[1]} (pn {cand[0]})")
+            scratch = self._decode_state(cand[2])
+            self._begin(cand[2], max(cand[1],
+                                     self._last_committed() + 1),
+                        scratch, [])
+        else:
+            self._pump_proposals(time.monotonic())
+
+    # -- phase 2: begin/accept (Paxos::begin / handle_begin) ----------
+    def _pump_proposals(self, now: float) -> None:
+        """Fold every queued mutation into one proposal (one in flight
+        at a time — the single-decree pipeline). Mutations run on a
+        SCRATCH copy: nothing becomes visible or durable unless a
+        quorum accepts. Caller holds the lock."""
+        if self._proposal is not None or not self._mut_queue or \
+                not self.is_leader():
+            return
+        if self._leader_pn == 0 or \
+                self._leader_pn < self._accepted_pn():
+            # pn not established (or fenced by a higher promise):
+            # phase 1 first
+            if self._collect is None:
+                self._start_collect(now)
+            return
+        entries = self._mut_queue
+        self._mut_queue = []
+        committed = (self.osdmap, self.ec_profiles, self._cmd_replies)
+        self.osdmap = OSDMap.decode(self.osdmap.encode())
+        self.ec_profiles = json.loads(json.dumps(self.ec_profiles))
+        self._cmd_replies = dict(self._cmd_replies)
+        batch_dirty = False
+        for ent in entries:
+            self._dirty = False     # per-mutation marker (dedup needs
+            try:                    # to know if THIS one mutated)
+                ent["fn"]()
+            except Exception as exc:
+                log(0, f"mon.{self.name}: mutation failed: {exc!r}")
+            batch_dirty |= self._dirty
+        scratch = (self.osdmap, self.ec_profiles, self._cmd_replies)
+        self.osdmap, self.ec_profiles, self._cmd_replies = committed
+        dones = [ent.get("done") for ent in entries]
+        if not batch_dirty:
+            # nothing to commit (read-only/error commands): answer now
+            for done in dones:
+                if done is not None:
+                    done(True)
+            self._pump_proposals(now)
+            return
+        state = self._encode_state_of(*scratch)
+        self._begin(state, self._last_committed() + 1, scratch, dones)
+
+    def _begin(self, state: bytes, version: int, scratch,
+               entries: list) -> None:
+        pn = self._leader_pn
+        self._set_pending(pn, version, state)    # leader self-accept
+        self._proposal = {"pn": pn, "version": version, "state": state,
+                         "scratch": scratch, "entries": entries,
+                         "acks": {self.rank}, "ts": time.monotonic()}
+        if len(self._proposal["acks"]) >= self._majority():
+            self._commit_proposal()              # single-mon fast path
+            return
+        for rank, addr in self.monmap.items():
+            if rank != self.rank:
+                self.msgr.send_message(M.MPaxosBegin(
+                    pn=pn, version=version, state=state,
+                    rank=self.rank), addr)
+
+    def _handle_begin(self, msg: M.MPaxosBegin) -> None:
+        ok = msg.pn >= self._accepted_pn() and \
+            msg.version > self._last_committed()
+        if ok:
+            self._set_pending(msg.pn, msg.version, msg.state)
+        addr = self.monmap.get(msg.rank)
+        if addr:
+            self.msgr.send_message(M.MPaxosAccept(
+                ok=ok, pn=msg.pn, version=msg.version, rank=self.rank,
+                accepted_pn=self._accepted_pn()), addr)
+
+    def _handle_accept(self, msg: M.MPaxosAccept) -> None:
+        prop = self._proposal
+        if prop is None or msg.pn != prop["pn"] or \
+                msg.version != prop["version"]:
+            return
+        if not msg.ok:
+            if msg.accepted_pn > prop["pn"]:
+                # fenced: a newer leader's pn is promised out there —
+                # this proposal can never reach quorum (dueling-leader
+                # safety; the value may still be completed by the NEW
+                # leader's collect, in which case the replicated dedup
+                # answers the client's retry)
+                log(1, f"mon.{self.name}: proposal v{prop['version']} "
+                    f"fenced by pn {msg.accepted_pn}; standing down")
+                self._fail_proposal()
+                self._leader_pn = 0
+            return
+        prop["acks"].add(msg.rank)
+        if len(prop["acks"]) >= self._majority():
+            self._commit_proposal()
+
+    def _commit_proposal(self) -> None:
+        """Quorum accepted: make the value durable + visible, publish,
+        replicate the commit (Paxos::commit). Caller holds the lock."""
+        prop = self._proposal
+        self._proposal = None
+        version, state = prop["version"], prop["state"]
+        self.osdmap, self.ec_profiles, self._cmd_replies = \
+            prop["scratch"]
+        batch = WriteBatch()
+        batch.put(f"paxos/{version:016d}", state)
+        batch.put("paxos/last_committed", str(version).encode())
+        batch.delete("paxos/pending")
+        self.db.submit(batch, sync=True)
+        log(10, f"committed version {version} "
+            f"(epoch {self.osdmap.epoch})")
+        self._publish()
+        for rank, addr in self.monmap.items():
+            if rank != self.rank:
+                self.msgr.send_message(M.MPaxosCommit(
+                    version=version, state=state, rank=self.rank),
+                    addr)
+        for done in prop["entries"]:
+            if done is not None:
+                done(True)
+        self._pump_proposals(time.monotonic())
+
+    def _fail_proposal(self) -> None:
+        """Drop the in-flight proposal WITHOUT committing: the scratch
+        evaporates, state stays untouched (what -110 promises the
+        client). The self-accepted pending value intentionally stays
+        on disk — a successor's collect may still complete it."""
+        prop = self._proposal
+        self._proposal = None
+        if prop is None:
+            return
+        for done in prop["entries"]:
+            if done is not None:
+                done(False)
+
     def _apply_remote_commit(self, msg: M.MPaxosCommit) -> None:
-        """Peon side: adopt a commit from a more advanced mon. States
-        are full snapshots, so any newer version applies directly. An
-        EQUAL version from the mon we recognize as leader also applies
-        — that heals a split-brain where both sides committed the same
+        """Adopt a commit from a more advanced mon. States are full
+        snapshots, so any newer version applies directly. An EQUAL
+        version from the mon we recognize as leader also applies —
+        that heals a split-brain where both sides committed the same
         version number with different states."""
         if msg.version < self._last_committed():
             return
         if msg.version == self._last_committed() and (
                 self.is_leader() or msg.rank != self._leader_rank):
             return
-        from ceph_tpu.utils.encoding import Decoder
-        d = Decoder(msg.state)
-        self.osdmap = OSDMap.decode(d.bytes())
-        self.ec_profiles = json.loads(d.str())
+        self._adopt_state(msg.version, msg.state)
+
+    def _adopt_state(self, version: int, state: bytes) -> None:
+        """Install a committed snapshot (remote commit / catch-up /
+        collect recovery). Caller holds the lock."""
+        self.osdmap, self.ec_profiles, self._cmd_replies = \
+            self._decode_state(state)
         batch = WriteBatch()
-        batch.put(f"paxos/{msg.version:016d}", msg.state)
-        batch.put("paxos/last_committed", str(msg.version).encode())
+        batch.put(f"paxos/{version:016d}", state)
+        batch.put("paxos/last_committed", str(version).encode())
+        pend = self._pending()
+        if pend is not None and pend[1] <= version:
+            batch.delete("paxos/pending")    # superseded
         self.db.submit(batch, sync=True)
-        log(10, f"mon.{self.name}: applied remote commit v{msg.version} "
+        log(10, f"mon.{self.name}: adopted commit v{version} "
             f"(epoch {self.osdmap.epoch})")
         self._publish()
 
     def _encode_state(self) -> bytes:
+        return self._encode_state_of(self.osdmap, self.ec_profiles,
+                                     self._cmd_replies)
+
+    @staticmethod
+    def _encode_state_of(osdmap, ec_profiles, cmd_replies) -> bytes:
         from ceph_tpu.utils.encoding import Encoder
         e = Encoder()
-        e.bytes(self.osdmap.encode())
-        e.str(json.dumps(self.ec_profiles))
+        e.bytes(osdmap.encode())
+        e.str(json.dumps(ec_profiles))
+        e.str(json.dumps(cmd_replies))
         return e.getvalue()
+
+    @staticmethod
+    def _decode_state(raw: bytes):
+        from ceph_tpu.utils.encoding import Decoder
+        d = Decoder(raw)
+        osdmap = OSDMap.decode(d.bytes())
+        profiles = json.loads(d.str())
+        replies = json.loads(d.str()) if not d.eof() else {}
+        return osdmap, profiles, replies
 
     def _replay(self) -> None:
         last = self._last_committed()
         if last == 0:
             return
-        from ceph_tpu.utils.encoding import Decoder
         raw = self.db.get(f"paxos/{last:016d}")
-        d = Decoder(raw)
-        self.osdmap = OSDMap.decode(d.bytes())
-        self.ec_profiles = json.loads(d.str())
+        self.osdmap, self.ec_profiles, self._cmd_replies = \
+            self._decode_state(raw)
         # a restarted mon can't know which osds are still alive; they
         # re-boot or get timed out by the beacon grace
         log(1, f"mon.{self.name} replayed to version {last}, "
@@ -315,42 +622,6 @@ class Monitor:
     def _majority(self) -> int:
         return len(self.monmap) // 2 + 1
 
-    def _on_commit_ack(self, version: int, rank: int) -> None:
-        """Acks are cumulative (states are full snapshots): rank
-        acking V holds every commit <= V. Fires deferred command
-        replies whose commit reached majority. Caller holds the
-        lock."""
-        for v in sorted(self._pending_commits):
-            if v > version:
-                break
-            pend = self._pending_commits[v]
-            pend["acks"].add(rank)
-            if len(pend["acks"]) >= self._majority():
-                for cb in pend["cbs"]:
-                    cb(True)
-                del self._pending_commits[v]
-
-    def _expire_pending_commits(self, now: float) -> None:
-        timeout = g_conf()["mon_commit_timeout"]
-        for v in [v for v, p in self._pending_commits.items()
-                  if now - p["ts"] > timeout]:
-            pend = self._pending_commits.pop(v)
-            log(1, f"mon.{self.name}: commit v{v} gathered "
-                f"{len(pend['acks'])}/{self._majority()} acks in "
-                f"{timeout}s; failing {len(pend['cbs'])} commands")
-            for cb in pend["cbs"]:
-                cb(False)
-
-    def _defer_until_majority(self, version: int, cb) -> bool:
-        """Register ``cb(acked: bool)`` to fire when ``version`` is
-        majority-held; returns False when it already is (single mon or
-        acks raced ahead). Caller holds the lock."""
-        pend = self._pending_commits.get(version)
-        if pend is None:
-            return False
-        pend["cbs"].append(cb)
-        return True
-
     def _dispatch(self, msg: M.Message, conn: Connection) -> None:
         with self._lock:
             if isinstance(msg, M.MMonHB):
@@ -367,16 +638,18 @@ class Monitor:
                 self._peer_seen[msg.rank] = (time.monotonic(),
                                              msg.version)
                 self._apply_remote_commit(msg)
-                # accept ack: we durably hold everything <= max(ours,
-                # sender's version) now
-                peer = self.monmap.get(msg.rank)
-                if peer is not None and msg.rank != self.rank:
-                    self.msgr.send_message(M.MPaxosCommitAck(
-                        version=self._last_committed(),
-                        rank=self.rank), peer)
                 return
-            if isinstance(msg, M.MPaxosCommitAck):
-                self._on_commit_ack(msg.version, msg.rank)
+            if isinstance(msg, M.MPaxosCollect):
+                self._handle_collect(msg)
+                return
+            if isinstance(msg, M.MPaxosCollectReply):
+                self._handle_collect_reply(msg)
+                return
+            if isinstance(msg, M.MPaxosBegin):
+                self._handle_begin(msg)
+                return
+            if isinstance(msg, M.MPaxosAccept):
+                self._handle_accept(msg)
                 return
             if isinstance(msg, M.MPaxosPull):
                 peer = self.monmap.get(msg.rank)
@@ -404,11 +677,13 @@ class Monitor:
                 # report to it (the reference forwards to the leader)
                 self.msgr.send_message(msg, self.leader_addr())
             elif isinstance(msg, M.MOSDBoot):
-                self._handle_boot(msg, conn)
+                self._enqueue_mutation(
+                    lambda: self._handle_boot(msg, conn))
             elif isinstance(msg, M.MOSDAlive):
                 self._last_beacon[msg.osd_id] = time.monotonic()
             elif isinstance(msg, M.MOSDFailure):
-                self._handle_failure(msg)
+                self._enqueue_mutation(
+                    lambda: self._handle_failure(msg))
             elif isinstance(msg, M.MMonSubscribe):
                 self._subscribers[conn.peer_name] = conn
                 conn.send_message(M.MOSDMap(
@@ -422,50 +697,79 @@ class Monitor:
                         outs=f"NOTLEADER {self.leader_addr()}",
                         data=b""))
                     return
-                key = (conn.peer_name, msg.tid)
-                ent = self._cmd_dedup.get(key)
-                if ent is not None:
-                    if ent["state"] == "done":
-                        code, outs, data = ent["reply"]
-                        conn.send_message(M.MMonCommandReply(
-                            tid=msg.tid, code=code, outs=outs,
-                            data=data))
-                    else:          # still awaiting majority: attach
-                        ent["conns"].append((conn, msg.tid))
-                    return
-                pre = self._last_committed()
-                code, outs, data = self._handle_command(dict(msg.cmd))
-                version = self._last_committed()
-                if code == 0 and version > pre:
-                    # mutating command: answer only once a MAJORITY of
-                    # the monmap durably holds the commit (the real
-                    # Paxos contract — a leader dying inside one
-                    # replication round trip must not have acked)
-                    ent = {"state": "pending",
-                           "reply": (code, outs, data),
-                           "conns": [(conn, msg.tid)]}
+                self._handle_mon_command(msg, conn)
 
-                    def reply(acked: bool, ent=ent, v=version,
-                              key=key):
-                        if not acked:
-                            ent["reply"] = (
-                                -110,
-                                f"commit v{v} not acknowledged by a "
-                                "monitor majority", b"")
-                        ent["state"] = "done"
-                        rcode, routs, rdata = ent["reply"]
-                        for c, t in ent.pop("conns", []):
-                            c.send_message(M.MMonCommandReply(
-                                tid=t, code=rcode, outs=routs,
-                                data=rdata))
-                        ent["conns"] = []
-                    if self._defer_until_majority(version, reply):
-                        self._dedup_put(key, ent)
-                        return
-                self._dedup_put(key, {"state": "done",
-                                      "reply": (code, outs, data)})
+    def _handle_mon_command(self, msg: M.MMonCommand,
+                            conn: Connection) -> None:
+        """Leader command path: dedup, then queue the execution as a
+        mutation folded into the next proposal. The reply defers until
+        the proposal commits (quorum accepted) — the Paxos contract
+        that a minority leader can never ack. Caller holds the lock."""
+        key = f"{conn.peer_name}|{msg.tid}"
+        rep = self._cmd_replies.get(key)
+        if rep is not None:
+            # REPLICATED dedup: the original execution committed
+            # (possibly under a previous leader) — a retry attaches
+            # to it instead of re-running the mutation
+            conn.send_message(M.MMonCommandReply(
+                tid=msg.tid, code=rep[0], outs=rep[1],
+                data=bytes.fromhex(rep[2])))
+            return
+        ent = self._cmd_dedup.get(key)
+        if ent is not None:
+            if ent["state"] == "done":
+                code, outs, data = ent["reply"]
                 conn.send_message(M.MMonCommandReply(
                     tid=msg.tid, code=code, outs=outs, data=data))
+            else:              # still awaiting its proposal: attach
+                ent["conns"].append((conn, msg.tid))
+            return
+        ent = {"state": "pending", "reply": None,
+               "conns": [(conn, msg.tid)]}
+        self._dedup_put(key, ent)
+
+        def mutate(ent=ent, key=key, cmd=dict(msg.cmd)):
+            # runs on the proposal's scratch state; _dirty was reset
+            # by the pump so it reflects THIS command only
+            code, outs, data = self._handle_command(cmd)
+            ent["reply"] = (code, outs, data)
+            if self._dirty:
+                # fold the reply into the replicated state itself: if
+                # this proposal commits anywhere, the dedup travels
+                # with it (survives leader failover — the reference's
+                # session dedup made durable)
+                replies = self._cmd_replies
+                replies[key] = [code, outs, data.hex()]
+                while len(replies) > 256:
+                    replies.pop(next(iter(replies)))
+
+        def done(acked: bool, ent=ent, key=key):
+            if not acked:
+                ent["reply"] = (
+                    -110, "proposal not accepted by a monitor "
+                    "majority", b"")
+            ent["state"] = "done"
+            code, outs, data = ent["reply"]
+            for c, t in ent.pop("conns", []):
+                c.send_message(M.MMonCommandReply(
+                    tid=t, code=code, outs=outs, data=data))
+            ent["conns"] = []
+            if not acked:
+                # nothing committed: a retry must be free to re-run
+                # (caching -110 would wedge the command forever)
+                if self._cmd_dedup.get(key) is ent:
+                    del self._cmd_dedup[key]
+
+        self._mut_queue.append({"fn": mutate, "done": done,
+                                "ts": time.monotonic()})
+        self._pump_proposals(time.monotonic())
+
+    def _enqueue_mutation(self, fn) -> None:
+        """Queue an internal (no-reply) state mutation — osd boots,
+        failure reports, beacon timeouts. Caller holds the lock."""
+        self._mut_queue.append({"fn": fn, "done": None,
+                                "ts": time.monotonic()})
+        self._pump_proposals(time.monotonic())
 
     def _handle_auth(self, msg: M.MAuth, conn: Connection) -> None:
         """AuthMonitor role: grant a ticket. An auth-disabled mon
@@ -565,19 +869,54 @@ class Monitor:
                         addr=self.addr), addr)
             if len(self.monmap) > 1:
                 self._elect(now)
-            self._expire_pending_commits(now)
+            # paxos upkeep: a proposal that cannot gather a quorum
+            # (minority leader, fenced pn) times out WITHOUT touching
+            # state; a stalled collect retries; queued mutations that
+            # never got proposed expire
+            timeout = g_conf()["mon_commit_timeout"]
+            if self._proposal is not None and \
+                    now - self._proposal["ts"] > timeout:
+                log(1, f"mon.{self.name}: proposal "
+                    f"v{self._proposal['version']} gathered "
+                    f"{len(self._proposal['acks'])}/{self._majority()}"
+                    f" accepts in {timeout}s; failing it")
+                self._fail_proposal()
+            if self._collect is not None and \
+                    now - self._collect["ts"] > \
+                    g_conf()["mon_election_timeout"]:
+                self._collect = None     # retried by the pump
+            keep = []
+            for ent in self._mut_queue:
+                if now - ent["ts"] > timeout:
+                    if ent["done"] is not None:
+                        ent["done"](False)
+                else:
+                    keep.append(ent)
+            self._mut_queue = keep
             if not self.is_leader():
                 return   # peons never mutate (beacon state flows to
                 # the leader via forwarding)
-            changed = False
-            for osd, info in self.osdmap.osds.items():
-                if info.up and \
-                        now - self._last_beacon.get(osd, now) > grace:
-                    log(1, f"osd.{osd} beacon timeout, marking down")
-                    self.osdmap.mark_down(osd)
-                    changed = True
-            if changed:
-                self._commit()
+            self._pump_proposals(now)
+
+            def check_beacons():
+                self._beacon_check_queued = False
+                changed = False
+                for osd, info in self.osdmap.osds.items():
+                    if info.up and now - self._last_beacon.get(
+                            osd, now) > grace:
+                        log(1, f"osd.{osd} beacon timeout, "
+                            "marking down")
+                        self.osdmap.mark_down(osd)
+                        changed = True
+                if changed:
+                    self._commit()
+
+            stale = [osd for osd, info in self.osdmap.osds.items()
+                     if info.up and
+                     now - self._last_beacon.get(osd, now) > grace]
+            if stale and not self._beacon_check_queued:
+                self._beacon_check_queued = True
+                self._enqueue_mutation(check_beacons)
 
     # -- command handling (OSDMonitor::prepare_command role) ----------
     def _handle_command(self, cmd: dict) -> tuple[int, str, bytes]:

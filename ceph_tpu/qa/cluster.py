@@ -163,6 +163,21 @@ class MiniCluster:
         log(1, f"revived osd.{osd_id}")
         return osd
 
+    def partition_mons(self, *groups: list[int]) -> None:
+        """Symmetric mon-level network partition (the qa suites'
+        partition-thrashing role): mons in different groups silently
+        drop each other's frames (messenger blocked_peers injection).
+        OSD/client traffic is unaffected."""
+        ranks = {r for g in groups for r in g}
+        for g in groups:
+            for r in g:
+                self.mons[r].msgr.blocked_peers = {
+                    self.mons[o].addr for o in ranks if o not in g}
+
+    def heal_mons(self) -> None:
+        for m in self.mons.values():
+            m.msgr.blocked_peers = set()
+
     def kill_mon(self, rank: int) -> None:
         """Hard-stop a monitor; its commit log survives for revive."""
         m = self.mons.pop(rank)

@@ -1,0 +1,204 @@
+"""Paxos phase machinery under partitions (src/mon/Paxos.{h,cc}
+collect/begin/accept/commit): minority leaders cannot commit, dueling
+leaders converge, and a new leader completes its predecessor's
+accepted-but-uncommitted proposal — with the replicated command dedup
+answering the client's retry."""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import RadosClient
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.utils.config import g_conf
+
+
+@pytest.fixture
+def fast():
+    conf = g_conf()
+    keys = ("osd_heartbeat_interval", "osd_heartbeat_grace",
+            "mon_election_timeout", "mon_commit_timeout")
+    old = {k: conf[k] for k in keys}
+    conf.set("osd_heartbeat_interval", 0.25)
+    conf.set("osd_heartbeat_grace", 2.0)
+    conf.set("mon_election_timeout", 0.8)
+    conf.set("mon_commit_timeout", 1.5)
+    yield
+    for k, v in old.items():
+        conf.set(k, v)
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(msg)
+
+
+def _send_cmd_tid(client: RadosClient, tid: int, cmd: dict, addr: str,
+                  timeout: float = 8.0):
+    """Send one MMonCommand with a CHOSEN tid to a specific mon —
+    simulates a client retry of the same logical command (the mon
+    dedups on (client entity, tid))."""
+    monc = client.monc
+    ent = [threading.Event(), None]
+    with monc._lock:
+        monc._pending[tid] = ent
+    client.msgr.send_message(
+        M.MMonCommand(tid=tid, cmd={k: str(v) for k, v in cmd.items()}),
+        addr)
+    ok = ent[0].wait(timeout)
+    with monc._lock:
+        monc._pending.pop(tid, None)
+    if not ok:
+        return None
+    rep = ent[1]
+    return rep.code, rep.outs, rep.data
+
+
+def test_minority_leader_cannot_commit_majority_side_can(fast):
+    """Partition {leader} | {peon, peon}: the isolated leader's
+    proposals starve of accepts and fail with -110 leaving state
+    untouched, while the majority side elects and commits. On heal the
+    minority converges to the majority's history."""
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        cluster.create_pool("base", pg_num=2, size=2)   # pn established
+        _wait(lambda: len({m._last_committed()
+                           for m in cluster.mons.values()}) == 1)
+
+        cluster.partition_mons([0], [1, 2])
+        # minority side: mon0 keeps its seat but can never commit
+        c0 = RadosClient(cluster.mons[0].addr).connect()
+        try:
+            code, outs, _ = c0.mon_command(
+                {"prefix": "osd pool create", "pool": "minority",
+                 "pg_num": "2", "size": "2"})
+            assert code == -110, (code, outs)
+            assert "majority" in outs
+        finally:
+            c0.shutdown()
+        assert "minority" not in cluster.mons[0].osdmap.pool_by_name
+
+        # majority side: elects rank 1, commits fine
+        _wait(lambda: cluster.mons[1].is_leader(),
+              msg="majority side never elected rank 1")
+        c12 = RadosClient(cluster.mons[1].addr).connect()
+        try:
+            code, outs, _ = c12.mon_command(
+                {"prefix": "osd pool create", "pool": "majority",
+                 "pg_num": "2", "size": "2"})
+            assert code == 0, (code, outs)
+        finally:
+            c12.shutdown()
+
+        cluster.heal_mons()
+        # dueling leaders converge: exactly one leader again, all mons
+        # hold the majority's pool and NOT the minority's
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1,
+              msg="leaders never converged after heal")
+        _wait(lambda: all(
+            "majority" in m.osdmap.pool_by_name and
+            "minority" not in m.osdmap.pool_by_name
+            for m in cluster.mons.values()),
+            msg="state never converged after heal")
+
+
+def test_new_leader_completes_predecessors_proposal(fast):
+    """The leader fans out a begin (peons durably accept) but dies
+    before committing. The successor's collect phase must recover the
+    accepted value and complete it — and the REPLICATED dedup must
+    answer a client retry of the same tid with the original reply,
+    not EEXIST (the execution happened exactly once)."""
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        leader = next(m for m in cluster.mons.values() if m.is_leader())
+        assert leader.rank == 0
+        cluster.create_pool("base", pg_num=2, size=2)   # pn established
+        _wait(lambda: len({m._last_committed()
+                           for m in cluster.mons.values()}) == 1)
+
+        # crash-point injection: the leader "dies" between quorum
+        # accept and commit — acceptors hold the value durably
+        leader._commit_proposal = lambda: None
+
+        client = cluster.client()
+        tid = 424242
+        cmd = {"prefix": "osd pool create", "pool": "recov",
+               "pg_num": "2", "size": "2"}
+        got = _send_cmd_tid(client, tid, cmd, leader.addr, timeout=8.0)
+        assert got is not None and got[0] == -110, got
+        # the peons durably accepted the value
+        assert any(cluster.mons[r]._pending() is not None
+                   for r in (1, 2)), "no acceptor holds the value"
+
+        cluster.kill_mon(0)
+        _wait(lambda: any(m.is_leader()
+                          for m in cluster.mons.values()),
+              msg="no successor elected")
+        # the successor's collect completes the in-flight proposal
+        _wait(lambda: all("recov" in m.osdmap.pool_by_name
+                          for m in cluster.mons.values()),
+              msg="successor never completed the in-flight proposal")
+
+        # client retry (same tid) hits the replicated dedup: the
+        # ORIGINAL reply, not EEXIST — proof the execution is exactly
+        # once even across the leader change
+        new_leader = next(m for m in cluster.mons.values()
+                          if m.is_leader())
+        got = _send_cmd_tid(client, tid, cmd, new_leader.addr,
+                            timeout=8.0)
+        assert got is not None, "retry got no reply"
+        code, outs, _ = got
+        assert code == 0, (code, outs)
+        assert "created" in outs
+
+
+def test_accepted_pn_fences_stale_leader(fast):
+    """A deposed leader whose pn has been outbid cannot push proposals:
+    peons that promised the higher pn refuse its begins (ok=False) and
+    the stale leader stands down instead of committing."""
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        cluster.create_pool("base", pg_num=2, size=2)
+        _wait(lambda: len({m._last_committed()
+                           for m in cluster.mons.values()}) == 1)
+        m0 = cluster.mons[0]
+        old_pn = m0._leader_pn
+        assert old_pn > 0
+        # a rival establishes a higher promise on the peons (what a
+        # competing collector does)
+        rival_pn = m0._next_pn() + (1 << 8)
+        for r in (1, 2):
+            with cluster.mons[r]._lock:
+                cluster.mons[r]._promise(rival_pn)
+        code, outs, _ = cluster.mon_cmd(prefix="osd pool create",
+                                        pool="fenced", pg_num="2",
+                                        size="2")
+        assert code in (-110, 0), (code, outs)
+        if code == -110:
+            # fenced as designed: nothing committed anywhere
+            assert all("fenced" not in m.osdmap.pool_by_name
+                       for m in cluster.mons.values())
+            # and the leader re-collects with a HIGHER pn, after which
+            # commands flow again
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                code, outs, _ = cluster.mon_cmd(
+                    prefix="osd pool create", pool="fenced2",
+                    pg_num="2", size="2")
+                if code == 0:
+                    break
+                time.sleep(0.25)
+            assert code == 0, (code, outs)
+            leader = next(m for m in cluster.mons.values()
+                          if m.is_leader())
+            assert leader._leader_pn > rival_pn
