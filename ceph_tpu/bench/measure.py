@@ -64,3 +64,69 @@ def chained_slope(step_fn, x0, *, min_traffic_bytes: int,
     if not slopes:                       # all noise-dominated: honest
         slopes = [times[counts[1]] / counts[1]]
     return min(slopes)
+
+
+def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
+                      counts: tuple[int, int] = (5, 25),
+                      time_budget: float = 240.0, stable_n: int = 5,
+                      stable_tol: float = 0.10, sleep: float = 0.5
+                      ) -> tuple[float, float, int]:
+    """Adaptive best-slope estimator for a SHARED chip.
+
+    The tunnel chip is contended by other users in bursts, so a fixed
+    round count reports whatever the contention happened to be (the
+    round-1 failure mode: 63-424 GB/s across driver runs). This keeps
+    sampling chained slopes until ``stable_n`` samples agree with the
+    best within ``stable_tol`` (the uncontended plateau — contention
+    only ever makes slopes WORSE, so the guarded best is the physical
+    number) or the time budget runs out.
+
+    Returns (best_slope_seconds, spread_pct, n_samples): spread_pct is
+    the relative spread of the plateau samples around their median —
+    the run-to-run reproducibility figure BASELINE.md documents.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def loop(x, iters):
+        def body(i, xx):
+            return step_fn(xx)
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    def force(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return int(jnp.sum(leaf.reshape(-1)[::4096]
+                           .astype(jnp.uint32)))
+
+    force(loop(x0, 2))                   # warmup / compile
+    min_slope = min_traffic_bytes / (HBM_CEILING_GBPS * 1e9)
+    t_start = time.perf_counter()
+    slopes: list[float] = []
+    while time.perf_counter() - t_start < time_budget:
+        times = {}
+        for iters in counts:
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                force(loop(x0, iters))
+                best = min(best, time.perf_counter() - t0)
+            times[iters] = best
+        s = (times[counts[1]] - times[counts[0]]) / (
+            counts[1] - counts[0])
+        if s >= min_slope:               # physically possible only
+            slopes.append(s)
+            best = min(slopes)
+            plateau = [x for x in slopes
+                       if x <= best * (1 + stable_tol)]
+            if len(plateau) >= stable_n and \
+                    time.perf_counter() - t_start > 20.0:
+                break
+        time.sleep(sleep)
+    if not slopes:
+        return times[counts[1]] / counts[1], 100.0, 0
+    best = min(slopes)
+    plateau = sorted(x for x in slopes if x <= best * (1 + stable_tol))
+    med = plateau[len(plateau) // 2]
+    spread = 100.0 * (max(plateau) - min(plateau)) / med
+    return best, round(spread, 1), len(slopes)

@@ -39,8 +39,14 @@ class IoCtx:
         self.client = client
         self.pool_id = pool_id
         self.pool_name = pool_name
+        #: per-ioctx op timeout override (seconds); benches raise it
+        #: so device-kernel compile stalls slow ops instead of
+        #: failing them
+        self.op_timeout: float | None = None
 
     def _submit(self, oid: str, op: int, **kw) -> M.MOSDOpReply:
+        if self.op_timeout is not None:
+            kw.setdefault("timeout", self.op_timeout)
         try:
             return self.client.objecter.op_submit(
                 self.pool_id, oid, op, **kw)

@@ -67,18 +67,29 @@ def _bitsliced_matvec_device(bmat: "jax.Array", data: "jax.Array") -> "jax.Array
 
 
 class _MatrixCache:
-    """Host GF matrix -> device-resident binary matrix, keyed by bytes."""
+    """Host GF matrix -> device-resident binary matrix, keyed by bytes.
+
+    Trace-safe like gf_pallas._PermMatrixCache: under an outer jit
+    (e.g. the fused encode+crc flush, osd/ec_util.py) the expansion is
+    handed out as a fresh numpy constant — caching the jnp array there
+    would store a tracer and poison every later call."""
 
     def __init__(self) -> None:
-        self._cache: dict[bytes, "jax.Array"] = {}
+        self._host: dict[bytes, np.ndarray] = {}
+        self._dev: dict[bytes, "jax.Array"] = {}
 
     def get(self, mat: np.ndarray) -> "jax.Array":
         key = mat.shape[0].to_bytes(2, "little") + mat.tobytes()
-        dev = self._cache.get(key)
+        bmat = self._host.get(key)
+        if bmat is None:
+            bmat = self._host[key] = \
+                bitmatrix.expand_bitmatrix(mat).astype(np.int8)
+        from ceph_tpu.ops.jax_util import tracing_active
+        if tracing_active():
+            return jnp.asarray(bmat)
+        dev = self._dev.get(key)
         if dev is None:
-            bmat = bitmatrix.expand_bitmatrix(mat).astype(np.int8)
-            dev = jnp.asarray(bmat)
-            self._cache[key] = dev
+            dev = self._dev[key] = jnp.asarray(bmat)
         return dev
 
 

@@ -122,10 +122,8 @@ def _matvec_padded(bmat: jax.Array, data: jax.Array,
 
 
 def _tracing() -> bool:
-    try:
-        return not jax.core.trace_state_clean()
-    except AttributeError:      # jax moved/renamed it: be conservative
-        return True
+    from ceph_tpu.ops.jax_util import tracing_active
+    return tracing_active()
 
 
 class _PermMatrixCache:
@@ -164,8 +162,11 @@ _perm_cache = _PermMatrixCache()
 def matvec_device(mat: np.ndarray, data, tile: int = DEFAULT_TILE):
     """Device-in/device-out GF matvec via the Pallas kernel.
 
-    data: [k, N] uint8 (jax or numpy). N is padded to the block size with
-    zeros (GF-linear => padding encodes to zeros and is sliced off).
+    data: [k, N] uint8 (jax or numpy). N is padded UP TO A POW2 GRID
+    BUCKET with zeros (GF-linear => padding encodes to zeros and is
+    sliced off). Bucketing bounds the compile count to O(log N) — the
+    OSD's batch engine feeds arbitrary batch sizes, and an exact-fit
+    grid would recompile (~30s over the chip tunnel) per size.
     """
     mat = np.asarray(mat, dtype=np.uint8)
     m_out, k = mat.shape
@@ -175,7 +176,10 @@ def matvec_device(mat: np.ndarray, data, tile: int = DEFAULT_TILE):
     n = data.shape[1]
     t = min(tile // g, max(128, _round_up(-(-n // g), 128)))
     block = g * t
-    pad = _round_up(n, block) - n
+    nb = block
+    while nb < n:
+        nb <<= 1
+    pad = nb - n
     if pad:
         data = jnp.pad(data, ((0, 0), (0, pad)))
     out = _matvec_padded(bmat, data, k, m_out, g, t)

@@ -1,0 +1,34 @@
+"""Small shared jax helpers for the kernel modules."""
+
+from __future__ import annotations
+
+
+def tracing_active() -> bool:
+    """True when called under a jax trace (jit/vmap/...), False on the
+    eager path. Used by the device-matrix caches: under a trace they
+    must hand out fresh numpy constants (a cached jnp array would be a
+    leaked tracer); eagerly they reuse a device-resident copy (a numpy
+    constant there would re-upload the matrix every call).
+
+    Probes the known jax APIs in order and falls back to True
+    (conservative: correct everywhere, merely slower eagerly).
+    tests/test_gf_jax.py pins the BEHAVIOR — eager vs traced must
+    differ — so a jax rename that lands us on the fallback fails CI
+    instead of silently degrading the hot path.
+    """
+    import jax
+
+    core = jax.core
+    fn = getattr(core, "trace_state_clean", None)
+    if fn is not None:
+        try:
+            return not fn()
+        except Exception:
+            pass
+    ctx = getattr(core, "trace_ctx", None)
+    if ctx is not None and hasattr(ctx, "is_top_level"):
+        try:
+            return not ctx.is_top_level()
+        except Exception:
+            pass
+    return True

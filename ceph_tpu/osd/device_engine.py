@@ -1,0 +1,162 @@
+"""DeviceEncodeEngine — the OSD's device-side stripe-batch pipeline.
+
+This is the seam SURVEY.md §0 calls the north star: "ECBackend
+accumulates sub-writes into device-side stripe batches". The reference
+encodes synchronously inside try_reads_to_commit
+(src/osd/ECBackend.cc:1986-2048, per-stripe loop ECUtil.cc:120-159);
+a TPU cannot be fed per-4KiB-op without drowning in dispatch latency,
+so the daemon's encode work is decoupled from the op path:
+
+- ``stage_encode`` queues an op's padded payload; the engine folds
+  every queued payload (across PGs — batching across placement groups
+  is where the batch size comes from) into ONE device kernel launch
+  via :class:`ceph_tpu.osd.ec_util.StripeBatcher`, then dispatches
+  each op's continuation (hinfo + shard-txn build + fan-out) back
+  onto the OSD's sharded op queue.
+- ``stage_barrier`` queues a NON-encode mutation (remove, RMW
+  partial write). A barrier flushes everything staged before it and
+  is dispatched after those continuations — on the same per-PG FIFO
+  wq shard — so per-PG commit order is exactly submission order (the
+  check_ops pipeline-ordering invariant, ECBackend.cc:2107-2112).
+
+Batching policy ("batch while busy"): the engine thread drains
+whatever is queued and encodes it in one launch; while the device
+works, new ops accumulate for the next launch. An idle engine
+therefore adds no latency (a lone op flushes immediately) and a busy
+one amortizes dispatch over the whole backlog. A size cap
+(``flush_bytes``) bounds the device working set.
+
+Failure containment: a device encode error fails over to the op
+continuations with the error; ECBackend re-encodes those ops on its
+host codec (the daemon must never wedge on an accelerator fault).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+import numpy as np
+
+from ceph_tpu.osd import ec_util
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("osd")
+
+
+class DeviceEncodeEngine:
+    """One per OSD; owns the device dispatch thread."""
+
+    def __init__(self, dispatch: Callable[[object, Callable], None],
+                 flush_bytes: int = 64 << 20,
+                 counters=None) -> None:
+        #: dispatch(key, fn): run fn on the per-key FIFO executor (the
+        #: OSD passes op_wq.enqueue, keyed by pgid)
+        self._dispatch = dispatch
+        self._flush_bytes = flush_bytes
+        self._counters = counters
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._running = True
+        #: introspection (asok / tests): launches, ops, bytes, and the
+        #: largest ops-per-launch seen — proof the batching engages
+        self.stats = {"flushes": 0, "ops": 0, "bytes": 0,
+                      "max_batch_ops": 0, "errors": 0}
+        self._thread = threading.Thread(
+            target=self._run, name="ec-device-engine", daemon=True)
+        self._thread.start()
+
+    # -- producer side (op-shard threads) -----------------------------
+    def stage_encode(self, key, codec, sinfo: ec_util.StripeInfo,
+                     data: np.ndarray,
+                     cont: Callable[[dict | None, dict | None,
+                                     Exception | None], None]) -> None:
+        """Queue one op's stripe-aligned payload for batched device
+        encode; ``cont(shards, crcs, err)`` is dispatched on ``key``
+        (crcs = per-shard LINEAR crc parts computed on device from the
+        same buffers, or None; err set and shards None on device
+        failure — caller falls back)."""
+        self._q.put(("enc", key, codec, sinfo, data, cont))
+
+    def stage_barrier(self, key, fn: Callable[[], None]) -> None:
+        """Queue an ordering barrier: ``fn`` dispatches on ``key``
+        after every previously staged op's continuation."""
+        self._q.put(("bar", key, fn))
+
+    def stop(self) -> None:
+        self._running = False
+        self._q.put(None)
+        self._thread.join(timeout=10)
+
+    # -- engine thread ------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            pending: dict[int, tuple] = {}   # id(codec) -> state
+            nbytes = 0
+            while True:
+                if item is None:
+                    self._flush(pending)
+                    return
+                if item[0] == "enc":
+                    _, key, codec, sinfo, data, cont = item
+                    _, _, items = pending.setdefault(
+                        id(codec), (codec, sinfo, []))
+                    items.append((key, data, cont))
+                    nbytes += data.nbytes
+                    if nbytes >= self._flush_bytes:
+                        self._flush(pending)
+                        pending, nbytes = {}, 0
+                else:                        # barrier
+                    self._flush(pending)
+                    pending, nbytes = {}, 0
+                    _, key, fn = item
+                    self._dispatch(key, fn)
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    # nothing else queued: launch what we have now
+                    # (an idle engine adds no batching latency)
+                    self._flush(pending)
+                    pending, nbytes = {}, 0
+                    break
+            if not self._running:
+                return
+
+    def _flush(self, pending: dict) -> None:
+        from ceph_tpu.parallel import mesh as mesh_mod
+        for codec, sinfo, items in pending.values():
+            # a configured default mesh routes the flush through the
+            # multi-chip encode step (pod deployments; dryrun/tests)
+            batcher = ec_util.StripeBatcher(
+                sinfo, codec, mesh=mesh_mod.get_default_mesh())
+            for i, (_key, data, _cont) in enumerate(items):
+                batcher.append(i, data)
+            try:
+                results = batcher.flush(
+                    with_crcs=ec_util.fuse_crc_policy(codec))
+            except Exception as exc:
+                log(0, f"device encode batch of {len(items)} ops "
+                    f"failed: {exc!r}")
+                self.stats["errors"] += 1
+                for key, _data, cont in items:
+                    self._dispatch(key, _bind(cont, None, None, exc))
+                continue
+            self.stats["flushes"] += 1
+            self.stats["ops"] += len(items)
+            self.stats["bytes"] += sum(d.nbytes for _, d, _c in items)
+            self.stats["max_batch_ops"] = max(
+                self.stats["max_batch_ops"], len(items))
+            if self._counters is not None:
+                self._counters.inc("device_batches")
+                self._counters.inc("device_batch_ops", len(items))
+            for (key, _data, cont), (_i, shards, crcs) in zip(items,
+                                                             results):
+                self._dispatch(key, _bind(cont, shards, crcs, None))
+        pending.clear()
+
+
+def _bind(cont, shards, crcs, err):
+    return lambda: cont(shards, crcs, err)

@@ -72,20 +72,41 @@ class ECReadError(StoreError):
     """Not enough readable shards to reconstruct."""
 
 
+#: profile backends that run on the accelerator through the batched
+#: stripe engine (everything else is a host backend used synchronously)
+DEVICE_BACKENDS = ("jax", "pallas", "auto_device")
+
+
 class ECBackend(PGBackend):
     def __init__(self, parent: Listener, pool_info) -> None:
         super().__init__(parent, pool_info)
         profile = dict(pool_info.ec_profile)
-        if "backend" not in profile:
-            # the OSD's synchronous op path runs host-side kernels (the
-            # ISA-L seat: our native C++ AVX2 lib, numpy fallback); the
-            # jax/TPU path serves the batched stripe engine, where
-            # shapes are static and launches amortized — a per-op jit
-            # dispatch would stall the latency-sensitive daemon
-            from ceph_tpu.ops import backend as backend_mod
-            avail = backend_mod.available_backends()
-            profile["backend"] = ("native" if "native" in avail
-                                  else "numpy")
+        from ceph_tpu.ops import backend as backend_mod
+        avail = backend_mod.available_backends()
+        want = profile.get("backend")
+        if want == "auto_device":
+            # best available device path (pallas on a TPU, plain-XLA
+            # bit-sliced elsewhere)
+            want = profile["backend"] = \
+                "pallas" if "pallas" in avail else "jax"
+        host_backend = "native" if "native" in avail else "numpy"
+        self.device = None
+        self.device_codec = None
+        if want in DEVICE_BACKENDS:
+            # device backends serve the BATCHED stripe engine (full-
+            # object writes coalesced across PGs into one kernel
+            # launch); the synchronous op paths — degraded reads, RMW
+            # re-encode, recovery decode — keep a host twin, because a
+            # per-op jit dispatch would stall the latency-sensitive
+            # daemon (SURVEY.md §7.5)
+            self.device_codec = ec_registry.instance().factory(
+                profile.get("plugin", "jerasure"), profile)
+            self.device = parent.device_engine()
+            profile = dict(profile)
+            profile["backend"] = host_backend
+        elif want is None:
+            # the ISA-L seat: our native C++ AVX2 lib, numpy fallback
+            profile["backend"] = host_backend
         self.codec = ec_registry.instance().factory(
             profile.get("plugin", "jerasure"), profile)
         self.k = self.codec.get_data_chunk_count()
@@ -179,13 +200,50 @@ class ECBackend(PGBackend):
     def submit_write(self, pg: PG, oid: str, data: bytes, version: int,
                      on_commit: Callable[[int], None]) -> None:
         data = bytes(data)
-        padded = self._pad(data)
-        shards = ec_util.encode(self.sinfo, self.codec, padded)
+        pg.extent_cache.pin(oid, version, 0, data, len(data), full=True)
+        if self.device is not None:
+            # the TPU path: stage into the device stripe-batch engine;
+            # the continuation (hinfo + txns + fan-out) runs on this
+            # PG's wq shard in staging order, so per-PG commit order is
+            # preserved across the async flush (check_ops invariant,
+            # ECBackend.cc:2107-2112)
+            buf = np.frombuffer(self._pad(data), dtype=np.uint8)
+
+            def cont(shards, crcs, err, pg=pg, oid=oid, data=data,
+                     version=version, on_commit=on_commit):
+                if shards is None:
+                    log(0, f"device encode failed for {oid} "
+                        f"({err!r}); host fallback")
+                    shards = ec_util.encode(self.sinfo, self.codec,
+                                            self._pad(data))
+                    crcs = None
+                with pg.lock:
+                    self._finish_write(pg, oid, data, version, shards,
+                                       on_commit, crcs=crcs)
+
+            self.device.stage_encode(pg.pgid, self.device_codec,
+                                     self.sinfo, buf, cont)
+            return
+        shards = ec_util.encode(self.sinfo, self.codec, self._pad(data))
+        self._finish_write(pg, oid, data, version, shards, on_commit)
+
+    def _finish_write(self, pg: PG, oid: str, data: bytes, version: int,
+                      shards: dict[int, np.ndarray],
+                      on_commit: Callable[[int], None],
+                      crcs: dict[int, int] | None = None) -> None:
+        """Post-encode tail of a full-object write: hinfo, per-shard
+        txns, fan-out (caller holds pg.lock on the async path).
+        ``crcs``: per-shard crc LINEAR parts computed on device from
+        the encode's own HBM buffers (Checksummer.h role, SURVEY.md §0
+        item (c)) — combined with the hinfo seed host-side."""
         hinfo = HashInfo(self.n)
-        hinfo.append(0, shards)
+        if crcs is not None and shards:
+            hinfo.append_linear(0, crcs,
+                                len(next(iter(shards.values()))))
+        else:
+            hinfo.append(0, shards)
         hinfo_raw = json.dumps(hinfo.to_dict()).encode()
         size_raw = len(data).to_bytes(8, "little")
-        pg.extent_cache.pin(oid, version, 0, data, len(data), full=True)
         self._fan_out(
             pg, oid, version, LOG_WRITE,
             lambda pos, cid: object_write_txn(
@@ -198,11 +256,24 @@ class ECBackend(PGBackend):
                       on_commit: Callable[[int], None]) -> None:
         pg.extent_cache.pin(oid, version, 0, b"", 0, full=True,
                             remove=True)
-        self._fan_out(
-            pg, oid, version, LOG_REMOVE,
-            lambda pos, cid: object_remove_txn(cid, oid),
-            self._unpin_on_commit(pg, oid, version, on_commit),
-            "ec_sub_remove", supersedes_recovery=True)
+
+        def run() -> None:
+            self._fan_out(
+                pg, oid, version, LOG_REMOVE,
+                lambda pos, cid: object_remove_txn(cid, oid),
+                self._unpin_on_commit(pg, oid, version, on_commit),
+                "ec_sub_remove", supersedes_recovery=True)
+
+        if self.device is not None:
+            # ordering barrier: a staged-but-unflushed write to this
+            # object must fan out BEFORE the remove, or the remove
+            # would be resurrected by the older write's txn
+            def barrier(pg=pg) -> None:
+                with pg.lock:
+                    run()
+            self.device.stage_barrier(pg.pgid, barrier)
+            return
+        run()
 
     def submit_partial_write(self, pg: PG, oid: str, offset: int,
                              data: bytes, version: int,
@@ -223,8 +294,46 @@ class ECBackend(PGBackend):
         read (degraded beyond reach): a transient read failure must
         fail the op, never silently truncate to old_size=0.
         """
-        sw, cs = self.sinfo.stripe_width, self.sinfo.chunk_size
         data = bytes(data)
+        if self.device is not None:
+            # defer behind the engine as an ordering barrier: a staged
+            # full write of this object must fan out first, or its
+            # whole-object txn (landing later) would clobber this
+            # range write. A THIN marker pin goes in NOW so ops that
+            # run before the barrier (a subsequent append's offset
+            # computation, an overlapping RMW's overlay) already see
+            # this write's bytes and size; the barrier body re-pins
+            # the full spliced window at the same version, and the
+            # commit unpins both.
+            end = offset + len(data)
+            base = old_size if old_size is not None else 0
+            pg.extent_cache.pin(oid, version, offset, data,
+                                max(base, end), full=False)
+
+            def barrier(pg=pg, oid=oid, offset=offset, data=data,
+                        version=version, on_commit=on_commit,
+                        old_size=old_size) -> None:
+                with pg.lock:
+                    try:
+                        self._submit_partial_write_sync(
+                            pg, oid, offset, data, version, on_commit,
+                            old_size)
+                    except StoreError as exc:
+                        log(1, f"deferred partial write {oid} "
+                            f"v{version} failed: {exc}")
+                        pg.extent_cache.unpin(oid, version)
+                        on_commit(-5)
+
+            self.device.stage_barrier(pg.pgid, barrier)
+            return
+        self._submit_partial_write_sync(pg, oid, offset, data, version,
+                                        on_commit, old_size)
+
+    def _submit_partial_write_sync(self, pg: PG, oid: str, offset: int,
+                                   data: bytes, version: int,
+                                   on_commit: Callable[[int], None],
+                                   old_size: int | None = None) -> None:
+        sw, cs = self.sinfo.stripe_width, self.sinfo.chunk_size
         end = offset + len(data)
         if old_size is None:
             try:

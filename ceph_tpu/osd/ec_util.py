@@ -171,6 +171,22 @@ class HashInfo:
                 data, self.cumulative_shard_hashes[shard])
         self.total_chunk_size += sizes.pop()
 
+    def append_linear(self, old_size: int, linear: dict[int, int],
+                      chunk_len: int) -> None:
+        """Fold an append whose per-shard LINEAR crc parts were
+        computed on device (ops/crc32c_device.py): the running crc is
+        recovered host-side as L(chunk) ^ crc32c(0^len, prev) — the
+        affine identity — in O(32^2 log len), no byte re-hash."""
+        if old_size != self.total_chunk_size:
+            raise ValueError(
+                f"hinfo append at {old_size} != current size "
+                f"{self.total_chunk_size} (appends must be contiguous)")
+        from ceph_tpu.ops.crc32c_device import zeros_crc
+        for shard, lv in linear.items():
+            self.cumulative_shard_hashes[shard] = int(lv) ^ zeros_crc(
+                chunk_len, self.cumulative_shard_hashes[shard])
+        self.total_chunk_size += chunk_len
+
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 
@@ -198,10 +214,16 @@ class StripeBatcher:
     """
 
     def __init__(self, sinfo: StripeInfo, codec,
-                 flush_bytes: int = 8 << 20) -> None:
+                 flush_bytes: int = 8 << 20, mesh=None) -> None:
         self.sinfo = sinfo
         self.codec = codec
         self.flush_bytes = flush_bytes
+        #: jax.sharding.Mesh: when set (and the codec is a plain
+        #: matrix codec), flushes run the DISTRIBUTED encode step over
+        #: the mesh (sharded_codec.make_encode_step) — stripe batches
+        #: shard over ('stripe' x 'shard'), parity computes with zero
+        #: communication, integrity stats psum over ICI
+        self.mesh = mesh
         self._pending: list[tuple[object, np.ndarray]] = []
         self._pending_bytes = 0
 
@@ -217,13 +239,36 @@ class StripeBatcher:
     def should_flush(self) -> bool:
         return self._pending_bytes >= self.flush_bytes
 
-    def flush(self) -> list[tuple[object, dict[int, np.ndarray]]]:
-        """Encode all queued ops in one batch; returns [(op_id, shards)]
-        in submission order."""
+    def flush(self, with_crcs: bool = False
+              ) -> list[tuple[object, dict[int, np.ndarray],
+                              dict[int, int] | None]]:
+        """Encode all queued ops in one batch; returns
+        [(op_id, shards, crcs-or-None)] in submission order.
+
+        ``with_crcs`` computes each op's per-shard LINEAR crc parts on
+        device from the same buffers as the encode (SURVEY.md §0 item
+        (c) — the Checksummer/BlueStore-verify pass riding the encode's
+        HBM residency); only available on the fused device path, None
+        otherwise (callers fall back to host hashing).
+        """
         if not self._pending:
             return []
         ops, bufs = zip(*self._pending)
         self._pending, self._pending_bytes = [], 0
+        if self.mesh is not None and _device_fusable(self.codec):
+            try:
+                return _flush_mesh(self.mesh, self.sinfo, self.codec,
+                                   ops, bufs)
+            except Exception:
+                pass          # single-device fallback below
+        if with_crcs and _device_fusable(self.codec):
+            try:
+                return _flush_device_fused(self.sinfo, self.codec,
+                                           ops, bufs)
+            except Exception:
+                # fused path failure must not lose the batch: the
+                # plain path below re-encodes (host or device)
+                pass
         batch = np.concatenate(bufs)
         shards = encode(self.sinfo, self.codec, batch)
         results = []
@@ -232,6 +277,190 @@ class StripeBatcher:
         for op_id, buf in zip(ops, bufs):
             nchunk = len(buf) // sw * cs
             results.append((op_id, {
-                i: v[off:off + nchunk] for i, v in shards.items()}))
+                i: v[off:off + nchunk] for i, v in shards.items()},
+                None))
             off += nchunk
         return results
+
+
+#: pool-profile backends whose matvec runs on the accelerator
+_DEVICE_MATVEC = {"jax", "pallas"}
+
+#: upper bound on the fused path's padded crc working set (the bit
+#: unpack amplifies 8x in device memory; a ragged op mix must fall
+#: back to the plain flush instead of OOMing the runtime)
+_FUSE_CRC_MAX_SEG_BYTES = 256 << 20
+
+
+def _device_fusable(codec) -> bool:
+    from ceph_tpu.models.matrix_codec import MatrixErasureCode
+    return (isinstance(codec, MatrixErasureCode)
+            and not codec.chunk_mapping
+            and getattr(codec, "backend", "") in _DEVICE_MATVEC)
+
+
+def fuse_crc_policy(codec) -> bool:
+    """Whether the engine should ask for device-fused crcs: on the
+    real accelerator (pallas) yes; the plain-XLA jax backend — which
+    mostly means CPU CI, where the crc bit-unpack's 8x memory
+    amplification across many in-process OSDs thrashes the host —
+    only when explicitly forced (CEPH_TPU_FUSE_CRC=1)."""
+    import os
+    if not _device_fusable(codec):
+        return False
+    return codec.backend == "pallas" or \
+        bool(os.environ.get("CEPH_TPU_FUSE_CRC"))
+
+
+#: (backend, matrix bytes, Nb, lmax_b, nops_b) -> jitted fused fn —
+#: all dimensions are pow2-BUCKETED so the compile cache stays small
+#: no matter what op-size mixes the daemon sees (an unbucketed
+#: signature recompiles per batch shape and stalls the op path)
+_fused_cache: dict = {}
+
+
+def _pow2_bucket(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+#: id(mesh) -> {(matrix bytes): jitted encode step}; bounded — each
+#: closure pins its mesh + compiled executables, so unbounded growth
+#: across mesh reconfigurations would leak device programs
+_mesh_step_cache: dict = {}
+_MESH_STEP_CACHE_MAX = 8
+
+
+def _flush_mesh(mesh, sinfo: StripeInfo, codec, ops, bufs):
+    """Flush the batch through the MULTI-CHIP encode step: stripes
+    shard over the mesh's ('stripe' x 'shard') axes, parity computes
+    locally on every chip (position-wise math — zero communication),
+    and the integrity stat psums over ICI. Parity bytes are bit-exact
+    vs the host codec (place=False keeps them home; the TCP messenger
+    owns shard placement in this architecture)."""
+    from ceph_tpu.parallel import sharded_codec
+    cs, sw = sinfo.chunk_size, sinfo.stripe_width
+    k = codec.get_data_chunk_count()
+    n_chunks = codec.get_chunk_count()
+    lens = [len(b) // sw * cs for b in bufs]
+    batch = np.concatenate(bufs)
+    s = len(batch) // sw
+    data = batch.reshape(s, k, cs)
+    n_stripe = mesh.shape["stripe"]
+    # pow2-bucket the stripe count (bounds compiles) and round to the
+    # stripe axis; zero stripes encode to zero parity and slice off
+    s_pad = _pow2_bucket(max(s, n_stripe), n_stripe)
+    if s_pad % n_stripe:
+        s_pad = -(-s_pad // n_stripe) * n_stripe
+    if s_pad != s:
+        data = np.concatenate(
+            [data, np.zeros((s_pad - s, k, cs), dtype=np.uint8)])
+    if id(mesh) not in _mesh_step_cache and \
+            len(_mesh_step_cache) >= _MESH_STEP_CACHE_MAX:
+        _mesh_step_cache.clear()
+    per_mesh = _mesh_step_cache.setdefault(id(mesh), {})
+    key = codec.coding_matrix.tobytes()
+    step = per_mesh.get(key)
+    if step is None:
+        step = per_mesh[key] = sharded_codec.make_encode_step(
+            mesh, np.asarray(codec.coding_matrix, dtype=np.uint8),
+            place=False)
+    chunks, _csum = step(sharded_codec.shard_stripe_batch(mesh, data))
+    chunks = np.asarray(chunks)[:s]            # [s, k+m, cs]
+    streams = {i: np.ascontiguousarray(
+        chunks[:, i, :]).reshape(-1) for i in range(n_chunks)}
+    results = []
+    off = 0
+    for op_id, ln in zip(ops, lens):
+        results.append((op_id,
+                        {i: streams[i][off:off + ln]
+                         for i in range(n_chunks)}, None))
+        off += ln
+    return results
+
+
+def _flush_device_fused(sinfo: StripeInfo, codec, ops, bufs):
+    """One device program per bucketed batch signature: upload the
+    stripe batch once, encode parity, and take every op's per-shard
+    crc linear part from the SAME device-resident shards (one download
+    round trip for parity + 4 bytes/shard of crcs). Per-op segment
+    boundaries are DYNAMIC inputs (offsets/lengths arrays), with
+    front-zero padding — free under crc linearity — masking the
+    neighbour bytes a fixed-width window drags in."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from ceph_tpu.ops import crc32c_device as cd
+    cs, sw = sinfo.chunk_size, sinfo.stripe_width
+    k = codec.get_data_chunk_count()
+    n_chunks = codec.get_chunk_count()
+    m = n_chunks - k
+    lens = [len(b) // sw * cs for b in bufs]
+    batch = np.concatenate(bufs)
+    s = len(batch) // sw
+    n_bytes = s * cs
+    data_shards = np.ascontiguousarray(
+        batch.reshape(s, k, cs).transpose(1, 0, 2).reshape(k, n_bytes))
+
+    n_b = _pow2_bucket(n_bytes, 1 << 14)
+    lmax_b = _pow2_bucket(max(lens), max(cd.ROW_BYTES, 1 << 12))
+    nops_b = _pow2_bucket(len(ops), 1)
+    if nops_b * n_chunks * lmax_b > _FUSE_CRC_MAX_SEG_BYTES:
+        raise ValueError("fused crc working set too large; "
+                         "plain flush")
+    key = (codec.backend, codec.coding_matrix.tobytes(),
+           n_b, lmax_b, nops_b)
+    fn = _fused_cache.get(key)
+    if fn is None:
+        if len(_fused_cache) > 256:
+            _fused_cache.clear()
+        if codec.backend == "pallas":
+            from ceph_tpu.ops import gf_pallas as dev
+        else:
+            from ceph_tpu.ops import gf_jax as dev
+        mat = np.asarray(codec.coding_matrix, dtype=np.uint8)
+
+        def fused(data, offs, seg_lens):
+            parity = dev.matvec_device(mat, data)
+            shards = jnp.concatenate(
+                [data, parity.astype(jnp.uint8)], axis=0)
+            padded = jnp.pad(shards, ((0, 0), (lmax_b, 0)))
+
+            def seg(off, ln):
+                # window ENDING at the segment end; bytes before the
+                # segment (neighbour ops / padding) masked to zero
+                win = lax.dynamic_slice(
+                    padded, (0, off + ln), (n_chunks, lmax_b))
+                mask = jnp.arange(lmax_b) >= (lmax_b - ln)
+                return win * mask.astype(jnp.uint8)
+
+            segs = jax.vmap(seg)(offs, seg_lens)
+            lin = cd.crc_linear_device(
+                segs.reshape(nops_b * n_chunks, lmax_b))
+            return parity, lin
+
+        fn = _fused_cache[key] = jax.jit(fused)
+    if n_b != n_bytes:
+        data_dev = np.zeros((k, n_b), dtype=np.uint8)
+        data_dev[:, :n_bytes] = data_shards
+    else:
+        data_dev = data_shards
+    offs_arr = np.zeros(nops_b, dtype=np.int32)
+    offs_arr[:len(ops)] = np.cumsum([0] + lens[:-1])
+    lens_arr = np.zeros(nops_b, dtype=np.int32)
+    lens_arr[:len(ops)] = lens
+    parity, lin = fn(data_dev, offs_arr, lens_arr)
+    parity = np.asarray(parity)
+    lin = np.asarray(lin).reshape(nops_b, n_chunks)
+    results = []
+    off = 0
+    for idx, (op_id, ln) in enumerate(zip(ops, lens)):
+        shards = {i: data_shards[i, off:off + ln] for i in range(k)}
+        for j in range(m):
+            shards[k + j] = parity[j, off:off + ln]
+        crcs = {i: int(lin[idx, i]) for i in range(n_chunks)}
+        results.append((op_id, shards, crcs))
+        off += ln
+    return results

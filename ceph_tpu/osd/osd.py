@@ -206,6 +206,10 @@ class OSD:
         self._recovery_res_lock = threading.Lock()
         self._recovery_active = 0
         self._backends: dict[int, PGBackend] = {}
+        # device stripe-batch engine (SURVEY.md §7.5): created lazily
+        # by the first EC pool whose profile selects a device backend
+        self._device_engine = None
+        self._device_engine_lock = threading.Lock()
         self._tid = 0
         self._tid_lock = threading.Lock()
         self._inflight: dict[int, InflightWrite] = {}
@@ -254,6 +258,10 @@ class OSD:
         perf.add_u64_counter("recovery_subchunk_reads",
                              "repairs served by fragmented sub-chunk "
                              "reads (clay repair-bandwidth path)")
+        perf.add_u64_counter("device_batches",
+                             "stripe-batch device kernel launches")
+        perf.add_u64_counter("device_batch_ops",
+                             "ops encoded through the device engine")
         perf.add_time_avg("op_latency", "client op latency")
         return perf
 
@@ -313,6 +321,8 @@ class OSD:
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=5)
+        if self._device_engine is not None:
+            self._device_engine.stop()
         self.op_wq.drain_stop()
         self.reader_wq.drain_stop()
         self.msgr.shutdown()
@@ -321,6 +331,17 @@ class OSD:
         collection().remove(self._perf_name)
 
     # -- Listener interface (what backends use) -----------------------
+    def device_engine(self):
+        """Lazy per-OSD DeviceEncodeEngine (the stripe-batch
+        accumulator of SURVEY.md §0): continuations dispatch onto the
+        sharded op queue keyed by pgid, preserving per-PG order."""
+        with self._device_engine_lock:
+            if self._device_engine is None:
+                from ceph_tpu.osd.device_engine import DeviceEncodeEngine
+                self._device_engine = DeviceEncodeEngine(
+                    self.op_wq.enqueue, counters=self.logger)
+            return self._device_engine
+
     def get_osdmap(self) -> OSDMap:
         with self._map_lock:
             return self.osdmap

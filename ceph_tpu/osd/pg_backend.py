@@ -163,6 +163,7 @@ class Listener(Protocol):
     def unregister_wait(self, tid: int) -> None: ...
     def queue_local_txn(self, txn: Transaction,
                         on_commit: Callable[[], None]) -> None: ...
+    def device_engine(self): ...   # lazy per-OSD DeviceEncodeEngine
 
 
 class PGBackend:

@@ -41,3 +41,20 @@ def _largest_factor_leq(n: int, cap: int) -> int:
         if n % f == 0:
             return f
     return 1
+
+
+#: process-wide default mesh: when set, the OSD's device engine routes
+#: stripe-batch flushes through the sharded encode step
+#: (parallel/sharded_codec.py) instead of the single-chip kernel —
+#: the multi-chip deployment switch (dryrun/tests set it; a pod
+#: deployment sets it at daemon start)
+_default_mesh: Mesh | None = None
+
+
+def set_default_mesh(mesh: Mesh | None) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Mesh | None:
+    return _default_mesh

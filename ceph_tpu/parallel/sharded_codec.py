@@ -46,14 +46,18 @@ def _bitsliced_encode_local(bmat: jax.Array, data: jax.Array) -> jax.Array:
         axis=1, dtype=jnp.uint32).astype(jnp.uint8)
 
 
-def make_encode_step(mesh: Mesh, coding_matrix: np.ndarray):
+def make_encode_step(mesh: Mesh, coding_matrix: np.ndarray,
+                     place: bool = True):
     """Build the jitted distributed EC write step.
 
     Input  : data [S, k, C] uint8, sharded (stripe, -, shard).
-    Output : chunks [S, k+m, C] uint8 with parity placed one shard-ring
-             position away (the messenger fan-out analog), and a psum'd
-             integrity checksum per chunk position.
-    """
+    Output : chunks [S, k+m, C] uint8 and a psum'd integrity checksum
+             per chunk position. With ``place`` (default), parity is
+             shipped one shard-ring position away (the messenger
+             fan-out analog) — the host-visible parity bytes are then
+             ring-rolled along C by device blocks; ``place=False``
+             keeps parity home (the batcher flush path, where the TCP
+             messenger owns placement and the bytes must be exact)."""
     bmat = jnp.asarray(bitmatrix.expand_bitmatrix(coding_matrix), jnp.int8)
     m, k = coding_matrix.shape
     n_shard = mesh.shape["shard"]
@@ -64,11 +68,12 @@ def make_encode_step(mesh: Mesh, coding_matrix: np.ndarray):
         flat = data.transpose(1, 0, 2).reshape(k_, s_l * c_l)
         parity = _bitsliced_encode_local(bmat, flat)
         parity = parity.reshape(m, s_l, c_l).transpose(1, 0, 2)
-        # placement: ship parity bytes to the next shard position on the
-        # ICI ring (stand-in for the per-shard sub-write fan-out,
-        # ECBackend.cc:2023-2039)
-        perm = [(i, (i + 1) % n_shard) for i in range(n_shard)]
-        parity = jax.lax.ppermute(parity, "shard", perm)
+        if place:
+            # placement: ship parity bytes to the next shard position
+            # on the ICI ring (stand-in for the per-shard sub-write
+            # fan-out, ECBackend.cc:2023-2039)
+            perm = [(i, (i + 1) % n_shard) for i in range(n_shard)]
+            parity = jax.lax.ppermute(parity, "shard", perm)
         chunks = jnp.concatenate([data, parity], axis=1)  # [S_l, k+m, C_l]
         # integrity stats over the full mesh (hinfo crc role): per-position
         # byte sums reduced with psum across stripe and shard axes
@@ -80,6 +85,33 @@ def make_encode_step(mesh: Mesh, coding_matrix: np.ndarray):
         step, mesh=mesh,
         in_specs=P("stripe", None, "shard"),
         out_specs=(P("stripe", None, "shard"), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_matrix_step(mesh: Mesh, flat_matrix: np.ndarray):
+    """Generic distributed GF matrix step: [S, rows_in, C] sharded
+    (stripe, -, shard) -> (local [S, rows_out, C], all-gathered full
+    rows). This is the collective shape shared by degraded reads AND
+    the Clay linearized repair (models/clay.py _repair_matrix): helper
+    sub-chunk fragments gather along ``shard`` and one flat GF matmul
+    reconstructs the lost chunk's sub-chunks."""
+    bmat = jnp.asarray(bitmatrix.expand_bitmatrix(flat_matrix), jnp.int8)
+    w = flat_matrix.shape[0]
+
+    def step(x):  # [S_l, rows_in, C_l]
+        s_l, p, c_l = x.shape
+        flat = x.transpose(1, 0, 2).reshape(p, s_l * c_l)
+        rec = _bitsliced_encode_local(bmat, flat)
+        rec = rec.reshape(w, s_l, c_l).transpose(1, 0, 2)
+        full = jax.lax.all_gather(rec, "shard", axis=2, tiled=True)
+        return rec, full
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=P("stripe", None, "shard"),
+        out_specs=(P("stripe", None, "shard"), P("stripe", None, None)),
         check_vma=False,
     )
     return jax.jit(sharded)

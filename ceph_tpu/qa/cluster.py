@@ -139,8 +139,14 @@ class MiniCluster:
                        plugin: str = "jerasure", pg_num: int = 8,
                        **profile_extra) -> None:
         import json
+        import os
         profile = {"plugin": plugin, "k": str(k), "m": str(m),
                    **{a: str(b) for a, b in profile_extra.items()}}
+        # CEPH_TPU_EC_BACKEND=jax/pallas runs the whole qa suite with
+        # the device stripe-batch path engaged (the real-chip gate)
+        forced = os.environ.get("CEPH_TPU_EC_BACKEND")
+        if forced and "backend" not in profile:
+            profile["backend"] = forced
         code, outs, _ = self.mon_cmd(
             prefix="osd erasure-code-profile set", name=f"{name}_profile",
             profile=json.dumps(profile))

@@ -1,0 +1,98 @@
+"""Device-side crc32c (ops/crc32c_device.py — the Checksummer.h role
+riding the encode's HBM buffers): bit-equality vs the host oracle
+across lengths/seeds, the affine seed-correction identity, the fused
+StripeBatcher flush, and HashInfo built from device linear parts."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models import registry as ec_registry
+from ceph_tpu.ops import crc32c_device as cd
+from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.ec_util import HashInfo, StripeBatcher, StripeInfo
+from ceph_tpu.utils import checksum as ck
+
+
+def test_zeros_crc_matches_oracle():
+    for n in (1, 5, 511, 512, 513, 4096, 1 << 20):
+        for s in (0, 0xFFFFFFFF, 0xDEADBEEF):
+            assert cd.zeros_crc(n, s) == ck.crc32c(b"\x00" * n, s)
+
+
+def test_batch_crc_bit_equal_across_lengths_and_seeds():
+    rng = np.random.default_rng(1)
+    for length in (1, 17, 512, 800, 4096, 65536):
+        x = rng.integers(0, 256, size=(4, length), dtype=np.uint8)
+        for s in (0, 0xFFFFFFFF, 0x1234):
+            got = cd.crc32c_device(x, s)
+            want = np.array(
+                [ck.crc32c(x[i].tobytes(), s) for i in range(4)],
+                dtype=np.uint32)
+            assert np.array_equal(got, want), (length, s)
+
+
+def test_front_zero_padding_is_free():
+    """The linearity property the device layout relies on: leading
+    zero bytes do not change the crc linear part."""
+    rng = np.random.default_rng(2)
+    m = rng.integers(0, 256, size=(1, 1000), dtype=np.uint8)
+    lp = np.asarray(cd.crc_linear_device(m))[0]
+    padded = np.concatenate(
+        [np.zeros((1, 3096), dtype=np.uint8), m], axis=1)
+    lp2 = np.asarray(cd.crc_linear_device(padded))[0]
+    assert lp == lp2
+
+
+@pytest.fixture
+def jcodec():
+    return ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "2", "m": "1",
+                     "backend": "jax"})
+
+
+def test_fused_flush_crcs_match_host_hinfo(jcodec):
+    """The engine's fused device flush: shards bit-equal to host
+    encode, and HashInfo built from device linear parts identical to
+    the host-hashed HashInfo (the corpus gate for the crc kernel)."""
+    si = StripeInfo(stripe_width=2 * 4096, chunk_size=4096)
+    rng = np.random.default_rng(3)
+    b = StripeBatcher(si, jcodec)
+    bufs = {}
+    for op in range(4):
+        data = rng.integers(0, 256, size=(op + 1) * si.stripe_width,
+                            dtype=np.uint8)
+        bufs[op] = data
+        b.append(op, data)
+    results = b.flush(with_crcs=True)
+    assert len(results) == 4
+    host = ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "2", "m": "1",
+                     "backend": "numpy"})
+    for op, shards, crcs in results:
+        assert crcs is not None, "fused path did not engage"
+        want = ec_util.encode(si, host, bufs[op])
+        for i in range(3):
+            assert np.array_equal(shards[i], want[i]), (op, i)
+        hi_dev = HashInfo(3)
+        hi_dev.append_linear(0, crcs, len(shards[0]))
+        hi_host = HashInfo(3)
+        hi_host.append(0, want)
+        assert hi_dev.to_dict() == hi_host.to_dict(), op
+
+
+def test_append_linear_cumulative(jcodec):
+    """Cumulative hinfo across MULTIPLE appends: the affine seed
+    correction must chain device linear parts exactly like host
+    re-hashing chains raw bytes."""
+    rng = np.random.default_rng(4)
+    a = rng.integers(0, 256, size=(3, 5000), dtype=np.uint8)
+    b = rng.integers(0, 256, size=(3, 700), dtype=np.uint8)
+    hi_dev, hi_host = HashInfo(3), HashInfo(3)
+    lin_a = np.asarray(cd.crc_linear_device(a))
+    lin_b = np.asarray(cd.crc_linear_device(b))
+    hi_dev.append_linear(0, {i: int(lin_a[i]) for i in range(3)}, 5000)
+    hi_dev.append_linear(5000, {i: int(lin_b[i]) for i in range(3)},
+                         700)
+    hi_host.append(0, {i: a[i] for i in range(3)})
+    hi_host.append(5000, {i: b[i] for i in range(3)})
+    assert hi_dev.to_dict() == hi_host.to_dict()

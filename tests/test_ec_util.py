@@ -113,8 +113,8 @@ def test_stripe_batcher_order_and_content(codec):
         bufs[f"op{op}"] = data
         batcher.append(f"op{op}", data)
     results = batcher.flush()
-    assert [op for op, _ in results] == [f"op{i}" for i in range(5)]
-    for op, shards in results:
+    assert [op for op, _, _ in results] == [f"op{i}" for i in range(5)]
+    for op, shards, _crcs in results:
         want = ec_util.encode(si, codec, bufs[op])
         for i in range(6):
             assert np.array_equal(shards[i], want[i]), (op, i)

@@ -1,5 +1,7 @@
 """Sharded EC pipeline tests on the virtual 8-device CPU mesh."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -71,3 +73,117 @@ def test_distributed_degraded_read(mesh):
     rec, full = step(sharded_codec.shard_stripe_batch(mesh, surv))
     assert np.array_equal(np.asarray(rec), all_chunks[:, lost])
     assert np.array_equal(np.asarray(full), all_chunks[:, lost])
+
+
+def test_batcher_flush_routes_through_mesh(mesh):
+    """VERDICT #8: the daemon's StripeBatcher flushes through the
+    multi-chip encode step when a mesh is present — bit-exact vs the
+    host codec, per-op slices preserved."""
+    from ceph_tpu.models import registry as ec_registry
+    from ceph_tpu.osd import ec_util
+    from ceph_tpu.osd.ec_util import StripeBatcher, StripeInfo
+
+    codec = ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "4", "m": "2",
+                     "backend": "jax"})
+    cs = mesh.shape["shard"] * 64
+    si = StripeInfo(stripe_width=4 * cs, chunk_size=cs)
+    host = ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "4", "m": "2",
+                     "backend": "numpy"})
+    rng = np.random.default_rng(7)
+    b = StripeBatcher(si, codec, mesh=mesh)
+    bufs = {}
+    for op in range(3):
+        data = rng.integers(0, 256, size=(op + 1) * si.stripe_width,
+                            dtype=np.uint8)
+        bufs[op] = data
+        b.append(op, data)
+    results = b.flush()
+    assert len(results) == 3
+    for op, shards, _crcs in results:
+        want = ec_util.encode(si, host, bufs[op])
+        for i in range(6):
+            assert np.array_equal(shards[i], want[i]), (op, i)
+
+
+def test_engine_uses_default_mesh(mesh):
+    """The device engine picks up the process default mesh: flushes
+    run the sharded encode step (multi-chip data plane engaged from
+    the daemon seam)."""
+    from ceph_tpu.models import registry as ec_registry
+    from ceph_tpu.osd import ec_util
+    from ceph_tpu.osd.device_engine import DeviceEncodeEngine
+    from ceph_tpu.osd.ec_util import StripeInfo
+    from ceph_tpu.parallel import mesh as mesh_mod
+
+    codec = ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "4", "m": "2",
+                     "backend": "jax"})
+    cs = mesh.shape["shard"] * 64
+    si = StripeInfo(stripe_width=4 * cs, chunk_size=cs)
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, size=2 * si.stripe_width,
+                        dtype=np.uint8)
+    got = []
+    eng = DeviceEncodeEngine(lambda key, fn: fn())
+    mesh_mod.set_default_mesh(mesh)
+    try:
+        eng.stage_encode("pg", codec, si, data,
+                         lambda s, c, e: got.append((s, e)))
+        deadline = time.monotonic() + 15
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        mesh_mod.set_default_mesh(None)
+        eng.stop()
+    assert got and got[0][1] is None
+    host = ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "4", "m": "2",
+                     "backend": "numpy"})
+    want = ec_util.encode(si, host, data)
+    for i in range(6):
+        assert np.array_equal(got[0][0][i], want[i])
+
+
+def test_distributed_clay_repair(mesh):
+    """Clay single-node repair as a mesh collective: helper sub-chunk
+    fragments shard over the mesh, the linearized repair matrix
+    (models/clay.py _repair_matrix) reconstructs the lost chunk, and
+    an all_gather reassembles it — bit-exact vs the host repair."""
+    from ceph_tpu.models import registry as ec_registry
+
+    codec = ec_registry.instance().factory(
+        "clay", {"plugin": "clay", "k": "4", "m": "2",
+                 "backend": "numpy"})
+    ssc = codec.get_sub_chunk_count()
+    rss = ssc // codec.q
+    sub = mesh.shape["shard"] * 16          # bytes per sub-chunk
+    cs = ssc * sub
+    rng = np.random.default_rng(9)
+    data = {i: rng.integers(0, 256, cs, dtype=np.uint8)
+            for i in range(4)}
+    enc = codec.encode_chunks(list(range(6)), data)
+    chunks = {**{i: np.asarray(data[i]) for i in range(4)},
+              **{i: np.asarray(v) for i, v in enc.items()}}
+    lost = 2
+    helpers = tuple(i for i in range(6) if i != lost)
+    # helper fragments: the repair sub-chunk ranges of each helper
+    ranges = codec.get_repair_subchunks(lost)
+    frag = {h: np.concatenate([
+        chunks[h][off * sub:(off + cnt) * sub]
+        for off, cnt in ranges]) for h in helpers}
+    # host oracle
+    want = codec.decode([lost], {h: f for h, f in frag.items()}, cs)
+    mat = codec._repair_matrix(lost, helpers)
+    # distribute: stack fragments as rows [S=1, H*rss, sub]
+    x = np.stack([f.reshape(rss, sub) for h, f in
+                  sorted(frag.items())]).reshape(1, len(helpers) * rss,
+                                                 sub)
+    # one logical stripe replicated across the stripe axis (the axis
+    # must divide S; real batches carry many stripes)
+    x = np.repeat(x, mesh.shape["stripe"], axis=0)
+    step = sharded_codec.make_matrix_step(mesh, mat)
+    rec, full = step(sharded_codec.shard_stripe_batch(mesh, x))
+    got = np.asarray(full)[0].reshape(-1)
+    assert np.array_equal(got, np.asarray(want[lost])), "clay mesh repair"
