@@ -9,6 +9,7 @@ here instead of each hand-rolling the pattern.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable, TypeVar
 
@@ -20,25 +21,40 @@ class BoundedLRU(OrderedDict):
 
     ``maxsize`` is a plain attribute so callers (and tests) can retune
     the bound after construction.
+
+    Thread-safe for the put/get_or_build accessors: the decode-table
+    caches are shared across the OSD's op-shard and reader threads
+    (the reference locks its table cache the same way —
+    ErasureCodeIsaTableCache, and tests the class of bug with
+    TestErasureCodeShec_thread.cc). Without the lock, a get's
+    move_to_end can race another thread's eviction of the same key
+    into a KeyError, and two concurrent builds can double-evict.
+    Plain dict operations remain unlocked — callers using them (the
+    mon's dedup) hold their own locks.
     """
 
     def __init__(self, maxsize: int) -> None:
         super().__init__()
         self.maxsize = maxsize
+        self._lock = threading.RLock()
 
     def put(self, key, value) -> None:
         """Bounded insert (plain ``self[key] =`` does NOT evict)."""
-        self[key] = value
-        self.move_to_end(key)
-        if len(self) > self.maxsize:
-            self.popitem(last=False)
-
-    def get_or_build(self, key, build: Callable[[], V]) -> V:
-        hit = self.get(key)
-        if hit is None:
-            hit = self[key] = build()
+        with self._lock:
+            self[key] = value
+            self.move_to_end(key)
             if len(self) > self.maxsize:
                 self.popitem(last=False)
-        else:
-            self.move_to_end(key)
-        return hit
+
+    def get_or_build(self, key, build: Callable[[], V]) -> V:
+        with self._lock:
+            hit = self.get(key)
+            if hit is None:
+                # build under the lock: deterministic table builds are
+                # cheap, and racing builders would double-insert/evict
+                hit = self[key] = build()
+                if len(self) > self.maxsize:
+                    self.popitem(last=False)
+            else:
+                self.move_to_end(key)
+            return hit
