@@ -148,6 +148,38 @@ class CrushMap:
         1.0 = always accept, 0.0 = always reject (device drained)."""
         self.device_weights[osd_id] = w
 
+    def set_crush_weight(self, osd_id: int, w: float) -> None:
+        """Adjust a device's CRUSH weight in its parent bucket AND
+        propagate the delta up every ancestor's subtree weight — the
+        straw2 draw weight (CrushWrapper::adjust_item_weight role,
+        which updates ancestor weight sums the same way), distinct
+        from reweight()'s post-selection acceptance knob. Without the
+        propagation, upweighting the sole device of a one-device host
+        bucket (the mon's boot-time topology) would be a placement
+        no-op: the root-level draw over hosts would never see it.
+        straw2 then moves only the proportional share of placements
+        (tests/test_crush_quality.py quantifies it)."""
+        item, delta = osd_id, None
+        while True:
+            holder = None
+            for b in self.buckets.values():
+                for i, it in enumerate(b.items):
+                    if it == item:
+                        holder, idx = b, i
+                        break
+                if holder is not None:
+                    break
+            if holder is None:
+                if delta is None:
+                    raise KeyError(f"no device {osd_id} in any bucket")
+                return                  # reached an un-parented root
+            if delta is None:
+                delta = w - holder.weights[idx]
+                holder.weights[idx] = w
+            else:
+                holder.weights[idx] += delta
+            item = holder.id            # continue up from this bucket
+
     def bucket_of(self, name: str) -> Bucket:
         return self.buckets[self.by_name[name]]
 
