@@ -58,6 +58,14 @@ from ceph_tpu.utils.dout import Dout
 log = Dout("mon")
 
 
+#: command prefixes that never mutate state — answered straight from
+#: committed state, bypassing the proposal pipeline
+_READONLY_COMMANDS = frozenset({
+    "osd erasure-code-profile ls", "osd erasure-code-profile get",
+    "osd pool ls", "osd tree", "osd dump", "status", "health",
+})
+
+
 class Monitor:
     """A single monitor daemon ("mon.a")."""
 
@@ -705,6 +713,18 @@ class Monitor:
         mutation folded into the next proposal. The reply defers until
         the proposal commits (quorum accepted) — the Paxos contract
         that a minority leader can never ack. Caller holds the lock."""
+        prefix = msg.cmd.get("prefix", "")
+        if prefix in _READONLY_COMMANDS:
+            # reads answer immediately from COMMITTED state (peons do
+            # too, via redirect->leader; the reference serves reads
+            # under the leader lease): queuing them behind the
+            # proposal pipeline would tax every status poll with a
+            # full-state scratch copy and block reads for
+            # mon_commit_timeout on a stalled/minority leader
+            code, outs, data = self._handle_command(dict(msg.cmd))
+            conn.send_message(M.MMonCommandReply(
+                tid=msg.tid, code=code, outs=outs, data=data))
+            return
         key = f"{conn.peer_name}|{msg.tid}"
         rep = self._cmd_replies.get(key)
         if rep is not None:
@@ -731,7 +751,14 @@ class Monitor:
         def mutate(ent=ent, key=key, cmd=dict(msg.cmd)):
             # runs on the proposal's scratch state; _dirty was reset
             # by the pump so it reflects THIS command only
-            code, outs, data = self._handle_command(cmd)
+            try:
+                code, outs, data = self._handle_command(cmd)
+            except Exception as exc:
+                # anything _handle_command's own guards miss must
+                # still produce a reply — a None reply would crash
+                # done() and wedge the command (and its retries, via
+                # the pending dedup entry) forever
+                code, outs, data = -22, f"internal error: {exc!r}", b""
             ent["reply"] = (code, outs, data)
             if self._dirty:
                 # fold the reply into the replicated state itself: if
@@ -748,6 +775,8 @@ class Monitor:
                 ent["reply"] = (
                     -110, "proposal not accepted by a monitor "
                     "majority", b"")
+            elif ent["reply"] is None:     # mutation never ran/failed
+                ent["reply"] = (-22, "command execution failed", b"")
             ent["state"] = "done"
             code, outs, data = ent["reply"]
             for c, t in ent.pop("conns", []):
