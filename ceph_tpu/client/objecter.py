@@ -94,6 +94,8 @@ class Objecter:
     def op_submit(self, pool: int, oid: str, op: int, *, offset: int = 0,
                   length: int = 0, data: bytes = b"", ps: int = -1,
                   cls: str = "", method: str = "",
+                  snap_seq: int = 0, snaps: list | tuple = (),
+                  snapid: int = 0,
                   timeout: float = 30.0) -> M.MOSDOpReply:
         """Synchronous submit (the aio variant is just this on a
         thread); raises ObjecterError on errno replies."""
@@ -106,7 +108,9 @@ class Objecter:
         msg = M.MOSDOp(tid=tid, client=self.msgr.entity_name, epoch=0,
                        pool=pool, ps=max(ps, 0), oid=oid, op=op,
                        offset=offset, length=length, data=bytes(data),
-                       trace=span.wire(), cls=cls, method=method)
+                       trace=span.wire(), cls=cls, method=method,
+                       snap_seq=snap_seq, snaps=list(snaps),
+                       snapid=snapid)
         rec = _Op(tid, msg)
         with self._lock:
             self._pending[tid] = rec

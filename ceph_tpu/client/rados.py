@@ -53,29 +53,93 @@ class IoCtx:
         except ObjecterError as exc:
             raise RadosError(exc.code, str(exc)) from None
 
+    def _snapc(self) -> dict:
+        """The pool's snap context for mutations (librados attaches
+        the SnapContext to every write the same way)."""
+        m = self.client.monc.osdmap
+        pool = m.pools.get(self.pool_id) if m else None
+        if pool is None or not pool.snap_seq:
+            return {}
+        seq, snaps = pool.snap_context()
+        return {"snap_seq": seq, "snaps": snaps}
+
     # -- data ops -----------------------------------------------------
     def write_full(self, oid: str, data: bytes) -> int:
         """Replace the object; returns the new object version."""
-        return self._submit(oid, M.OSD_OP_WRITE_FULL, data=data).version
+        return self._submit(oid, M.OSD_OP_WRITE_FULL, data=data,
+                            **self._snapc()).version
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> int:
         return self._submit(oid, M.OSD_OP_WRITE, data=data,
-                            offset=offset).version
+                            offset=offset, **self._snapc()).version
 
     def append(self, oid: str, data: bytes) -> int:
-        return self._submit(oid, M.OSD_OP_APPEND, data=data).version
+        return self._submit(oid, M.OSD_OP_APPEND, data=data,
+                            **self._snapc()).version
 
-    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+    def read(self, oid: str, length: int = 0, offset: int = 0,
+             snap: int = 0) -> bytes:
+        """``snap``: read the object's state as of that pool snapshot
+        (0 = head)."""
         return self._submit(oid, M.OSD_OP_READ, offset=offset,
-                            length=length).data
+                            length=length, snapid=snap).data
 
-    def stat(self, oid: str) -> int:
+    def stat(self, oid: str, snap: int = 0) -> int:
         """Object size in bytes."""
-        rep = self._submit(oid, M.OSD_OP_STAT)
+        rep = self._submit(oid, M.OSD_OP_STAT, snapid=snap)
         return json.loads(rep.data)["size"]
 
     def remove(self, oid: str) -> None:
-        self._submit(oid, M.OSD_OP_REMOVE)
+        self._submit(oid, M.OSD_OP_REMOVE, **self._snapc())
+
+    # -- pool snapshots (librados snap API role) ----------------------
+    def snap_create(self, name: str) -> int:
+        """Pool snapshot (rados_ioctx_snap_create): returns the snap
+        id. Subsequent writes COW-preserve pre-snap object states."""
+        code, outs, data = self.client.mon_command(
+            {"prefix": "osd pool mksnap", "pool": self.pool_name,
+             "snap": name})
+        if code != 0:
+            raise RadosError(code, outs)
+        snapid = json.loads(data)["snapid"]
+        self._wait_map(lambda p: snapid in p.snaps)
+        return snapid
+
+    def snap_remove(self, name: str) -> None:
+        """Delete a pool snapshot; OSD trimmers reclaim its clones."""
+        code, outs, _ = self.client.mon_command(
+            {"prefix": "osd pool rmsnap", "pool": self.pool_name,
+             "snap": name})
+        if code != 0:
+            raise RadosError(code, outs)
+        self._wait_map(lambda p: name not in p.snaps.values())
+
+    def snap_list(self) -> dict[int, str]:
+        m = self.client.monc.osdmap
+        return dict(m.pools[self.pool_id].snaps)
+
+    def snap_lookup(self, name: str) -> int:
+        for sid, n in self.snap_list().items():
+            if n == name:
+                return sid
+        raise RadosError(-2, f"no snap {name!r}")
+
+    def snap_rollback(self, oid: str, name: str) -> None:
+        """Restore the head to its state at the snapshot
+        (rados_ioctx_snap_rollback: copy the covering clone up)."""
+        data = self.read(oid, snap=self.snap_lookup(name))
+        self.write_full(oid, data)
+
+    def _wait_map(self, pred, timeout: float = 10.0) -> None:
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            m = self.client.monc.osdmap
+            pool = m.pools.get(self.pool_id) if m else None
+            if pool is not None and pred(pool):
+                return
+            _time.sleep(0.05)
+        raise RadosError(-110, "osdmap never reflected snap change")
 
     def execute(self, oid: str, cls: str, method: str,
                 inp: bytes = b"") -> bytes:

@@ -78,6 +78,20 @@ ENOENT = -2
 ESTALE = -116
 EINVAL = -22
 
+#: separator for internal snapshot companion objects (clone bodies
+#: and snapset metadata live as ordinary versioned/recoverable
+#: objects next to the head; the separator is outside the client
+#: namespace and PGLS filters it)
+SNAP_SEP = "\x1e"
+
+
+def snap_clone_oid(oid: str, snapid: int) -> str:
+    return f"{oid}{SNAP_SEP}{snapid:016x}"
+
+
+def snapset_oid(oid: str) -> str:
+    return f"{oid}{SNAP_SEP}ss"
+
 
 #: QoS classes of the sharded queue (the reference's op classes:
 #: client ops vs recovery vs scrub, src/osd/OSD.cc:2095 + dmclock)
@@ -258,6 +272,8 @@ class OSD:
         perf.add_u64_counter("recovery_subchunk_reads",
                              "repairs served by fragmented sub-chunk "
                              "reads (clay repair-bandwidth path)")
+        perf.add_u64_counter("snap_clones", "snapshot COW clones made")
+        perf.add_u64_counter("snap_trims", "snapshot clones trimmed")
         perf.add_u64_counter("device_batches",
                              "stripe-batch device kernel launches")
         perf.add_u64_counter("device_batch_ops",
@@ -441,6 +457,24 @@ class OSD:
                 with self._sub_lock:
                     self._inflight.pop(iw.tid, None)
                 self.op_wq.enqueue(iw.pg.pgid, iw.on_all_commit)
+        # snap-trim trigger: pools whose snap set SHRANK get their
+        # primary PGs trimmed (the snap trim queue role) — clones of
+        # deleted snaps are reclaimed as scrub-class background work
+        shrunk = set()
+        if oldmap is not None:
+            for pid, pool in newmap.pools.items():
+                old = oldmap.pools.get(pid)
+                if old is not None and set(old.snaps) - set(pool.snaps):
+                    shrunk.add(pid)
+        if shrunk:
+            with self._pgs_lock:
+                trim_pgs = [pg for pg in self.pgs.values()
+                            if pg.pool in shrunk and pg.acting
+                            and pg.acting[0] == self.whoami]
+            for pg in trim_pgs:
+                self.op_wq.enqueue(pg.pgid,
+                                   lambda p=pg: self._snap_trim(p),
+                                   qos=QOS_SCRUB)
         # re-evaluate every primary PG against the new acting set
         with self._pgs_lock:
             pgids = list(self.pgs)
@@ -788,6 +822,30 @@ class OSD:
         be = pg.backend
         op = msg.op
         try:
+            if msg.snap_seq and op in (M.OSD_OP_WRITE_FULL,
+                                       M.OSD_OP_WRITE,
+                                       M.OSD_OP_APPEND,
+                                       M.OSD_OP_REMOVE):
+                # snapshot COW (PrimaryLogPG::make_writeable role):
+                # first mutation under a newer snap context clones the
+                # head before the write lands
+                self._make_writeable(pg, be, msg)
+            if msg.snapid and op in (M.OSD_OP_READ, M.OSD_OP_STAT):
+                # snap read: resolve through the snapset to the clone
+                # covering the wanted snap (find_object_context role)
+                oid = self._resolve_snap_oid(pg, be, msg.oid,
+                                             msg.snapid)
+                if op == M.OSD_OP_STAT:
+                    reply(0, json.dumps(
+                        {"size": be.stat_object(pg, oid)}).encode())
+                    return
+                data = be.read_object(pg, oid)
+                if msg.length:
+                    data = data[msg.offset:msg.offset + msg.length]
+                elif msg.offset:
+                    data = data[msg.offset:]
+                reply(0, bytes(data))
+                return
             if op == M.OSD_OP_WRITE_FULL:
                 self.logger.inc("op_w")
                 version = pg.log.last_version + 1
@@ -864,6 +922,14 @@ class OSD:
                     msg.cls, msg.method, msg.data, cur)
                 if code < 0:
                     reply(code)
+                elif new_obj is cls_mod.REMOVE:
+                    # the method dropped the object (cls_cxx_remove
+                    # role, e.g. refcount.put on the last reference)
+                    self.logger.inc("op_w")
+                    version = pg.log.last_version + 1
+                    be.submit_remove(
+                        pg, msg.oid, version,
+                        lambda c, v=version, o=out: reply(c, o, v))
                 elif new_obj is not None:
                     self.logger.inc("op_w")
                     version = pg.log.last_version + 1
@@ -887,7 +953,7 @@ class OSD:
         cid = pg.backend.local_cid(pg)
         try:
             return sorted(o for o in self.store.list_objects(cid)
-                          if o != PGMETA)
+                          if o != PGMETA and SNAP_SEP not in o)
         except StoreError:
             return []
 
@@ -1051,6 +1117,16 @@ class OSD:
         if pg.peer_missing:
             self.op_wq.enqueue(pg.pgid, lambda: self._recover(pg),
                                qos=QOS_RECOVERY)
+        # trim-on-activation (durability: the map-shrink trigger is
+        # in-memory only, so an rmsnap committed while this primary
+        # was down would otherwise leak its clones forever): any pool
+        # that ever had snaps gets a scan after peering
+        pool = osdmap.pools.get(pg.pool)
+        if pool is not None and pool.snap_seq and \
+                pg.acting and pg.acting[0] == self.whoami:
+            self.op_wq.enqueue(pg.pgid,
+                               lambda p=pg: self._snap_trim(p),
+                               qos=QOS_SCRUB)
 
     # -- scrub (PGBackend::be_compare_scrubmaps role) -----------------
     def scrub_pg(self, pgid: tuple[int, int], repair: bool = True,
@@ -1153,6 +1229,133 @@ class OSD:
                     if all(oid not in pg.peer_missing.get(pos, {})
                            for pos in bad)]
         return out
+
+    # -- pool snapshots (PrimaryLogPG snapset + snap trimming) --------
+    # Reference roles: SnapSet/clone handling in PrimaryLogPG.cc
+    # (make_writeable, find_object_context) and snap_mapper.h. The
+    # reduction here: clones and the snapset ride as ORDINARY objects
+    # through the backend (so replication/EC, recovery, scrub and the
+    # log all apply to them unchanged), and the trimmer finds work by
+    # scanning the primary shard's listing instead of a SnapMapper
+    # index — right for this scale, O(objects) per trim pass.
+
+    def _load_snapset(self, pg: PG, be, oid: str) -> dict:
+        try:
+            return json.loads(bytes(be.read_object(pg,
+                                                   snapset_oid(oid))))
+        except (NoSuchObject, NoSuchCollection):
+            return {"seq": 0, "clones": []}
+
+    def _store_snapset(self, pg: PG, be, oid: str, ss: dict) -> None:
+        version = pg.log.last_version + 1
+        be.submit_write(pg, snapset_oid(oid),
+                        json.dumps(ss, sort_keys=True).encode(),
+                        version, lambda code: None)
+
+    def _make_writeable(self, pg: PG, be, msg: M.MOSDOp) -> None:
+        """First mutation under a snap context newer than the object's
+        snapset seq: preserve the head as a clone object covering the
+        new snaps (PrimaryLogPG::make_writeable). Caller holds
+        pg.lock; the clone/snapset writes take their own versions, so
+        the actual op's version allocation must happen AFTER this."""
+        ss = self._load_snapset(pg, be, msg.oid)
+        seq = ss.get("seq", 0)
+        if msg.snap_seq <= seq:
+            return
+        try:
+            head = bytes(be.read_object(pg, msg.oid))
+        except (NoSuchObject, NoSuchCollection):
+            # no head to preserve: advance seq so a later write under
+            # this context does not clone a head born after the snap
+            ss["seq"] = msg.snap_seq
+            self._store_snapset(pg, be, msg.oid, ss)
+            return
+        covered = sorted(s for s in msg.snaps if s > seq) or \
+            [msg.snap_seq]
+        clone_id = covered[-1]
+        version = pg.log.last_version + 1
+        be.submit_write(pg, snap_clone_oid(msg.oid, clone_id), head,
+                        version, lambda code: None)
+        ss["seq"] = msg.snap_seq
+        ss.setdefault("clones", []).append(
+            {"id": clone_id, "snaps": covered, "size": len(head)})
+        self._store_snapset(pg, be, msg.oid, ss)
+        self.logger.inc("snap_clones")
+
+    def _resolve_snap_oid(self, pg: PG, be, oid: str,
+                          snapid: int) -> str:
+        """Object name serving a read at ``snapid``: the FIRST clone
+        (ascending) whose id >= snapid covers it; no such clone means
+        the head is unchanged since the snap."""
+        ss = self._load_snapset(pg, be, oid)
+        for c in ss.get("clones", []):
+            if c["id"] >= snapid:
+                return snap_clone_oid(oid, c["id"])
+        return oid
+
+    def _snap_trim(self, pg: PG) -> int:
+        """Reclaim clones whose snaps were all deleted (snap trimmer
+        role): runs on the primary from the map-change hook, as
+        scrub-class queue work. Returns clones removed."""
+        osdmap = self.get_osdmap()
+        pool = osdmap.pools.get(pg.pool)
+        if pool is None:
+            return 0
+        existing = set(pool.snaps)
+        with pg.lock:
+            if pg.state != PG.ACTIVE:
+                return 0
+            be = pg.backend
+            cid = be.local_cid(pg)
+            try:
+                names = self.store.list_objects(cid)
+            except StoreError:
+                return 0
+            suffix = SNAP_SEP + "ss"
+            removed = 0
+            for name in names:
+                if not name.endswith(suffix):
+                    continue
+                oid = name[:-len(suffix)]
+                try:
+                    ss = self._load_snapset(pg, be, oid)
+                except StoreError:
+                    continue
+                keep, changed = [], False
+                for c in ss.get("clones", []):
+                    live = [s for s in c["snaps"] if s in existing]
+                    if not live:
+                        version = pg.log.last_version + 1
+                        be.submit_remove(
+                            pg, snap_clone_oid(oid, c["id"]), version,
+                            lambda code: None)
+                        removed += 1
+                        changed = True
+                    elif live != c["snaps"]:
+                        keep.append({**c, "snaps": live})
+                        changed = True
+                    else:
+                        keep.append(c)
+                if not changed:
+                    continue
+                ss["clones"] = keep
+                if not keep:
+                    # no clones left: the snapset survives only to
+                    # carry seq for a LIVE head; a deleted head's
+                    # snapset goes too
+                    try:
+                        be.stat_object(pg, oid)
+                        self._store_snapset(pg, be, oid, ss)
+                    except (NoSuchObject, NoSuchCollection):
+                        version = pg.log.last_version + 1
+                        be.submit_remove(pg, snapset_oid(oid), version,
+                                         lambda code: None)
+                else:
+                    self._store_snapset(pg, be, oid, ss)
+            if removed:
+                log(1, f"{pg}: snap trim removed {removed} clones")
+                self.logger.inc("snap_trims", removed)
+        return removed
 
     def _scrub_listing(self, pg: PG) -> list[str]:
         """Union of every up shard's object listing (the reference

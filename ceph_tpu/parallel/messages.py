@@ -179,7 +179,13 @@ class MOSDOp(Message):
               ("pool", "i32"), ("ps", "u32"), ("oid", "str"),
               ("op", "u8"), ("offset", "u64"), ("length", "u64"),
               ("data", "bytes"), ("trace", "str"),
-              ("cls", "str"), ("method", "str")]
+              ("cls", "str"), ("method", "str"),
+              # snapshot context (appended; old readers skip):
+              # writes carry the pool snapc (seq + existing snap ids,
+              # newest first — PrimaryLogPG make_writeable inputs);
+              # reads carry the wanted snapid (0 = head)
+              ("snap_seq", "u64"), ("snaps", "u64_list"),
+              ("snapid", "u64")]
 
 
 class MOSDOpReply(Message):

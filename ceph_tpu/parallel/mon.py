@@ -62,7 +62,8 @@ log = Dout("mon")
 #: committed state, bypassing the proposal pipeline
 _READONLY_COMMANDS = frozenset({
     "osd erasure-code-profile ls", "osd erasure-code-profile get",
-    "osd pool ls", "osd tree", "osd dump", "status", "health",
+    "osd pool ls", "osd pool lssnap", "osd tree", "osd dump",
+    "status", "health",
 })
 
 
@@ -966,6 +967,32 @@ class Monitor:
             if prefix == "osd pool ls":
                 return 0, "", json.dumps(
                     sorted(self.osdmap.pool_by_name)).encode()
+            if prefix == "osd pool mksnap":
+                pid = self._resolve_pool(cmd["pool"])
+                pool = self.osdmap.pools[pid]
+                name = cmd["snap"]
+                if name in pool.snaps.values():
+                    return -17, f"snap {name!r} exists", b""
+                pool.snap_seq += 1
+                pool.snaps[pool.snap_seq] = name
+                self._commit()
+                return (0, f"created pool snap {name!r}",
+                        json.dumps({"snapid": pool.snap_seq}).encode())
+            if prefix == "osd pool rmsnap":
+                pid = self._resolve_pool(cmd["pool"])
+                pool = self.osdmap.pools[pid]
+                sid = next((i for i, n in pool.snaps.items()
+                            if n == cmd["snap"]), None)
+                if sid is None:
+                    return -2, f"no snap {cmd['snap']!r}", b""
+                del pool.snaps[sid]
+                self._commit()   # OSD trimmers react to the new map
+                return 0, f"removed pool snap {cmd['snap']!r}", b""
+            if prefix == "osd pool lssnap":
+                pid = self._resolve_pool(cmd["pool"])
+                return 0, "", json.dumps(
+                    {str(i): n for i, n in
+                     self.osdmap.pools[pid].snaps.items()}).encode()
             if prefix == "osd tree":
                 return 0, "", json.dumps(self._osd_tree()).encode()
             if prefix == "osd out":

@@ -38,10 +38,20 @@ class PoolInfo:
     min_size: int                  # floor to serve I/O (k for EC)
     ec_profile: dict = field(default_factory=dict)  # empty = replicated
     stripe_unit: int = 4096        # see osd_pool_erasure_code_stripe_unit
+    #: pool snapshots (pg_pool_t snap_seq/snaps roles): monotonically
+    #: increasing snap ids; removing a snap deletes its entry — OSD
+    #: snap trimmers reclaim clones whose snaps no longer exist
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)       # snapid -> name
 
     @property
     def is_ec(self) -> bool:
         return bool(self.ec_profile)
+
+    def snap_context(self) -> tuple[int, list[int]]:
+        """(seq, existing snap ids newest-first) — what write ops
+        carry (the SnapContext of librados)."""
+        return self.snap_seq, sorted(self.snaps, reverse=True)
 
 
 @dataclass
@@ -225,12 +235,18 @@ class OSDMap:
                  lambda en, k: (en.i32(k[0]), en.u32(k[1])),
                  lambda en, v: en.list(
                      v, lambda en2, p: (en2.i32(p[0]), en2.i32(p[1]))))
-        e.section(2, body)
+        # v3: pool snapshots (appended)
+        body.map({pid: p for pid, p in self.pools.items()},
+                 Encoder.i32,
+                 lambda en, p: (en.u64(p.snap_seq),
+                                en.map(p.snaps, Encoder.u64,
+                                       Encoder.str)))
+        e.section(3, body)
         return e.getvalue()
 
     @classmethod
     def decode(cls, buf: bytes) -> "OSDMap":
-        version, d = Decoder(buf).section(2)
+        version, d = Decoder(buf).section(3)
         m = cls()
         m.epoch = d.u32()
 
@@ -272,4 +288,12 @@ class OSDMap:
             m.pg_upmap_items = d.map(
                 lambda dd: (dd.i32(), dd.u32()),
                 lambda dd: dd.list(lambda d2: (d2.i32(), d2.i32())))
+        if version >= 3:
+            snapinfo = d.map(
+                Decoder.i32,
+                lambda dd: (dd.u64(), dd.map(Decoder.u64, Decoder.str)))
+            for pid, (seq, snaps) in snapinfo.items():
+                if pid in m.pools:
+                    m.pools[pid].snap_seq = seq
+                    m.pools[pid].snaps = dict(snaps)
         return m
