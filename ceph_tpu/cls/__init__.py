@@ -31,6 +31,11 @@ Method = Callable[[bytes, "bytes | None"],
 
 _REGISTRY: dict[tuple[str, str], Method] = {}
 
+#: sentinel a method returns as ``new_obj`` to REMOVE the object (the
+#: reference's cls_cxx_remove — e.g. cls_refcount drops the object
+#: when the last reference is put)
+REMOVE = object()
+
 
 class ClsError(Exception):
     def __init__(self, code: int, message: str = "") -> None:
@@ -169,6 +174,21 @@ def _rgw_bucket_rm(inp: bytes, obj: bytes | None):
     return 0, b"", json.dumps(idx).encode()
 
 
+@register("rgw", "mp_add_part")
+def _rgw_mp_add_part(inp: bytes, obj: bytes | None):
+    """Record one multipart part in the upload's meta object —
+    ATOMICALLY under the PG lock, so concurrent part uploads (the
+    normal S3 client pattern) cannot lose each other's entries the
+    way a client-side read-modify-write would."""
+    req = json.loads(inp)
+    if not obj:
+        return -2, b"", None          # NoSuchUpload
+    meta = json.loads(obj)
+    meta["parts"][str(req["part"])] = {"size": req["size"],
+                                       "etag": req["etag"]}
+    return 0, b"", json.dumps(meta).encode()
+
+
 @register("rgw", "bucket_list")
 def _rgw_bucket_list(inp: bytes, obj: bytes | None):
     req = json.loads(inp) if inp else {}
@@ -220,3 +240,9 @@ def _fs_dir_unlink(inp: bytes, obj: bytes | None):
     inode["mtime"] = time.time()
     return 0, json.dumps({"ino": ino}).encode(), \
         json.dumps(inode).encode()
+
+
+# further reference modules (cls_version, cls_refcount, cls_numops,
+# cls_timeindex, cls_statelog, cls_hello) live in classes.py — split
+# so this framework file stays readable
+from ceph_tpu.cls import classes as _classes  # noqa: E402,F401

@@ -100,12 +100,36 @@ def _probe() -> None:
         import snappy
         _registry.register("snappy", snappy.compress, snappy.decompress)
     except ImportError:
-        pass
+        # our NATIVE snappy (ops/native/lzcodecs.cc, from the format
+        # spec — the reference vendors libsnappy the same way)
+        from ceph_tpu.ops import native_loader as _nl
+        if _nl.available():
+            _registry.register("snappy", _nl.snappy_compress,
+                               _nl.snappy_decompress)
     try:
         import lz4.frame as _lz4
         _registry.register("lz4", _lz4.compress, _lz4.decompress)
     except ImportError:
-        pass
+        from ceph_tpu.ops import native_loader as _nl
+        if _nl.available():
+            # LZ4 block + u32 length prefix (the block format carries
+            # no raw length; the reference's compressor framing
+            # records it the same way)
+            def _lz4_c(d: bytes) -> bytes:
+                return len(d).to_bytes(4, "little") + \
+                    _nl.lz4_compress(d)
+
+            def _lz4_d(d: bytes) -> bytes:
+                raw_len = int.from_bytes(d[:4], "little")
+                # the prefix is blob data (possibly corrupt): clamp
+                # against LZ4's max expansion (255x) BEFORE allocating
+                # the output buffer, or a flipped prefix commits GiBs
+                if raw_len > max(len(d) * 255, 1 << 16):
+                    raise CompressionError(
+                        "corrupt lz4 blob: implausible raw length")
+                return _nl.lz4_decompress(d[4:], raw_len)
+
+            _registry.register("lz4", _lz4_c, _lz4_d)
 
 
 _probe()

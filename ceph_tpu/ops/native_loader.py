@@ -32,7 +32,8 @@ def get_lib():
         if _lib is not None or _failed:
             return _lib
         try:
-            srcs = [_DIR / "gf256.cc", _DIR / "io_engine.cc"]
+            srcs = [_DIR / "gf256.cc", _DIR / "io_engine.cc",
+                    _DIR / "lzcodecs.cc"]
             if not _SO.exists() or any(
                     _SO.stat().st_mtime < src.stat().st_mtime
                     for src in srcs if src.exists()):
@@ -77,6 +78,17 @@ def _bind(lib) -> None:
     lib.ioeng_close.argtypes = [ctypes.c_int]
     lib.ceph_xxhash32.restype = ctypes.c_uint32
     lib.ceph_xxhash32.argtypes = [ctypes.c_uint32, u8p, ctypes.c_uint64]
+    for fn in ("lz4_compress", "lz4_decompress", "snappy_compress",
+               "snappy_decompress"):
+        f = getattr(lib, fn)
+        f.restype = ctypes.c_int64
+        f.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    for fn in ("lz4_max_compressed", "snappy_max_compressed"):
+        f = getattr(lib, fn)
+        f.restype = ctypes.c_int64
+        f.argtypes = [ctypes.c_int64]
+    lib.snappy_uncompressed_length.restype = ctypes.c_int64
+    lib.snappy_uncompressed_length.argtypes = [u8p, ctypes.c_int64]
 
 
 def _as_u8p(arr: np.ndarray):
@@ -134,3 +146,54 @@ def xxhash32(data, seed: int = 0) -> int:
     buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
         if not isinstance(data, np.ndarray) else np.ascontiguousarray(data, np.uint8)
     return int(lib.ceph_xxhash32(ctypes.c_uint32(seed), _as_u8p(buf), buf.size))
+
+
+def _lz_roundtrip(name: str, data, op: str) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    buf = np.frombuffer(memoryview(bytes(data)), dtype=np.uint8)
+    if op == "c":
+        cap = int(getattr(lib, f"{name}_max_compressed")(buf.size))
+    elif name == "snappy":
+        cap = int(lib.snappy_uncompressed_length(_as_u8p(buf),
+                                                 buf.size)) \
+            if buf.size else 0
+        if cap < 0:
+            raise ValueError("corrupt snappy header")
+    else:
+        # LZ4 block carries no length header (the reference's
+        # compressor framing records raw length; ours stores it in
+        # the blob extent) — callers prepend it, see compressor layer
+        raise ValueError("lz4 decompress needs an explicit capacity")
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    fn = getattr(lib, f"{name}_{'compress' if op == 'c' else 'decompress'}")
+    got = int(fn(_as_u8p(buf), buf.size, _as_u8p(out), out.size))
+    if got < 0:
+        raise ValueError(f"{name} codec error")
+    return out[:got].tobytes()
+
+
+def snappy_compress(data) -> bytes:
+    return _lz_roundtrip("snappy", data, "c")
+
+
+def snappy_decompress(data) -> bytes:
+    return _lz_roundtrip("snappy", data, "d")
+
+
+def lz4_compress(data) -> bytes:
+    return _lz_roundtrip("lz4", data, "c")
+
+
+def lz4_decompress(data, raw_len: int) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    buf = np.frombuffer(memoryview(bytes(data)), dtype=np.uint8)
+    out = np.empty(max(raw_len, 1), dtype=np.uint8)
+    got = int(lib.lz4_decompress(_as_u8p(buf), buf.size, _as_u8p(out),
+                                 raw_len))
+    if got != raw_len:
+        raise ValueError("lz4 codec error")
+    return out[:got].tobytes()

@@ -15,10 +15,21 @@ journal provides coming from in-OSD object-class methods instead:
 - ``fsdata.<ino>``  — file content through the striper
 
 API mirrors libcephfs: mkdir/rmdir/readdir, open/read/write, unlink,
-rename, stat. Reductions (documented): rename of a file is
-link-then-unlink (a crash between the two can leave both names —
-fsck-able, never data loss); no hard links across dirs; no
-permissions/uids; one flat namespace per pool.
+rename, stat. Reductions (documented): no hard links across dirs; no
+permissions/uids; one flat namespace per pool; single active
+metadata writer (the MDS role — the journal assumes one, like the
+reference's single-active-MDS rank).
+
+Metadata journaling (the osdc/Journaler + MDLog role): every
+MULTI-STEP namespace op (mkdir/create/unlink/rmdir/rename) appends an
+intent record to the ``mdslog`` journal before executing its steps;
+mount replays the un-committed tail, re-executing steps idempotently.
+That closes the crash windows the reference closes with the MDS
+journal — most importantly rename's link-then-unlink window (a crash
+between the two no longer leaves both names) — and is the MDS
+FAILOVER story: the next mount (the standby taking over) recovers the
+half-done op from the journal, exactly as a standby MDS replays the
+failed rank's journal.
 """
 
 from __future__ import annotations
@@ -28,9 +39,13 @@ import json
 import time
 
 from ceph_tpu.client.striper import FileLayout, StripedObject
+from ceph_tpu.services.journal import Journaler, JournalError
 
 ROOT_INO = 1
 SUPER_OID = ".fs_super"
+
+#: the metadata writer's journal-client id (single active MDS rank)
+MDS_CLIENT = "mds"
 
 
 class FSError(Exception):
@@ -42,18 +57,126 @@ class FSError(Exception):
 class CephFS:
     """A mounted filesystem (libcephfs ceph_mount role)."""
 
-    def __init__(self, ioctx,
-                 layout: FileLayout | None = None) -> None:
+    def __init__(self, ioctx, layout: FileLayout | None = None,
+                 journaling: bool = True) -> None:
         self.io = ioctx
         self.layout = layout or FileLayout(stripe_unit=1 << 20,
                                            stripe_count=1,
                                            object_size=1 << 20)
+        self.journal = Journaler(self.io, "mdslog") if journaling \
+            else None
+        import threading
+        self._mds_lock = threading.Lock()
+        self._mds_pos = 0            # next position to commit
+        self._mds_done: set[int] = set()
+        if self.journal is not None:
+            if not self.journal.exists():
+                self.journal.create()
+            self._replay_mds_tail()
         # bootstrap the root directory (idempotent)
         try:
             self._read_inode(ROOT_INO)
         except FSError:
             self._write_inode(ROOT_INO, {
                 "type": "dir", "entries": {}, "mtime": time.time()})
+
+    # -- MDS journal (osdc/Journaler + MDLog roles) -------------------
+    def _replay_mds_tail(self) -> None:
+        """Mount-time recovery (the standby-MDS replay): re-execute
+        journaled intents the previous writer never completed. Steps
+        are idempotent-tolerant, so replaying an op that partially
+        (or fully) applied converges."""
+        try:
+            end = self.journal.end_position()
+        except JournalError:
+            return
+        pos = self.journal.committed(MDS_CLIENT)
+        applied = min(pos, end)
+        try:
+            for epos, payload in self.journal.read_from(applied):
+                self._apply_mds_event(json.loads(payload))
+                applied = epos + 1
+        except JournalError:
+            pass            # commit only the prefix that applied
+        self._mds_pos = applied
+        self.journal.commit(MDS_CLIENT, applied)
+
+    def _mds_event(self, op: str, **args) -> int | None:
+        if self.journal is None:
+            return None
+        return self.journal.append(
+            json.dumps({"op": op, **args}).encode())
+
+    def _mds_committed(self, pos: int | None) -> None:
+        """Mark an op's intent completed — including DELIBERATE
+        failures (EEXIST etc.): only a crash mid-steps may leave an
+        intent for replay. The commit pointer advances over the
+        CONTIGUOUS prefix of completed positions (concurrent dirops
+        finish out of order; a naive equals-check would freeze the
+        pointer forever after the first inversion, and a later mount
+        would replay stale completed intents — unlink/rename replays
+        that name-match objects re-created since: data loss)."""
+        if self.journal is None or pos is None:
+            return
+        with self._mds_lock:
+            self._mds_done.add(pos)
+            advanced = False
+            while self._mds_pos in self._mds_done:
+                self._mds_done.discard(self._mds_pos)
+                self._mds_pos += 1
+                advanced = True
+            if advanced:
+                self.journal.commit(MDS_CLIENT, self._mds_pos)
+                if self._mds_pos % 128 == 0:
+                    # reclaim consumed journal chunks (the reference
+                    # trims MDLog segments the same way); without this
+                    # the journal grows one entry per dirop forever
+                    self.journal.trim()
+
+    @staticmethod
+    def _step(fn) -> None:
+        """Run one replay step, tolerating already-applied state
+        (EEXIST/ENOENT from a step that landed before the crash):
+        tolerance must be PER STEP — an op's later steps are exactly
+        what the replay exists to finish."""
+        try:
+            fn()
+        except Exception:
+            pass
+
+    def _apply_mds_event(self, rec: dict) -> None:
+        op = rec["op"]
+        if op in ("mkdir", "create"):
+            kind = "dir" if op == "mkdir" else "file"
+            inode = {"type": kind, "mtime": time.time()}
+            inode.update({"entries": {}} if kind == "dir"
+                         else {"size": 0})
+
+            def mk():
+                try:
+                    self._read_inode(rec["ino"])
+                except FSError:
+                    self._write_inode(rec["ino"], inode)
+            self._step(mk)
+            self._step(lambda: self._dir_link(rec["parent"],
+                                              rec["name"],
+                                              rec["ino"]))
+        elif op == "unlink":
+            self._step(lambda: self._dir_unlink(rec["parent"],
+                                                rec["name"]))
+            self._step(lambda: StripedObject(
+                self.io, f"fsdata.{rec['ino']}").remove())
+            self._step(lambda: self.io.remove(f"inode.{rec['ino']}"))
+        elif op == "rmdir":
+            self._step(lambda: self._dir_unlink(rec["parent"],
+                                                rec["name"]))
+            self._step(lambda: self.io.remove(f"inode.{rec['ino']}"))
+        elif op == "rename":
+            self._step(lambda: self._dir_link(rec["new_parent"],
+                                              rec["new_name"],
+                                              rec["ino"]))
+            self._step(lambda: self._dir_unlink(rec["old_parent"],
+                                                rec["old_name"]))
 
     # -- inode plumbing ------------------------------------------------
     def _read_inode(self, ino: int) -> dict:
@@ -114,9 +237,14 @@ class CephFS:
     def mkdir(self, path: str) -> None:
         parent, name = self._resolve_parent(path)
         ino = self._alloc_ino()
-        self._write_inode(ino, {"type": "dir", "entries": {},
-                                "mtime": time.time()})
-        self._dir_link(parent, name, ino)
+        pos = self._mds_event("mkdir", parent=parent, name=name,
+                              ino=ino)
+        try:
+            self._write_inode(ino, {"type": "dir", "entries": {},
+                                    "mtime": time.time()})
+            self._dir_link(parent, name, ino)
+        finally:
+            self._mds_committed(pos)
 
     def readdir(self, path: str) -> list[str]:
         _, inode = self._resolve(path)
@@ -141,15 +269,25 @@ class CephFS:
         if inode["entries"]:
             raise FSError(errno.ENOTEMPTY, path)
         parent, name = self._resolve_parent(path)
-        self._dir_unlink(parent, name)
-        self.io.remove(f"inode.{ino}")
+        pos = self._mds_event("rmdir", parent=parent, name=name,
+                              ino=ino)
+        try:
+            self._dir_unlink(parent, name)
+            self.io.remove(f"inode.{ino}")
+        finally:
+            self._mds_committed(pos)
 
     def create(self, path: str) -> "File":
         parent, name = self._resolve_parent(path)
         ino = self._alloc_ino()
-        self._write_inode(ino, {"type": "file", "size": 0,
-                                "mtime": time.time()})
-        self._dir_link(parent, name, ino)
+        pos = self._mds_event("create", parent=parent, name=name,
+                              ino=ino)
+        try:
+            self._write_inode(ino, {"type": "file", "size": 0,
+                                    "mtime": time.time()})
+            self._dir_link(parent, name, ino)
+        finally:
+            self._mds_committed(pos)
         return File(self, ino)
 
     def open(self, path: str, create: bool = False) -> "File":
@@ -168,19 +306,32 @@ class CephFS:
         if inode["type"] == "dir":
             raise FSError(errno.EISDIR, path)
         parent, name = self._resolve_parent(path)
-        self._dir_unlink(parent, name)
-        StripedObject(self.io, f"fsdata.{ino}").remove()
-        self.io.remove(f"inode.{ino}")
+        pos = self._mds_event("unlink", parent=parent, name=name,
+                              ino=ino)
+        try:
+            self._dir_unlink(parent, name)
+            StripedObject(self.io, f"fsdata.{ino}").remove()
+            self.io.remove(f"inode.{ino}")
+        finally:
+            self._mds_committed(pos)
 
     def rename(self, old: str, new: str) -> None:
-        """Link under the new name, then unlink the old (the reference
-        does this atomically in the MDS journal; here a crash between
-        the steps leaves both names pointing at the same inode)."""
+        """Link under the new name, then unlink the old. The journaled
+        intent makes the pair crash-atomic: a mount after a crash
+        between the steps replays the intent and finishes the unlink
+        (the MDS journal's dirop atomicity, MDLog/EUpdate role)."""
         ino, _ = self._resolve(old)
         new_parent, new_name = self._resolve_parent(new)
         old_parent, old_name = self._resolve_parent(old)
-        self._dir_link(new_parent, new_name, ino)
-        self._dir_unlink(old_parent, old_name)
+        pos = self._mds_event(
+            "rename", ino=ino, new_parent=new_parent,
+            new_name=new_name, old_parent=old_parent,
+            old_name=old_name)
+        try:
+            self._dir_link(new_parent, new_name, ino)
+            self._dir_unlink(old_parent, old_name)
+        finally:
+            self._mds_committed(pos)
 
 
 class File:

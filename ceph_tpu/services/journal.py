@@ -49,6 +49,8 @@ class Journaler:
         # image mutation)
         self._registered: set[str] = set()
         self._commit_cache: dict[str, int] = {}
+        import threading
+        self._append_lock = threading.Lock()
 
     # -- header --------------------------------------------------------
     def _load(self) -> dict:
@@ -141,16 +143,23 @@ class Journaler:
     def append(self, payload: bytes) -> int:
         """Append one entry; returns its position. The entry is durable
         (RADOS-committed) before the header advances, so a reader never
-        sees a position without its entry."""
-        h = self._load()
-        pos = h["entries"]
-        e = Encoder()
-        e.u64(pos)
-        e.bytes(payload)
-        self.io.append(self._chunk_oid(pos // SPLAY), e.getvalue())
-        h["entries"] = pos + 1
-        self._save(h)
-        return pos
+        sees a position without its entry.
+
+        Serialized per INSTANCE (the header advance is a read-modify-
+        write; concurrent in-process writers — cephfs dirops run from
+        many threads — would assign the same position and lose
+        entries). Cross-process single-writer stays the documented
+        contract (the reference's exclusive lock)."""
+        with self._append_lock:
+            h = self._load()
+            pos = h["entries"]
+            e = Encoder()
+            e.u64(pos)
+            e.bytes(payload)
+            self.io.append(self._chunk_oid(pos // SPLAY), e.getvalue())
+            h["entries"] = pos + 1
+            self._save(h)
+            return pos
 
     def end_position(self) -> int:
         return self._load()["entries"]

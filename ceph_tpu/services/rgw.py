@@ -129,6 +129,109 @@ class RGWGateway:
                         "marker": marker}).encode())
         return json.loads(out or b"{}")
 
+    # -- multipart uploads (src/rgw/rgw_multi.cc roles) ----------------
+    # Parts land as independent striped objects under a hidden
+    # .multipart prefix; complete stitches them into the final object
+    # and computes the S3 multipart etag (md5-of-binary-md5s "-N").
+
+    def _mp_oid(self, bucket: str, key: str, upload_id: str,
+                part: int | None = None) -> str:
+        base = f".multipart.{bucket}/{key}/{upload_id}"
+        return base if part is None else f"{base}.{part:05d}"
+
+    def _mp_meta(self, bucket: str, key: str, upload_id: str) -> dict:
+        try:
+            return json.loads(self.io.read(
+                self._mp_oid(bucket, key, upload_id)))
+        except Exception:
+            raise RGWError(404, "NoSuchUpload") from None
+
+    def initiate_multipart(self, bucket: str, key: str) -> str:
+        self._check_bucket(bucket)
+        import secrets
+        upload_id = secrets.token_hex(16)
+        self.io.write_full(self._mp_oid(bucket, key, upload_id),
+                           json.dumps({"key": key,
+                                       "parts": {}}).encode())
+        return upload_id
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        if not 1 <= part_number <= 10000:
+            raise RGWError(400, "InvalidArgument")
+        self._mp_meta(bucket, key, upload_id)   # NoSuchUpload check
+        poid = self._mp_oid(bucket, key, upload_id, part_number)
+        StripedObject(self.io, poid).remove()
+        so = StripedObject(self.io, poid, self._layout)
+        if data:
+            so.write(data)
+        etag = hashlib.md5(data).hexdigest()
+        # record the part via the ATOMIC in-OSD method: concurrent
+        # part uploads must not lose each other (a client-side RMW of
+        # the shared meta would — the reference uses cls_rgw omap ops
+        # for exactly this)
+        from ceph_tpu.client.rados import RadosError
+        try:
+            self.io.execute(
+                self._mp_oid(bucket, key, upload_id), "rgw",
+                "mp_add_part",
+                json.dumps({"part": part_number, "size": len(data),
+                            "etag": etag}).encode())
+        except RadosError as exc:
+            if exc.code == -2:
+                raise RGWError(404, "NoSuchUpload") from None
+            raise
+        return etag
+
+    def list_parts(self, bucket: str, key: str,
+                   upload_id: str) -> dict:
+        return self._mp_meta(bucket, key, upload_id)["parts"]
+
+    def complete_multipart(self, bucket: str, key: str, upload_id: str,
+                           parts: list[tuple[int, str]]) -> str:
+        """``parts``: the client's (part_number, etag) manifest — must
+        match what was uploaded, ascending (S3 CompleteMultipart)."""
+        meta = self._mp_meta(bucket, key, upload_id)
+        have = meta["parts"]
+        nums = [p for p, _ in parts]
+        if not parts or any(b <= a for a, b in zip(nums, nums[1:])):
+            # strictly ascending, unique (S3 InvalidPartOrder —
+            # duplicates would stitch the same bytes twice)
+            raise RGWError(400, "InvalidPartOrder")
+        digests = b""
+        for num, etag in parts:
+            ent = have.get(str(num))
+            if ent is None or ent["etag"].strip('"') != etag.strip('"'):
+                raise RGWError(400, "InvalidPart")
+            digests += bytes.fromhex(ent["etag"])
+        # stitch: read parts in order, write the final object through
+        # the normal put path (bucket index updates atomically)
+        body = b"".join(
+            StripedObject(self.io,
+                          self._mp_oid(bucket, key, upload_id,
+                                       num)).read()
+            for num, _ in parts)
+        self.put_object(bucket, key, body)
+        final_etag = (hashlib.md5(digests).hexdigest()
+                      + f"-{len(parts)}")
+        # the S3 multipart etag replaces the plain-md5 one
+        self.io.execute(f".bucket.{bucket}", "rgw", "bucket_add",
+                        json.dumps({"key": key, "size": len(body),
+                                    "etag": final_etag}).encode())
+        self.abort_multipart(bucket, key, upload_id)
+        return final_etag
+
+    def abort_multipart(self, bucket: str, key: str,
+                        upload_id: str) -> None:
+        meta = self._mp_meta(bucket, key, upload_id)
+        for num in meta["parts"]:
+            StripedObject(self.io, self._mp_oid(bucket, key, upload_id,
+                                                int(num))).remove()
+        try:
+            self.io.remove(self._mp_oid(bucket, key, upload_id))
+        except Exception:
+            pass
+
 
 def _xml_escape(v: str) -> str:
     return (v.replace("&", "&amp;").replace("<", "&lt;")
@@ -162,6 +265,52 @@ def _xml_listing(bucket: str, prefix: str, max_keys: int,
             f"<MaxKeys>{max_keys}</MaxKeys>"
             f"<IsTruncated>{flag}</IsTruncated>{next_marker}{items}"
             f"</ListBucketResult>").encode()
+
+
+def _xml_initiate(bucket: str, key: str, upload_id: str) -> bytes:
+    return (f"<InitiateMultipartUploadResult>"
+            f"<Bucket>{_xml_escape(bucket)}</Bucket>"
+            f"<Key>{_xml_escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId>"
+            f"</InitiateMultipartUploadResult>").encode()
+
+
+def _xml_complete(bucket: str, key: str, etag: str) -> bytes:
+    return (f"<CompleteMultipartUploadResult>"
+            f"<Bucket>{_xml_escape(bucket)}</Bucket>"
+            f"<Key>{_xml_escape(key)}</Key>"
+            f'<ETag>"{etag}"</ETag>'
+            f"</CompleteMultipartUploadResult>").encode()
+
+
+def _xml_parts(bucket: str, key: str, upload_id: str,
+               parts: dict) -> bytes:
+    rows = "".join(
+        f"<Part><PartNumber>{n}</PartNumber>"
+        f'<ETag>"{p["etag"]}"</ETag><Size>{p["size"]}</Size></Part>'
+        for n, p in sorted(parts.items(), key=lambda kv: int(kv[0])))
+    return (f"<ListPartsResult><Bucket>{_xml_escape(bucket)}</Bucket>"
+            f"<Key>{_xml_escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId>{rows}"
+            f"</ListPartsResult>").encode()
+
+
+def _parse_complete_xml(body: bytes) -> list[tuple[int, str]]:
+    """Parse the CompleteMultipartUpload manifest (PartNumber/ETag
+    pairs, document order) — real XML parsing so every quoting/escape
+    style (&quot;, ", bare) resolves uniformly."""
+    import xml.etree.ElementTree as ET
+    try:
+        root = ET.fromstring(body.decode())
+    except Exception:
+        return []
+    parts = []
+    for p in root.iter("Part"):
+        num = p.findtext("PartNumber")
+        etag = (p.findtext("ETag") or "").strip().strip('"')
+        if num:
+            parts.append((int(num), etag))
+    return parts
 
 
 def _xml_error(code: str, message: str) -> bytes:
@@ -294,7 +443,9 @@ class _Handler(BaseHTTPRequestHandler):
         parts = parsed.path.lstrip("/").split("/", 1)
         bucket = urllib.parse.unquote(parts[0])
         key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
-        q = dict(urllib.parse.parse_qsl(parsed.query))
+        # keep_blank_values: S3 sub-resources are bare keys (?uploads)
+        q = dict(urllib.parse.parse_qsl(parsed.query,
+                                        keep_blank_values=True))
         return bucket, key, q
 
     def _reply(self, status: int, body: bytes = b"",
@@ -329,6 +480,10 @@ class _Handler(BaseHTTPRequestHandler):
         def run() -> None:
             if not bucket:
                 self._reply(200, _xml_buckets(self.gw.list_buckets()))
+            elif key and "uploadId" in q:
+                parts = self.gw.list_parts(bucket, key, q["uploadId"])
+                self._reply(200, _xml_parts(bucket, key,
+                                            q["uploadId"], parts))
             elif not key:
                 prefix = q.get("prefix", "")
                 marker = q.get("marker", "")
@@ -369,7 +524,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._run(run)
 
     def do_PUT(self) -> None:  # noqa: N802
-        bucket, key, _ = self._split()
+        bucket, key, q = self._split()
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n) if n else b""
 
@@ -377,6 +532,13 @@ class _Handler(BaseHTTPRequestHandler):
             if not key:
                 self.gw.create_bucket(bucket)
                 self._reply(200)
+            elif "uploadId" in q and "partNumber" in q:
+                etag = self.gw.upload_part(bucket, key, q["uploadId"],
+                                           int(q["partNumber"]), body)
+                self.send_response(200)
+                self.send_header("ETag", f'"{etag}"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
             else:
                 etag = self.gw.put_object(bucket, key, body)
                 self.send_response(200)
@@ -385,11 +547,31 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
         self._run(run, payload=body)
 
-    def do_DELETE(self) -> None:  # noqa: N802
-        bucket, key, _ = self._split()
+    def do_POST(self) -> None:  # noqa: N802
+        bucket, key, q = self._split()
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n) if n else b""
 
         def run() -> None:
-            if not key:
+            if "uploads" in q and key:
+                upload_id = self.gw.initiate_multipart(bucket, key)
+                self._reply(200, _xml_initiate(bucket, key, upload_id))
+            elif "uploadId" in q and key:
+                parts = _parse_complete_xml(body)
+                etag = self.gw.complete_multipart(
+                    bucket, key, q["uploadId"], parts)
+                self._reply(200, _xml_complete(bucket, key, etag))
+            else:
+                raise RGWError(400, "InvalidRequest")
+        self._run(run, payload=body)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        bucket, key, q = self._split()
+
+        def run() -> None:
+            if key and "uploadId" in q:
+                self.gw.abort_multipart(bucket, key, q["uploadId"])
+            elif not key:
                 self.gw.delete_bucket(bucket)
             else:
                 self.gw.delete_object(bucket, key)

@@ -41,7 +41,8 @@ from ceph_tpu.utils.encoding import Decoder, Encoder
 #: on-disk compressor ids (bluestore_compression_algorithm role); the
 #: id is stored per blob so config changes never orphan old blobs
 COMP_NONE = 0
-_COMP_ALGS = {1: "zlib", 2: "zstd", 3: "bz2", 4: "lzma"}
+_COMP_ALGS = {1: "zlib", 2: "zstd", 3: "bz2", 4: "lzma", 5: "lz4",
+              6: "snappy"}
 _COMP_IDS = {v: k for k, v in _COMP_ALGS.items()}
 
 #: blob checksum algorithms (Checksummer.h:11-19 role); id rides the

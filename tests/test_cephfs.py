@@ -107,3 +107,46 @@ def test_concurrent_dirops_atomic(fs):
         list(pool.map(worker, range(24)))
     assert fs.readdir("/race") == sorted(
         (f"f{i}" for i in range(24)))
+
+def test_mds_journal_replays_half_done_rename(cluster):
+    """MDS failover story (osdc/Journaler + MDLog roles): a crash
+    between rename's link and unlink steps leaves both names; the
+    next mount (the standby taking over) replays the journal intent
+    and finishes the op — exactly one name survives."""
+    from ceph_tpu.services.cephfs import CephFS, MDS_CLIENT
+    io = cluster._clients[0].open_ioctx("fspool")
+    fs = CephFS(io)
+    f = fs.open("/crashy", create=True)
+    f.write(b"payload")
+    # simulate the crash: journal the intent, apply only the LINK
+    ino, _ = fs._resolve("/crashy")
+    fs._mds_event("rename", ino=ino, new_parent=1, new_name="moved",
+                  old_parent=1, old_name="crashy")
+    fs._dir_link(1, "moved", ino)
+    # both names visible — the torn state
+    assert {"crashy", "moved"} <= set(fs.readdir("/"))
+    fs2 = CephFS(io)          # failover mount: replays the tail
+    names = set(fs2.readdir("/"))
+    assert "moved" in names and "crashy" not in names
+    assert fs2.open("/moved").read() == b"payload"
+    assert fs2.journal.committed(MDS_CLIENT) == \
+        fs2.journal.end_position()
+    fs2.unlink("/moved")
+
+
+def test_mds_journal_replays_half_done_unlink(cluster):
+    from ceph_tpu.services.cephfs import CephFS
+    io = cluster._clients[0].open_ioctx("fspool")
+    fs = CephFS(io)
+    f = fs.open("/doomed2", create=True)
+    f.write(b"bye")
+    ino, _ = fs._resolve("/doomed2")
+    # crash after the dir unlink, before the inode/data removal
+    fs._mds_event("unlink", parent=1, name="doomed2", ino=ino)
+    fs._dir_unlink(1, "doomed2")
+    fs2 = CephFS(io)
+    assert "doomed2" not in fs2.readdir("/")
+    import pytest
+    from ceph_tpu.client.rados import RadosError
+    with pytest.raises(RadosError):
+        io.read(f"inode.{ino}")      # replay removed the orphan

@@ -81,3 +81,81 @@ def test_exec_log_end_to_end(io):
     # the cls state object replicates like any object: it survives on
     # every replica through the normal write path
     assert io.stat("events") > 0
+
+
+def test_version_refcount_numops_pure():
+    from ceph_tpu import cls as C
+    import json
+    # version: set/inc/read/check
+    code, _, obj = C.call("version", "set", b'{"ver": 5, "tag": "t"}',
+                          None)
+    assert code == 0
+    code, _, obj = C.call("version", "inc", b"", obj)
+    code, out, _ = C.call("version", "read", b"", obj)
+    assert json.loads(out) == {"ver": 6, "tag": "t"}
+    assert C.call("version", "check", b'{"ver": 6, "op": "eq"}',
+                  obj)[0] == 0
+    assert C.call("version", "check", b'{"ver": 7, "op": "ge"}',
+                  obj)[0] == -125
+    # refcount: last put removes the object
+    code, _, obj = C.call("refcount", "get", b'{"tag": "a"}', None)
+    code, _, obj = C.call("refcount", "get", b'{"tag": "b"}', obj)
+    code, out, _ = C.call("refcount", "read", b"", obj)
+    assert json.loads(out) == ["a", "b"]
+    code, _, obj = C.call("refcount", "put", b'{"tag": "a"}', obj)
+    assert obj is not C.REMOVE
+    code, _, obj = C.call("refcount", "put", b'{"tag": "b"}', obj)
+    assert obj is C.REMOVE
+    # numops
+    code, out, obj = C.call("numops", "add",
+                            b'{"key": "x", "value": 2.5}', None)
+    code, out, obj = C.call("numops", "mul",
+                            b'{"key": "x", "value": 4}', obj)
+    assert json.loads(out) == {"x": 10.0}
+
+
+def test_timeindex_statelog_pure():
+    from ceph_tpu import cls as C
+    import json
+    obj = None
+    for ts, key in ((10.0, "a"), (20.0, "b"), (30.0, "c")):
+        code, _, obj = C.call(
+            "timeindex", "add",
+            json.dumps({"ts": ts, "key": key}).encode(), obj)
+        assert code == 0
+    code, out, _ = C.call("timeindex", "list",
+                          b'{"from": 15, "to": 35}', obj)
+    assert [e["key"] for e in json.loads(out)] == ["b", "c"]
+    code, _, obj = C.call("timeindex", "trim", b'{"to": 25}', obj)
+    code, out, _ = C.call("timeindex", "list", b"", obj)
+    assert [e["key"] for e in json.loads(out)] == ["c"]
+    # statelog
+    code, _, obj = C.call(
+        "statelog", "add",
+        b'{"client": "c1", "op_id": 1, "state": "started"}', None)
+    code, out, _ = C.call("statelog", "list", b'{"client": "c1"}', obj)
+    assert json.loads(out)["c1/1"]["state"] == "started"
+    code, _, obj = C.call("statelog", "remove",
+                          b'{"client": "c1", "op_id": 1}', obj)
+    code, out, _ = C.call("statelog", "list", b"", obj)
+    assert json.loads(out) == {}
+
+
+def test_refcount_removal_end_to_end(io):
+    """refcount.put on the last tag REMOVES the object through the
+    OSD's versioned remove path (cls_cxx_remove seam)."""
+    import pytest
+    from ceph_tpu.client.rados import RadosError
+    io.execute("rc_obj", "refcount", "get", b'{"tag": "one"}')
+    assert io.read("rc_obj")          # object exists (json state)
+    io.execute("rc_obj", "refcount", "put", b'{"tag": "one"}')
+    with pytest.raises(RadosError):
+        io.read("rc_obj")
+
+
+def test_hello_end_to_end(io):
+    assert io.execute("greet", "hello", "say_hello", b"ceph") == \
+        b"Hello, ceph!"
+    io.execute("greet", "hello", "record_hello", b"tpu")
+    assert io.execute("greet", "hello", "replay", b"") == \
+        b"Hello, tpu!"
