@@ -107,11 +107,6 @@ def _refcount_set(inp: bytes, obj: bytes | None):
 # -- cls_numops (src/cls/numops/cls_numops.cc): server-side numeric
 # read-modify-write ----------------------------------------------------
 
-def _numop(obj, fn):
-    st = _state(obj, {})
-    return st, fn
-
-
 @register("numops", "add")
 def _numops_add(inp: bytes, obj: bytes | None):
     req = json.loads(inp)

@@ -1,0 +1,21 @@
+"""crimson-lite — single-reactor OSD prototype (src/crimson/ role).
+
+The reference's crimson is an early-stage seastar rewrite of the OSD:
+a shared-nothing, futures-based reactor replacing the thread-pool
+daemon (src/crimson/: SocketMessenger, mon client, config — 3,309 LoC
+skeleton, no peering/recovery yet). The analog here keeps the same
+scope and the same architectural bet, in asyncio:
+
+- ONE event loop runs everything — boot, heartbeats, map handling and
+  the op path are coroutines on the messenger's reactor; there is no
+  sharded thread pool, no pg.lock (per-object ordering falls out of
+  cooperative scheduling + per-object asyncio locks).
+- The wire protocol is the mainline one (typed messages over the
+  framed messenger), exactly as crimson speaks ceph's msgr protocol —
+  a stock client cannot tell which flavor of OSD answered it.
+- Scope matches the reference prototype: boot + maps + beacons + a
+  flat object service. No peering, no recovery, no EC — those live in
+  the mainline OSD (osd/osd.py), as in the reference.
+"""
+
+from ceph_tpu.crimson.osd import CrimsonOSD  # noqa: F401

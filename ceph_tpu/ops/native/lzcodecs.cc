@@ -241,6 +241,8 @@ int64_t snappy_decompress(const uint8_t *src, int64_t n, uint8_t *dst,
   int shift = 0;
   int64_t ip = 0;
   while (ip < n) {
+    if (shift > 63) return -1;  // >10-byte varint: corrupt (a shift
+                                // past 63 would be UB)
     uint8_t b = src[ip++];
     want |= (uint64_t)(b & 0x7f) << shift;
     if (!(b & 0x80)) break;

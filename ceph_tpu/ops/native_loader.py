@@ -159,7 +159,10 @@ def _lz_roundtrip(name: str, data, op: str) -> bytes:
         cap = int(lib.snappy_uncompressed_length(_as_u8p(buf),
                                                  buf.size)) \
             if buf.size else 0
-        if cap < 0:
+        # the header varint is untrusted blob bytes: clamp against
+        # snappy's max expansion (<64x) BEFORE allocating, or a
+        # corrupt prefix commits terabytes
+        if cap < 0 or cap > max(buf.size * 64, 1 << 16):
             raise ValueError("corrupt snappy header")
     else:
         # LZ4 block carries no length header (the reference's

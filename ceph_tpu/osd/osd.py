@@ -1121,7 +1121,8 @@ class OSD:
         # in-memory only, so an rmsnap committed while this primary
         # was down would otherwise leak its clones forever): any pool
         # that ever had snaps gets a scan after peering
-        pool = osdmap.pools.get(pg.pool)
+        osdmap = self.get_osdmap()
+        pool = osdmap.pools.get(pg.pool) if osdmap else None
         if pool is not None and pool.snap_seq and \
                 pg.acting and pg.acting[0] == self.whoami:
             self.op_wq.enqueue(pg.pgid,

@@ -120,14 +120,15 @@ class CephFS:
             return
         with self._mds_lock:
             self._mds_done.add(pos)
-            advanced = False
+            old_pos = self._mds_pos
             while self._mds_pos in self._mds_done:
                 self._mds_done.discard(self._mds_pos)
                 self._mds_pos += 1
-                advanced = True
-            if advanced:
+            if self._mds_pos != old_pos:
                 self.journal.commit(MDS_CLIENT, self._mds_pos)
-                if self._mds_pos % 128 == 0:
+                # boundary-crossing check: out-of-order completion can
+                # advance PAST a multiple of 128 in one step
+                if old_pos // 128 != self._mds_pos // 128:
                     # reclaim consumed journal chunks (the reference
                     # trims MDLog segments the same way); without this
                     # the journal grows one entry per dirop forever

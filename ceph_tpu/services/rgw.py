@@ -305,11 +305,24 @@ def _parse_complete_xml(body: bytes) -> list[tuple[int, str]]:
     except Exception:
         return []
     parts = []
-    for p in root.iter("Part"):
-        num = p.findtext("PartNumber")
-        etag = (p.findtext("ETag") or "").strip().strip('"')
+    # namespace-blind matching: real S3 clients (boto3) stamp the
+    # document with xmlns="http://s3.amazonaws.com/doc/2006-03-01/",
+    # which ElementTree folds into every tag name
+    for p in root.iter():
+        if p.tag.rsplit("}", 1)[-1] != "Part":
+            continue
+        num = etag = None
+        for child in p:
+            tag = child.tag.rsplit("}", 1)[-1]
+            if tag == "PartNumber":
+                num = child.text
+            elif tag == "ETag":
+                etag = (child.text or "").strip().strip('"')
         if num:
-            parts.append((int(num), etag))
+            try:
+                parts.append((int(num), etag or ""))
+            except ValueError:
+                return []
     return parts
 
 
@@ -488,7 +501,10 @@ class _Handler(BaseHTTPRequestHandler):
                 prefix = q.get("prefix", "")
                 marker = q.get("marker", "")
                 try:
-                    max_keys = int(q.get("max-keys", 1000))
+                    raw = q.get("max-keys")
+                    # blank value (= absent pre-keep_blank_values
+                    # behavior) falls back to the S3 default
+                    max_keys = int(raw) if raw else 1000
                     if max_keys < 0:
                         raise ValueError
                 except ValueError:
@@ -533,8 +549,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self.gw.create_bucket(bucket)
                 self._reply(200)
             elif "uploadId" in q and "partNumber" in q:
+                try:
+                    part_no = int(q["partNumber"])
+                except ValueError:
+                    raise RGWError(400, "InvalidArgument") from None
                 etag = self.gw.upload_part(bucket, key, q["uploadId"],
-                                           int(q["partNumber"]), body)
+                                           part_no, body)
                 self.send_response(200)
                 self.send_header("ETag", f'"{etag}"')
                 self.send_header("Content-Length", "0")

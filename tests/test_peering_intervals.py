@@ -1,0 +1,169 @@
+"""Interval-change invariants (doc/osd_peering.md; the reference's
+peering-statechart correctness story, pg.rst): stale-interval
+bookkeeping must be fenced, pushes must never regress versions, and
+writes complete on survivors with dropped shards recorded missing."""
+
+import time
+
+import numpy as np
+
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.utils.config import g_conf
+
+
+class _CaptureConn:
+    def __init__(self):
+        self.sent = []
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+
+def test_stale_push_version_refused_and_equal_applies():
+    """I3: a push with an older version is refused (committed=False)
+    and the stored object is untouched; an equal-version push applies
+    (the scrub-repair path)."""
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_pool("iv", pg_num=1, size=2)
+        io = rados.open_ioctx("iv")
+        io.write_full("obj", b"current")
+        io.write_full("obj", b"newer")        # version 2
+        # find the PG's primary OSD and its collection
+        osdmap = cluster.mon.osdmap
+        _, acting, primary = osdmap.pg_to_up_acting(
+            io.pool_id, 0)
+        posd = cluster.osds[primary]
+        pg = next(p for p in posd.pgs.values()
+                  if p.pool == io.pool_id)
+        from ceph_tpu.osd.pg import NO_SHARD, pg_cid
+        cid = pg_cid(pg.pool, pg.ps, NO_SHARD)
+        stored_v = int.from_bytes(
+            posd.store.getattr(cid, "obj", "v"), "little")
+        conn = _CaptureConn()
+        # stale push (version - 1): must refuse and not clobber
+        posd._handle_pg_push(M.MPGPush(
+            pool=pg.pool, ps=pg.ps, shard=NO_SHARD, oid="obj",
+            version=stored_v - 1, data=b"STALE", attrs={},
+            remove=False, tid=1), conn)
+        assert conn.sent and conn.sent[-1].committed is False
+        assert posd.store.read(cid, "obj") == b"newer"
+        # equal-version push applies (scrub repair semantics)
+        posd._handle_pg_push(M.MPGPush(
+            pool=pg.pool, ps=pg.ps, shard=NO_SHARD, oid="obj",
+            version=stored_v, data=b"fixed", attrs={},
+            remove=False, tid=2), conn)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                posd.store.read(cid, "obj") != b"fixed":
+            time.sleep(0.05)
+        assert posd.store.read(cid, "obj") == b"fixed"
+
+
+def test_superseded_recovery_round_refuses_log_sync():
+    """I2: log-sync from a recovery round whose interval was
+    superseded (pg.epoch advanced) must refuse — the position may
+    name a different OSD in the new interval."""
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("iv2", k=2, m=1, pg_num=1)
+        io = rados.open_ioctx("iv2")
+        io.write_full("o1", b"x" * 10000)
+        osdmap = cluster.mon.osdmap
+        _, acting, primary = osdmap.pg_to_up_acting(io.pool_id, 0)
+        posd = cluster.osds[primary]
+        pg = next(p for p in posd.pgs.values()
+                  if p.pool == io.pool_id)
+        with pg.lock:
+            stale_epoch = pg.epoch
+            pg.epoch += 7              # simulate a new interval
+        from ceph_tpu.osd.pg import pg_cid
+        cid = pg_cid(pg.pool, pg.ps, 1)
+
+        def pgmeta():
+            try:
+                if "pgmeta" in posd.store.list_objects(cid):
+                    return posd.store.omap_get(cid, "pgmeta")
+            except Exception:
+                pass
+            return {}
+
+        before = pgmeta()
+        posd._log_sync_shard(pg, 1, ["o1"], list(pg.acting),
+                             stale_epoch)
+        time.sleep(0.3)
+        after = pgmeta()
+        assert before == after, "superseded round advanced pgmeta"
+        with pg.lock:
+            pg.epoch = stale_epoch     # restore for teardown
+
+
+def test_write_completes_on_survivors_dead_shard_missing():
+    """I4: a write racing an OSD death completes on the surviving
+    shards once the map change drops the dead one, the dropped shard
+    is recorded missing, and recovery repairs it on revive."""
+    conf = g_conf()
+    old = {k: conf[k] for k in ("osd_heartbeat_interval",
+                                "osd_heartbeat_grace")}
+    conf.set("osd_heartbeat_interval", 0.25)
+    conf.set("osd_heartbeat_grace", 1.5)
+    try:
+        with MiniCluster(n_osds=3) as cluster:
+            rados = cluster.client()
+            cluster.create_ec_pool("iv3", k=2, m=1, pg_num=2)
+            io = rados.open_ioctx("iv3")
+            io.write_full("pre", b"seed" * 1000)
+            # kill an OSD and write IMMEDIATELY (before the mon marks
+            # it down): sub-ops to the dead shard are lost; the write
+            # must complete on survivors after the map change
+            cluster.kill_osd(2)
+            for i in range(4):
+                io.write_full(f"racing{i}", b"r" * 20000)
+            for i in range(4):
+                assert io.read(f"racing{i}") == b"r" * 20000
+            cluster.wait_for_osd_down(2, timeout=30)
+            cluster.revive_osd(2)
+            cluster.wait_for_clean(timeout=60)
+            # every shard of every object repaired: scrub says clean
+            for ps in range(2):
+                pool_id = io.pool_id
+                osdmap = cluster.mon.osdmap
+                _, acting, primary = osdmap.pg_to_up_acting(pool_id,
+                                                            ps)
+                res = cluster.osds[primary].scrub_pg((pool_id, ps),
+                                                     repair=False)
+                assert not res.get("inconsistent"), res
+    finally:
+        for k, v in old.items():
+            conf.set(k, v)
+
+
+def test_indep_positions_stable_across_failure():
+    """I-placement: EC (indep) acting positions keep their meaning
+    across a failure — surviving positions never move (the CRUSH
+    crush_choose_indep contract surfaced at the PG level)."""
+    conf = g_conf()
+    old = {k: conf[k] for k in ("osd_heartbeat_interval",
+                                "osd_heartbeat_grace")}
+    conf.set("osd_heartbeat_interval", 0.25)
+    conf.set("osd_heartbeat_grace", 1.5)
+    try:
+        with MiniCluster(n_osds=4) as cluster:
+            cluster.create_ec_pool("iv4", k=2, m=1, pg_num=8)
+            osdmap = cluster.mon.osdmap
+            pool_id = osdmap.pool_by_name["iv4"]
+            before = {ps: osdmap.pg_to_up_acting(pool_id, ps)[1]
+                      for ps in range(8)}
+            cluster.kill_osd(3)
+            cluster.wait_for_osd_down(3, timeout=30)
+            osdmap2 = cluster.mon.osdmap
+            for ps in range(8):
+                b = before[ps]
+                a = osdmap2.pg_to_up_acting(pool_id, ps)[1]
+                for slot, (x, y) in enumerate(zip(b, a)):
+                    if x != 3:
+                        assert x == y, (ps, slot, b, a)
+    finally:
+        for k, v in old.items():
+            conf.set(k, v)
