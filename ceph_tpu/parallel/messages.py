@@ -231,6 +231,15 @@ class MPaxosPull(Message):
     FIELDS = [("rank", "i32"), ("from_version", "u64")]
 
 
+class MConfig(Message):
+    """Mon -> subscribed daemons: the full centralized config map
+    (src/mon/ConfigMonitor.cc MConfig role). Daemons REPLACE their
+    'mon' config source layer with it — removals propagate as absent
+    keys."""
+    MSG_TYPE = 49
+    FIELDS = [("config", "str_map")]
+
+
 class MPaxosCollect(Message):
     """New leader -> peers: phase-1 prepare (Paxos::collect,
     src/mon/Paxos.cc). ``pn`` is the proposal number the leader will

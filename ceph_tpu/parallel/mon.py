@@ -63,7 +63,7 @@ log = Dout("mon")
 _READONLY_COMMANDS = frozenset({
     "osd erasure-code-profile ls", "osd erasure-code-profile get",
     "osd pool ls", "osd pool lssnap", "osd tree", "osd dump",
-    "status", "health",
+    "status", "health", "config dump",
 })
 
 
@@ -129,6 +129,11 @@ class Monitor:
         # re-running the mutation (the reference's session dedup,
         # made durable)
         self._cmd_replies: dict[str, list] = {}
+        # centralized config (ConfigMonitor role, src/mon/
+        # ConfigMonitor.cc): replicated name->value map pushed to
+        # subscribed daemons as MConfig on every commit; daemons apply
+        # it into their 'mon' config source layer
+        self._central_config: dict[str, str] = {}
         # in-memory dedup for commands still awaiting their proposal
         # (holds the waiting connections) + completed-reply LRU
         from ceph_tpu.utils.lru import BoundedLRU
@@ -430,10 +435,12 @@ class Monitor:
             return
         entries = self._mut_queue
         self._mut_queue = []
-        committed = (self.osdmap, self.ec_profiles, self._cmd_replies)
+        committed = (self.osdmap, self.ec_profiles,
+                     self._cmd_replies, self._central_config)
         self.osdmap = OSDMap.decode(self.osdmap.encode())
         self.ec_profiles = json.loads(json.dumps(self.ec_profiles))
         self._cmd_replies = dict(self._cmd_replies)
+        self._central_config = dict(self._central_config)
         batch_dirty = False
         for ent in entries:
             self._dirty = False     # per-mutation marker (dedup needs
@@ -442,8 +449,10 @@ class Monitor:
             except Exception as exc:
                 log(0, f"mon.{self.name}: mutation failed: {exc!r}")
             batch_dirty |= self._dirty
-        scratch = (self.osdmap, self.ec_profiles, self._cmd_replies)
-        self.osdmap, self.ec_profiles, self._cmd_replies = committed
+        scratch = (self.osdmap, self.ec_profiles, self._cmd_replies,
+                   self._central_config)
+        (self.osdmap, self.ec_profiles, self._cmd_replies,
+         self._central_config) = committed
         dones = [ent.get("done") for ent in entries]
         if not batch_dirty:
             # nothing to commit (read-only/error commands): answer now
@@ -509,8 +518,8 @@ class Monitor:
         prop = self._proposal
         self._proposal = None
         version, state = prop["version"], prop["state"]
-        self.osdmap, self.ec_profiles, self._cmd_replies = \
-            prop["scratch"]
+        (self.osdmap, self.ec_profiles, self._cmd_replies,
+         self._central_config) = prop["scratch"]
         batch = WriteBatch()
         batch.put(f"paxos/{version:016d}", state)
         batch.put("paxos/last_committed", str(version).encode())
@@ -558,8 +567,8 @@ class Monitor:
     def _adopt_state(self, version: int, state: bytes) -> None:
         """Install a committed snapshot (remote commit / catch-up /
         collect recovery). Caller holds the lock."""
-        self.osdmap, self.ec_profiles, self._cmd_replies = \
-            self._decode_state(state)
+        (self.osdmap, self.ec_profiles, self._cmd_replies,
+         self._central_config) = self._decode_state(state)
         batch = WriteBatch()
         batch.put(f"paxos/{version:016d}", state)
         batch.put("paxos/last_committed", str(version).encode())
@@ -573,15 +582,18 @@ class Monitor:
 
     def _encode_state(self) -> bytes:
         return self._encode_state_of(self.osdmap, self.ec_profiles,
-                                     self._cmd_replies)
+                                     self._cmd_replies,
+                                     self._central_config)
 
     @staticmethod
-    def _encode_state_of(osdmap, ec_profiles, cmd_replies) -> bytes:
+    def _encode_state_of(osdmap, ec_profiles, cmd_replies,
+                         central_config) -> bytes:
         from ceph_tpu.utils.encoding import Encoder
         e = Encoder()
         e.bytes(osdmap.encode())
         e.str(json.dumps(ec_profiles))
         e.str(json.dumps(cmd_replies))
+        e.str(json.dumps(central_config))
         return e.getvalue()
 
     @staticmethod
@@ -591,15 +603,16 @@ class Monitor:
         osdmap = OSDMap.decode(d.bytes())
         profiles = json.loads(d.str())
         replies = json.loads(d.str()) if not d.eof() else {}
-        return osdmap, profiles, replies
+        config = json.loads(d.str()) if not d.eof() else {}
+        return osdmap, profiles, replies, config
 
     def _replay(self) -> None:
         last = self._last_committed()
         if last == 0:
             return
         raw = self.db.get(f"paxos/{last:016d}")
-        self.osdmap, self.ec_profiles, self._cmd_replies = \
-            self._decode_state(raw)
+        (self.osdmap, self.ec_profiles, self._cmd_replies,
+         self._central_config) = self._decode_state(raw)
         # a restarted mon can't know which osds are still alive; they
         # re-boot or get timed out by the beacon grace
         log(1, f"mon.{self.name} replayed to version {last}, "
@@ -608,11 +621,13 @@ class Monitor:
     def _publish(self) -> None:
         msg = M.MOSDMap(epoch=self.osdmap.epoch,
                         map_bytes=self.osdmap.encode())
+        cfg = M.MConfig(config=dict(self._central_config))
         for name, conn in list(self._subscribers.items()):
             if conn.closed:
                 del self._subscribers[name]   # dead clients drop out
                 continue
             conn.send_message(msg)
+            conn.send_message(cfg)
 
     # -- dispatch -----------------------------------------------------
     def _dedup_put(self, key, ent: dict) -> None:
@@ -698,6 +713,8 @@ class Monitor:
                 conn.send_message(M.MOSDMap(
                     epoch=self.osdmap.epoch,
                     map_bytes=self.osdmap.encode()))
+                conn.send_message(M.MConfig(
+                    config=dict(self._central_config)))
             elif isinstance(msg, M.MMonCommand):
                 if not self.is_leader():
                     # clients re-target on this redirect
@@ -988,6 +1005,24 @@ class Monitor:
                 del pool.snaps[sid]
                 self._commit()   # OSD trimmers react to the new map
                 return 0, f"removed pool snap {cmd['snap']!r}", b""
+            if prefix == "config set":
+                from ceph_tpu.utils.config import SCHEMA
+                name, value = cmd["name"], cmd["value"]
+                try:
+                    SCHEMA.get(name).coerce(value)
+                except (KeyError, ValueError) as exc:
+                    return -22, f"config set: {exc}", b""
+                self._central_config[name] = str(value)
+                self._commit()
+                return 0, f"set {name} = {value}", b""
+            if prefix == "config rm":
+                if self._central_config.pop(cmd["name"], None) is None:
+                    return -2, f"no central config {cmd['name']!r}", b""
+                self._commit()
+                return 0, f"removed {cmd['name']}", b""
+            if prefix == "config dump":
+                return 0, "", json.dumps(self._central_config,
+                                         sort_keys=True).encode()
             if prefix == "osd pool lssnap":
                 pid = self._resolve_pool(cmd["pool"])
                 return 0, "", json.dumps(

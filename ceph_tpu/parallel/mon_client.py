@@ -71,6 +71,13 @@ class MonClient:
             for fn in callbacks:
                 fn(newmap)
             return True
+        if isinstance(msg, M.MConfig):
+            # centralized config push (ConfigMonitor MConfig role):
+            # swap the daemon's 'mon' source layer — layered below
+            # env/override, so local settings still win
+            from ceph_tpu.utils.config import g_conf
+            g_conf().set_mon_layer(dict(msg.config))
+            return True
         if isinstance(msg, (M.MMonCommandReply, M.MAuthReply)):
             with self._lock:
                 ent = self._pending.pop(msg.tid, None)

@@ -156,6 +156,31 @@ class ConfigProxy:
             if env in os.environ:
                 self.set(name, os.environ[env], "env")
 
+    def set_mon_layer(self, values: dict[str, Any]) -> None:
+        """Replace the 'mon' source layer wholesale (the MConfig push
+        from the ConfigMonitor role): additions, changes AND removals
+        land in one swap; observers fire for every effective change.
+        Unknown names / uncoercible values are skipped (version skew
+        between mon and daemon must not poison the whole push)."""
+        coerced: dict[str, Any] = {}
+        for name, value in values.items():
+            try:
+                coerced[name] = self.schema.get(name).coerce(value)
+            except (KeyError, ValueError):
+                continue
+        with self._lock:
+            touched = set(self._values["mon"]) | set(coerced)
+            old = {n: self.get(n) for n in touched}
+            self._values["mon"] = coerced
+            fire = []
+            for n in touched:
+                new = self.get(n)
+                if new != old[n]:
+                    fire.extend((fn, n, new) for fn in
+                                self._observers.get(n, ()))
+        for fn, n, new in fire:
+            fn(n, new)
+
     def add_observer(self, name: str,
                      fn: Callable[[str, Any], None]) -> None:
         self.schema.get(name)
