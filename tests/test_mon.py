@@ -222,7 +222,14 @@ def test_centralized_config_pushed_and_persisted():
     from ceph_tpu.qa.cluster import MiniCluster
     from ceph_tpu.utils.config import g_conf
     conf = g_conf()
-    assert conf["osd_max_backfills"] == 2          # compiled default
+
+    def mon_layer(name):
+        # assert on the MON SOURCE LAYER itself: an earlier test may
+        # have left an override-layer entry, which (by design) masks
+        # the mon layer in the effective value
+        with conf._lock:
+            return conf._values["mon"].get(name)
+
     try:
         with MiniCluster(n_osds=2) as cluster:
             code, outs, _ = cluster.mon_cmd(
@@ -231,9 +238,9 @@ def test_centralized_config_pushed_and_persisted():
             assert code == 0, outs
             deadline = _t.monotonic() + 10
             while _t.monotonic() < deadline and \
-                    conf["osd_max_backfills"] != 5:
+                    mon_layer("osd_max_backfills") != 5:
                 _t.sleep(0.05)
-            assert conf["osd_max_backfills"] == 5  # mon layer applied
+            assert mon_layer("osd_max_backfills") == 5
             # validation: unknown option and bad value refuse
             code, outs, _ = cluster.mon_cmd(
                 prefix="config set", name="no_such_option", value="1")
@@ -265,8 +272,8 @@ def test_centralized_config_pushed_and_persisted():
                 c2.shutdown()
             deadline = _t.monotonic() + 10
             while _t.monotonic() < deadline and \
-                    conf["osd_max_backfills"] != 2:
+                    mon_layer("osd_max_backfills") is not None:
                 _t.sleep(0.05)
-            assert conf["osd_max_backfills"] == 2
+            assert mon_layer("osd_max_backfills") is None
     finally:
         conf.set_mon_layer({})                     # isolation
