@@ -138,8 +138,13 @@ def _get_jnp():
     return jax, jnp
 
 
-#: rows per pallas grid step (input tile = _TR x ROW_BYTES bytes)
+#: rows per fold group per grid step
 _TR = 256
+#: row groups folded block-diagonally per matmul: widens the output
+#: from 32 to _G*32 = 128 lanes — without the fold the matmul leaves
+#: three quarters of the MXU's output lanes idle (the same g-fold
+#: trick gf_pallas uses on the contraction side)
+_G = 4
 
 
 @functools.lru_cache(maxsize=1)
@@ -147,8 +152,9 @@ def _pallas_rows_fn():
     """Fused stage-1 kernel: unpack -> MXU matmul -> mod-2, all in
     VMEM per tile (the plain-XLA path materializes the 8x bit
     expansion in HBM — measured 1 GB/s vs ~500 for the same-shaped GF
-    kernel). Input [rows, C] uint8, B [C*8, 32] -> [rows, 32] int8
-    bits of each row's crc contribution."""
+    kernel). Input [rows, C] uint8, B block-diag [G*C*8, G*32] ->
+    [rows, 32] int8 bits of each row's crc contribution; each grid
+    step processes G row groups through ONE full-width matmul."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -157,32 +163,42 @@ def _pallas_rows_fn():
     c = ROW_BYTES
 
     def kernel(b_ref, x_ref, o_ref):
-        x = x_ref[:].astype(jnp.int32)             # [tr, c]
-        # bit planes concatenated along LANES (mosaic supports the
-        # concat where it rejects a minor-dim reshape); B is permuted
-        # to the matching (bit*c + col) row order host-side
-        planes = [((x >> b) & 1) for b in range(8)]
-        bits = jnp.concatenate(planes, axis=1)     # [tr, 8c]
+        x = x_ref[:].astype(jnp.int32)             # [G*tr, c]
+        # per group: bit planes concatenated along LANES (mosaic
+        # supports the concat where it rejects a minor-dim reshape; B
+        # is permuted to the matching (bit*c + col) row order
+        # host-side); groups stack block-diagonally along lanes
+        groups = []
+        for g in range(_G):
+            grp = x[g * _TR:(g + 1) * _TR]
+            planes = [((grp >> b) & 1) for b in range(8)]
+            groups.append(jnp.concatenate(planes, axis=1))  # [tr, 8c]
+        bits = jnp.concatenate(groups, axis=1)     # [tr, G*8c]
         acc = jax.lax.dot_general(
             bits.astype(jnp.bfloat16),
             b_ref[:].astype(jnp.bfloat16),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # exact: sums<=4096
-        o_ref[:] = (acc.astype(jnp.int32) & 1).astype(jnp.int8)
+        bo = (acc.astype(jnp.int32) & 1).astype(jnp.int8)
+        for g in range(_G):
+            o_ref[g * _TR:(g + 1) * _TR, :] = \
+                bo[:, g * 32:(g + 1) * 32]
+
+    block = _G * _TR
 
     @functools.partial(jax.jit, static_argnames=("rows",))
     def run(x, b_mat, rows: int):
-        grid = (rows // _TR,)
+        grid = (rows // block,)
         return pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((c * 8, 32), lambda i: (0, 0),
+                pl.BlockSpec((_G * c * 8, _G * 32), lambda i: (0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((_TR, c), lambda i: (i, 0),
+                pl.BlockSpec((block, c), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((_TR, 32), lambda i: (i, 0),
+            out_specs=pl.BlockSpec((block, 32), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((rows, 32), jnp.int8),
         )(b_mat, x)
@@ -192,13 +208,19 @@ def _pallas_rows_fn():
 
 @functools.lru_cache(maxsize=8)
 def _B_matrix_planar(c_bytes: int) -> np.ndarray:
-    """B rows reordered to the pallas kernel's plane-major bit layout:
-    row (bit*C + col) = _B_matrix row (col*8 + bit)."""
+    """B rows reordered to the pallas kernel's plane-major bit layout
+    (row (bit*C + col) = _B_matrix row (col*8 + bit)), stacked
+    block-diagonally _G times so each matmul fills all 128 output
+    lanes with _G independent row groups."""
     b = _B_matrix(c_bytes)
-    out = np.empty_like(b)
+    planar = np.empty_like(b)
     for bit in range(8):
         for col in range(c_bytes):
-            out[bit * c_bytes + col] = b[col * 8 + bit]
+            planar[bit * c_bytes + col] = b[col * 8 + bit]
+    r, w = planar.shape
+    out = np.zeros((_G * r, _G * w), dtype=planar.dtype)
+    for g in range(_G):
+        out[g * r:(g + 1) * r, g * w:(g + 1) * w] = planar
     return out
 
 
@@ -221,7 +243,7 @@ def _jit_linear_batch():
         n = x.shape[0]
         if use_pallas:
             rows = n * r
-            rows_p = _round_up(rows, _TR)
+            rows_p = _round_up(rows, _G * _TR)
             flat = x.reshape(rows, c)
             if rows_p != rows:
                 # zero rows contribute nothing (crc linearity)
