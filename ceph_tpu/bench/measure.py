@@ -103,7 +103,13 @@ def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
     min_slope = min_traffic_bytes / (HBM_CEILING_GBPS * 1e9)
     t_start = time.perf_counter()
     slopes: list[float] = []
-    while time.perf_counter() - t_start < time_budget:
+    times: dict[int, float] = {}
+    first = True
+    # always run at least one sampling round: the no-slopes fallback
+    # below reads ``times``, and a zero/elapsed time budget must
+    # return the honest fallback, not NameError (r2 advisor low)
+    while first or time.perf_counter() - t_start < time_budget:
+        first = False
         times = {}
         for iters in counts:
             best = float("inf")

@@ -110,26 +110,33 @@ def _probe() -> None:
         import lz4.frame as _lz4
         _registry.register("lz4", _lz4.compress, _lz4.decompress)
     except ImportError:
-        from ceph_tpu.ops import native_loader as _nl
-        if _nl.available():
-            # LZ4 block + u32 length prefix (the block format carries
-            # no raw length; the reference's compressor framing
-            # records it the same way)
-            def _lz4_c(d: bytes) -> bytes:
-                return len(d).to_bytes(4, "little") + \
-                    _nl.lz4_compress(d)
+        # 'lz4' means the LZ4 FRAME format only. The native block
+        # codec below is a DIFFERENT wire format (u32 raw-length
+        # prefix + LZ4 block) and registers under its own name (and
+        # blockstore comp id), so a blob written without python-lz4
+        # never gets misparsed as a frame after installing it (and
+        # vice versa) — r2 advisor finding.
+        pass
+    from ceph_tpu.ops import native_loader as _nl
+    if _nl.available():
+        # LZ4 block + u32 length prefix (the block format carries
+        # no raw length; the reference's compressor framing
+        # records it the same way)
+        def _lz4_c(d: bytes) -> bytes:
+            return len(d).to_bytes(4, "little") + \
+                _nl.lz4_compress(d)
 
-            def _lz4_d(d: bytes) -> bytes:
-                raw_len = int.from_bytes(d[:4], "little")
-                # the prefix is blob data (possibly corrupt): clamp
-                # against LZ4's max expansion (255x) BEFORE allocating
-                # the output buffer, or a flipped prefix commits GiBs
-                if raw_len > max(len(d) * 255, 1 << 16):
-                    raise CompressionError(
-                        "corrupt lz4 blob: implausible raw length")
-                return _nl.lz4_decompress(d[4:], raw_len)
+        def _lz4_d(d: bytes) -> bytes:
+            raw_len = int.from_bytes(d[:4], "little")
+            # the prefix is blob data (possibly corrupt): clamp
+            # against LZ4's max expansion (255x) BEFORE allocating
+            # the output buffer, or a flipped prefix commits GiBs
+            if raw_len > max(len(d) * 255, 1 << 16):
+                raise CompressionError(
+                    "corrupt lz4 blob: implausible raw length")
+            return _nl.lz4_decompress(d[4:], raw_len)
 
-            _registry.register("lz4", _lz4_c, _lz4_d)
+        _registry.register("lz4block", _lz4_c, _lz4_d)
 
 
 _probe()

@@ -848,12 +848,12 @@ class OSD:
                 return
             if op == M.OSD_OP_WRITE_FULL:
                 self.logger.inc("op_w")
-                version = pg.log.last_version + 1
+                version = pg.alloc_version()
                 be.submit_write(pg, msg.oid, msg.data, version,
                                 lambda code, v=version: reply(code, b"", v))
             elif op in (M.OSD_OP_WRITE, M.OSD_OP_APPEND):
                 self.logger.inc("op_w")
-                version = pg.log.last_version + 1
+                version = pg.alloc_version()
                 if isinstance(be, ECBackend):
                     # partial-stripe RMW: only the touched stripe
                     # window is read, re-encoded, and range-written
@@ -905,7 +905,7 @@ class OSD:
                 reply(0, json.dumps({"size": size}).encode())
             elif op == M.OSD_OP_REMOVE:
                 be.stat_object(pg, msg.oid)   # ENOENT check
-                version = pg.log.last_version + 1
+                version = pg.alloc_version()
                 be.submit_remove(pg, msg.oid, version,
                                  lambda code, v=version: reply(code, b"", v))
             elif op == M.OSD_OP_CALL:
@@ -926,13 +926,13 @@ class OSD:
                     # the method dropped the object (cls_cxx_remove
                     # role, e.g. refcount.put on the last reference)
                     self.logger.inc("op_w")
-                    version = pg.log.last_version + 1
+                    version = pg.alloc_version()
                     be.submit_remove(
                         pg, msg.oid, version,
                         lambda c, v=version, o=out: reply(c, o, v))
                 elif new_obj is not None:
                     self.logger.inc("op_w")
-                    version = pg.log.last_version + 1
+                    version = pg.alloc_version()
                     be.submit_write(
                         pg, msg.oid, new_obj, version,
                         lambda c, v=version, o=out: reply(c, o, v))
@@ -1248,7 +1248,7 @@ class OSD:
             return {"seq": 0, "clones": []}
 
     def _store_snapset(self, pg: PG, be, oid: str, ss: dict) -> None:
-        version = pg.log.last_version + 1
+        version = pg.alloc_version()
         be.submit_write(pg, snapset_oid(oid),
                         json.dumps(ss, sort_keys=True).encode(),
                         version, lambda code: None)
@@ -1274,7 +1274,7 @@ class OSD:
         covered = sorted(s for s in msg.snaps if s > seq) or \
             [msg.snap_seq]
         clone_id = covered[-1]
-        version = pg.log.last_version + 1
+        version = pg.alloc_version()
         be.submit_write(pg, snap_clone_oid(msg.oid, clone_id), head,
                         version, lambda code: None)
         ss["seq"] = msg.snap_seq
@@ -1326,7 +1326,7 @@ class OSD:
                 for c in ss.get("clones", []):
                     live = [s for s in c["snaps"] if s in existing]
                     if not live:
-                        version = pg.log.last_version + 1
+                        version = pg.alloc_version()
                         be.submit_remove(
                             pg, snap_clone_oid(oid, c["id"]), version,
                             lambda code: None)
@@ -1348,7 +1348,7 @@ class OSD:
                         be.stat_object(pg, oid)
                         self._store_snapset(pg, be, oid, ss)
                     except (NoSuchObject, NoSuchCollection):
-                        version = pg.log.last_version + 1
+                        version = pg.alloc_version()
                         be.submit_remove(pg, snapset_oid(oid), version,
                                          lambda code: None)
                 else:

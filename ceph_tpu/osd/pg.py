@@ -179,6 +179,22 @@ class PG:
         from ceph_tpu.osd.extent_cache import ExtentCache
         self.extent_cache = ExtentCache()
         self.backend = None       # set by the OSD when instantiated
+        # version allocation cursor: versions are handed out when an op
+        # is ACCEPTED (under pg.lock), not when its log entry stages.
+        # On the device path staging is deferred to the engine
+        # continuation, so ``log.last_version + 1`` at op time would
+        # hand the SAME version to concurrent ops (and to the snap-COW
+        # clone + snapset + client-op triple) — colliding PGLog omap
+        # keys silently overwrite each other and replica replay loses
+        # ops. The cursor never runs behind last_version (peering may
+        # raise last_version past it).
+        self._ver_cursor = 0
+
+    def alloc_version(self) -> int:
+        """Next unique object/log version (caller holds pg.lock)."""
+        self._ver_cursor = max(self._ver_cursor,
+                               self.log.last_version) + 1
+        return self._ver_cursor
 
     def missing_dirty(self) -> bool:
         """Any shard still missing objects? Safe to call WITHOUT the pg

@@ -811,10 +811,13 @@ class Monitor:
                                 "ts": time.monotonic()})
         self._pump_proposals(time.monotonic())
 
-    def _enqueue_mutation(self, fn) -> None:
+    def _enqueue_mutation(self, fn, done=None) -> None:
         """Queue an internal (no-reply) state mutation — osd boots,
-        failure reports, beacon timeouts. Caller holds the lock."""
-        self._mut_queue.append({"fn": fn, "done": None,
+        failure reports, beacon timeouts. ``done(ok)`` fires if the
+        entry expires unproposed (mutations that guard a re-arm flag
+        must clear it, or the state machine wedges). Caller holds the
+        lock."""
+        self._mut_queue.append({"fn": fn, "done": done,
                                 "ts": time.monotonic()})
         self._pump_proposals(time.monotonic())
 
@@ -963,7 +966,15 @@ class Monitor:
                      now - self._last_beacon.get(osd, now) > grace]
             if stale and not self._beacon_check_queued:
                 self._beacon_check_queued = True
-                self._enqueue_mutation(check_beacons)
+
+                def rearm(ok: bool) -> None:
+                    # the queued check can expire unproposed (stalled
+                    # proposal window on a minority leader); without
+                    # this the flag stays set forever and beacon
+                    # mark-down is permanently disabled on this mon
+                    self._beacon_check_queued = False
+
+                self._enqueue_mutation(check_beacons, done=rearm)
 
     # -- command handling (OSDMonitor::prepare_command role) ----------
     def _handle_command(self, cmd: dict) -> tuple[int, str, bytes]:

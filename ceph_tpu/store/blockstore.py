@@ -42,7 +42,7 @@ from ceph_tpu.utils.encoding import Decoder, Encoder
 #: id is stored per blob so config changes never orphan old blobs
 COMP_NONE = 0
 _COMP_ALGS = {1: "zlib", 2: "zstd", 3: "bz2", 4: "lzma", 5: "lz4",
-              6: "snappy"}
+              6: "snappy", 7: "lz4block"}
 _COMP_IDS = {v: k for k, v in _COMP_ALGS.items()}
 
 #: blob checksum algorithms (Checksummer.h:11-19 role); id rides the
@@ -394,7 +394,23 @@ class BlockStore(ObjectStore):
                 f"checksum mismatch reading blob at {x.blob_off}")
         if x.comp != COMP_NONE:
             from ceph_tpu.compressor import Compressor
-            blob = Compressor.create(_COMP_ALGS[x.comp]).decompress(blob)
+            try:
+                blob = Compressor.create(
+                    _COMP_ALGS[x.comp]).decompress(blob)
+            except Exception as exc:
+                # legacy id-5 blobs: before 'lz4block' got its own id,
+                # environments without python-lz4 wrote the native
+                # BLOCK framing under id 5. The frame format opens
+                # with magic 0x184D2204, so a block blob reliably
+                # fails frame decode (or 'lz4' is unregistered) —
+                # retry it as lz4block instead of going EIO.
+                if x.comp != _COMP_IDS.get("lz4"):
+                    raise
+                try:
+                    blob = Compressor.create("lz4block").decompress(
+                        blob)
+                except Exception:
+                    raise exc
             if len(blob) != x.blob_len:
                 raise EIOError(
                     f"decompressed blob at {x.blob_off} has wrong size")

@@ -224,7 +224,7 @@ for _o in [
     Option("bluestore_compression_algorithm", str, "none", "advanced",
            "blob compression (options.cc bluestore_compression_algorithm)",
            enum_allowed=("none", "zlib", "zstd", "bz2", "lzma",
-                         "lz4", "snappy")),
+                         "lz4", "lz4block", "snappy")),
     Option("bluestore_compression_min_blob_size", int, 4096, "advanced",
            "blobs below this are stored raw"),
     Option("bluestore_compression_required_ratio", float, 0.875,

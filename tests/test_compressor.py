@@ -150,8 +150,14 @@ def test_native_lz4_snappy_roundtrip():
 
     import pytest
 
+    from ceph_tpu.ops import native_loader
+    if not native_loader.available():
+        pytest.skip("native library unavailable")
     from ceph_tpu.compressor import Compressor, registry
-    for name in ("lz4", "snappy"):
+    # 'lz4block' is the native block framing's OWN name/comp id: the
+    # 'lz4' name is reserved for the (incompatible) LZ4 frame format
+    # from python-lz4, so the two never cross-decode (r2 advisor)
+    for name in ("lz4block", "snappy"):
         assert name in registry().plugins()
         c = Compressor.create(name)
         rng = random.Random(7)
@@ -171,13 +177,18 @@ def test_native_lz4_snappy_roundtrip():
 def test_blockstore_lz4_snappy_blobs(tmp_path):
     """End-to-end: BlueStore-role blob compression with the native
     codecs, readable back through the checksum gate."""
+    import pytest
+
+    from ceph_tpu.ops import native_loader
+    if not native_loader.available():
+        pytest.skip("native library unavailable")
     from ceph_tpu.store.blockstore import BlockStore
     from ceph_tpu.store.object_store import Transaction
     from ceph_tpu.utils.config import g_conf
     conf = g_conf()
     old = conf["bluestore_compression_algorithm"]
     try:
-        for alg in ("lz4", "snappy"):
+        for alg in ("lz4block", "snappy"):
             conf.set("bluestore_compression_algorithm", alg)
             bs = BlockStore(str(tmp_path / alg))
             bs.mount()
@@ -187,6 +198,49 @@ def test_blockstore_lz4_snappy_blobs(tmp_path):
             t.write("c", "o", 0, b"squeeze me " * 4096)
             bs.queue_transaction(t)
             assert bs.read("c", "o") == b"squeeze me " * 4096
+            # compression actually engaged (id 7 = lz4block / 6 =
+            # snappy), not the raw fallback
+            comp_ids = {x.comp for x in bs._meta("c", "o").extents}
+            assert comp_ids == {7 if alg == "lz4block" else 6}
             bs.umount()
+    finally:
+        conf.set("bluestore_compression_algorithm", old)
+
+
+def test_legacy_lz4_id5_block_blob_still_readable(tmp_path):
+    """Upgrade path: blobs written under comp id 5 ('lz4') by the
+    pre-lz4block code in a python-lz4-free environment carry the
+    native BLOCK framing; the reader must fall back to lz4block
+    instead of answering EIO for durable data."""
+    import pytest
+
+    from ceph_tpu.ops import native_loader
+    if not native_loader.available():
+        pytest.skip("native library unavailable")
+    from ceph_tpu.store.blockstore import BlockStore
+    from ceph_tpu.store.object_store import Transaction
+    from ceph_tpu.utils.config import g_conf
+    conf = g_conf()
+    old = conf["bluestore_compression_algorithm"]
+    try:
+        conf.set("bluestore_compression_algorithm", "lz4block")
+        bs = BlockStore(str(tmp_path / "legacy"))
+        bs.mount()
+        t = Transaction()
+        t.create_collection("c")
+        t.touch("c", "o")
+        t.write("c", "o", 0, b"legacy bytes " * 4096)
+        bs.queue_transaction(t)
+        # rewrite the extent's comp id to the legacy 5 in metadata,
+        # exactly what an old store's kv rows contain
+        meta = bs._meta("c", "o")
+        for x in meta.extents:
+            assert x.comp == 7
+            x.comp = 5
+        from ceph_tpu.store.kv import WriteBatch
+        bs._db.submit(
+            WriteBatch().put(bs._okey("c", "o"), meta.encode()))
+        assert bs.read("c", "o") == b"legacy bytes " * 4096
+        bs.umount()
     finally:
         conf.set("bluestore_compression_algorithm", old)

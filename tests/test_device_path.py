@@ -118,6 +118,54 @@ def test_engine_barrier_ordering_and_error_fallback():
         eng.stop()
 
 
+def test_version_allocation_survives_deferred_staging():
+    """Versions are allocated when an op is ACCEPTED, not when its log
+    entry stages: on the device path staging defers to the engine
+    continuation, so ``log.last_version + 1`` at op time handed the
+    same version to concurrent ops (r2 advisor high)."""
+    from ceph_tpu.osd.pg import LOG_WRITE, PG, LogEntry
+    pg = PG(1, 0)
+    # nothing staged between allocations — versions must still advance
+    vs = [pg.alloc_version() for _ in range(5)]
+    assert vs == [1, 2, 3, 4, 5]
+    # peering raising last_version past the cursor advances allocation
+    pg.log.stage(LogEntry(100, LOG_WRITE, "o"))
+    assert pg.alloc_version() == 101
+
+
+def test_concurrent_one_pg_writes_distinct_log_versions():
+    """Concurrent writes to ONE PG through the device engine: every op
+    must land under its own PGLog version (colliding omap keys silently
+    overwrite each other and replica replay loses ops)."""
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("onepg", k=2, m=1, pg_num=1,
+                               backend="jax")
+        io = rados.open_ioctx("onepg")
+        n = 10
+        errs = []
+
+        def w(i):
+            try:
+                io.write_full(f"vo{i}", b"v" * 8192 + bytes([i]))
+            except Exception as exc:
+                errs.append(exc)
+
+        ts = [threading.Thread(target=w, args=(i,)) for i in range(n)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs, errs
+        pgs = [pg for o in cluster.osds.values()
+               for pg in o.pgs.values() if pg.pool != 0]
+        assert pgs, "no primary PG found"
+        entries = {v: e.oid for pg in pgs
+                   for v, e in pg.log.entries.items()}
+        logged_oids = {e.oid for pg in pgs
+                       for e in pg.log.entries.values()}
+        assert logged_oids >= {f"vo{i}" for i in range(n)}, (
+            "log entries collided", entries)
+
+
 def test_cluster_device_backend_end_to_end():
     """Full cluster with the device path engaged (backend=jax — the
     bit-sliced XLA kernel; identical code path to pallas on a chip):

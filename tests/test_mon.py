@@ -277,3 +277,49 @@ def test_centralized_config_pushed_and_persisted():
             assert mon_layer("osd_max_backfills") is None
     finally:
         conf.set_mon_layer({})                     # isolation
+
+
+def test_beacon_check_rearms_after_expired_mutation(mon, client):
+    """An expired check_beacons mutation must re-arm the queue flag
+    (r2 advisor medium: a stalled proposal window — e.g. a minority
+    leader — expired the entry with done=None while
+    _beacon_check_queued stayed True forever, permanently disabling
+    beacon-timeout mark-down on that mon)."""
+    from ceph_tpu.utils.config import g_conf
+    conf = g_conf()
+    old_timeout = conf["mon_commit_timeout"]
+    conf.set("mon_commit_timeout", 0.2)
+    try:
+        boot(client, 0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                not mon.osdmap.osds.get(0, None):
+            time.sleep(0.05)
+        assert mon.osdmap.osds[0].up
+        # stall the proposal window, then let the beacon go stale
+        orig_pump = mon._pump_proposals
+        mon._pump_proposals = lambda now: None
+        with mon._lock:
+            mon._last_beacon[0] = time.monotonic() - 10_000
+        mon.tick()
+        assert mon._beacon_check_queued is True
+        time.sleep(0.3)                  # > mon_commit_timeout
+        mon.tick()   # expires the queued check; the re-armed flag
+        # lets the SAME tick enqueue a fresh one. With the bug the
+        # flag stayed set, the queue stayed empty, and beacon
+        # mark-down was permanently disabled on this mon.
+        with mon._lock:
+            assert mon._mut_queue, (
+                "expired beacon check never re-enqueued: flag stuck",
+                mon._beacon_check_queued)
+        # un-stall: the next tick re-enqueues and the mark-down lands
+        mon._pump_proposals = orig_pump
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and mon.osdmap.osds[0].up:
+            with mon._lock:
+                mon._last_beacon[0] = time.monotonic() - 10_000
+            mon.tick()
+            time.sleep(0.1)
+        assert not mon.osdmap.osds[0].up
+    finally:
+        conf.set("mon_commit_timeout", old_timeout)
