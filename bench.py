@@ -1,8 +1,15 @@
 #!/usr/bin/env python
-"""Driver benchmark gate: k=8,m=3 RS encode GB/s on one TPU chip.
+"""Driver benchmark gate: k=8,m=3 RS encode AND recovery-decode GB/s
+on one TPU chip (both halves of the north-star metric, BASELINE.json).
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N,
+     "decode_e1_GBps": N, "decode_e1_vs_baseline": N,
+     "decode_e2_GBps": N, "decode_e2_vs_baseline": N, ...}
+
+The primary metric/value stays the canonical encode (so driver history
+is comparable across rounds); the decode fields carry the recovery
+configs (``-w decode -e {1,2}``, src/erasure-code/isa/README:40-45).
 
 Measures the canonical config of BASELINE.md — Reed-Solomon k=8, m=3
 (ISA profile), 1 MiB objects (reference run:
@@ -79,14 +86,52 @@ def main() -> None:
         min_traffic_bytes=data_bytes * (K + M) // K,
         time_budget=240.0, stable_n=6)
     gbps = data_bytes / slope / 1e9
-    print(json.dumps({
+    out = {
         "metric": "ec_encode_rs_k8m3_device_GBps",
         "value": round(gbps, 2),
         "unit": "GB/s",
         "vs_baseline": round(gbps / _cpu_baseline_gbps(mat), 2),
         "spread_pct": spread_pct,
         "samples": samples,
-    }))
+    }
+    # recovery decode (the other half of the metric): reconstruct e
+    # erased chunks from the k cheapest survivors, device-resident,
+    # same chained-slope method. GB/s counts the object bytes the
+    # decode consumes (k survivor chunks = one object), matching the
+    # reference benchmark's KiB-processed accounting.
+    for e in (1, 2):
+        gen = gf256.systematic_generator(mat)
+        missing = list(range(e))        # erase data chunks: real work
+        present = [i for i in range(K + M) if i not in missing][:K]
+        dmat = gf256.decode_matrix(gen, present, missing)
+        # bit-exactness gate vs the host oracle
+        enc_small = gf256.gf_matvec_chunks(mat, small)
+        stack = np.concatenate([small, enc_small])
+        surv_small = stack[present]
+        assert np.array_equal(
+            gf_pallas.matvec(dmat, surv_small), small[missing]), \
+            f"TPU decode e={e} is not bit-exact vs CPU reference"
+        full = np.concatenate([data, np.asarray(
+            gf256.gf_matvec_chunks(mat, data))])
+        dsurv = jax.device_put(jnp.asarray(full[present]))
+        dbmat = gf_pallas._perm_cache.get(dmat, g)
+        dtile = gf_pallas.DEFAULT_TILE // g
+
+        def dstep(ss, dbmat=dbmat, e=e):
+            rec = gf_pallas._matvec_padded(dbmat, ss, K, e, g, dtile)
+            return ss.at[0:1].set(rec[0:1])
+
+        dslope, dspread, dsamples = stable_best_slope(
+            dstep, dsurv, counts=LOOP_COUNTS,
+            min_traffic_bytes=data_bytes * (K + e) // K,
+            time_budget=150.0, stable_n=6)
+        dgbps = data_bytes / dslope / 1e9
+        out[f"decode_e{e}_GBps"] = round(dgbps, 2)
+        out[f"decode_e{e}_vs_baseline"] = round(
+            dgbps / _cpu_baseline_gbps(dmat), 2)
+        out[f"decode_e{e}_spread_pct"] = dspread
+        out[f"decode_e{e}_samples"] = dsamples
+    print(json.dumps(out))
 
 
 def _cpu_baseline_gbps(mat) -> float:

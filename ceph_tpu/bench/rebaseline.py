@@ -1,0 +1,191 @@
+"""Device re-baseline session: every BASELINE.md device row re-measured
+with the plateau method (``measure.stable_best_slope``), replacing the
+round-1 fixed-round numbers the round-2 methodology work discredited.
+
+Rows (the canonical configs of BASELINE.json / the reference's
+`ceph_erasure_code_benchmark` runs, src/erasure-code/isa/README:36-45):
+
+  rs_dec3     RS k=8,m=3 decode, 3 erasures
+  shec_enc    SHEC k=8,m=4,c=3 encode
+  shec_rec    SHEC k=8,m=4,c=3 single-chunk recovery (local-layer solve)
+  clay_rep    Clay k=8,m=4,d=11 single-node repair (linearized signature
+              matrix on the MXU, sub-chunk helper reads)
+  crc32c      device crc32c over a 24 MiB resident batch
+
+Byte accounting follows the reference benchmark's contract (elapsed vs
+KiB *of object data* processed, ceph_erasure_code_benchmark.cc:188,326):
+encode/decode rows count k*n object bytes per iteration; the Clay
+repair row counts the object bytes the repair logically serves
+(helper reads move only sub_chunk_no/q of that — the bandwidth
+optimality being measured); crc counts hashed bytes.
+
+Usage:  python -m ceph_tpu.bench.rebaseline [row ...]
+Prints one JSON line per row.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from ceph_tpu.bench.measure import stable_best_slope
+from ceph_tpu.ops import gf256
+
+#: lanes per measured batch (bytes per matrix-input row)
+N_LANES = 16 << 20
+
+
+def _matvec_rows(tag, mat, data, counted_bytes, budget=150.0):
+    """Measure a device-resident chained matvec; returns the row dict."""
+    import jax
+    import jax.numpy as jnp
+    from ceph_tpu.ops import gf_pallas
+
+    mat = np.asarray(mat, dtype=np.uint8)
+    dd = jax.device_put(jnp.asarray(data))
+
+    def step(x):
+        out = gf_pallas.matvec_device(mat, x)
+        return x.at[0:1].set(out[0:1])
+
+    traffic = data.nbytes + mat.shape[0] * data.shape[1]
+    slope, spread, samples = stable_best_slope(
+        step, dd, min_traffic_bytes=traffic, time_budget=budget,
+        stable_n=6)
+    return {"row": tag, "GBps": round(counted_bytes / slope / 1e9, 2),
+            "spread_pct": spread, "samples": samples,
+            "mat_shape": list(mat.shape)}
+
+
+def rs_dec3():
+    k, m = 8, 3
+    mat = gf256.rs_matrix_isa(k, m)
+    gen = gf256.systematic_generator(mat)
+    missing = [0, 1, 2]
+    present = [i for i in range(k + m) if i not in missing][:k]
+    dmat = gf256.decode_matrix(gen, present, missing)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(k, N_LANES // 8), dtype=np.uint8)
+    # bit-exactness gate
+    small = rng.integers(0, 256, size=(k, 1 << 14), dtype=np.uint8)
+    full = np.concatenate([small, gf256.gf_matvec_chunks(mat, small)])
+    assert np.array_equal(gf256.gf_matvec_chunks(dmat, full[present]),
+                          small[missing])
+    full_b = np.concatenate([data, gf256.gf_matvec_chunks(mat, data)])
+    return _matvec_rows("rs_k8m3_decode_e3", dmat, full_b[present],
+                        counted_bytes=k * data.shape[1])
+
+
+def _shec_codec(backend="numpy"):
+    from ceph_tpu.models import registry as _reg
+    return _reg.instance().factory("shec", {
+        "plugin": "shec", "k": "8", "m": "4", "c": "3",
+        "backend": backend})
+
+
+def shec_enc():
+    codec = _shec_codec()
+    mat = codec.coding_matrix                     # [4, 8]
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(8, N_LANES // 8), dtype=np.uint8)
+    return _matvec_rows("shec_k8m4c3_encode", mat, data,
+                        counted_bytes=data.nbytes)
+
+
+def shec_rec():
+    """Single-chunk recovery: the local-layer solve (the repair set the
+    plan search picks — reads a c-sized neighbourhood, not k chunks)."""
+    codec = _shec_codec()
+    k = 8
+    dup, rows, cols, _psel, minimum, _wd = codec._search_plan(
+        frozenset({0}), frozenset(range(1, 12)))
+    sub = codec._submatrix(rows, cols)
+    inv = gf256.invert_matrix(sub)
+    rng = np.random.default_rng(2)
+    n = N_LANES // 8
+    data = rng.integers(0, 256, size=(len(rows), n), dtype=np.uint8)
+    # bit-exactness gate: device solve == host decode of chunk 0
+    small_d = rng.integers(0, 256, size=(k, 1 << 14), dtype=np.uint8)
+    enc = codec.encode_chunks(list(range(8, 12)),
+                              {i: small_d[i] for i in range(k)})
+    chunks = {i: small_d[i] for i in range(1, k)}
+    chunks.update(enc)
+    host = codec.decode_chunks([0], chunks)[0]
+    b = np.stack([np.asarray(chunks[r], dtype=np.uint8) for r in rows])
+    dev = gf256.gf_matvec_chunks(inv, b)[cols.index(0)]
+    assert np.array_equal(dev, host)
+    out = _matvec_rows("shec_k8m4c3_recover1", inv, data,
+                       counted_bytes=k * n)
+    out["helpers"] = len(rows)
+    return out
+
+
+def clay_rep():
+    from ceph_tpu.models import registry as _reg
+    codec = _reg.instance().factory("clay", {
+        "plugin": "clay", "k": "8", "m": "4", "d": "11",
+        "backend": "numpy"})
+    ssc = codec.sub_chunk_no                       # q^t = 64
+    rss = ssc // codec.q                           # helper rows = 16
+    helpers = tuple(range(1, 12))                  # repair chunk 0, d=11
+    mat = codec._repair_matrix(0, helpers)         # [64, 176]
+    sc = (N_LANES // 8) // ssc
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, size=(len(helpers) * rss, sc),
+                        dtype=np.uint8)
+    # one object's repair serves k*ssc*sc logical bytes while reading
+    # only len(helpers)*rss*sc helper bytes (the MSR bandwidth win)
+    counted = 8 * ssc * sc
+    out = _matvec_rows("clay_k8m4d11_repair", mat, data,
+                       counted_bytes=counted)
+    out["helper_bytes_per_object"] = len(helpers) * rss * sc
+    out["object_bytes"] = counted
+    return out
+
+
+def crc32c():
+    import jax
+    import jax.numpy as jnp
+    from ceph_tpu.ops import crc32c_device as cd
+    from ceph_tpu.utils import checksum
+
+    rows, ln = 12, 2 << 20                         # 24 MiB resident
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=(rows, ln), dtype=np.uint8)
+    # bit-exactness gate vs the host oracle
+    got = cd.crc32c_device(data[:2, : 1 << 16])
+    want = [checksum.crc32c(bytes(r), 0) for r in data[:2, : 1 << 16]]
+    assert [int(x) for x in got] == want
+    dd = jax.device_put(jnp.asarray(data))
+
+    def step(x):
+        lin = cd.crc_linear_device(x)
+        return x.at[0, 0].set((lin[0] & 0xFF).astype(jnp.uint8))
+
+    slope, spread, samples = stable_best_slope(
+        step, dd, min_traffic_bytes=data.nbytes, time_budget=150.0,
+        stable_n=6)
+    return {"row": "crc32c_device_24MiB",
+            "GBps": round(data.nbytes / slope / 1e9, 2),
+            "spread_pct": spread, "samples": samples}
+
+
+ROWS = {"rs_dec3": rs_dec3, "shec_enc": shec_enc,
+        "shec_rec": shec_rec, "clay_rep": clay_rep, "crc32c": crc32c}
+
+
+def main(argv=None) -> int:
+    want = (argv if argv is not None else sys.argv[1:]) or list(ROWS)
+    for name in want:
+        try:
+            print(json.dumps(ROWS[name]()), flush=True)
+        except Exception as exc:                 # keep the session going
+            print(json.dumps({"row": name, "error": repr(exc)}),
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -95,7 +95,8 @@ class Objecter:
                   length: int = 0, data: bytes = b"", ps: int = -1,
                   cls: str = "", method: str = "",
                   snap_seq: int = 0, snaps: list | tuple = (),
-                  snapid: int = 0,
+                  snapid: int = 0, xname: str = "", xop: int = 0,
+                  gname: str = "", gop: int = 0, gval: bytes = b"",
                   timeout: float = 30.0) -> M.MOSDOpReply:
         """Synchronous submit (the aio variant is just this on a
         thread); raises ObjecterError on errno replies."""
@@ -110,7 +111,8 @@ class Objecter:
                        offset=offset, length=length, data=bytes(data),
                        trace=span.wire(), cls=cls, method=method,
                        snap_seq=snap_seq, snaps=list(snaps),
-                       snapid=snapid)
+                       snapid=snapid, xname=xname, xop=xop,
+                       gname=gname, gop=gop, gval=bytes(gval))
         rec = _Op(tid, msg)
         with self._lock:
             self._pending[tid] = rec

@@ -147,6 +147,95 @@ class IoCtx:
         return self._submit(oid, M.OSD_OP_CALL, data=inp, cls=cls,
                             method=method).data
 
+    # -- xattrs (rados_{get,set,rm}xattr / getxattrs roles) -----------
+    @staticmethod
+    def _guard_kw(guard) -> dict:
+        """``guard=(name, op, value)`` attaches an atomic cmpxattr
+        guard to any op (the reference couples a CMPXATTR to the ops
+        after it in one transaction); op is a M.CMPXATTR_* mode."""
+        if guard is None:
+            return {}
+        name, gop, gval = guard
+        return {"gname": name, "gop": int(gop), "gval": bytes(gval)}
+
+    def getxattr(self, oid: str, name: str) -> bytes:
+        return self._submit(oid, M.OSD_OP_GETXATTR, xname=name).data
+
+    def setxattr(self, oid: str, name: str, value: bytes,
+                 guard=None) -> int:
+        return self._submit(oid, M.OSD_OP_SETXATTR, xname=name,
+                            data=value,
+                            **self._guard_kw(guard)).version
+
+    def rmxattr(self, oid: str, name: str) -> None:
+        self._submit(oid, M.OSD_OP_RMXATTR, xname=name)
+
+    def getxattrs(self, oid: str) -> dict[str, bytes]:
+        rep = self._submit(oid, M.OSD_OP_GETXATTRS)
+        return {n: bytes.fromhex(v)
+                for n, v in json.loads(rep.data).items()}
+
+    def cmpxattr(self, oid: str, name: str, op: int,
+                 value: bytes) -> bool:
+        """True when the comparison holds; False on -ECANCELED
+        mismatch (other errors raise)."""
+        try:
+            self._submit(oid, M.OSD_OP_CMPXATTR, xname=name,
+                         xop=int(op), data=bytes(value))
+            return True
+        except RadosError as exc:
+            if exc.code == -125:
+                return False
+            raise
+
+    # -- omap (rados_omap_* roles; replicated pools only, EC pools
+    # answer -EOPNOTSUPP exactly like the reference) -------------------
+    def omap_set(self, oid: str, kv: dict[str, bytes],
+                 guard=None) -> int:
+        payload = json.dumps({k: bytes(v).hex()
+                              for k, v in kv.items()}).encode()
+        return self._submit(oid, M.OSD_OP_OMAPSET, data=payload,
+                            **self._guard_kw(guard)).version
+
+    def omap_get(self, oid: str, keys: list[str] | None = None, *,
+                 prefix: str = "", start_after: str = "",
+                 max_return: int = 0) -> dict[str, bytes]:
+        """Exact keys (``keys``) or a ranged page (``prefix``/
+        ``start_after``/``max_return`` — the omap-get-vals paging
+        contract; the server sends only the page)."""
+        if prefix or start_after or max_return:
+            payload = json.dumps({"prefix": prefix,
+                                  "start_after": start_after,
+                                  "max": max_return}).encode()
+        else:
+            payload = json.dumps(list(keys or [])).encode()
+        rep = self._submit(oid, M.OSD_OP_OMAPGET, data=payload)
+        return {k: bytes.fromhex(v)
+                for k, v in json.loads(rep.data).items()}
+
+    def omap_get_keys(self, oid: str) -> list[str]:
+        rep = self._submit(oid, M.OSD_OP_OMAPGETKEYS)
+        return json.loads(rep.data)
+
+    def omap_rm_keys(self, oid: str, keys: list[str]) -> None:
+        self._submit(oid, M.OSD_OP_OMAPRMKEYS,
+                     data=json.dumps(list(keys)).encode())
+
+    def create(self, oid: str, exclusive: bool = False,
+               guard=None) -> int:
+        """Materialize an empty object (CEPH_OSD_OP_CREATE);
+        ``exclusive`` raises -EEXIST when it already exists."""
+        return self._submit(oid, M.OSD_OP_CREATE,
+                            xop=1 if exclusive else 0,
+                            **self._guard_kw(guard)).version
+
+    def write_full_guarded(self, oid: str, data: bytes,
+                           guard) -> int:
+        """write_full coupled to a cmpxattr guard, atomically."""
+        return self._submit(oid, M.OSD_OP_WRITE_FULL, data=data,
+                            **self._guard_kw(guard),
+                            **self._snapc()).version
+
     def list_objects(self) -> list[str]:
         """Union of per-PG listings (PGLS role)."""
         osdmap = self.client.monc.osdmap

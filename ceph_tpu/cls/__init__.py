@@ -87,10 +87,14 @@ def _lock_lock(inp: bytes, obj: bytes | None):
     now = time.time()
     lockers = {k: v for k, v in st["lockers"].items()
                if not v["expires"] or v["expires"] > now}
-    excl = any(v["type"] == "exclusive" for v in lockers.values())
     key = f"{req['name']}/{req['cookie']}"
-    if key not in lockers and (
-            excl or (req["type"] == "exclusive" and lockers)):
+    # conflicts are judged against the OTHER lockers: a re-lock by the
+    # same cookie renews (or up/downgrades) its own entry, but an
+    # upgrade to exclusive must still fail while another holder exists
+    # (granting it would hand two clients conflicting caps)
+    others = {k: v for k, v in lockers.items() if k != key}
+    excl = any(v["type"] == "exclusive" for v in others.values())
+    if excl or (req["type"] == "exclusive" and others):
         return -16, b"", None         # -EBUSY
     lockers[key] = {
         "type": req["type"],

@@ -93,12 +93,13 @@ class ECBackend(PGBackend):
         self.device = None
         self.device_codec = None
         if want in DEVICE_BACKENDS:
-            # device backends serve the BATCHED stripe engine (full-
-            # object writes coalesced across PGs into one kernel
-            # launch); the synchronous op paths — degraded reads, RMW
-            # re-encode, recovery decode — keep a host twin, because a
-            # per-op jit dispatch would stall the latency-sensitive
-            # daemon (SURVEY.md §7.5)
+            # device backends serve the BATCHED stripe engine: full-
+            # object writes coalesce across PGs into one kernel
+            # launch, and degraded-read / recovery reconstructs batch
+            # by erasure signature (stage_decode). The host twin
+            # remains the fallback for device faults, RMW re-encode
+            # of tiny windows, and codecs the batched decode cannot
+            # take (ec_util.device_decodable).
             self.device_codec = ec_registry.instance().factory(
                 profile.get("plugin", "jerasure"), profile)
             self.device = parent.device_engine()
@@ -132,6 +133,26 @@ class ECBackend(PGBackend):
         if rem == 0 and data:
             return data
         return data + b"\x00" * (sw - rem if rem else sw)
+
+    def _decode(self, pg: PG, shards: dict[int, np.ndarray],
+                want: list[int]) -> dict[int, np.ndarray]:
+        """Reconstruct ``want`` chunk streams — on the DEVICE when the
+        pool runs a device backend (the round-3 seam: degraded reads
+        and recovery decode batch through the engine grouped by
+        erasure signature, objects_read_and_reconstruct /
+        continue_recovery_op roles, src/osd/ECBackend.cc:2301,537),
+        host twin otherwise or on device fault."""
+        missing = [i for i in want if i not in shards]
+        if missing and self.device is not None and \
+                self.device_codec is not None and \
+                ec_util.device_decodable(self.device_codec):
+            out = self.device.decode_sync(
+                pg.pgid, self.device_codec, self.sinfo, shards, want)
+            if out is not None:
+                return out
+            log(1, f"{pg}: device decode fell back to host "
+                f"(want {want})")
+        return ec_util.decode(self.sinfo, self.codec, shards, want)
 
     def _chunks_to_logical(self, shards: dict[int, np.ndarray],
                            size: int) -> bytes:
@@ -275,6 +296,79 @@ class ECBackend(PGBackend):
             return
         run()
 
+    def submit_setattrs(self, pg: PG, oid: str,
+                        sets: dict[str, bytes], rms: list[str],
+                        version: int,
+                        on_commit: Callable[[int], None]) -> None:
+        """Client xattr mutation: the attrs ride EVERY shard (so any
+        surviving shard set answers a degraded getxattr, and recovery
+        pushes them back — the SETATTR log-entry role of
+        ecbackend.rst:9-26)."""
+        from ceph_tpu.osd.pg_backend import USER_XATTR
+
+        def run() -> None:
+            try:
+                self.stat_object(pg, oid)
+                exists = True
+            except (NoSuchObject, NoSuchCollection):
+                exists = False
+
+            def build(pos: int, cid: str) -> Transaction:
+                txn = Transaction()
+                txn.create_collection(cid)
+                txn.touch(cid, oid)
+                for name, val in sets.items():
+                    txn.setattr(cid, oid, USER_XATTR + name, val)
+                for name in rms:
+                    txn.rmattr(cid, oid, USER_XATTR + name)
+                txn.setattr(cid, oid, "v",
+                            version.to_bytes(8, "little"))
+                if not exists:
+                    # attr ops imply create (reference semantics):
+                    # materialize an empty object
+                    txn.setattr(cid, oid, "sz", (0).to_bytes(8,
+                                                             "little"))
+                return txn
+
+            self._fan_out(pg, oid, version, LOG_WRITE, build,
+                          on_commit, "ec_sub_setattr",
+                          supersedes_recovery=False)
+
+        if self.device is not None:
+            # ordering barrier: a staged-but-unflushed write of this
+            # object must fan out first, or its (deferred) txn would
+            # land after ours with an OLDER "v" — shard versions would
+            # regress against the log
+            def barrier(pg=pg) -> None:
+                with pg.lock:
+                    run()
+            self.device.stage_barrier(pg.pgid, barrier)
+            return
+        run()
+
+    def get_xattrs(self, pg: PG, oid: str) -> dict[str, bytes]:
+        from ceph_tpu.osd.pg_backend import user_xattrs
+        mypos = self.my_position(pg)
+        if mypos >= 0:
+            cid = pg_cid(pg.pool, pg.ps, mypos)
+            try:
+                return user_xattrs(self.parent.store.getattrs(cid,
+                                                              oid))
+            except (NoSuchObject, NoSuchCollection):
+                # authoritative ENOENT when nothing is degraded: a
+                # cluster fan-out (with its retry ladder, under
+                # pg.lock) just to rediscover ENOENT would stall the
+                # PG's op pipeline on every guarded op / getxattr of
+                # a nonexistent object
+                if not any(oid in m for m in pg.peer_missing.values()):
+                    raise
+            except StoreError:
+                pass       # local shard unreadable (EIO): fan out
+        # degraded: any shard's attrs carry the client xattrs
+        # (_read_shards raises NoSuchObject on ENOENT everywhere)
+        _, attrs = self._read_shards(pg, oid, [0])
+        return user_xattrs(attrs)
+
     def submit_partial_write(self, pg: PG, oid: str, offset: int,
                              data: bytes, version: int,
                              on_commit: Callable[[int], None],
@@ -385,8 +479,7 @@ class ECBackend(PGBackend):
                 base_ver = int.from_bytes(rattrs.get("v", b""),
                                           "little")
                 if not all(i in chunks for i in want):
-                    chunks = ec_util.decode(self.sinfo, self.codec,
-                                            chunks, want)
+                    chunks = self._decode(pg, chunks, want)
                 old_win = self._chunks_to_logical(
                     {i: chunks[i] for i in want}, read_to - a)
                 window[:len(old_win)] = old_win
@@ -583,7 +676,7 @@ class ECBackend(PGBackend):
         size = self._attr_size(attrs)
         if all(i in chunks for i in want):
             return self._chunks_to_logical(chunks, size)
-        decoded = ec_util.decode(self.sinfo, self.codec, chunks, want)
+        decoded = self._decode(pg, chunks, want)
         return self._chunks_to_logical(decoded, size)
 
     def stat_object(self, pg: PG, oid: str) -> int:
@@ -623,8 +716,7 @@ class ECBackend(PGBackend):
         if shard in chunks:
             chunk = chunks[shard]
         else:
-            decoded = ec_util.decode(
-                self.sinfo, self.codec, chunks, [shard])
+            decoded = self._decode(pg, chunks, [shard])
             chunk = decoded[shard]
         return self._push_from_chunk(pg, oid, shard, version, chunk,
                                      attrs, tid)
@@ -646,8 +738,9 @@ class ECBackend(PGBackend):
                 f"{actual_v} < wanted v{version}; pushing surviving "
                 "state (the wanted write never fully committed)")
         push_attrs = {"v": actual_v.to_bytes(8, "little")}
-        for name in ("sz", "hinfo"):
-            if name in attrs:
+        from ceph_tpu.osd.pg_backend import USER_XATTR
+        for name in attrs:
+            if name in ("sz", "hinfo") or name.startswith(USER_XATTR):
                 push_attrs[name] = attrs[name]
         return M.MPGPush(
             pool=pg.pool, ps=pg.ps, shard=shard, oid=oid,
@@ -873,8 +966,7 @@ class ECBackend(PGBackend):
         if all(i in have for i in want_data):
             data_chunks = {i: have[i] for i in want_data}
         else:
-            data_chunks = ec_util.decode(self.sinfo, self.codec,
-                                         have, want_data)
+            data_chunks = self._decode(pg, have, want_data)
         logical = self._chunks_to_logical(data_chunks, size)
         padded = self._pad(bytes(logical))
         shards = ec_util.encode(self.sinfo, self.codec, padded)
@@ -882,6 +974,10 @@ class ECBackend(PGBackend):
         hinfo.append(0, shards)
         attrs = {"sz": size.to_bytes(8, "little"),
                  "hinfo": json.dumps(hinfo.to_dict()).encode()}
+        from ceph_tpu.osd.pg_backend import USER_XATTR
+        for name, val in attrs_by_pos[vers[best][0]].items():
+            if name.startswith(USER_XATTR):
+                attrs[name] = val
         log(1, f"{pg}: rolling back {oid} to content of v{best} "
             f"(labelled v{label}) on positions {positions}")
         return {pos: mk(pos, shards[pos].tobytes(), attrs, False)
@@ -934,6 +1030,14 @@ class ECBackend(PGBackend):
             reply.data = data
             if msg.want_attrs:
                 reply.attrs = dict(attrs)
+                if msg.offset == 0 and not msg.length \
+                        and not msg.offsets:
+                    # full-object pull: ship the omap too (replicated
+                    # recovery; EC objects carry no client omap)
+                    try:
+                        reply.omap = store.omap_get(cid, msg.oid)
+                    except StoreError:
+                        pass
         except EIOError as exc:
             log(1, f"sub_read EIO: {exc}")
             reply.code = -5

@@ -49,6 +49,18 @@ log = Dout("osd")
 #: the shard as unavailable (messenger is lossy; peers may be dead)
 SUBOP_TIMEOUT = 5.0
 
+#: store-attr namespace for CLIENT xattrs (the reference separates
+#: user xattrs with a "_" prefix from internal "_ceph." attrs —
+#: src/osd/PrimaryLogPG.cc getxattr/setxattr; ours are "u/<name>"
+#: beside the internal "v"/"sz"/"hinfo"/"crc" attrs)
+USER_XATTR = "u/"
+
+
+def user_xattrs(attrs: dict[str, bytes]) -> dict[str, bytes]:
+    """Strip the store-attr namespace down to the client's view."""
+    return {n[len(USER_XATTR):]: v for n, v in attrs.items()
+            if n.startswith(USER_XATTR)}
+
 
 class SubOpWait:
     """Blocking rendezvous for a read fan-out."""
@@ -209,6 +221,35 @@ class PGBackend:
         rollback does not apply / state is unknown."""
         return None
 
+    # -- client xattrs/omap (do_osd_ops attr families) ----------------
+    def submit_setattrs(self, pg: PG, oid: str,
+                        sets: dict[str, bytes], rms: list[str],
+                        version: int,
+                        on_commit: Callable[[int], None]) -> None:
+        """Apply client xattr mutations at ``version`` across the
+        acting set (CEPH_OSD_OP_SETXATTR/RMXATTR). Creates the object
+        if absent (the reference's attr ops imply create)."""
+        raise NotImplementedError
+
+    def get_xattrs(self, pg: PG, oid: str) -> dict[str, bytes]:
+        """Client xattrs of ``oid`` (degraded-safe). Raises
+        NoSuchObject when the object does not exist."""
+        raise NotImplementedError
+
+    def omap_supported(self) -> bool:
+        """EC pools reject omap exactly as the reference does
+        (PrimaryLogPG returns -EOPNOTSUPP on EC pools)."""
+        return False
+
+    def submit_omap(self, pg: PG, oid: str, sets: dict[str, bytes],
+                    rms: list[str], version: int,
+                    on_commit: Callable[[int], None]) -> None:
+        raise NotImplementedError
+
+    def get_omap(self, pg: PG, oid: str,
+                 keys: "list[str] | None" = None) -> dict[str, bytes]:
+        raise NotImplementedError
+
     def local_cid(self, pg: PG) -> str:
         raise NotImplementedError
 
@@ -230,13 +271,24 @@ class PGBackend:
 
 
 def object_write_txn(cid: str, oid: str, data: bytes, version: int,
-                     attrs: dict[str, bytes] | None = None) -> Transaction:
+                     attrs: dict[str, bytes] | None = None,
+                     replace: bool = False) -> Transaction:
     """Write-full of one store object + its version attr (and extras),
-    all in one atomic txn."""
+    all in one atomic txn.
+
+    ``replace=False`` (client WRITEFULL semantics,
+    CEPH_OSD_OP_WRITEFULL): the data stream is truncated and
+    rewritten; client xattrs and omap SURVIVE. ``replace=True``
+    (recovery pushes): the object is recreated from exactly the pushed
+    state — stale attrs/omap a down shard accumulated must not
+    outlive recovery."""
     txn = Transaction()
     txn.create_collection(cid)
-    txn.remove(cid, oid)
+    if replace:
+        txn.remove(cid, oid)
     txn.touch(cid, oid)
+    if not replace:
+        txn.truncate(cid, oid, 0)
     if data:
         txn.write(cid, oid, 0, data)
     txn.setattr(cid, oid, "v", version.to_bytes(8, "little"))
@@ -317,6 +369,61 @@ class ReplicatedBackend(PGBackend):
     def stat_object(self, pg: PG, oid: str) -> int:
         return self.parent.store.stat(self.local_cid(pg), oid)
 
+    # -- client xattrs/omap -------------------------------------------
+    def _attr_txn(self, cid: str, oid: str, sets: dict[str, bytes],
+                  rms: list[str], version: int,
+                  omap_sets: dict[str, bytes] | None = None,
+                  omap_rms: list[str] | None = None) -> Transaction:
+        txn = Transaction()
+        txn.create_collection(cid)
+        txn.touch(cid, oid)
+        for name, val in sets.items():
+            txn.setattr(cid, oid, USER_XATTR + name, val)
+        for name in rms:
+            txn.rmattr(cid, oid, USER_XATTR + name)
+        if omap_sets:
+            txn.omap_set(cid, oid, omap_sets)
+        if omap_rms:
+            txn.omap_rm(cid, oid, omap_rms)
+        txn.setattr(cid, oid, "v", version.to_bytes(8, "little"))
+        return txn
+
+    def submit_setattrs(self, pg: PG, oid: str,
+                        sets: dict[str, bytes], rms: list[str],
+                        version: int,
+                        on_commit: Callable[[int], None]) -> None:
+        entry = LogEntry(version, LOG_WRITE, oid)
+        self._fan_out(pg, oid, entry,
+                      lambda cid: self._attr_txn(cid, oid, sets, rms,
+                                                 version), on_commit)
+
+    def get_xattrs(self, pg: PG, oid: str) -> dict[str, bytes]:
+        return user_xattrs(
+            self.parent.store.getattrs(self.local_cid(pg), oid))
+
+    def omap_supported(self) -> bool:
+        return True
+
+    def submit_omap(self, pg: PG, oid: str, sets: dict[str, bytes],
+                    rms: list[str], version: int,
+                    on_commit: Callable[[int], None]) -> None:
+        entry = LogEntry(version, LOG_WRITE, oid)
+        self._fan_out(pg, oid, entry,
+                      lambda cid: self._attr_txn(cid, oid, {}, [],
+                                                 version,
+                                                 omap_sets=sets,
+                                                 omap_rms=rms),
+                      on_commit)
+
+    def get_omap(self, pg: PG, oid: str,
+                 keys: "list[str] | None" = None) -> dict[str, bytes]:
+        cid = self.local_cid(pg)
+        self.parent.store.stat(cid, oid)       # ENOENT check
+        omap = self.parent.store.omap_get(cid, oid)
+        if keys:
+            return {k: omap[k] for k in keys if k in omap}
+        return omap
+
     def build_push(self, pg: PG, oid: str, shard: int, version: int,
                    tid: int) -> M.MPGPush | None:
         cid = self.local_cid(pg)
@@ -328,6 +435,7 @@ class ReplicatedBackend(PGBackend):
                 version=-version, data=b"", attrs={}, remove=True,
                 tid=tid)
         data = attrs = None
+        omap: dict[str, bytes] = {}
         push_version = version
         try:
             attrs = self.parent.store.getattrs(cid, oid)
@@ -335,13 +443,17 @@ class ReplicatedBackend(PGBackend):
             if v_local >= version:
                 data = self.parent.store.read(cid, oid)
                 push_version = v_local
+                try:
+                    omap = self.parent.store.omap_get(cid, oid)
+                except StoreError:
+                    omap = {}
         except StoreError:
             pass
         if data is None:
             # the local copy is absent or stale (the PRIMARY may be the
             # shard being recovered): pull the wanted-or-newer version
             # from a replica that has it (the reference's pull path)
-            data, attrs, push_version = self._pull_copy(
+            data, attrs, omap, push_version = self._pull_copy(
                 pg, oid, version, exclude={shard})
             if data is None:
                 log(1, f"recover {oid}: no replica holds v>={version}")
@@ -349,11 +461,11 @@ class ReplicatedBackend(PGBackend):
         return M.MPGPush(
             pool=pg.pool, ps=pg.ps, shard=NO_SHARD, oid=oid,
             version=push_version, data=data, attrs=dict(attrs),
-            remove=False, tid=tid)
+            remove=False, tid=tid, omap=dict(omap or {}))
 
     def _pull_copy(self, pg: PG, oid: str, version: int,
                    exclude: set[int]
-                   ) -> tuple[bytes | None, dict | None, int]:
+                   ) -> "tuple[bytes | None, dict | None, dict, int]":
         with pg.lock:
             donors = [p for p in self.up_positions(pg)
                       if p not in exclude
@@ -380,5 +492,6 @@ class ReplicatedBackend(PGBackend):
                     log(1, f"pull {oid}: donor pos {pos} fails its own "
                         "crc, trying next donor")
                     continue      # silently-corrupt donor: never spread
-            return rep.data, dict(rep.attrs), rep.version
-        return None, None, 0
+            return rep.data, dict(rep.attrs), \
+                dict(getattr(rep, "omap", {}) or {}), rep.version
+        return None, None, {}, 0

@@ -170,6 +170,31 @@ OSD_OP_WRITE = 5       # offset write (EC: RMW over the full object)
 OSD_OP_APPEND = 6
 OSD_OP_LIST = 7        # list objects of one PG (PGLS role)
 OSD_OP_CALL = 8        # in-OSD object class method (CEPH_OSD_OP_CALL)
+# client-visible xattr/omap surface (the do_osd_ops op families of
+# src/osd/PrimaryLogPG.cc:5664 — CEPH_OSD_OP_{GETXATTR,SETXATTR,
+# RMXATTR,GETXATTRS,CMPXATTR,OMAPGETVALS,OMAPSETVALS,OMAPRMKEYS,
+# OMAPGETKEYS,CREATE}):
+OSD_OP_GETXATTR = 9    # xname -> value in reply data
+OSD_OP_SETXATTR = 10   # xname, value in data
+OSD_OP_RMXATTR = 11    # xname
+OSD_OP_GETXATTRS = 12  # reply data = json {name: value_hex}
+OSD_OP_CMPXATTR = 13   # xname, xop, operand in data; -ECANCELED on miss
+OSD_OP_OMAPGET = 14    # data = json [keys] ([] = all) -> {k: v_hex}
+OSD_OP_OMAPSET = 15    # data = json {k: v_hex}
+OSD_OP_OMAPRMKEYS = 16  # data = json [keys]
+OSD_OP_OMAPGETKEYS = 17  # reply data = json [keys]
+OSD_OP_CREATE = 18     # xop=1: exclusive (-EEXIST if present)
+
+# cmpxattr / guard comparison modes (CEPH_OSD_CMPXATTR_OP_*,
+# src/include/rados.h): EQ..LTE compare the stored value against the
+# operand — bytes for EQ/NE, u64 (decimal operand) for the orderings
+CMPXATTR_EQ = 1
+CMPXATTR_NE = 2
+CMPXATTR_GT = 3
+CMPXATTR_GTE = 4
+CMPXATTR_LT = 5
+CMPXATTR_LTE = 6
+
 
 class MOSDOp(Message):
     """``trace`` carries the dataflow-trace context (Message.h:264
@@ -185,7 +210,15 @@ class MOSDOp(Message):
               # newest first — PrimaryLogPG make_writeable inputs);
               # reads carry the wanted snapid (0 = head)
               ("snap_seq", "u64"), ("snaps", "u64_list"),
-              ("snapid", "u64")]
+              ("snapid", "u64"),
+              # xattr/omap surface (appended): xname/xop parameterize
+              # the op itself; gname/gop/gval are an OPTIONAL xattr
+              # guard evaluated atomically (under pg.lock) before ANY
+              # op executes — the single-guard reduction of the
+              # reference's multi-op transaction vectors, where a
+              # failed CMPXATTR aborts the ops after it
+              ("xname", "str"), ("xop", "u8"),
+              ("gname", "str"), ("gop", "u8"), ("gval", "bytes")]
 
 
 class MOSDOpReply(Message):
@@ -211,7 +244,12 @@ class MMonHB(Message):
     lowest-ranked live peer."""
     MSG_TYPE = 40
     FIELDS = [("rank", "i32"), ("name", "str"),
-              ("last_committed", "u64"), ("addr", "str")]
+              ("last_committed", "u64"), ("addr", "str"),
+              # lease grant seconds (appended; 0 = no grant): only a
+              # leader that itself sees a quorum hands these out — a
+              # deposed-but-unaware minority leader must not keep its
+              # peons' read leases alive (Paxos.cc extend_lease role)
+              ("lease", "f64")]
 
 
 class MPaxosCommit(Message):
@@ -338,7 +376,10 @@ class MECSubReadReply(Message):
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("oid", "str"), ("code", "i32"),
               ("data", "bytes"), ("attrs", "bytes_map"),
-              ("version", "u64"), ("crc", "u32")]
+              ("version", "u64"), ("crc", "u32"),
+              # object omap for replicated-pool pulls (appended;
+              # served only on want_attrs full-object reads)
+              ("omap", "bytes_map")]
 
 
 # -- recovery (MOSDPGPush role) ----------------------------------------
@@ -353,7 +394,10 @@ class MPGPush(Message):
     FIELDS = [("pool", "i32"), ("ps", "u32"), ("shard", "u8"),
               ("oid", "str"), ("version", "u64"), ("data", "bytes"),
               ("attrs", "bytes_map"), ("remove", "bool"),
-              ("tid", "u64")]
+              ("tid", "u64"),
+              # client omap rides replicated-pool pushes (appended;
+              # EC pools reject omap, matching the reference)
+              ("omap", "bytes_map")]
 
 
 class MPGPushReply(Message):

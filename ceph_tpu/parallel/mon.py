@@ -27,8 +27,13 @@ commands) and ConfigMonitor. Collapsed here to one daemon class with:
     failover, so a client retry attaches to the original execution).
     Remaining reduction vs the reference: values are full-state
     snapshots (no per-value log transfer; catch-up and commit are
-    the same message) and there is no lease machinery — peons serve
-    reads from their last committed state.
+    the same message). Reads are LEASE-bounded (Paxos.h:174 lease
+    fields, Paxos.cc extend_lease role): the leader's heartbeats and
+    commit replications grant peons a mon_lease window during which
+    they may answer read-only commands from committed state; an
+    expired lease (partitioned peon, deposed-but-unaware leader)
+    answers EAGAIN instead of unboundedly stale state, and clients
+    rotate to a mon that can serve.
   - OSDMonitor logic: MOSDBoot marks OSDs up (new epoch), failure
     reports and beacon-timeout mark them down (OSDMap epochs move
     forward only), pool/EC-profile commands validated by actually
@@ -138,6 +143,11 @@ class Monitor:
         # (holds the waiting connections) + completed-reply LRU
         from ceph_tpu.utils.lru import BoundedLRU
         self._cmd_dedup: BoundedLRU = BoundedLRU(1024)
+        #: monotonic deadline until which this PEON may serve reads
+        #: from committed state (granted by leader HBs/commits —
+        #: Paxos lease role; the leader's own lease is quorum
+        #: visibility, see _lease_valid)
+        self._lease_until = 0.0
         self._replay()
 
     # -- lifecycle ----------------------------------------------------
@@ -256,6 +266,19 @@ class Monitor:
     # -- quorum (Paxos/Elector roles) ---------------------------------
     def is_leader(self) -> bool:
         return self._leader_rank == self.rank
+
+    def _lease_valid(self, now: float) -> bool:
+        """May this mon answer reads from its committed state? (the
+        Paxos lease contract, src/mon/Paxos.h:174 / Paxos.cc
+        extend_lease): a single mon always may; the leader may while
+        it can see a quorum (a partitioned minority 'leader' goes
+        read-dark within mon_election_timeout); a peon may while the
+        leader's heartbeat/commit lease grant is unexpired."""
+        if len(self.monmap) <= 1:
+            return True
+        if self.is_leader():
+            return len(self._alive_ranks(now)) >= self._majority()
+        return now < self._lease_until
 
     def leader_addr(self) -> str:
         return self.monmap.get(self._leader_rank, self.addr)
@@ -559,6 +582,10 @@ class Monitor:
         version number with different states."""
         if msg.version < self._last_committed():
             return
+        if msg.rank == self._leader_rank and msg.rank != self.rank:
+            # a commit from the leader is also a lease grant: after
+            # applying it we hold exactly the leader's state
+            self._lease_until = time.monotonic() + g_conf()["mon_lease"]
         if msg.version == self._last_committed() and (
                 self.is_leader() or msg.rank != self._leader_rank):
             return
@@ -649,10 +676,21 @@ class Monitor:
     def _dispatch(self, msg: M.Message, conn: Connection) -> None:
         with self._lock:
             if isinstance(msg, M.MMonHB):
-                self._peer_seen[msg.rank] = (time.monotonic(),
-                                             msg.last_committed)
+                now = time.monotonic()
+                self._peer_seen[msg.rank] = (now, msg.last_committed)
                 if msg.addr:     # revived mons rebind to a new port
                     self.monmap[msg.rank] = msg.addr
+                if msg.rank == self._leader_rank and \
+                        msg.rank != self.rank and msg.lease > 0 and \
+                        msg.last_committed <= self._last_committed():
+                    # lease grant/extension (Paxos.cc extend_lease
+                    # role): the leader is at least as advanced as us
+                    # AND itself quorum-visible (lease > 0 — a deposed
+                    # minority leader keeps heartbeating but grants
+                    # nothing, so our lease expires). A leader ahead
+                    # of us grants nothing either (we are stale; the
+                    # elect pump pulls its commit first).
+                    self._lease_until = now + msg.lease
                 return
             if isinstance(msg, M.MPaxosCommit):
                 # the committer provably has this version: advance our
@@ -716,6 +754,25 @@ class Monitor:
                 conn.send_message(M.MConfig(
                     config=dict(self._central_config)))
             elif isinstance(msg, M.MMonCommand):
+                if msg.cmd.get("prefix", "") in _READONLY_COMMANDS:
+                    # reads serve from committed state on ANY mon —
+                    # but only under a valid lease (Paxos lease role):
+                    # a partitioned peon or quorum-less leader answers
+                    # EAGAIN instead of unboundedly stale state
+                    now = time.monotonic()
+                    if self._lease_valid(now):
+                        code, outs, data = self._handle_command(
+                            dict(msg.cmd))
+                        conn.send_message(M.MMonCommandReply(
+                            tid=msg.tid, code=code, outs=outs,
+                            data=data))
+                    else:
+                        conn.send_message(M.MMonCommandReply(
+                            tid=msg.tid, code=-11,
+                            outs="EAGAIN read lease expired "
+                                 "(no reachable quorum/leader)",
+                            data=b""))
+                    return
                 if not self.is_leader():
                     # clients re-target on this redirect
                     conn.send_message(M.MMonCommandReply(
@@ -731,18 +788,8 @@ class Monitor:
         mutation folded into the next proposal. The reply defers until
         the proposal commits (quorum accepted) — the Paxos contract
         that a minority leader can never ack. Caller holds the lock."""
-        prefix = msg.cmd.get("prefix", "")
-        if prefix in _READONLY_COMMANDS:
-            # reads answer immediately from COMMITTED state (peons do
-            # too, via redirect->leader; the reference serves reads
-            # under the leader lease): queuing them behind the
-            # proposal pipeline would tax every status poll with a
-            # full-state scratch copy and block reads for
-            # mon_commit_timeout on a stalled/minority leader
-            code, outs, data = self._handle_command(dict(msg.cmd))
-            conn.send_message(M.MMonCommandReply(
-                tid=msg.tid, code=code, outs=outs, data=data))
-            return
+        # (read-only commands never reach here: _dispatch serves them
+        # lease-gated from committed state on any mon)
         key = f"{conn.peer_name}|{msg.tid}"
         rep = self._cmd_replies.get(key)
         if rep is not None:
@@ -910,13 +957,16 @@ class Monitor:
         grace = g_conf()["osd_heartbeat_grace"] * 2  # mon backstop
         now = time.monotonic()
         with self._lock:
-            # quorum upkeep: beacon peers, re-derive the leader
+            # quorum upkeep: beacon peers, re-derive the leader. Only
+            # a quorum-visible leader grants read leases with its HBs.
+            grant = g_conf()["mon_lease"] \
+                if self.is_leader() and self._lease_valid(now) else 0.0
             for rank, addr in self.monmap.items():
                 if rank != self.rank:
                     self.msgr.send_message(M.MMonHB(
                         rank=self.rank, name=self.name,
                         last_committed=self._last_committed(),
-                        addr=self.addr), addr)
+                        addr=self.addr, lease=grant), addr)
             if len(self.monmap) > 1:
                 self._elect(now)
             # paxos upkeep: a proposal that cannot gather a quorum

@@ -239,4 +239,14 @@ class MonClient:
                         f"mon command {cmd.get('prefix')!r}: "
                         "no leader found")
                 continue
+            if reply.code == -11 and reply.outs.startswith("EAGAIN"):
+                # read lease expired on this mon (partitioned peon /
+                # quorum-less leader): another mon may hold a valid
+                # lease — rotate and retry until the deadline
+                if len(self.mon_addrs) > 1 and \
+                        time.monotonic() < deadline:
+                    self._rotate()
+                    time.sleep(0.1)
+                    continue
+                return reply.code, reply.outs, reply.data
             return reply.code, reply.outs, reply.data

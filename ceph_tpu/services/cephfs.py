@@ -16,9 +16,21 @@ journal provides coming from in-OSD object-class methods instead:
 
 API mirrors libcephfs: mkdir/rmdir/readdir, open/read/write, unlink,
 rename, stat. Reductions (documented): no hard links across dirs; no
-permissions/uids; one flat namespace per pool; single active
-metadata writer (the MDS role — the journal assumes one, like the
-reference's single-active-MDS rank).
+permissions/uids; one flat namespace per pool.
+
+Concurrent mounts are first-class (round 3): the mdslog journal runs
+in multi-writer mode (atomic position allocator + OSD-atomic chunk
+appends; each mount tracks its own commit position), dir mutations
+were already atomic in-OSD cls methods, and per-file CAPABILITIES
+(src/mds/Capability.h role) coordinate data access — shared-read /
+exclusive-write leases taken through the cls lock family on a
+``caps.<ino>`` object. A cap is a TTL lease: its holder may cache the
+inode while it holds the cap; a conflicting opener blocks until
+release or lease expiry (the reference's cap revoke collapsed to
+lease expiry — there is no MDS daemon to recall through). A mount
+that dies without ``umount()`` pins the journal trim floor at its
+last commit until a later mount re-commits past it (the reference
+evicts such sessions; space-only, never correctness).
 
 Metadata journaling (the osdc/Journaler + MDLog role): every
 MULTI-STEP namespace op (mkdir/create/unlink/rmdir/rename) appends an
@@ -44,8 +56,15 @@ from ceph_tpu.services.journal import Journaler, JournalError
 ROOT_INO = 1
 SUPER_OID = ".fs_super"
 
-#: the metadata writer's journal-client id (single active MDS rank)
+#: legacy journal-client id (pre-multi-writer mounts); still honored
+#: in the replay floor so an old journal replays correctly
 MDS_CLIENT = "mds"
+
+#: capability lease (Capability.h role): seconds a shared/exclusive
+#: file cap stays valid without renewal; a dead holder's cap expires
+#: and a blocked conflicting opener proceeds
+CAP_TTL = 2.0
+CAP_NAME = "fscap"
 
 
 class FSError(Exception):
@@ -58,17 +77,38 @@ class CephFS:
     """A mounted filesystem (libcephfs ceph_mount role)."""
 
     def __init__(self, ioctx, layout: FileLayout | None = None,
-                 journaling: bool = True) -> None:
+                 journaling: bool = True, caps: bool = True,
+                 client_id: str | None = None) -> None:
         self.io = ioctx
         self.layout = layout or FileLayout(stripe_unit=1 << 20,
                                            stripe_count=1,
                                            object_size=1 << 20)
-        self.journal = Journaler(self.io, "mdslog") if journaling \
-            else None
+        if client_id is None:
+            import uuid
+            client_id = f"mds-{uuid.uuid4().hex[:8]}"
+        self.client_id = client_id
+        self.caps_enabled = caps
+        self.journal = Journaler(self.io, "mdslog",
+                                 multi_writer=True) \
+            if journaling else None
         import threading
         self._mds_lock = threading.Lock()
-        self._mds_pos = 0            # next position to commit
-        self._mds_done: set[int] = set()
+        self._mds_pos = 0            # own commit floor
+        #: positions THIS mount allocated and has not yet completed
+        self._mds_pending: set[int] = set()
+        #: MOUNT-level cap table (Capability.h: caps belong to the
+        #: CLIENT session, not the fd): ino -> (type, expires). All
+        #: File handles of this mount share one cls-lock cookie, so
+        #: acquisition must go through here — a handle re-locking the
+        #: shared cookie with a weaker type would silently downgrade
+        #: a sibling handle's exclusive cap on the server.
+        self._caps: dict[int, tuple[str, float]] = {}
+        self._caps_lock = threading.Lock()
+        #: MOUNT-level inode cache, valid only while the mount's cap
+        #: on that ino is valid. Shared across handles: a sibling
+        #: handle's write must be visible to every reader of this
+        #: mount, cap or no cap (same-client coherence).
+        self._ino_cache: dict[int, dict] = {}
         if self.journal is not None:
             if not self.journal.exists():
                 self.journal.create()
@@ -80,55 +120,93 @@ class CephFS:
             self._write_inode(ROOT_INO, {
                 "type": "dir", "entries": {}, "mtime": time.time()})
 
+    def umount(self, drain_timeout: float = 5.0) -> None:
+        """Clean unmount: release every held cap (a waiting opener on
+        another mount proceeds immediately), drain in-flight dirops,
+        and retire this mount's journal client (its commit position
+        stops pinning the trim floor for good — the session-eviction
+        role). If dirops fail to drain within ``drain_timeout`` the
+        retirement is skipped LOUDLY — the client id stays pinned so
+        the un-finished intents remain replayable."""
+        for ino in list(self._caps):
+            self._cap_release(ino)
+        if self.journal is None:
+            return
+        deadline = time.time() + drain_timeout
+        while time.time() < deadline:
+            with self._mds_lock:
+                if not self._mds_pending:
+                    self.journal.retire(self.client_id)
+                    return
+            time.sleep(0.05)
+        import sys
+        print(f"cephfs umount: {len(self._mds_pending)} dirops still "
+              f"pending after {drain_timeout}s; journal client "
+              f"{self.client_id} NOT retired (its intents stay "
+              "replayable)", file=sys.stderr)
+
     # -- MDS journal (osdc/Journaler + MDLog roles) -------------------
     def _replay_mds_tail(self) -> None:
         """Mount-time recovery (the standby-MDS replay): re-execute
-        journaled intents the previous writer never completed. Steps
-        are idempotent-tolerant, so replaying an op that partially
-        (or fully) applied converges."""
+        journaled intents from the lowest committed position of ANY
+        registered mount — a crashed mount's half-done op is finished
+        here. Steps are idempotent-tolerant, so replaying an op that
+        partially (or fully) applied — even one a LIVE mount is
+        executing concurrently — converges."""
         try:
             end = self.journal.end_position()
         except JournalError:
             return
-        pos = self.journal.committed(MDS_CLIENT)
-        applied = min(pos, end)
+        clients = self.journal.clients()
+        floor = min(clients.values()) if clients \
+            else self.journal.trim_floor()
+        applied = max(min(floor, end), self.journal.trim_floor())
+        clean = True
         try:
             for epos, payload in self.journal.read_from(applied):
                 self._apply_mds_event(json.loads(payload))
                 applied = epos + 1
         except JournalError:
-            pass            # commit only the prefix that applied
+            clean = False   # commit only the prefix that applied: a
+            # transient chunk-read failure must NOT advance the floor
+            # past un-replayed intents (a later mount re-attempts)
+        if clean:
+            # trailing hole positions (alloc'd, never appended) have
+            # nothing to replay: the floor may cover them
+            applied = max(applied, end)
         self._mds_pos = applied
-        self.journal.commit(MDS_CLIENT, applied)
+        self.journal.commit(self.client_id, applied)
 
     def _mds_event(self, op: str, **args) -> int | None:
         if self.journal is None:
             return None
-        return self.journal.append(
-            json.dumps({"op": op, **args}).encode())
+        payload = json.dumps({"op": op, **args}).encode()
+        with self._mds_lock:
+            pos = self.journal.append(payload)
+            self._mds_pending.add(pos)
+        return pos
 
     def _mds_committed(self, pos: int | None) -> None:
         """Mark an op's intent completed — including DELIBERATE
         failures (EEXIST etc.): only a crash mid-steps may leave an
-        intent for replay. The commit pointer advances over the
-        CONTIGUOUS prefix of completed positions (concurrent dirops
-        finish out of order; a naive equals-check would freeze the
-        pointer forever after the first inversion, and a later mount
-        would replay stale completed intents — unlink/rename replays
-        that name-match objects re-created since: data loss)."""
+        intent for replay. This mount's commit position advances to
+        just below its OLDEST still-pending op (positions interleave
+        across mounts; other mounts' positions never hold ours back —
+        each mount's pointer promises only 'none of MY incomplete ops
+        are below this')."""
         if self.journal is None or pos is None:
             return
         with self._mds_lock:
-            self._mds_done.add(pos)
+            self._mds_pending.discard(pos)
             old_pos = self._mds_pos
-            while self._mds_pos in self._mds_done:
-                self._mds_done.discard(self._mds_pos)
-                self._mds_pos += 1
-            if self._mds_pos != old_pos:
-                self.journal.commit(MDS_CLIENT, self._mds_pos)
+            new_pos = min(self._mds_pending) if self._mds_pending \
+                else pos + 1
+            if new_pos > old_pos:
+                self._mds_pos = new_pos
+                self.journal.commit(self.client_id, new_pos)
                 # boundary-crossing check: out-of-order completion can
                 # advance PAST a multiple of 128 in one step
-                if old_pos // 128 != self._mds_pos // 128:
+                if old_pos // 128 != new_pos // 128:
                     # reclaim consumed journal chunks (the reference
                     # trims MDLog segments the same way); without this
                     # the journal grows one entry per dirop forever
@@ -302,6 +380,103 @@ class CephFS:
             raise FSError(errno.EISDIR, path)
         return File(self, ino)
 
+    # -- capabilities (Capability.h role, per-mount session) ----------
+    def cap_holders(self, path: str) -> dict:
+        """Live cap lockers of a file: {"name/cookie": {"type", ...}}
+        (the MDS's cap tracking, surfaced for tests/tools)."""
+        ino, _ = self._resolve(path)
+        out = self.io.execute(f"caps.{ino}", "lock", "info")
+        return json.loads(out).get("lockers", {})
+
+    def _cap_acquire(self, ino: int, want: str,
+                     timeout: float) -> None:
+        """Take/renew this MOUNT's cap on ``ino`` — never weaker than
+        what the mount already holds (an exclusive cap covers shared
+        requests; re-locking the shared cookie with 'shared' would
+        downgrade a sibling handle's exclusive on the server). The
+        lease deadline is stamped from BEFORE the lock RPC, so the
+        client-side expiry is always <= the server-side one. The
+        table lock guards only table reads/writes — the RPC runs
+        OUTSIDE it, so a contended file never stalls cap checks of
+        other files in this mount."""
+        if not self.caps_enabled:
+            return
+        from ceph_tpu.client.rados import RadosError
+        deadline = time.time() + timeout
+        while True:
+            with self._caps_lock:
+                cur = self._caps.get(ino)
+                now = time.time()
+                if cur is not None and now < cur[1] - CAP_TTL / 2 \
+                        and (cur[0] == want or cur[0] == "exclusive"):
+                    return              # held, fresh, and sufficient
+                eff = "exclusive" if want == "exclusive" or (
+                    cur is not None and cur[0] == "exclusive"
+                    and now < cur[1]) else want
+            t_req = time.time()
+            try:
+                self.io.execute(
+                    f"caps.{ino}", "lock", "lock",
+                    json.dumps({"name": CAP_NAME,
+                                "cookie": self.client_id,
+                                "type": eff,
+                                "duration": CAP_TTL}).encode())
+                with self._caps_lock:
+                    # keep the strongest view: a concurrent acquirer
+                    # may have upgraded while our RPC was in flight
+                    cur = self._caps.get(ino)
+                    if cur is None or cur[0] != "exclusive" or \
+                            eff == "exclusive":
+                        self._caps[ino] = (eff, t_req + CAP_TTL)
+                return
+            except RadosError as exc:
+                if exc.code != -16:      # not EBUSY
+                    raise FSError(-exc.code) from None
+                with self._caps_lock:
+                    self._caps.pop(ino, None)
+                    self._ino_cache.pop(ino, None)
+            if time.time() >= deadline:
+                raise FSError(errno.EAGAIN,
+                              "file cap held by another client")
+            time.sleep(0.05)
+
+    def _cap_release(self, ino: int) -> None:
+        """Drop the mount's cap on ``ino`` (all handles lose it; the
+        next op re-acquires)."""
+        with self._caps_lock:
+            held = self._caps.pop(ino, None)
+            self._ino_cache.pop(ino, None)
+        if held is None or not self.caps_enabled:
+            return
+        from ceph_tpu.client.rados import RadosError
+        try:
+            self.io.execute(
+                f"caps.{ino}", "lock", "unlock",
+                json.dumps({"name": CAP_NAME,
+                            "cookie": self.client_id}).encode())
+        except RadosError:
+            pass                        # already expired/stolen
+
+    def _cap_valid(self, ino: int) -> bool:
+        with self._caps_lock:
+            cur = self._caps.get(ino)
+            return cur is not None and time.time() < cur[1]
+
+    def _cached_inode(self, ino: int) -> "dict | None":
+        """Mount-level cached inode, valid only under a live cap."""
+        with self._caps_lock:
+            cur = self._caps.get(ino)
+            if cur is None or time.time() >= cur[1]:
+                self._ino_cache.pop(ino, None)
+                return None
+            return self._ino_cache.get(ino)
+
+    def _cache_inode(self, ino: int, inode: dict) -> None:
+        with self._caps_lock:
+            cur = self._caps.get(ino)
+            if cur is not None and time.time() < cur[1]:
+                self._ino_cache[ino] = inode
+
     def unlink(self, path: str) -> None:
         ino, inode = self._resolve(path)
         if inode["type"] == "dir":
@@ -336,23 +511,78 @@ class CephFS:
 
 
 class File:
-    """An open file handle (libcephfs Fh role)."""
+    """An open file handle (libcephfs Fh role) with per-file
+    CAPABILITIES (src/mds/Capability.h role, reduced to leases):
+
+    - reads take a SHARED cap, writes an EXCLUSIVE cap, on the file's
+      ``caps.<ino>`` object via the cls lock family — any number of
+      readers, one writer, cluster-wide;
+    - a cap is a CAP_TTL lease renewed lazily by use; while held, the
+      inode may be cached (cache validity == cap validity — the
+      coherence contract caps exist for);
+    - a conflicting opener blocks until release or lease expiry
+      (the reference's revoke recall, collapsed to lease expiry), then
+      raises EAGAIN past ``cap_timeout``.
+    """
 
     def __init__(self, fs: CephFS, ino: int) -> None:
         self.fs = fs
         self.ino = ino
         self._data = StripedObject(fs.io, f"fsdata.{ino}", fs.layout)
+        self.cap_timeout = 10.0
 
-    def write(self, data: bytes, offset: int = 0) -> int:
-        self._data.write(data, offset=offset)
+    # -- caps (delegated to the MOUNT's session table) ----------------
+    def _acquire_cap(self, want: str) -> None:
+        self.fs._cap_acquire(self.ino, want, self.cap_timeout)
+
+    def release(self) -> None:
+        """Drop the mount's cap on this file (libcephfs close role): a
+        waiting conflicting opener proceeds immediately instead of at
+        lease expiry. Sibling handles of the same mount simply
+        re-acquire on their next op."""
+        self.fs._cap_release(self.ino)
+
+    close = release
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _inode(self) -> dict:
+        """Inode view — cached at the MOUNT level while the mount's
+        cap on this ino is unexpired (sibling handles of one mount
+        share the cache, so one handle's write is visible to the
+        others immediately); re-read otherwise."""
+        if self.fs.caps_enabled:
+            cached = self.fs._cached_inode(self.ino)
+            if cached is not None:
+                return cached
         inode = self.fs._read_inode(self.ino)
+        if self.fs.caps_enabled:
+            self.fs._cache_inode(self.ino, inode)
+        return inode
+
+    def _put_inode(self, inode: dict) -> None:
+        self.fs._write_inode(self.ino, inode)
+        if self.fs.caps_enabled:
+            self.fs._cache_inode(self.ino, inode)
+
+    # -- I/O ----------------------------------------------------------
+    def write(self, data: bytes, offset: int = 0) -> int:
+        self._acquire_cap("exclusive")
+        self._data.write(data, offset=offset)
+        inode = self._inode()
+        inode = dict(inode)
         inode["size"] = max(inode.get("size", 0), offset + len(data))
         inode["mtime"] = time.time()
-        self.fs._write_inode(self.ino, inode)
+        self._put_inode(inode)
         return len(data)
 
     def read(self, length: int | None = None, offset: int = 0) -> bytes:
-        inode = self.fs._read_inode(self.ino)
+        self._acquire_cap("shared")
+        inode = self._inode()
         size = inode.get("size", 0)
         if length is None:
             length = max(size - offset, 0)
@@ -363,11 +593,13 @@ class File:
         return out + b"\x00" * (length - len(out))
 
     def truncate(self, size: int) -> None:
-        inode = self.fs._read_inode(self.ino)
+        self._acquire_cap("exclusive")
+        inode = dict(self._inode())
         inode["size"] = size
-        self.fs._write_inode(self.ino, inode)
+        self._put_inode(inode)
         self._data.size = min(self._data.size, size)
         self._data._write_meta()
 
     def size(self) -> int:
-        return self.fs._read_inode(self.ino).get("size", 0)
+        self._acquire_cap("shared")
+        return self._inode().get("size", 0)

@@ -10,12 +10,22 @@ appended to chunk objects (``<name>.<chunk>``, SPLAY entries per chunk
 positions are tracked, and trim removes chunks every client has fully
 committed.
 
-Single-writer by design (the image holds the exclusive lock in the
+Single-writer by default (the image holds the exclusive lock in the
 reference; our writer is the opened primary image). Writer and reader
 state are SEPARATE objects — the writer owns the header ({entries}),
 each reader owns its commit-position object, and the trimmer owns the
 floor object — so a replayer running concurrently with the writer
 never read-modify-writes the other side's state.
+
+``multi_writer=True`` (the cephfs mdslog: several mounts journal
+dirops concurrently) replaces the header read-modify-write with an
+ATOMIC position allocator (cls numops counter — the in-OSD method
+runs under the PG lock) plus the OSD's atomic byte-append into the
+chunk object; records carry their own position, so interleaved
+appends within a chunk need no ordering. A writer that dies between
+allocating a position and appending its record leaves a HOLE, which
+readers skip (an intent that was never durably journaled has, by
+definition, not executed any step yet — there is nothing to replay).
 """
 
 from __future__ import annotations
@@ -39,9 +49,11 @@ class JournalTrimmedError(JournalError):
 
 
 class Journaler:
-    def __init__(self, ioctx, name: str) -> None:
+    def __init__(self, ioctx, name: str,
+                 multi_writer: bool = False) -> None:
         self.io = ioctx
         self.name = name
+        self.multi_writer = multi_writer
         self.header_oid = f"journal.{name}"
         # per-instance caches (each client id is single-writer for its
         # own position, so commit() need not re-read the registry and
@@ -49,6 +61,7 @@ class Journaler:
         # image mutation)
         self._registered: set[str] = set()
         self._commit_cache: dict[str, int] = {}
+        self._seq_seeded = False
         import threading
         self._append_lock = threading.Lock()
 
@@ -82,7 +95,7 @@ class Journaler:
             entries = json.loads(out)
         except Exception:
             return []
-        seen = []
+        seen, retired = [], set()
         for entry in entries:
             # dict = cls_log entry; tolerate plain strings (a registry
             # object written by an older format must not crash commit)
@@ -90,9 +103,11 @@ class Journaler:
                 cid = entry.get("data", "")
             else:
                 cid = str(entry)
-            if cid and cid not in seen:
+            if cid.startswith("retired/"):
+                retired.add(cid[len("retired/"):])
+            elif cid and cid not in seen:
                 seen.append(cid)
-        return seen
+        return [c for c in seen if c not in retired]
 
     @property
     def _trim_oid(self) -> str:
@@ -104,6 +119,12 @@ class Journaler:
                                   "little")
         except Exception:
             return 0
+
+    def trim_floor(self) -> int:
+        """Lowest position still readable (positions below were
+        reclaimed): the replay start for a reader with no committed
+        position of its own."""
+        return self._trimmed_to()
 
     def create(self) -> None:
         self._save({"entries": 0})
@@ -117,9 +138,10 @@ class Journaler:
             return False
 
     def remove(self) -> None:
-        h = self._load()
+        self._load()
+        end = self.end_position()
         for chunk in range(self._trimmed_to() // SPLAY,
-                           -(-h["entries"] // SPLAY) + 1):
+                           -(-end // SPLAY) + 1):
             try:
                 self.io.remove(self._chunk_oid(chunk))
             except Exception:
@@ -129,7 +151,7 @@ class Journaler:
                 self.io.remove(self._client_oid(client))
             except Exception:
                 pass
-        for oid in (self._registry_oid, self._trim_oid):
+        for oid in (self._registry_oid, self._trim_oid, self._seq_oid):
             try:
                 self.io.remove(oid)
             except Exception:
@@ -139,17 +161,30 @@ class Journaler:
     def _chunk_oid(self, chunk: int) -> str:
         return f"{self.header_oid}.{chunk:08x}"
 
+    @property
+    def _seq_oid(self) -> str:
+        return f"{self.header_oid}.seq"
+
     # -- writer --------------------------------------------------------
     def append(self, payload: bytes) -> int:
-        """Append one entry; returns its position. The entry is durable
-        (RADOS-committed) before the header advances, so a reader never
-        sees a position without its entry.
+        """Append one entry; returns its position.
 
-        Serialized per INSTANCE (the header advance is a read-modify-
-        write; concurrent in-process writers — cephfs dirops run from
-        many threads — would assign the same position and lose
-        entries). Cross-process single-writer stays the documented
-        contract (the reference's exclusive lock)."""
+        Single-writer mode: the entry is durable (RADOS-committed)
+        before the header advances, so a reader never sees a position
+        without its entry; serialized per INSTANCE (the header advance
+        is a read-modify-write; concurrent in-process writers — dirops
+        run from many threads — would assign the same position and
+        lose entries).
+
+        Multi-writer mode: position from the atomic cls counter, then
+        an OSD-atomic append; safe from any number of mounts."""
+        if self.multi_writer:
+            pos = self._alloc_pos()
+            e = Encoder()
+            e.u64(pos)
+            e.bytes(payload)
+            self.io.append(self._chunk_oid(pos // SPLAY), e.getvalue())
+            return pos
         with self._append_lock:
             h = self._load()
             pos = h["entries"]
@@ -161,7 +196,42 @@ class Journaler:
             self._save(h)
             return pos
 
+    def _alloc_pos(self) -> int:
+        """Atomically allocate the next multi-writer position. A
+        journal UPGRADED from single-writer mode has entries 0..N-1
+        under the header counter and no seq object yet: the first
+        allocation seeds the seq PAST the header count (value=N+1 in
+        one atomic add), so new positions can never collide with
+        legacy records. Two mounts racing the seed both add N+1 —
+        that leaves a hole (tolerated), never a collision."""
+        bump = 1
+        if not self._seq_seeded:
+            try:
+                json.loads(self.io.read(self._seq_oid))
+                self._seq_seeded = True
+            except Exception:
+                try:
+                    bump = self._load()["entries"] + 1
+                except JournalError:
+                    bump = 1
+        out = self.io.execute(
+            self._seq_oid, "numops", "add",
+            json.dumps({"key": "seq", "value": bump}).encode())
+        self._seq_seeded = True
+        return int(json.loads(out)["seq"]) - 1
+
     def end_position(self) -> int:
+        if self.multi_writer:
+            try:
+                st = json.loads(self.io.read(self._seq_oid))
+                return int(st.get("seq", 0))
+            except Exception:
+                # pre-upgrade journal: no seq object yet — the legacy
+                # header count still bounds the replayable entries
+                try:
+                    return self._load()["entries"]
+                except JournalError:
+                    return 0
         return self._load()["entries"]
 
     # -- readers -------------------------------------------------------
@@ -173,8 +243,8 @@ class Journaler:
         read — a transient failure must surface, not silently end the
         stream (a replayer that mistook it for end-of-journal would
         advance its commit position past events it never applied)."""
-        h = self._load()
-        end = h["entries"]
+        self._load()                       # journal-exists check
+        end = self.end_position()
         floor = self._trimmed_to()
         if pos < floor:
             raise JournalTrimmedError(
@@ -184,15 +254,26 @@ class Journaler:
             try:
                 raw = self.io.read(self._chunk_oid(chunk))
             except Exception as exc:
+                if self.multi_writer and \
+                        getattr(exc, "code", None) == -2:
+                    # hole chunk: a writer allocated into it but died
+                    # before appending — nothing journaled, nothing
+                    # to replay
+                    chunk += 1
+                    continue
                 raise JournalError(
                     f"journal chunk {chunk} unreadable: {exc}") \
                     from exc
+            entries = []
             d = Decoder(raw)
             while not d.eof():
                 epos = d.u64()
                 payload = d.bytes()
                 if pos <= epos < end:
-                    yield epos, payload
+                    entries.append((epos, payload))
+            # multi-writer appends land in allocation order only
+            # per-writer; replay order must be global position order
+            yield from sorted(entries)
             chunk += 1
 
     # -- commit positions / trim ---------------------------------------
@@ -214,6 +295,24 @@ class Journaler:
             self.io.write_full(self._client_oid(client),
                                pos.to_bytes(8, "little"))
         self._commit_cache[client] = pos
+
+    def retire(self, client: str) -> None:
+        """Deregister a client for good (clean unmount / session
+        eviction role): its position no longer pins trim() and its
+        position object is removed. Tombstones ride the same atomic
+        registry log, so a concurrent registration cannot resurrect
+        it."""
+        try:
+            self.io.execute(self._registry_oid, "log", "add",
+                            f"retired/{client}".encode())
+        except Exception:
+            return                      # registry gone: nothing pins
+        try:
+            self.io.remove(self._client_oid(client))
+        except Exception:
+            pass
+        self._registered.discard(client)
+        self._commit_cache.pop(client, None)
 
     def committed(self, client: str) -> int:
         try:

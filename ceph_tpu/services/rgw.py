@@ -41,12 +41,84 @@ class RGWError(Exception):
 
 
 class RGWGateway:
-    """Gateway core (the librados-facing half of radosgw)."""
+    """Gateway core (the librados-facing half of radosgw).
+
+    The bucket index lives in the index object's OMAP — one key per
+    object entry — exactly as the reference's cls_rgw keeps it
+    (src/cls/rgw/ over omap), so concurrent puts never contend on a
+    serialized blob, and listings page server-side. The format is
+    decided ONCE at bucket creation and recorded as the index
+    object's "fmt" xattr: buckets created before the omap index (no
+    attr) keep their cls-blob index forever, and EC index pools —
+    where omap is rejected, reference parity — record "cls". Every
+    gateway then routes per bucket, so mixed-era buckets and
+    gateways can never split one index across two formats."""
 
     def __init__(self, ioctx) -> None:
         self.io = ioctx
         self._layout = FileLayout(stripe_unit=1 << 20, stripe_count=1,
                                   object_size=1 << 20)
+        self._fmt_cache: dict[str, str] = {}
+
+    # -- bucket index (cls_rgw bucket-index role) ----------------------
+    def _pool_omap(self) -> bool:
+        m = self.io.client.monc.osdmap
+        pool = m.pools.get(self.io.pool_id) if m else None
+        return pool is not None and not pool.is_ec
+
+    def _bucket_fmt(self, bucket: str) -> str:
+        fmt = self._fmt_cache.get(bucket)
+        if fmt is None:
+            try:
+                fmt = self.io.getxattr(f".bucket.{bucket}",
+                                       "fmt").decode()
+            except Exception:
+                fmt = "cls"            # legacy bucket: blob index
+            self._fmt_cache[bucket] = fmt
+        return fmt
+
+    def _index_add(self, bucket: str, key: str, size: int,
+                   etag: str) -> None:
+        if self._bucket_fmt(bucket) == "omap":
+            self.io.omap_set(
+                f".bucket.{bucket}",
+                {key: json.dumps({"size": size, "etag": etag}).encode()})
+        else:
+            self.io.execute(f".bucket.{bucket}", "rgw", "bucket_add",
+                            json.dumps({"key": key, "size": size,
+                                        "etag": etag}).encode())
+
+    def _index_rm(self, bucket: str, key: str) -> None:
+        """Raises RGWError 404 when the key is not in the index."""
+        from ceph_tpu.client.rados import RadosError
+        if self._bucket_fmt(bucket) == "omap":
+            oid = f".bucket.{bucket}"
+            if not self.io.omap_get(oid, [key]):
+                raise RGWError(404, "NoSuchKey")
+            self.io.omap_rm_keys(oid, [key])
+            return
+        try:
+            self.io.execute(f".bucket.{bucket}", "rgw", "bucket_rm",
+                            json.dumps({"key": key}).encode())
+        except RadosError as exc:
+            if exc.code == -2:
+                raise RGWError(404, "NoSuchKey") from None
+            raise
+
+    def _index_list(self, bucket: str, prefix: str, max_keys: int,
+                    marker: str) -> dict:
+        if self._bucket_fmt(bucket) == "omap":
+            # server-side page: transfer is proportional to max_keys,
+            # not the bucket size (omap-get-vals paging)
+            page = self.io.omap_get(f".bucket.{bucket}",
+                                    prefix=prefix, start_after=marker,
+                                    max_return=max_keys)
+            return {k: json.loads(v) for k, v in page.items()}
+        out = self.io.execute(
+            f".bucket.{bucket}", "rgw", "bucket_list",
+            json.dumps({"prefix": prefix, "max_keys": max_keys,
+                        "marker": marker}).encode())
+        return json.loads(out or b"{}")
 
     # -- buckets -------------------------------------------------------
     def _buckets(self) -> dict:
@@ -67,6 +139,9 @@ class RGWGateway:
         b[name] = {}
         self.io.write_full(BUCKETS_OID, json.dumps(b).encode())
         self.io.write_full(f".bucket.{name}", b"{}")
+        fmt = "omap" if self._pool_omap() else "cls"
+        self.io.setxattr(f".bucket.{name}", "fmt", fmt.encode())
+        self._fmt_cache[name] = fmt
 
     def delete_bucket(self, name: str) -> None:
         b = self._buckets()
@@ -94,9 +169,7 @@ class RGWGateway:
         if data:
             so.write(data)
         etag = hashlib.md5(data).hexdigest()
-        self.io.execute(f".bucket.{bucket}", "rgw", "bucket_add",
-                        json.dumps({"key": key, "size": len(data),
-                                    "etag": etag}).encode())
+        self._index_add(bucket, key, len(data), etag)
         return etag
 
     def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
@@ -110,24 +183,13 @@ class RGWGateway:
 
     def delete_object(self, bucket: str, key: str) -> None:
         self._check_bucket(bucket)
-        from ceph_tpu.client.rados import RadosError
-        try:
-            self.io.execute(f".bucket.{bucket}", "rgw", "bucket_rm",
-                            json.dumps({"key": key}).encode())
-        except RadosError as exc:
-            if exc.code == -2:
-                raise RGWError(404, "NoSuchKey")
-            raise
+        self._index_rm(bucket, key)
         StripedObject(self.io, f"{bucket}/{key}").remove()
 
     def list_objects(self, bucket: str, prefix: str = "",
                      max_keys: int = 1000, marker: str = "") -> dict:
         self._check_bucket(bucket)
-        out = self.io.execute(
-            f".bucket.{bucket}", "rgw", "bucket_list",
-            json.dumps({"prefix": prefix, "max_keys": max_keys,
-                        "marker": marker}).encode())
-        return json.loads(out or b"{}")
+        return self._index_list(bucket, prefix, max_keys, marker)
 
     # -- multipart uploads (src/rgw/rgw_multi.cc roles) ----------------
     # Parts land as independent striped objects under a hidden
@@ -150,9 +212,14 @@ class RGWGateway:
         self._check_bucket(bucket)
         import secrets
         upload_id = secrets.token_hex(16)
-        self.io.write_full(self._mp_oid(bucket, key, upload_id),
-                           json.dumps({"key": key,
-                                       "parts": {}}).encode())
+        moid = self._mp_oid(bucket, key, upload_id)
+        self.io.write_full(moid, json.dumps({"key": key,
+                                             "parts": {}}).encode())
+        if self._bucket_fmt(bucket) == "omap":
+            # liveness marker for the upload_part guard: an aborted
+            # upload's meta object is gone, so a guarded part record
+            # fails ATOMICALLY instead of resurrecting the object
+            self.io.setxattr(moid, "mp", b"1")
         return upload_id
 
     def upload_part(self, bucket: str, key: str, upload_id: str,
@@ -166,33 +233,55 @@ class RGWGateway:
         if data:
             so.write(data)
         etag = hashlib.md5(data).hexdigest()
-        # record the part via the ATOMIC in-OSD method: concurrent
-        # part uploads must not lose each other (a client-side RMW of
-        # the shared meta would — the reference uses cls_rgw omap ops
-        # for exactly this)
+        # record the part ATOMICALLY: concurrent part uploads must not
+        # lose each other. Omap pools write one omap key per part (the
+        # reference's cls_rgw-over-omap discipline); EC pools use the
+        # atomic in-OSD cls method over the meta blob.
         from ceph_tpu.client.rados import RadosError
+        from ceph_tpu.parallel import messages as _M
+        moid = self._mp_oid(bucket, key, upload_id)
         try:
-            self.io.execute(
-                self._mp_oid(bucket, key, upload_id), "rgw",
-                "mp_add_part",
-                json.dumps({"part": part_number, "size": len(data),
-                            "etag": etag}).encode())
+            if self._bucket_fmt(bucket) == "omap":
+                # guard on the liveness marker: the guard+omap_set
+                # pair evaluates atomically under the PG lock, so a
+                # racing abort (which removes the meta object) makes
+                # this fail instead of the OMAPSET's implicit touch
+                # resurrecting the upload
+                self.io.omap_set(
+                    moid, {f"{part_number:05d}": json.dumps(
+                        {"size": len(data), "etag": etag}).encode()},
+                    guard=("mp", _M.CMPXATTR_EQ, b"1"))
+            else:
+                self.io.execute(
+                    moid, "rgw", "mp_add_part",
+                    json.dumps({"part": part_number,
+                                "size": len(data),
+                                "etag": etag}).encode())
         except RadosError as exc:
-            if exc.code == -2:
+            if exc.code in (-2, -125):    # ENOENT / guard miss
                 raise RGWError(404, "NoSuchUpload") from None
             raise
         return etag
 
+    def _mp_parts(self, bucket: str, key: str,
+                  upload_id: str) -> dict:
+        """{str(part_number): {"size", "etag"}} for the upload
+        (raises NoSuchUpload when the meta object is gone)."""
+        meta = self._mp_meta(bucket, key, upload_id)
+        if self._bucket_fmt(bucket) != "omap":
+            return meta["parts"]
+        omap = self.io.omap_get(self._mp_oid(bucket, key, upload_id))
+        return {str(int(k)): json.loads(v) for k, v in omap.items()}
+
     def list_parts(self, bucket: str, key: str,
                    upload_id: str) -> dict:
-        return self._mp_meta(bucket, key, upload_id)["parts"]
+        return self._mp_parts(bucket, key, upload_id)
 
     def complete_multipart(self, bucket: str, key: str, upload_id: str,
                            parts: list[tuple[int, str]]) -> str:
         """``parts``: the client's (part_number, etag) manifest — must
         match what was uploaded, ascending (S3 CompleteMultipart)."""
-        meta = self._mp_meta(bucket, key, upload_id)
-        have = meta["parts"]
+        have = self._mp_parts(bucket, key, upload_id)
         nums = [p for p, _ in parts]
         if not parts or any(b <= a for a, b in zip(nums, nums[1:])):
             # strictly ascending, unique (S3 InvalidPartOrder —
@@ -215,16 +304,13 @@ class RGWGateway:
         final_etag = (hashlib.md5(digests).hexdigest()
                       + f"-{len(parts)}")
         # the S3 multipart etag replaces the plain-md5 one
-        self.io.execute(f".bucket.{bucket}", "rgw", "bucket_add",
-                        json.dumps({"key": key, "size": len(body),
-                                    "etag": final_etag}).encode())
+        self._index_add(bucket, key, len(body), final_etag)
         self.abort_multipart(bucket, key, upload_id)
         return final_etag
 
     def abort_multipart(self, bucket: str, key: str,
                         upload_id: str) -> None:
-        meta = self._mp_meta(bucket, key, upload_id)
-        for num in meta["parts"]:
+        for num in self._mp_parts(bucket, key, upload_id):
             StripedObject(self.io, self._mp_oid(bucket, key, upload_id,
                                                 int(num))).remove()
         try:

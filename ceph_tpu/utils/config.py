@@ -274,6 +274,10 @@ for _o in [
            "within this many seconds"),
     Option("mon_election_timeout", float, 2.0, "advanced",
            "mon election timeout seconds"),
+    Option("mon_lease", float, 5.0, "advanced",
+           "seconds a peon may serve reads from committed state after "
+           "a leader heartbeat/commit grant (Paxos lease, "
+           "src/mon/Paxos.h:174; reference default 5)"),
     Option("debug_default_level", int, 1, "advanced",
            "default per-subsystem log level", min=0, max=30),
     Option("log_ring_size", int, 10000, "advanced",

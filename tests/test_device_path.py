@@ -118,6 +118,150 @@ def test_engine_barrier_ordering_and_error_fallback():
         eng.stop()
 
 
+def test_poisoned_fused_flush_completes_and_counts(monkeypatch):
+    """A broken fused/mesh flush path must complete the writes on the
+    plain path AND increment device_fused_fallbacks — not silently
+    degrade (r2 verdict weak #3)."""
+    from ceph_tpu.osd import ec_util
+
+    monkeypatch.setenv("CEPH_TPU_FUSE_CRC", "1")
+
+    def boom(*a, **k):
+        raise RuntimeError("poisoned fused path")
+
+    monkeypatch.setattr(ec_util, "_flush_device_fused", boom)
+    codec = _codec(backend="jax")
+    sinfo = StripeInfo(stripe_width=2 * 1024, chunk_size=1024)
+    eng = DeviceEncodeEngine(lambda key, fn: fn())
+    try:
+        rng = np.random.default_rng(3)
+        payload = rng.integers(0, 256, 4096, dtype=np.uint8)
+        got = []
+        eng.stage_encode("pg0", codec, sinfo, payload,
+                         lambda s, c, e: got.append((s, c, e)))
+        deadline = time.monotonic() + 15
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got, "write never completed"
+        shards, crcs, err = got[0]
+        assert err is None and shards is not None
+        ref = ec_util.encode(sinfo, _codec(), payload)
+        for pos in ref:
+            assert np.array_equal(np.asarray(shards[pos]), ref[pos])
+        assert eng.stats["device_fused_fallbacks"] == 1, eng.stats
+        # log-once: a second poisoned flush counts again but the
+        # engine keeps completing writes
+        got.clear()
+        eng.stage_encode("pg0", codec, sinfo, payload,
+                         lambda s, c, e: got.append((s, c, e)))
+        deadline = time.monotonic() + 15
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got and got[0][2] is None
+        assert eng.stats["device_fused_fallbacks"] == 2
+    finally:
+        eng.stop()
+
+
+def test_engine_decode_batches_by_signature():
+    """Concurrent reconstructs with the same erasure signature
+    coalesce into ONE device matmul; different signatures flush as
+    separate launches; results are bit-exact vs the host decode."""
+    from ceph_tpu.osd import ec_util
+
+    codec = _codec(k=4, m=2)
+    sinfo = StripeInfo(stripe_width=4 * 1024, chunk_size=1024)
+    in_first = threading.Event()
+    release = threading.Event()
+    orig = codec._matvec
+    calls = []
+
+    def gated(mat, data):
+        calls.append(mat.shape)
+        if len(calls) == 1:
+            in_first.set()
+            release.wait(10)
+        return orig(mat, data)
+
+    codec._matvec = gated
+    eng = DeviceEncodeEngine(lambda key, fn: fn())
+    try:
+        rng = np.random.default_rng(1)
+        host = _codec(k=4, m=2)
+        payloads = [rng.integers(0, 256, 8192, dtype=np.uint8)
+                    for _ in range(9)]
+        full = [ec_util.encode(sinfo, host, p) for p in payloads]
+        # keep the engine busy so the staged decodes pile up
+        eng.stage_encode("pgX", codec, sinfo, payloads[0],
+                         lambda s, c, e: None)
+        assert in_first.wait(10)
+        results: dict[int, dict] = {}
+        done = threading.Event()
+
+        def mk(i):
+            def cont(out, err):
+                assert err is None, err
+                results[i] = out
+                if len(results) == 8:
+                    done.set()
+            return cont
+
+        for i in range(8):
+            shards = dict(full[i])
+            if i < 6:
+                del shards[1]            # signature A: lost chunk 1
+                eng.stage_decode(f"pg{i}", codec, sinfo, shards,
+                                 [0, 1, 2, 3], mk(i))
+            else:
+                del shards[0]
+                del shards[3]            # signature B: lost 0 and 3
+                eng.stage_decode(f"pg{i}", codec, sinfo, shards,
+                                 [0, 1, 2, 3], mk(i))
+        release.set()
+        assert done.wait(15)
+        # 6 sig-A ops in one launch, 2 sig-B ops in another
+        assert eng.stats["decode_flushes"] == 2, eng.stats
+        assert eng.stats["decode_ops"] == 8
+        assert eng.stats["max_decode_batch_ops"] == 6, eng.stats
+        for i in range(8):
+            for c in range(4):
+                assert np.array_equal(
+                    np.asarray(results[i][c]), full[i][c]), (i, c)
+    finally:
+        eng.stop()
+
+
+def test_engine_decode_sync_and_error_fallback():
+    from ceph_tpu.osd import ec_util
+
+    codec = _codec(k=2, m=1)
+    sinfo = StripeInfo(stripe_width=2 * 1024, chunk_size=1024)
+    eng = DeviceEncodeEngine(lambda key, fn: fn())
+    try:
+        rng = np.random.default_rng(2)
+        payload = rng.integers(0, 256, 4096, dtype=np.uint8)
+        full = ec_util.encode(sinfo, _codec(k=2, m=1), payload)
+        shards = {0: full[0], 2: full[2]}      # chunk 1 lost
+        out = eng.decode_sync("pg0", codec, sinfo, shards, [0, 1])
+        assert out is not None
+        assert np.array_equal(np.asarray(out[1]), full[1])
+
+        # a device fault surfaces as None (caller host-falls-back),
+        # never wedges the engine
+        bad = _codec(k=2, m=1)
+        bad._matvec = lambda m, d: (_ for _ in ()).throw(
+            RuntimeError("injected decode fault"))
+        assert eng.decode_sync("pg0", bad, sinfo, shards, [0, 1]) \
+            is None
+        assert eng.stats["decode_errors"] == 1
+        # engine still alive for good codecs
+        out2 = eng.decode_sync("pg0", codec, sinfo, shards, [1])
+        assert out2 is not None and \
+            np.array_equal(np.asarray(out2[1]), full[1])
+    finally:
+        eng.stop()
+
+
 def test_version_allocation_survives_deferred_staging():
     """Versions are allocated when an op is ACCEPTED, not when its log
     entry stages: on the device path staging defers to the engine
@@ -224,3 +368,11 @@ def test_cluster_device_backend_end_to_end():
         cluster.revive_osd(3)
         cluster.wait_for_clean(timeout=60)
         assert io.read("during") == b"deg" * 1000
+        # the round-3 seam: degraded reads and recovery reconstructs
+        # ran through the engine's batched decode, not the host twin
+        dstats = [o._device_engine.stats
+                  for o in cluster.osds.values()
+                  if o._device_engine is not None]
+        assert sum(s["decode_ops"] for s in dstats) > 0, (
+            "no decode ever routed through the device engine", dstats)
+        assert sum(s["decode_errors"] for s in dstats) == 0, dstats

@@ -202,3 +202,66 @@ def test_accepted_pn_fences_stale_leader(fast):
             leader = next(m for m in cluster.mons.values()
                           if m.is_leader())
             assert leader._leader_pn > rival_pn
+
+
+def test_lease_bounds_partitioned_reads(fast):
+    """Paxos lease (Paxos.h:174 / Paxos.cc extend_lease roles): a
+    partitioned minority peon — and a quorum-less leader — answer
+    read-only commands with EAGAIN once the lease expires, instead of
+    unboundedly stale committed state; the majority side keeps
+    serving; on heal the leader's heartbeats re-grant the lease."""
+    conf = g_conf()
+    old_lease = conf["mon_lease"]
+    conf.set("mon_lease", 1.0)
+    try:
+        with MiniCluster(n_osds=2, n_mons=3) as cluster:
+            _wait(lambda: sum(m.is_leader() for m in
+                              cluster.mons.values()) == 1)
+            cluster.create_pool("base", pg_num=2, size=2)
+            _wait(lambda: len({m._last_committed()
+                               for m in cluster.mons.values()}) == 1)
+            c = RadosClient(cluster.mons[2].addr).connect()
+            try:
+                # healthy cluster: the PEON serves reads locally under
+                # its lease (no NOTLEADER bounce)
+                got = _send_cmd_tid(c, 90001, {"prefix": "osd pool ls"},
+                                    cluster.mons[2].addr)
+                assert got is not None and got[0] == 0, got
+                assert b"base" in got[2]
+
+                # isolate peon 2 from the quorum; its lease expires
+                cluster.partition_mons([2], [0, 1])
+                time.sleep(1.5)            # > mon_lease
+                got = _send_cmd_tid(c, 90002, {"prefix": "osd pool ls"},
+                                    cluster.mons[2].addr)
+                assert got is not None and got[0] == -11, got
+                assert got[1].startswith("EAGAIN"), got
+
+                # the quorum-less OLD leader goes read-dark too (its
+                # lease is quorum visibility, mon_election_timeout)
+                cluster.partition_mons([0], [1, 2])
+                time.sleep(1.5)
+                got = _send_cmd_tid(c, 90003, {"prefix": "osd pool ls"},
+                                    cluster.mons[0].addr)
+                assert got is not None and got[0] == -11, got
+                assert got[1].startswith("EAGAIN"), got
+                # majority side still serves (rank 2 re-leased by the
+                # new leader's heartbeats)
+                _wait(lambda: _send_cmd_tid(
+                    c, 90010, {"prefix": "osd pool ls"},
+                    cluster.mons[2].addr, timeout=2.0) is not None and
+                    _send_cmd_tid(
+                        c, 90011, {"prefix": "osd pool ls"},
+                        cluster.mons[2].addr, timeout=2.0)[0] == 0,
+                    msg="majority-side peon never served under lease")
+
+                # heal: the isolated mon re-leases and serves again
+                cluster.heal_mons()
+                _wait(lambda: (lambda g: g is not None and g[0] == 0)(
+                    _send_cmd_tid(c, 90020, {"prefix": "osd pool ls"},
+                                  cluster.mons[0].addr, timeout=2.0)),
+                    msg="healed mon never served reads again")
+            finally:
+                c.shutdown()
+    finally:
+        conf.set("mon_lease", old_lease)

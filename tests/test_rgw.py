@@ -341,3 +341,49 @@ def test_multipart_concurrent_parts(setup):
     with pytest.raises(RGWError):
         gw.complete_multipart("mpc", "dup", u2, [(1, e), (1, e)])
     gw.abort_multipart("mpc", "dup", u2)
+
+
+def test_bucket_index_rides_omap_with_cls_fallback_on_ec():
+    """The bucket index is OMAP-backed on replicated pools (cls_rgw-
+    over-omap discipline) and falls back to the cls methods on EC
+    pools, where omap is rejected (reference parity)."""
+    with MiniCluster(n_osds=3) as c:
+        rados = c.client()
+        c.create_pool("rgw-rep", pg_num=4, size=2)
+        c.create_ec_pool("rgw-ec", k=2, m=1, pg_num=4)
+
+        gw = RGWGateway(rados.open_ioctx("rgw-rep"))
+        gw.create_bucket("b")
+        assert gw._bucket_fmt("b") == "omap"
+        gw.put_object("b", "k1", b"data1")
+        # the index entry is literally an omap key on the index object
+        omap = gw.io.omap_get(".bucket.b")
+        assert "k1" in omap
+        assert gw.list_objects("b")["k1"]["size"] == 5
+        gw.delete_object("b", "k1")
+        assert gw.io.omap_get(".bucket.b") == {}
+
+        gw2 = RGWGateway(rados.open_ioctx("rgw-ec"))
+        gw2.create_bucket("eb")
+        assert gw2._bucket_fmt("eb") == "cls"
+        gw2.put_object("eb", "k2", b"data22")
+        assert gw2.list_objects("eb")["k2"]["size"] == 6
+        gw2.delete_object("eb", "k2")
+        assert gw2.list_objects("eb") == {}
+
+        # LEGACY bucket (no fmt attr — created by the pre-omap code
+        # with a cls-blob index): a new gateway must keep routing its
+        # index through cls, never misread it as omap-empty
+        gw.io.write_full(".bucket.legacy", b"{}")
+        b = json.loads(gw.io.read(".buckets"))
+        b["legacy"] = {}
+        gw.io.write_full(".buckets", json.dumps(b).encode())
+        gw3 = RGWGateway(rados.open_ioctx("rgw-rep"))
+        assert gw3._bucket_fmt("legacy") == "cls"
+        gw3.put_object("legacy", "old-k", b"legacy data")
+        assert gw3.list_objects("legacy")["old-k"]["size"] == 11
+        # and a DIFFERENT gateway instance agrees on the format
+        gw4 = RGWGateway(rados.open_ioctx("rgw-rep"))
+        assert gw4.list_objects("legacy")["old-k"]["size"] == 11
+        gw4.delete_object("legacy", "old-k")
+        assert gw3.list_objects("legacy") == {}

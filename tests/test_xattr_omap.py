@@ -1,0 +1,179 @@
+"""Client-visible xattr/omap ops + cmpxattr guards (the do_osd_ops op
+families of src/osd/PrimaryLogPG.cc:5664 — CEPH_OSD_OP_{GETXATTR,
+SETXATTR,RMXATTR,GETXATTRS,CMPXATTR,OMAP*,CREATE}), exercised through
+the librados-role client against real daemons."""
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=4) as c:
+        rados = c.client()
+        c.create_ec_pool("xec", k=2, m=1, pg_num=4)
+        c.create_pool("xrep", pg_num=4, size=3)
+        yield c, rados
+
+
+def test_xattr_set_get_rm_list_ec(cluster):
+    c, rados = cluster
+    io = rados.open_ioctx("xec")
+    io.write_full("xo", b"payload" * 1000)
+    io.setxattr("xo", "owner", b"alice")
+    io.setxattr("xo", "mode", b"0644")
+    assert io.getxattr("xo", "owner") == b"alice"
+    assert io.getxattrs("xo") == {"owner": b"alice", "mode": b"0644"}
+    # write_full preserves xattrs (CEPH_OSD_OP_WRITEFULL semantics)
+    io.write_full("xo", b"replaced")
+    assert io.read("xo") == b"replaced"
+    assert io.getxattr("xo", "owner") == b"alice"
+    io.rmxattr("xo", "mode")
+    assert io.getxattrs("xo") == {"owner": b"alice"}
+    with pytest.raises(RadosError) as ei:
+        io.getxattr("xo", "mode")
+    assert ei.value.code == -61                      # ENODATA
+    with pytest.raises(RadosError) as ei:
+        io.rmxattr("xo", "never-there")
+    assert ei.value.code == -61
+    with pytest.raises(RadosError) as ei:
+        io.getxattr("no-such-object", "owner")
+    assert ei.value.code == -2                       # ENOENT
+
+
+def test_xattr_implies_create(cluster):
+    c, rados = cluster
+    io = rados.open_ioctx("xec")
+    io.setxattr("attr-born", "k", b"v")              # object materializes
+    assert io.stat("attr-born") == 0
+    assert io.read("attr-born") == b""
+    assert io.getxattr("attr-born", "k") == b"v"
+
+
+def test_cmpxattr_modes(cluster):
+    c, rados = cluster
+    io = rados.open_ioctx("xrep")
+    io.write_full("cmp", b"x")
+    io.setxattr("cmp", "tag", b"blue")
+    io.setxattr("cmp", "n", b"7")
+    assert io.cmpxattr("cmp", "tag", M.CMPXATTR_EQ, b"blue")
+    assert not io.cmpxattr("cmp", "tag", M.CMPXATTR_EQ, b"red")
+    assert io.cmpxattr("cmp", "tag", M.CMPXATTR_NE, b"red")
+    assert io.cmpxattr("cmp", "n", M.CMPXATTR_GT, b"3")
+    assert io.cmpxattr("cmp", "n", M.CMPXATTR_GTE, b"7")
+    assert not io.cmpxattr("cmp", "n", M.CMPXATTR_LT, b"7")
+    assert io.cmpxattr("cmp", "n", M.CMPXATTR_LTE, b"7")
+    # missing attr: EQ fails, NE holds; numeric treats missing as 0
+    assert not io.cmpxattr("cmp", "ghost", M.CMPXATTR_EQ, b"z")
+    assert io.cmpxattr("cmp", "ghost", M.CMPXATTR_NE, b"z")
+    assert io.cmpxattr("cmp", "ghost", M.CMPXATTR_LT, b"1")
+    # non-numeric operand in a numeric mode
+    with pytest.raises(RadosError) as ei:
+        io.cmpxattr("cmp", "tag", M.CMPXATTR_GT, b"3")
+    assert ei.value.code == -22                      # EINVAL
+
+
+def test_guarded_write_atomicity(cluster):
+    """A cmpxattr guard coupled to a mutation: the op executes only
+    when the guard holds (the reference's multi-op transaction where
+    a failed CMPXATTR aborts the rest)."""
+    c, rados = cluster
+    io = rados.open_ioctx("xrep")
+    io.write_full("gw", b"v1")
+    io.setxattr("gw", "state", b"draft")
+    # guard holds -> write lands
+    io.write_full_guarded("gw", b"v2",
+                          guard=("state", M.CMPXATTR_EQ, b"draft"))
+    assert io.read("gw") == b"v2"
+    # guard fails -> ECANCELED, object untouched
+    with pytest.raises(RadosError) as ei:
+        io.write_full_guarded("gw", b"v3",
+                              guard=("state", M.CMPXATTR_EQ,
+                                     b"published"))
+    assert ei.value.code == -125
+    assert io.read("gw") == b"v2"
+    # guarded setxattr: optimistic state transition
+    io.setxattr("gw", "state", b"published",
+                guard=("state", M.CMPXATTR_EQ, b"draft"))
+    with pytest.raises(RadosError) as ei:
+        io.setxattr("gw", "state", b"published",
+                    guard=("state", M.CMPXATTR_EQ, b"draft"))
+    assert ei.value.code == -125
+
+
+def test_exclusive_create(cluster):
+    c, rados = cluster
+    io = rados.open_ioctx("xec")
+    io.create("born", exclusive=True)
+    assert io.stat("born") == 0
+    with pytest.raises(RadosError) as ei:
+        io.create("born", exclusive=True)
+    assert ei.value.code == -17                      # EEXIST
+    io.create("born")                                # plain: no-op ok
+
+
+def test_omap_replicated_pool(cluster):
+    c, rados = cluster
+    io = rados.open_ioctx("xrep")
+    io.write_full("om", b"omap holder")
+    io.omap_set("om", {"k1": b"v1", "k2": b"v2", "k3": b"v3"})
+    assert io.omap_get("om") == {"k1": b"v1", "k2": b"v2",
+                                 "k3": b"v3"}
+    assert io.omap_get("om", ["k1", "k3"]) == {"k1": b"v1",
+                                               "k3": b"v3"}
+    assert io.omap_get_keys("om") == ["k1", "k2", "k3"]
+    io.omap_rm_keys("om", ["k2"])
+    assert io.omap_get_keys("om") == ["k1", "k3"]
+    # write_full preserves omap
+    io.write_full("om", b"rewritten")
+    assert io.omap_get("om") == {"k1": b"v1", "k3": b"v3"}
+    with pytest.raises(RadosError) as ei:
+        io.omap_get("nope")
+    assert ei.value.code == -2
+
+
+def test_omap_rejected_on_ec_pool(cluster):
+    """EC pools reject omap exactly as the reference does
+    (PrimaryLogPG: -EOPNOTSUPP)."""
+    c, rados = cluster
+    io = rados.open_ioctx("xec")
+    io.write_full("eo", b"x")
+    for fn in (lambda: io.omap_set("eo", {"k": b"v"}),
+               lambda: io.omap_get("eo"),
+               lambda: io.omap_get_keys("eo"),
+               lambda: io.omap_rm_keys("eo", ["k"])):
+        with pytest.raises(RadosError) as ei:
+            fn()
+        assert ei.value.code == -95
+
+
+def test_xattr_omap_survive_recovery(cluster):
+    """Recovery pushes carry client xattrs (EC + replicated) and omap
+    (replicated): a shard that missed them converges."""
+    import time
+
+    c, rados = cluster
+    ioe = rados.open_ioctx("xec")
+    ior = rados.open_ioctx("xrep")
+    c.kill_osd(3)
+    c.wait_for_osd_down(3, timeout=30)
+    ioe.write_full("rec-e", b"ec data" * 500)
+    ioe.setxattr("rec-e", "who", b"survivor")
+    ior.write_full("rec-r", b"rep data" * 500)
+    ior.setxattr("rec-r", "who", b"survivor")
+    ior.omap_set("rec-r", {"idx": b"42"})
+    c.revive_osd(3)
+    c.wait_for_clean(timeout=60)
+    # degraded-written state fully recovered, attrs/omap included
+    assert ioe.getxattr("rec-e", "who") == b"survivor"
+    assert ior.getxattr("rec-r", "who") == b"survivor"
+    assert ior.omap_get("rec-r") == {"idx": b"42"}
+    # and degraded READS of xattrs work while a shard is down
+    c.kill_osd(2)
+    c.wait_for_osd_down(2, timeout=30)
+    assert ioe.getxattr("rec-e", "who") == b"survivor"
+    c.revive_osd(2)
+    c.wait_for_clean(timeout=60)
