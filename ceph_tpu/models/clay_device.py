@@ -30,6 +30,23 @@ matmul, ~9 GB/s despite 20x FLOP waste) remains the production device
 path; this module is the faithful staged expression of the algorithm,
 kept as the validated alternative and the basis for a future
 plane-blocked kernel.
+
+Round-3 finding (``build_encode_fast``): for the ENCODE erasure
+pattern (all parities erased) the score-level chain collapses to ONE
+active level, so encode is exactly three stages — a 2-term pairwise
+pass over the data, ONE plane-wise [m,k] MDS matmul (RS-kernel
+class, 561 GB/s in isolation on this chip), and a 2-term recouple
+pass. The structured encoder below is bit-exact and does ~1/20 the
+dense MACs, yet measures only 8.2 GB/s composed (vs 9.0 dense):
+XLA inserts a layout copy between the gather/select producers and
+the pallas custom call (a bare row-gather feeding the kernel already
+drops it from 270 to 82 GB/s), and the per-slot constant-select
+chains do not fuse into single passes. The ceiling here is
+compilation, not algorithm: reaching the >= 50 GB/s target needs the
+whole three-stage chain inside ONE pallas kernel with the working
+set VMEM-resident (pair combines on the VPU around the in-kernel
+MXU matmul) — recorded as the next kernel project; the dense matrix
+stays the production encode path meanwhile.
 """
 
 from __future__ import annotations
@@ -318,6 +335,143 @@ def build_transform(codec, erased: frozenset[int]):
         return C
 
     return transform
+
+
+def build_encode_fast(codec):
+    """Structured device ENCODE (the round-2 verdict's plane-blocked
+    kernel, ErasureCodeClay.cc:644-709 coupling structure): for the
+    all-parity erasure pattern the score-level chain collapses to ONE
+    active level, so encode is exactly three stages —
+
+      1. U_data = pairwise uncouple of C_data (2-term GF combos, one
+         gather + two constant-table passes over the data array; the
+         erased partners' C is zero by construction and drops out);
+      2. U_parity = the plane-wise MDS encode — ONE [m,k] bit-sliced
+         MXU matmul over (ssc x lanes), the same shape/throughput
+         class as the plain RS kernel;
+      3. C_parity = pairwise recouple (2-term combos reading U_parity
+         and gathered C_data).
+
+    vs the dense [m*ssc, k*ssc] signature matrix this does ~1/20 the
+    MACs (the matrix is ~5% dense) and ~6 HBM passes instead of a
+    compute-bound dense matmul. Returns a jitted
+    ``[k, ssc, L] uint8 -> [m, ssc, L]`` (bit-exact vs the host
+    layered machinery — gated in tests)."""
+    import jax
+    import jax.numpy as jnp
+
+    q, t = codec.q, codec.t
+    qt, ssc = q * t, codec.sub_chunk_no
+    k, m = codec.k, codec.m
+    erased = frozenset(codec._node_id(i) for i in range(k, k + m))
+    levels = trace_layered(codec, erased)
+    active = [ops for ops in levels
+              if ops.ident or ops.pair_a or ops.planes]
+    assert len(active) == 1 and sorted(active[0].planes) == \
+        list(range(ssc)), "encode trace is not single-level"
+    ops = active[0]
+    coeffs = pft_coefficients(codec)
+    intact = [i for i in range(qt) if i not in erased]
+    er = sorted(erased)
+    row_of = {n: idx for idx, n in enumerate(intact)}
+    prow_of = {n: idx for idx, n in enumerate(er)}
+
+    # stage 1 tables over DATA slots [k, ssc]
+    a1 = np.zeros((k, ssc), dtype=np.uint8)
+    a2 = np.zeros((k, ssc), dtype=np.uint8)
+    perm = np.zeros((k, ssc), dtype=np.int32)    # flat data-slot idx
+    for n, z in ops.ident:
+        a1[row_of[n], z] = 1
+        perm[row_of[n], z] = row_of[n] * ssc + z
+    for v, lst in ops.pair_a.items():
+        mm = coeffs[("a", v)]
+        for nxy, z, nsw, zsw in lst:
+            r = row_of[nxy]
+            a1[r, z], perm[r, z] = int(mm[0][0]), r * ssc + z
+            if nsw in erased:
+                # partner C is an erased node: zero by construction
+                a2[r, z] = 0
+            else:
+                a2[r, z] = int(mm[0][1])
+                perm[r, z] = row_of[nsw] * ssc + zsw
+            rs = prow_of.get(nsw)
+            if rs is None:
+                r2 = row_of[nsw]
+                a1[r2, zsw] = int(mm[1][1])
+                a2[r2, zsw] = int(mm[1][0])
+                perm[r2, zsw] = r * ssc + z
+    # MDS decode matrix: erased-U from intact-U, identical per plane
+    probe = {i: np.zeros(len(intact), dtype=np.uint8) for i in intact}
+    for idx, i in enumerate(intact):
+        probe[i][idx] = 1
+    sol = codec.mds.decode_chunks(er, probe)
+    dmat = np.stack([np.asarray(sol[i], dtype=np.uint8) for i in er])
+
+    # stage 3 tables over PARITY slots [m, ssc]
+    b1 = np.zeros((m, ssc), dtype=np.uint8)      # * C_data[perm_c]
+    b2 = np.zeros((m, ssc), dtype=np.uint8)      # * U_par[self]
+    b3 = np.zeros((m, ssc), dtype=np.uint8)      # * U_par[perm_u]
+    perm_c = np.zeros((m, ssc), dtype=np.int32)
+    perm_u = np.zeros((m, ssc), dtype=np.int32)
+    for n, z in ops.ident2:
+        b2[prow_of[n], z] = 1
+    for v, lst in ops.type_c.items():
+        mm = coeffs[("c", v)]
+        for nxy, z, nsw, zsw in lst:
+            r = prow_of[nxy]
+            b1[r, z] = int(mm[0][0])
+            perm_c[r, z] = row_of[nsw] * ssc + zsw
+            b2[r, z] = int(mm[0][1])
+    mb = coeffs[("b", 0)]
+    for nxy, z, nsw, zsw in ops.pair_b:
+        r, rs = prow_of[nxy], prow_of[nsw]
+        b2[r, z], b3[r, z] = int(mb[0][0]), int(mb[0][1])
+        perm_u[r, z] = rs * ssc + zsw
+        b2[rs, zsw], b3[rs, zsw] = int(mb[1][1]), int(mb[1][0])
+        perm_u[rs, zsw] = r * ssc + z
+
+    if codec.backend == "pallas":
+        from ceph_tpu.ops.gf_pallas import matvec_device
+    else:
+        from ceph_tpu.ops.gf_jax import matvec_device
+    t_a1 = _varmul_tables(a1.reshape(-1, 1))
+    t_a2 = _varmul_tables(a2.reshape(-1, 1))
+    t_b1 = _varmul_tables(b1.reshape(-1, 1))
+    t_b2 = _varmul_tables(b2.reshape(-1, 1))
+    t_b3 = _varmul_tables(b3.reshape(-1, 1))
+    perm_f = jnp.asarray(perm.reshape(-1))
+    perm_cf = jnp.asarray(perm_c.reshape(-1))
+    perm_uf = jnp.asarray(perm_u.reshape(-1))
+
+    # the three stages live in two jitted pieces around the backend
+    # matvec (itself jitted/bucketed); XLA fuses the elementwise
+    # chains on each side
+    @jax.jit
+    def stage1(c_data):
+        L = c_data.shape[-1]
+        flat = c_data.reshape(k * ssc, L)
+        u_d = _varmul(flat[:, None, :], t_a1, jnp) ^ \
+            _varmul(flat[perm_f][:, None, :], t_a2, jnp)
+        return u_d.reshape(k, ssc * L)
+
+    @jax.jit
+    def stage3(c_data, u_par):
+        L = c_data.shape[-1]
+        flat_c = c_data.reshape(k * ssc, L)
+        flat_u = u_par.reshape(m * ssc, L)
+        out = _varmul(flat_c[perm_cf][:, None, :], t_b1, jnp) ^ \
+            _varmul(flat_u[:, None, :], t_b2, jnp) ^ \
+            _varmul(flat_u[perm_uf][:, None, :], t_b3, jnp)
+        return out.reshape(m, ssc, L)
+
+    def encode_fast(c_data):
+        L = c_data.shape[-1]
+        u_d = stage1(c_data)
+        u_p = matvec_device(dmat, u_d)       # [m, ssc*L], trace-safe
+        u_p = u_p.reshape(m, ssc, L)
+        return stage3(c_data, u_p)
+
+    return encode_fast
 
 
 class ClayDeviceCodec:
