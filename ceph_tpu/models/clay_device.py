@@ -231,11 +231,7 @@ def build_transform(codec, erased: frozenset[int]):
     ssc = codec.sub_chunk_no
     intact = [i for i in range(qt) if i not in erased]
     er = sorted(erased)
-    probe = {i: np.zeros(len(intact), dtype=np.uint8) for i in intact}
-    for idx, i in enumerate(intact):
-        probe[i][idx] = 1
-    sol = codec.mds.decode_chunks(er, probe)
-    dmat = np.stack([np.asarray(sol[i], dtype=np.uint8) for i in er])
+    dmat = _mds_decode_matrix(codec, intact, er)
     dbmat = bitmatrix.expand_bitmatrix(dmat).astype(np.int8)
 
     from ceph_tpu.ops.gf_jax import _bitsliced_matvec_device
@@ -337,6 +333,16 @@ def build_transform(codec, erased: frozenset[int]):
     return transform
 
 
+def _mds_decode_matrix(codec, intact: list, er: list) -> np.ndarray:
+    """[len(er), len(intact)] matrix recovering erased-U from intact-U
+    (identical per plane), probed from the scalar MDS codec."""
+    probe = {i: np.zeros(len(intact), dtype=np.uint8) for i in intact}
+    for idx, i in enumerate(intact):
+        probe[i][idx] = 1
+    sol = codec.mds.decode_chunks(er, probe)
+    return np.stack([np.asarray(sol[i], dtype=np.uint8) for i in er])
+
+
 def build_encode_fast(codec):
     """Structured device ENCODE (the round-2 verdict's plane-blocked
     kernel, ErasureCodeClay.cc:644-709 coupling structure): for the
@@ -371,15 +377,25 @@ def build_encode_fast(codec):
         list(range(ssc)), "encode trace is not single-level"
     ops = active[0]
     coeffs = pft_coefficients(codec)
+    # intact rows = data nodes (grid ids 0..k-1) PLUS the nu virtual
+    # nodes (grid ids k..k+nu-1) of profiles where q does not divide
+    # k+m: virtual C is zero, but virtual U mixes real data and feeds
+    # the MDS solve, so they get real rows
     intact = [i for i in range(qt) if i not in erased]
+    kk = len(intact)
+    assert kk == k + codec.nu, (kk, k, codec.nu)
     er = sorted(erased)
     row_of = {n: idx for idx, n in enumerate(intact)}
     prow_of = {n: idx for idx, n in enumerate(er)}
+    #: input embedding: padded row -> data chunk index (-1 = virtual)
+    src = np.full(kk, -1, dtype=np.int32)
+    for i in range(k):
+        src[row_of[codec._node_id(i)]] = i
 
-    # stage 1 tables over DATA slots [k, ssc]
-    a1 = np.zeros((k, ssc), dtype=np.uint8)
-    a2 = np.zeros((k, ssc), dtype=np.uint8)
-    perm = np.zeros((k, ssc), dtype=np.int32)    # flat data-slot idx
+    # stage 1 tables over INTACT slots [kk, ssc]
+    a1 = np.zeros((kk, ssc), dtype=np.uint8)
+    a2 = np.zeros((kk, ssc), dtype=np.uint8)
+    perm = np.zeros((kk, ssc), dtype=np.int32)   # flat intact-slot idx
     for n, z in ops.ident:
         a1[row_of[n], z] = 1
         perm[row_of[n], z] = row_of[n] * ssc + z
@@ -400,12 +416,7 @@ def build_encode_fast(codec):
                 a1[r2, zsw] = int(mm[1][1])
                 a2[r2, zsw] = int(mm[1][0])
                 perm[r2, zsw] = r * ssc + z
-    # MDS decode matrix: erased-U from intact-U, identical per plane
-    probe = {i: np.zeros(len(intact), dtype=np.uint8) for i in intact}
-    for idx, i in enumerate(intact):
-        probe[i][idx] = 1
-    sol = codec.mds.decode_chunks(er, probe)
-    dmat = np.stack([np.asarray(sol[i], dtype=np.uint8) for i in er])
+    dmat = _mds_decode_matrix(codec, intact, er)
 
     # stage 3 tables over PARITY slots [m, ssc]
     b1 = np.zeros((m, ssc), dtype=np.uint8)      # * C_data[perm_c]
@@ -430,7 +441,12 @@ def build_encode_fast(codec):
         b2[rs, zsw], b3[rs, zsw] = int(mb[1][1]), int(mb[1][0])
         perm_u[rs, zsw] = r * ssc + z
 
-    if codec.backend == "pallas":
+    from ceph_tpu.ops import backend as backend_mod
+    try:
+        resolved, _ = backend_mod.resolve(codec.backend)
+    except KeyError:
+        resolved = "jax"
+    if resolved == "pallas":
         from ceph_tpu.ops.gf_pallas import matvec_device
     else:
         from ceph_tpu.ops.gf_jax import matvec_device
@@ -442,6 +458,8 @@ def build_encode_fast(codec):
     perm_f = jnp.asarray(perm.reshape(-1))
     perm_cf = jnp.asarray(perm_c.reshape(-1))
     perm_uf = jnp.asarray(perm_u.reshape(-1))
+    src_j = jnp.asarray(np.maximum(src, 0))
+    virt = jnp.asarray((src < 0)[:, None, None])
 
     # the three stages live in two jitted pieces around the backend
     # matvec (itself jitted/bucketed); XLA fuses the elementwise
@@ -449,15 +467,18 @@ def build_encode_fast(codec):
     @jax.jit
     def stage1(c_data):
         L = c_data.shape[-1]
-        flat = c_data.reshape(k * ssc, L)
+        # embed the k data chunks into the kk intact rows (virtual
+        # node rows are zero)
+        padded = jnp.where(virt, jnp.uint8(0), c_data[src_j])
+        flat = padded.reshape(kk * ssc, L)
         u_d = _varmul(flat[:, None, :], t_a1, jnp) ^ \
             _varmul(flat[perm_f][:, None, :], t_a2, jnp)
-        return u_d.reshape(k, ssc * L)
+        return padded, u_d.reshape(kk, ssc * L)
 
     @jax.jit
-    def stage3(c_data, u_par):
-        L = c_data.shape[-1]
-        flat_c = c_data.reshape(k * ssc, L)
+    def stage3(padded, u_par):
+        L = padded.shape[-1]
+        flat_c = padded.reshape(kk * ssc, L)
         flat_u = u_par.reshape(m * ssc, L)
         out = _varmul(flat_c[perm_cf][:, None, :], t_b1, jnp) ^ \
             _varmul(flat_u[:, None, :], t_b2, jnp) ^ \
@@ -465,11 +486,10 @@ def build_encode_fast(codec):
         return out.reshape(m, ssc, L)
 
     def encode_fast(c_data):
-        L = c_data.shape[-1]
-        u_d = stage1(c_data)
+        padded, u_d = stage1(c_data)
         u_p = matvec_device(dmat, u_d)       # [m, ssc*L], trace-safe
-        u_p = u_p.reshape(m, ssc, L)
-        return stage3(c_data, u_p)
+        u_p = u_p.reshape(m, ssc, padded.shape[-1])
+        return stage3(padded, u_p)
 
     return encode_fast
 

@@ -104,24 +104,26 @@ def test_trace_structure_and_coefficients():
 def test_structured_encode_bit_exact():
     """build_encode_fast (the single-level structured encode): three
     stages — pairwise uncouple, plane-wise MDS matmul, recouple —
-    bit-exact vs the host layered machinery across payload sizes."""
-    import numpy as np
-
-    from ceph_tpu.models import registry as reg
+    bit-exact vs the host LAYERED machinery (linearize=false oracle)
+    across payload sizes, including a nu>0 profile (virtual nodes)."""
     from ceph_tpu.models.clay_device import build_encode_fast
 
-    c = reg.instance().factory("clay", {
-        "plugin": "clay", "k": "8", "m": "4", "d": "11",
-        "backend": "numpy"})
-    enc = build_encode_fast(c)
-    ssc, k, m = c.sub_chunk_no, c.k, c.m
     rng = np.random.default_rng(11)
-    for sc in (1, 5, 64, 777):
-        chunks = {i: rng.integers(0, 256, ssc * sc, dtype=np.uint8)
-                  for i in range(k)}
-        host = c.encode_chunks(list(range(k, k + m)), chunks)
-        x = np.stack([chunks[i].reshape(ssc, sc) for i in range(k)])
-        dev = np.asarray(enc(x))
-        for p in range(m):
-            assert np.array_equal(dev[p].reshape(-1),
-                                  np.asarray(host[k + p])), (sc, p)
+    for prof, sizes in ((dict(k=8, m=4, d=11), (1, 5, 64, 777)),
+                        (dict(k=4, m=3, d=6), (1, 9, 100))):
+        c = make(**prof)
+        assert (c.nu > 0) == (prof["k"] == 4)    # virtual-node case
+        enc = build_encode_fast(c)
+        ssc, k, m = c.sub_chunk_no, c.k, c.m
+        for sc in sizes:
+            chunks = {i: rng.integers(0, 256, ssc * sc,
+                                      dtype=np.uint8)
+                      for i in range(k)}
+            host = c.encode_chunks(list(range(k, k + m)), chunks)
+            x = np.stack([chunks[i].reshape(ssc, sc)
+                          for i in range(k)])
+            dev = np.asarray(enc(x))
+            for p in range(m):
+                assert np.array_equal(dev[p].reshape(-1),
+                                      np.asarray(host[k + p])), \
+                    (prof, sc, p)
