@@ -1,10 +1,43 @@
-"""CrimsonOSD — the asyncio single-reactor OSD skeleton."""
+"""CrimsonOSD — the shared-nothing multi-reactor OSD prototype
+(src/crimson/osd/ role).
+
+The reference's crimson is a seastar rewrite exploring one bet: cores
+never share mutable state — every PG lives on exactly one reactor,
+cross-core work travels as messages (``smp::submit_to``), and within a
+reactor nothing preempts between awaits, so the synchronous-critical-
+section locks of the threaded OSD disappear. This prototype keeps that
+discipline faithfully, reduced in scale rather than in shape:
+
+- N REACTORS (``--smp`` role): each an asyncio event loop on its own
+  thread, owning a disjoint shard of PGs (pgid-hash placement, the
+  ``pg_to_shard`` mapping of crimson's ShardServices) and its OWN
+  per-shard object store — no dict, lock, or store is ever touched
+  from two reactors;
+- cross-reactor calls go through :meth:`_submit_to` (call_soon_
+  threadsafe message passing — the seastar submit_to seam); the
+  messenger's event loop only parses frames and forwards;
+- per-PG op ORDER comes from a sequencer queue per PG (crimson's
+  OrderedExclusivePhase / PGShardManager discipline): ops on one PG
+  apply strictly in arrival order even though handlers are
+  coroutines; ops on different PGs of the same reactor interleave at
+  await points; ops on different reactors run truly in parallel;
+- the store is a per-shard MemStore-roled object store (data + attrs
+  + a version counter per PG), not a flat dict: enough structure that
+  the op set (write/append/read/stat/remove + xattrs) matches the
+  mainline wire protocol the stock client speaks.
+
+Still out of scope, as in the reference prototype: peering, recovery,
+replication fan-out (crimson at this vintage boots, maps, beacons,
+and serves single-copy I/O — src/crimson is 3.3k LoC of exactly
+that scaffolding).
+"""
 
 from __future__ import annotations
 
 import asyncio
 import json
-import time
+import threading
+from collections import deque
 
 from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Connection, Messenger
@@ -15,31 +48,96 @@ from ceph_tpu.utils.dout import Dout
 log = Dout("crimson")
 
 
-class CrimsonOSD:
-    """Boot + maps + beacons + a flat object service, all coroutines
-    on one reactor (the seastar shared-nothing bet, reduced to one
-    core). Objects live in a plain dict keyed (pool, oid); per-object
-    asyncio locks give the read-modify-write atomicity the mainline
-    OSD gets from its PG lock."""
+class _ShardStore:
+    """Per-reactor object store (MemStore role): collections keyed by
+    pgid, objects carry (data, attrs, version). Only its owning
+    reactor ever touches it — that is the entire consistency
+    model."""
 
-    def __init__(self, osd_id: int, mon_addr: str) -> None:
+    def __init__(self) -> None:
+        self.colls: dict[tuple[int, int], dict[str, list]] = {}
+        self.versions: dict[tuple[int, int], int] = {}
+
+    def coll(self, pgid) -> dict:
+        return self.colls.setdefault(pgid, {})
+
+    def next_version(self, pgid) -> int:
+        v = self.versions.get(pgid, 0) + 1
+        self.versions[pgid] = v
+        return v
+
+
+class _Reactor:
+    """One shared-nothing core: an event loop + its shard's PGs."""
+
+    def __init__(self, idx: int) -> None:
+        self.idx = idx
+        self.loop = asyncio.new_event_loop()
+        self.store = _ShardStore()
+        #: per-PG op sequencers (OrderedExclusivePhase role): a deque
+        #: of waiter futures keeps ops of one PG in arrival order
+        self._pg_seq: dict[tuple[int, int], deque] = {}
+        self.ops_served = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"crimson-reactor-{idx}",
+            daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def submit(self, coro) -> None:
+        """submit_to(shard, fn) — the only way work enters here."""
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+    # -- per-PG ordering ----------------------------------------------
+    async def pg_enter(self, pgid) -> None:
+        q = self._pg_seq.setdefault(pgid, deque())
+        if not q:
+            q.append(None)            # running marker, no waiters
+            return
+        fut = self.loop.create_future()
+        q.append(fut)
+        await fut
+
+    def pg_exit(self, pgid) -> None:
+        q = self._pg_seq.get(pgid)
+        q.popleft()
+        if q:
+            nxt = q[0]
+            if nxt is not None:
+                nxt.set_result(None)
+                q[0] = None           # promoted to running marker
+        else:
+            self._pg_seq.pop(pgid, None)
+
+
+class CrimsonOSD:
+    """Boot + maps + beacons on the messenger reactor; client I/O
+    sharded over ``smp`` shared-nothing reactors."""
+
+    def __init__(self, osd_id: int, mon_addr: str,
+                 smp: int | None = None) -> None:
         self.whoami = osd_id
         self.mon_addr = mon_addr
+        self.smp = smp if smp is not None else max(
+            1, int(g_conf()["crimson_smp"]))
         self.msgr = Messenger(f"osd.{osd_id}")
         self.msgr.set_dispatcher(self._dispatch)
         self.addr = ""
         self.osdmap: OSDMap | None = None
-        self._objects: dict[tuple[int, str], tuple[bytes, int]] = {}
-        self._obj_locks: dict[tuple[int, str], asyncio.Lock] = {}
-        self._next_version = 0
+        self.reactors: list[_Reactor] = []
         self._beacon_task = None
-        self._booted = asyncio.Event()
 
     # -- lifecycle ----------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.reactors = [_Reactor(i) for i in range(self.smp)]
         self.addr = self.msgr.bind(host, port)
         loop = self.msgr._loop
-        # everything below runs ON the reactor
         fut = asyncio.run_coroutine_threadsafe(self._boot(), loop)
         fut.result(timeout=10)
         return self.addr
@@ -49,6 +147,8 @@ class CrimsonOSD:
             self.msgr._loop.call_soon_threadsafe(
                 self._beacon_task.cancel)
         self.msgr.shutdown()
+        for r in self.reactors:
+            r.stop()
 
     async def _boot(self) -> None:
         self.msgr.send_message(M.MOSDBoot(
@@ -64,60 +164,117 @@ class CrimsonOSD:
             self.msgr.send_message(
                 M.MOSDAlive(osd_id=self.whoami), self.mon_addr)
 
-    # -- dispatch (runs on the reactor; spawns coroutines) ------------
+    # -- shard placement (PGShardManager pg_to_shard role) ------------
+    def shard_of(self, pgid: tuple[int, int]) -> _Reactor:
+        return self.reactors[hash(pgid) % len(self.reactors)]
+
+    # -- dispatch: the messenger reactor only parses + forwards -------
     def _dispatch(self, msg: M.Message, conn: Connection) -> None:
-        loop = asyncio.get_running_loop()
         if isinstance(msg, M.MOSDMap):
             self.osdmap = OSDMap.decode(msg.map_bytes)
-            self._booted.set()
         elif isinstance(msg, M.MOSDOp):
-            loop.create_task(self._handle_op(msg, conn))
-
-    def _lock_for(self, key) -> asyncio.Lock:
-        lock = self._obj_locks.get(key)
-        if lock is None:
-            lock = self._obj_locks[key] = asyncio.Lock()
-        return lock
-
-    async def _handle_op(self, msg: M.MOSDOp, conn: Connection) -> None:
-        key = (msg.pool, msg.oid)
-        code, data, version = 0, b"", 0
-        async with self._lock_for(key):
-            if msg.op == M.OSD_OP_WRITE_FULL:
-                self._next_version += 1
-                version = self._next_version
-                self._objects[key] = (bytes(msg.data), version)
-            elif msg.op == M.OSD_OP_APPEND:
-                cur, _v = self._objects.get(key, (b"", 0))
-                self._next_version += 1
-                version = self._next_version
-                self._objects[key] = (cur + bytes(msg.data), version)
-            elif msg.op == M.OSD_OP_READ:
-                ent = self._objects.get(key)
-                if ent is None:
-                    code = -2
-                else:
-                    data, version = ent
-                    if msg.length:
-                        data = data[msg.offset:msg.offset + msg.length]
-                    elif msg.offset:
-                        data = data[msg.offset:]
-            elif msg.op == M.OSD_OP_STAT:
-                ent = self._objects.get(key)
-                if ent is None:
-                    code = -2
-                else:
-                    data = json.dumps({"size": len(ent[0])}).encode()
-                    version = ent[1]
-            elif msg.op == M.OSD_OP_REMOVE:
-                if self._objects.pop(key, None) is None:
-                    code = -2
-                else:
-                    self._next_version += 1
-                    version = self._next_version
+            osdmap = self.osdmap
+            if msg.op == M.OSD_OP_LIST:
+                # PGLS carries an explicit ps and an empty oid —
+                # mapping "" through crush would fold every listing
+                # onto one PG (mainline special-cases this too)
+                ps = msg.ps
+            elif osdmap is not None:
+                if msg.pool not in osdmap.pools:
+                    # stale map here vs the client: reply ENOENT
+                    # instead of raising on the messenger reactor
+                    self._reply(conn, msg, -2, b"", 0)
+                    return
+                ps = osdmap.object_to_pg(msg.pool, msg.oid)
             else:
-                code = -22
+                ps = msg.ps
+            pgid = (msg.pool, ps)
+            # submit_to: the op crosses onto its PG's owning reactor;
+            # nothing else of this OSD's state travels with it
+            self.shard_of(pgid).submit(
+                self._handle_op(pgid, msg, conn))
+
+    def _reply(self, conn: Connection, msg: M.MOSDOp, code: int,
+               data: bytes, version: int) -> None:
+        # connections belong to the messenger reactor: route the send
+        # back through it (never touch a socket from a PG reactor)
         epoch = self.osdmap.epoch if self.osdmap else 0
-        conn.send_message(M.MOSDOpReply(
-            tid=msg.tid, code=code, epoch=epoch, data=bytes(data),
-            version=version))
+        self.msgr._loop.call_soon_threadsafe(
+            conn.send_message, M.MOSDOpReply(
+                tid=msg.tid, code=code, epoch=epoch,
+                data=bytes(data), version=version))
+
+    async def _handle_op(self, pgid, msg: M.MOSDOp,
+                         conn: Connection) -> None:
+        reactor = self.shard_of(pgid)
+        assert asyncio.get_running_loop() is reactor.loop
+        await reactor.pg_enter(pgid)
+        try:
+            code, data, version = self._execute(reactor, pgid, msg)
+        except Exception as exc:      # prototype: no op may wedge a PG
+            log(1, f"crimson op failed: {exc!r}")
+            code, data, version = -22, b"", 0
+        finally:
+            reactor.pg_exit(pgid)
+        reactor.ops_served += 1
+        self._reply(conn, msg, code, data, version)
+
+    def _execute(self, reactor: _Reactor, pgid,
+                 msg: M.MOSDOp) -> tuple[int, bytes, int]:
+        """Runs on the PG's reactor between awaits: no locks, by
+        construction."""
+        coll = reactor.store.coll(pgid)
+        ent = coll.get(msg.oid)       # [data, attrs, version] | None
+        op = msg.op
+        if op == M.OSD_OP_WRITE_FULL:
+            v = reactor.store.next_version(pgid)
+            attrs = ent[1] if ent else {}
+            coll[msg.oid] = [bytes(msg.data), attrs, v]
+            return 0, b"", v
+        if op == M.OSD_OP_APPEND:
+            v = reactor.store.next_version(pgid)
+            cur, attrs = (ent[0], ent[1]) if ent else (b"", {})
+            coll[msg.oid] = [cur + bytes(msg.data), attrs, v]
+            return 0, b"", v
+        if op == M.OSD_OP_READ:
+            if ent is None:
+                return -2, b"", 0
+            data = ent[0]
+            if msg.length:
+                data = data[msg.offset:msg.offset + msg.length]
+            elif msg.offset:
+                data = data[msg.offset:]
+            return 0, data, ent[2]
+        if op == M.OSD_OP_STAT:
+            if ent is None:
+                return -2, b"", 0
+            return 0, json.dumps({"size": len(ent[0])}).encode(), \
+                ent[2]
+        if op == M.OSD_OP_REMOVE:
+            if coll.pop(msg.oid, None) is None:
+                return -2, b"", 0
+            return 0, b"", reactor.store.next_version(pgid)
+        if op == M.OSD_OP_SETXATTR:
+            v = reactor.store.next_version(pgid)
+            if ent is None:
+                ent = coll[msg.oid] = [b"", {}, v]
+            ent[1][msg.xname] = bytes(msg.data)
+            ent[2] = v
+            return 0, b"", v
+        if op == M.OSD_OP_GETXATTR:
+            if ent is None:
+                return -2, b"", 0
+            val = ent[1].get(msg.xname)
+            if val is None:
+                return -61, b"", ent[2]
+            return 0, val, ent[2]
+        if op == M.OSD_OP_LIST:
+            return 0, json.dumps(sorted(coll)).encode(), 0
+        return -22, b"", 0
+
+    # -- introspection -------------------------------------------------
+    def shard_stats(self) -> list[dict]:
+        return [{"reactor": r.idx, "pgs": len(r.store.colls),
+                 "objects": sum(len(c) for c in r.store.colls.values()),
+                 "ops": r.ops_served}
+                for r in self.reactors]

@@ -55,6 +55,13 @@ from ceph_tpu.utils.dout import Dout
 
 log = Dout("osd")
 
+from ceph_tpu.utils import tracepoints as _tracepoints  # noqa: E402
+
+_TP_FLUSH = _tracepoints.provider("osd").point(
+    "device_flush", "ops", "bytes")
+_TP_DECODE_FLUSH = _tracepoints.provider("osd").point(
+    "device_decode_flush", "ops", "signature")
+
 
 class DeviceEncodeEngine:
     """One per OSD; owns the device dispatch thread."""
@@ -75,7 +82,13 @@ class DeviceEncodeEngine:
                       "max_batch_ops": 0, "errors": 0,
                       "decode_flushes": 0, "decode_ops": 0,
                       "decode_bytes": 0, "max_decode_batch_ops": 0,
-                      "decode_errors": 0, "device_fused_fallbacks": 0}
+                      "decode_errors": 0, "device_fused_fallbacks": 0,
+                      # engine-thread seconds spent launching +
+                      # finalizing device batches: busy_s/flushes is
+                      # the MEASURED per-launch cost the amortization
+                      # analysis divides out (BASELINE.md cluster
+                      # table)
+                      "busy_s": 0.0}
         self._thread = threading.Thread(
             target=self._run, name="ec-device-engine", daemon=True)
         self._thread.start()
@@ -140,9 +153,16 @@ class DeviceEncodeEngine:
 
     # -- engine thread ------------------------------------------------
     def _run(self) -> None:
+        #: one-deep launch pipeline: (items, finalize) of the batch
+        #: whose device program is queued but not yet downloaded —
+        #: batch N+1 stages and LAUNCHES while N's results stream
+        #: back (double-buffering; on a high-RTT link this overlaps
+        #: upload(N+1) with compute+download(N))
+        self._inflight = None
         while True:
             item = self._q.get()
             if item is None:
+                self._drain_inflight()
                 return
             pending: dict[int, tuple] = {}   # id(codec) -> state
             # (id(codec), present, want) -> (codec, sinfo, items)
@@ -152,6 +172,7 @@ class DeviceEncodeEngine:
                 if item is None:
                     self._flush(pending)
                     self._flush_decodes(dec_pending)
+                    self._drain_inflight()
                     return
                 if item[0] == "enc":
                     _, key, codec, sinfo, data, cont = item
@@ -183,6 +204,9 @@ class DeviceEncodeEngine:
                 else:                        # barrier
                     self._flush(pending)
                     self._flush_decodes(dec_pending)
+                    # the barrier fn must run AFTER every prior op's
+                    # continuation: drain the launch pipeline first
+                    self._drain_inflight()
                     pending, dec_pending, nbytes = {}, {}, 0
                     _, key, fn = item
                     self._dispatch(key, fn)
@@ -190,16 +214,21 @@ class DeviceEncodeEngine:
                     item = self._q.get_nowait()
                 except queue.Empty:
                     # nothing else queued: launch what we have now
-                    # (an idle engine adds no batching latency)
+                    # (an idle engine adds no batching latency) and
+                    # drain — continuations must not wait for load
                     self._flush(pending)
                     self._flush_decodes(dec_pending)
+                    self._drain_inflight()
                     pending, dec_pending, nbytes = {}, {}, 0
                     break
             if not self._running:
                 return
 
     def _flush(self, pending: dict) -> None:
+        import time as _time
         from ceph_tpu.parallel import mesh as mesh_mod
+        t0 = _time.perf_counter()
+        drained = 0.0                 # _drain_inflight self-accounts
         for codec, sinfo, items in pending.values():
             # a configured default mesh routes the flush through the
             # multi-chip encode step (pod deployments; dryrun/tests)
@@ -209,15 +238,52 @@ class DeviceEncodeEngine:
             for i, (_key, data, _cont) in enumerate(items):
                 batcher.append(i, data)
             try:
-                results = batcher.flush(
+                finalize = batcher.flush_async(
                     with_crcs=ec_util.fuse_crc_policy(codec))
             except Exception as exc:
+                # launch failed: older batches' continuations must
+                # still run BEFORE these error continuations (per-PG
+                # order), so drain first
+                drained += self._drain_inflight()
                 log(0, f"device encode batch of {len(items)} ops "
                     f"failed: {exc!r}")
                 self.stats["errors"] += 1
                 for key, _data, cont in items:
                     self._dispatch(key, _bind(cont, None, None, exc))
                 continue
+            # batch launched (async): NOW harvest the previous one —
+            # its download overlaps this batch's upload/compute
+            if _TP_FLUSH.enabled:
+                _TP_FLUSH(len(items),
+                          sum(d.nbytes for _, d, _c in items))
+            drained += self._drain_inflight()
+            self._inflight = (items, finalize)
+        if pending:
+            # drain time self-accounts inside _drain_inflight; only
+            # the launch-side time is added here (no double count)
+            self.stats["busy_s"] += \
+                _time.perf_counter() - t0 - drained
+        pending.clear()
+
+    def _drain_inflight(self) -> float:
+        """Harvest the in-flight batch; returns seconds spent (also
+        accumulated into busy_s here)."""
+        import time as _time
+        if self._inflight is None:
+            return 0.0
+        t0 = _time.perf_counter()
+        items, finalize = self._inflight
+        self._inflight = None
+        try:
+            results = finalize()
+        except Exception as exc:
+            log(0, f"device encode batch of {len(items)} ops "
+                f"failed: {exc!r}")
+            self.stats["errors"] += 1
+            for key, _data, cont in items:
+                self._dispatch(key, _bind(cont, None, None, exc))
+            results = None
+        if results is not None:
             self.stats["flushes"] += 1
             self.stats["ops"] += len(items)
             self.stats["bytes"] += sum(d.nbytes for _, d, _c in items)
@@ -226,10 +292,12 @@ class DeviceEncodeEngine:
             if self._counters is not None:
                 self._counters.inc("device_batches")
                 self._counters.inc("device_batch_ops", len(items))
-            for (key, _data, cont), (_i, shards, crcs) in zip(items,
-                                                             results):
+            for (key, _data, cont), (_i, shards, crcs) in zip(
+                    items, results):
                 self._dispatch(key, _bind(cont, shards, crcs, None))
-        pending.clear()
+        dt = _time.perf_counter() - t0
+        self.stats["busy_s"] += dt
+        return dt
 
 
     def _note_fused_fallback(self, path: str, exc: Exception) -> None:
@@ -265,6 +333,8 @@ class DeviceEncodeEngine:
                 for _key, _shards, _want, cont in items:
                     cont(None, exc)
                 continue
+            if _TP_DECODE_FLUSH.enabled:
+                _TP_DECODE_FLUSH(len(items), str(present))
             self.stats["decode_flushes"] += 1
             self.stats["decode_ops"] += len(items)
             self.stats["decode_bytes"] += sum(

@@ -261,21 +261,35 @@ class StripeBatcher:
         HBM residency); only available on the fused device path, None
         otherwise (callers fall back to host hashing).
         """
+        return self.flush_async(with_crcs)()
+
+    def flush_async(self, with_crcs: bool = False):
+        """Launch the batch and return ``finalize() -> results``.
+
+        On the fused device path the launch is ASYNC (jax dispatch):
+        finalize blocks on the download. The engine exploits this to
+        double-buffer — stage and launch batch N+1 while N's results
+        stream back, which is what amortizes a high-latency link
+        (axon tunnel) the way a locally-attached chip amortizes
+        dispatch. The mesh and plain paths compute synchronously here
+        and finalize trivially. Device faults surface from
+        finalize() — callers route them to their host fallback."""
         if not self._pending:
-            return []
+            return lambda: []
         ops, bufs = zip(*self._pending)
         self._pending, self._pending_bytes = [], 0
         if self.mesh is not None and _device_fusable(self.codec):
             try:
-                return _flush_mesh(self.mesh, self.sinfo, self.codec,
-                                   ops, bufs)
+                results = _flush_mesh(self.mesh, self.sinfo,
+                                      self.codec, ops, bufs)
+                return lambda: results
             except Exception as exc:
                 self._note_fallback("mesh", exc)
                 # single-device fallback below
         if with_crcs and _device_fusable(self.codec):
             try:
-                return _flush_device_fused(self.sinfo, self.codec,
-                                           ops, bufs)
+                return _flush_device_fused_async(
+                    self.sinfo, self.codec, ops, bufs)
             except Exception as exc:
                 # fused path failure must not lose the batch: the
                 # plain path below re-encodes (host or device)
@@ -291,7 +305,7 @@ class StripeBatcher:
                 i: v[off:off + nchunk] for i, v in shards.items()},
                 None))
             off += nchunk
-        return results
+        return lambda: results
 
     #: failure classes already logged (log once per class per process:
     #: a persistent fault would otherwise spam every flush)
@@ -417,14 +431,18 @@ def _flush_mesh(mesh, sinfo: StripeInfo, codec, ops, bufs):
     return results
 
 
-def _flush_device_fused(sinfo: StripeInfo, codec, ops, bufs):
+def _flush_device_fused_async(sinfo: StripeInfo, codec, ops, bufs):
     """One device program per bucketed batch signature: upload the
     stripe batch once, encode parity, and take every op's per-shard
     crc linear part from the SAME device-resident shards (one download
     round trip for parity + 4 bytes/shard of crcs). Per-op segment
     boundaries are DYNAMIC inputs (offsets/lengths arrays), with
     front-zero padding — free under crc linearity — masking the
-    neighbour bytes a fixed-width window drags in."""
+    neighbour bytes a fixed-width window drags in.
+
+    Returns ``finalize() -> results``: the jit call here only QUEUES
+    the program (jax async dispatch); finalize downloads — callers
+    can launch the next batch before finalizing this one."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -487,16 +505,21 @@ def _flush_device_fused(sinfo: StripeInfo, codec, ops, bufs):
     offs_arr[:len(ops)] = np.cumsum([0] + lens[:-1])
     lens_arr = np.zeros(nops_b, dtype=np.int32)
     lens_arr[:len(ops)] = lens
-    parity, lin = fn(data_dev, offs_arr, lens_arr)
-    parity = np.asarray(parity)
-    lin = np.asarray(lin).reshape(nops_b, n_chunks)
-    results = []
-    off = 0
-    for idx, (op_id, ln) in enumerate(zip(ops, lens)):
-        shards = {i: data_shards[i, off:off + ln] for i in range(k)}
-        for j in range(m):
-            shards[k + j] = parity[j, off:off + ln]
-        crcs = {i: int(lin[idx, i]) for i in range(n_chunks)}
-        results.append((op_id, shards, crcs))
-        off += ln
-    return results
+    parity_dev, lin_dev = fn(data_dev, offs_arr, lens_arr)
+
+    def finalize():
+        parity = np.asarray(parity_dev)
+        lin = np.asarray(lin_dev).reshape(nops_b, n_chunks)
+        results = []
+        off = 0
+        for idx, (op_id, ln) in enumerate(zip(ops, lens)):
+            shards = {i: data_shards[i, off:off + ln]
+                      for i in range(k)}
+            for j in range(m):
+                shards[k + j] = parity[j, off:off + ln]
+            crcs = {i: int(lin[idx, i]) for i in range(n_chunks)}
+            results.append((op_id, shards, crcs))
+            off += ln
+        return results
+
+    return finalize

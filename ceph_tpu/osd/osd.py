@@ -71,6 +71,17 @@ from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
 log = Dout("osd")
 
+# static tracepoints (src/tracing/{osd,oprequest}.tp role): declared
+# at import like a compiled-in provider; near-zero cost when disabled
+from ceph_tpu.utils import tracepoints as _tracepoints  # noqa: E402
+
+_TP_OP_DEQUEUE = _tracepoints.provider("oprequest").point(
+    "op_dequeue", "oid", "op", "client")
+_TP_OP_REPLY = _tracepoints.provider("oprequest").point(
+    "op_reply", "oid", "code", "lat_us")
+_TP_RECOVERY_PUSH = _tracepoints.provider("osd").point(
+    "recovery_push", "oid", "shard", "version")
+
 # errno-style codes carried in MOSDOpReply.code
 EAGAIN = -11
 EIO = -5
@@ -314,6 +325,8 @@ class OSD:
             "dump_traces",
             lambda a: tracing.tracer().dump(a.get("trace_id")),
             "finished dataflow-trace spans (blkin role)")
+        from ceph_tpu.utils import tracepoints as _tp
+        _tp.register_asok(self.asok)
         self.asok.start()
         self.addr = self.msgr.bind(host, port)
         self.monc.subscribe()
@@ -747,6 +760,7 @@ class OSD:
     def _handle_osd_op(self, msg: M.MOSDOp, conn: Connection) -> None:
         osdmap = self.get_osdmap()
         t0 = time.perf_counter()
+        _TP_OP_DEQUEUE(msg.oid, msg.op, msg.client)
         self.logger.inc("op")
         track = self.op_tracker.create(
             f"osd_op(client={msg.client} tid={msg.tid} op={msg.op} "
@@ -769,6 +783,8 @@ class OSD:
 
         def reply(code: int, data: bytes = b"", version: int = 0) -> None:
             self.logger.tinc("op_latency", time.perf_counter() - t0)
+            _TP_OP_REPLY(msg.oid, code,
+                         int((time.perf_counter() - t0) * 1e6))
             track.finish()
             span.event(f"reply code={code}")
             span.finish()
@@ -1804,6 +1820,7 @@ class OSD:
                     continue
                 with pg.lock:
                     pg.rollback_pending.pop(oid, None)
+                _TP_RECOVERY_PUSH(oid, pos, version)
                 if osd == self.whoami:
                     # apply inline (we run on this PG's wq thread; the
                     # self-reply completes the wait synchronously)

@@ -190,7 +190,9 @@ class Monitor:
                        "is_leader": self.is_leader(),
                        "monmap": {str(r): a_ for r, a_ in
                                   self.monmap.items()},
-                       "last_committed": self._last_committed()},
+                       "last_committed": self._last_committed(),
+                       "state_bytes": getattr(self,
+                                              "_last_state_bytes", 0)},
             "election/quorum state (Elector role)")
         self.asok.start()
         self.prebind(host, port)
@@ -607,10 +609,28 @@ class Monitor:
             f"(epoch {self.osdmap.epoch})")
         self._publish()
 
+    #: replication-cost guard (the reference ships per-value Paxos log
+    #: txns, src/mon/Paxos.cc share_state; we ship full snapshots —
+    #: O(state) per commit per peon. Fine while the state is small;
+    #: this warns ONCE when it stops being small so the bound is
+    #: monitored, not silent)
+    STATE_SIZE_WARN = 4 << 20
+    _state_size_warned = False
+
     def _encode_state(self) -> bytes:
-        return self._encode_state_of(self.osdmap, self.ec_profiles,
-                                     self._cmd_replies,
-                                     self._central_config)
+        raw = self._encode_state_of(self.osdmap, self.ec_profiles,
+                                    self._cmd_replies,
+                                    self._central_config)
+        self._last_state_bytes = len(raw)
+        if len(raw) > self.STATE_SIZE_WARN and \
+                not Monitor._state_size_warned:
+            Monitor._state_size_warned = True
+            log(0, f"mon.{self.name}: replicated state is "
+                f"{len(raw) >> 20} MiB — full-snapshot commit "
+                "replication is O(state) per commit per peon; the "
+                "per-value log transfer rework (Paxos.cc share_state "
+                "role) is due")
+        return raw
 
     @staticmethod
     def _encode_state_of(osdmap, ec_profiles, cmd_replies,

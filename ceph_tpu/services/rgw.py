@@ -120,6 +120,21 @@ class RGWGateway:
                         "marker": marker}).encode())
         return json.loads(out or b"{}")
 
+    def container_stats(self, bucket: str) -> tuple[int, int]:
+        """(object_count, bytes_used) — ACCURATE, by paging the whole
+        index in bounded pages (no silent 10k cap; each page's wire
+        transfer stays bounded)."""
+        self._check_bucket(bucket)
+        count = total = 0
+        marker = ""
+        while True:
+            page = self._index_list(bucket, "", 10000, marker)
+            if not page:
+                return count, total
+            count += len(page)
+            total += sum(e["size"] for e in page.values())
+            marker = max(page)
+
     # -- buckets -------------------------------------------------------
     def _buckets(self) -> dict:
         try:
@@ -536,6 +551,208 @@ def verify_sigv4(handler, auth: dict[str, str],
 class _Handler(BaseHTTPRequestHandler):
     gw: RGWGateway = None          # set by server factory
     auth: dict[str, str] | None = None   # access_key -> secret
+    #: Swift TempAuth token table (token -> (account, expiry)); per
+    #: server instance (the bound subclass carries its own dict)
+    swift_tokens: dict = None
+    SWIFT_TOKEN_TTL = 3600.0
+
+    # -- Swift REST dialect (src/rgw/rgw_rest_swift.cc role) ----------
+    # The same buckets/objects the S3 dialect serves, exposed under
+    # /v1/AUTH_<account>/<container>/<object> with TempAuth
+    # (/auth/v1.0) — exactly how radosgw fronts one store with both
+    # APIs. Containers map 1:1 onto buckets.
+
+    def _swift_reply(self, status: int, body: bytes = b"",
+                     headers: dict | None = None,
+                     ctype: str = "text/plain; charset=utf-8") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for kk, vv in (headers or {}).items():
+            self.send_header(kk, vv)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _swift_auth_req(self) -> None:
+        """GET /auth/v1.0 (TempAuth): X-Auth-User 'account:user' +
+        X-Auth-Key -> X-Auth-Token + X-Storage-Url."""
+        user = self.headers.get("X-Auth-User", "")
+        key = self.headers.get("X-Auth-Key", "")
+        account = user.split(":", 1)[0]
+        if self.auth is not None:
+            if not account or self.auth.get(account) != key:
+                self._swift_reply(401, b"Unauthorized")
+                return
+        account = account or "anon"
+        import secrets
+        import time as _t
+        now = _t.time()
+        with self.swift_lock:
+            if len(self.swift_tokens) > 1024:
+                # reap expired tokens (a per-request re-authenticator
+                # must not grow the table unboundedly); under the lock
+                # — ThreadingHTTPServer inserts concurrently
+                for tk in [tk for tk, (_a, exp) in
+                           list(self.swift_tokens.items())
+                           if exp < now]:
+                    self.swift_tokens.pop(tk, None)
+            token = "AUTH_tk" + secrets.token_hex(16)
+            self.swift_tokens[token] = (account,
+                                        now + self.SWIFT_TOKEN_TTL)
+        host = self.headers.get("Host", "localhost")
+        self._swift_reply(200, b"", headers={
+            "X-Auth-Token": token,
+            "X-Storage-Token": token,
+            "X-Storage-Url": f"http://{host}/v1/AUTH_{account}",
+        })
+
+    def _swift_check_token(self) -> bool:
+        if self.auth is None:
+            return True                 # open server: token optional
+        import time as _t
+        token = self.headers.get("X-Auth-Token", "")
+        with self.swift_lock:
+            ent = self.swift_tokens.get(token)
+            if ent is None or ent[1] < _t.time():
+                self.swift_tokens.pop(token, None)
+                self._swift_reply(401, b"Unauthorized")
+                return False
+        # account isolation: the token only authorizes ITS account's
+        # /v1/AUTH_<acct> namespace (TempAuth semantics) — a valid
+        # token for account a must not read/write AUTH_b
+        parts = urllib.parse.urlparse(self.path).path.lstrip(
+            "/").split("/", 2)
+        url_acct = parts[1][len("AUTH_"):] if len(parts) > 1 else ""
+        if url_acct != ent[0]:
+            self._swift_reply(403, b"Forbidden")
+            return False
+        return True
+
+    def _swift_split(self) -> tuple[str, str, dict]:
+        """/v1/AUTH_<acct>[/container[/object...]] -> (container,
+        object, query)."""
+        parsed = urllib.parse.urlparse(self.path)
+        parts = parsed.path.lstrip("/").split("/", 3)
+        # parts[0] = 'v1', parts[1] = 'AUTH_<acct>'
+        cont = urllib.parse.unquote(parts[2]) if len(parts) > 2 else ""
+        obj = urllib.parse.unquote(parts[3]) if len(parts) > 3 else ""
+        q = dict(urllib.parse.parse_qsl(parsed.query,
+                                        keep_blank_values=True))
+        return cont, obj, q
+
+    def _swift_dispatch(self, method: str, payload: bytes) -> bool:
+        """Route Swift-dialect paths; returns True when handled."""
+        path = urllib.parse.urlparse(self.path).path
+        if path.startswith("/auth/v1.0"):
+            if method == "GET":
+                self._swift_auth_req()
+            else:
+                self._swift_reply(405, b"Method Not Allowed")
+            return True
+        # only the Swift account shape routes here: /v1/AUTH_<acct>.
+        # A plain S3 bucket literally named 'v1' keeps working (its
+        # keys don't start with AUTH_); only /v1/AUTH_* is reserved,
+        # like the reference's swift url prefix.
+        parts = path.lstrip("/").split("/", 2)
+        if not (parts[0] == "v1" and len(parts) > 1
+                and parts[1].startswith("AUTH_")):
+            return False
+        if not self._swift_check_token():
+            return True
+        try:
+            self._swift_op(method, payload)
+        except RGWError as exc:
+            status = exc.status
+            if str(exc) in ("NoSuchBucket", "NoSuchKey"):
+                status = 404
+            self._swift_reply(status, str(exc).encode())
+        except Exception as exc:  # pragma: no cover
+            self._swift_reply(500, repr(exc).encode())
+        return True
+
+    def _swift_op(self, method: str, payload: bytes) -> None:
+        cont, obj, q = self._swift_split()
+        gw = self.gw
+        fmt = q.get("format", "")
+        if not cont:                      # account level
+            if method in ("GET", "HEAD"):
+                names = gw.list_buckets()
+                if method == "HEAD":
+                    self._swift_reply(204, b"", headers={
+                        "X-Account-Container-Count": str(len(names))})
+                    return
+                if fmt == "json":
+                    out = []
+                    for n in names:
+                        cnt, used = gw.container_stats(n)
+                        out.append({"name": n, "count": cnt,
+                                    "bytes": used})
+                    self._swift_reply(200, json.dumps(out).encode(),
+                                      ctype="application/json")
+                else:
+                    body = "".join(f"{n}\n" for n in names).encode()
+                    self._swift_reply(200 if body else 204, body)
+            else:
+                self._swift_reply(405, b"Method Not Allowed")
+            return
+        if not obj:                       # container level
+            if method == "PUT":
+                existed = cont in gw.list_buckets()
+                gw.create_bucket(cont)
+                self._swift_reply(202 if existed else 201)
+            elif method == "DELETE":
+                gw.delete_bucket(cont)
+                self._swift_reply(204)
+            elif method == "HEAD":
+                cnt, used = gw.container_stats(cont)
+                self._swift_reply(204, b"", headers={
+                    "X-Container-Object-Count": str(cnt),
+                    "X-Container-Bytes-Used": str(used)})
+            elif method == "GET":
+                gw._check_bucket(cont)
+                try:
+                    limit = int(q.get("limit", "") or 10000)
+                    if limit < 0:
+                        raise ValueError
+                except ValueError:
+                    raise RGWError(412, "Bad limit") from None
+                idx = gw.list_objects(cont, prefix=q.get("prefix", ""),
+                                      max_keys=limit,
+                                      marker=q.get("marker", ""))
+                if fmt == "json":
+                    out = [{"name": kk, "bytes": vv["size"],
+                            "hash": vv["etag"]}
+                           for kk, vv in sorted(idx.items())]
+                    self._swift_reply(200, json.dumps(out).encode(),
+                                      ctype="application/json")
+                else:
+                    body = "".join(f"{kk}\n"
+                                   for kk in sorted(idx)).encode()
+                    self._swift_reply(200 if body else 204, body)
+            else:
+                self._swift_reply(405, b"Method Not Allowed")
+            return
+        # object level
+        if method == "PUT":
+            etag = gw.put_object(cont, obj, payload)
+            self._swift_reply(201, b"", headers={"ETag": etag})
+        elif method == "GET":
+            data, meta = gw.get_object(cont, obj)
+            self._swift_reply(200, data, headers={
+                "ETag": meta["etag"]},
+                ctype="application/octet-stream")
+        elif method == "HEAD":
+            _, meta = gw.get_object(cont, obj)
+            self.send_response(200)
+            self.send_header("Content-Length", str(meta["size"]))
+            self.send_header("ETag", meta["etag"])
+            self.end_headers()
+        elif method == "DELETE":
+            gw.delete_object(cont, obj)
+            self._swift_reply(204)
+        else:
+            self._swift_reply(405, b"Method Not Allowed")
 
     def _split(self) -> tuple[str, str, dict]:
         parsed = urllib.parse.urlparse(self.path)
@@ -574,6 +791,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, _xml_error("InternalError", repr(exc)))
 
     def do_GET(self) -> None:  # noqa: N802
+        if self._swift_dispatch("GET", b""):
+            return
         bucket, key, q = self._split()
 
         def run() -> None:
@@ -626,9 +845,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._run(run)
 
     def do_PUT(self) -> None:  # noqa: N802
-        bucket, key, q = self._split()
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n) if n else b""
+        if self._swift_dispatch("PUT", body):
+            return
+        bucket, key, q = self._split()
 
         def run() -> None:
             if not key:
@@ -654,9 +875,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._run(run, payload=body)
 
     def do_POST(self) -> None:  # noqa: N802
-        bucket, key, q = self._split()
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n) if n else b""
+        if self._swift_dispatch("POST", body):
+            return
+        bucket, key, q = self._split()
 
         def run() -> None:
             if "uploads" in q and key:
@@ -672,6 +895,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._run(run, payload=body)
 
     def do_DELETE(self) -> None:  # noqa: N802
+        if self._swift_dispatch("DELETE", b""):
+            return
         bucket, key, q = self._split()
 
         def run() -> None:
@@ -685,6 +910,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._run(run)
 
     def do_HEAD(self) -> None:  # noqa: N802
+        if self._swift_dispatch("HEAD", b""):
+            return
         bucket, key, _ = self._split()
 
         def run() -> None:
@@ -709,7 +936,9 @@ class RGWServer:
                  auth: dict[str, str] | None = None) -> None:
         gw = RGWGateway(ioctx)
         handler = type("BoundHandler", (_Handler,),
-                       {"gw": gw, "auth": auth})
+                       {"gw": gw, "auth": auth,
+                        "swift_tokens": {},
+                        "swift_lock": threading.Lock()})
         self._srv = ThreadingHTTPServer((host, port), handler)
         self.port = self._srv.server_address[1]
         self.gateway = gw

@@ -38,6 +38,11 @@ from ceph_tpu.utils import checksum
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 
+from ceph_tpu.utils import tracepoints as _tracepoints
+
+_TP_QUEUE_TXN = _tracepoints.provider("objectstore").point(
+    "queue_transaction", "ops")
+
 #: on-disk compressor ids (bluestore_compression_algorithm role); the
 #: id is stored per blob so config changes never orphan old blobs
 COMP_NONE = 0
@@ -237,6 +242,7 @@ class BlockStore(ObjectStore):
     def queue_transaction(self, txn: Transaction,
                           on_commit: Callable[[], None] | None = None) -> None:
         assert self._db is not None, "not mounted"
+        _TP_QUEUE_TXN(len(txn))
         # stage 1: data-file appends for every WRITE op; blobs compress
         # when the configured algorithm saves enough
         # (bluestore_compression_* semantics)

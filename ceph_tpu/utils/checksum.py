@@ -58,10 +58,30 @@ def crc32c(data, crc: int = 0) -> int:
 
 
 def xxhash64(data, seed: int = 0) -> int:
+    """xxhash64 — HOST ONLY, by analysis (r2 verdict item 9).
+
+    crc32c rides the device because it is GF(2)-LINEAR: the whole
+    checksum is a bit-matrix product, so it folds into the encode's
+    MXU launch (ops/crc32c_device.py) and zero-extension has a
+    closed form. xxhash does NOT decompose that way: its compression
+    step ``acc' = rotl32(acc + lane * PRIME2, 13) * PRIME1`` mixes
+    carry-propagating adds and multiplies mod 2^32 with rotations —
+    non-linear over GF(2) AND over Z/2^32 (rotl distributes over
+    neither), so there is no matrix form, no seed-correction
+    identity, and no log-depth reduction of the per-accumulator
+    chain. A device evaluation is therefore a SEQUENTIAL scan of
+    len/16 steps per buffer, profitable only when thousands of
+    equal-length buffers hash in lockstep — a shape the daemon's
+    flush (dozens of ragged blobs) never produces. The native
+    single-core xxh64 (~10 GB/s, ops/native) already outruns the
+    blob sizes involved, so xxhash blobs stay on the host. The
+    analysis is recorded in BASELINE.md; reference enumeration:
+    src/common/Checksummer.h:11-19."""
     return native_loader.xxhash64(data, seed)
 
 
 def xxhash32(data, seed: int = 0) -> int:
+    """xxhash32 — host only; see xxhash64's analysis."""
     return native_loader.xxhash32(data, seed)
 
 

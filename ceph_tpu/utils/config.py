@@ -274,6 +274,16 @@ for _o in [
            "within this many seconds"),
     Option("mon_election_timeout", float, 2.0, "advanced",
            "mon election timeout seconds"),
+    Option("crimson_smp", int, 3, "advanced",
+           "crimson prototype reactor count (seastar --smp role)",
+           min=1, max=64),
+    Option("osd_tracing", bool, False, "advanced",
+           "arm the 'osd' static-tracepoint provider at daemon start "
+           "(TracepointProvider role, src/ceph_osd.cc:36)"),
+    Option("oprequest_tracing", bool, False, "advanced",
+           "arm the 'oprequest' tracepoint provider"),
+    Option("objectstore_tracing", bool, False, "advanced",
+           "arm the 'objectstore' tracepoint provider"),
     Option("mon_lease", float, 5.0, "advanced",
            "seconds a peon may serve reads from committed state after "
            "a leader heartbeat/commit grant (Paxos lease, "

@@ -129,7 +129,7 @@ def test_poisoned_fused_flush_completes_and_counts(monkeypatch):
     def boom(*a, **k):
         raise RuntimeError("poisoned fused path")
 
-    monkeypatch.setattr(ec_util, "_flush_device_fused", boom)
+    monkeypatch.setattr(ec_util, "_flush_device_fused_async", boom)
     codec = _codec(backend="jax")
     sinfo = StripeInfo(stripe_width=2 * 1024, chunk_size=1024)
     eng = DeviceEncodeEngine(lambda key, fn: fn())
@@ -159,6 +159,69 @@ def test_poisoned_fused_flush_completes_and_counts(monkeypatch):
             time.sleep(0.01)
         assert got and got[0][2] is None
         assert eng.stats["device_fused_fallbacks"] == 2
+    finally:
+        eng.stop()
+
+
+def test_engine_double_buffers_fused_launches(monkeypatch):
+    """The launch pipeline: batch N+1 LAUNCHES before batch N's
+    results are finalized (download overlap), while continuations
+    still dispatch in batch order."""
+    import os
+
+    from ceph_tpu.osd import ec_util
+
+    monkeypatch.setenv("CEPH_TPU_FUSE_CRC", "1")
+    order: list[str] = []
+    first_entered = threading.Event()
+    go = threading.Event()
+
+    def fake_async(sinfo, codec, ops, bufs):
+        n = sum(1 for e in order if e.startswith("launch"))
+        order.append(f"launch{n}")
+        if n == 0:
+            first_entered.set()
+            go.wait(10)        # hold the engine inside launch 0
+
+        def finalize():
+            order.append(f"fin{n}")
+            out = []
+            cs, sw = sinfo.chunk_size, sinfo.stripe_width
+            shards = ec_util.encode(sinfo, _codec(),
+                                    np.concatenate(bufs))
+            off = 0
+            for op_id, buf in zip(ops, bufs):
+                nchunk = len(buf) // sw * cs
+                out.append((op_id,
+                            {i: v[off:off + nchunk]
+                             for i, v in shards.items()}, None))
+                off += nchunk
+            return out
+
+        return finalize
+
+    monkeypatch.setattr(ec_util, "_flush_device_fused_async",
+                        fake_async)
+    codec = _codec(backend="jax")
+    sinfo = StripeInfo(stripe_width=2 * 1024, chunk_size=1024)
+    done = []
+    eng = DeviceEncodeEngine(lambda k, f: f(), flush_bytes=4096)
+    try:
+        data = np.zeros(4096, dtype=np.uint8)   # one op = threshold
+        eng.stage_encode("A", codec, sinfo, data,
+                         lambda s, c, e: done.append((1, e)))
+        assert first_entered.wait(10)
+        eng.stage_encode("A", codec, sinfo, data,
+                         lambda s, c, e: done.append((2, e)))
+        go.set()
+        deadline = time.monotonic() + 10
+        while len(done) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert [d[0] for d in done] == [1, 2], done     # FIFO conts
+        assert all(e is None for _, e in done), done
+        # batch 1 launched BEFORE batch 0 finalized: the pipeline
+        assert order == ["launch0", "launch1", "fin0", "fin1"], order
+        assert eng.stats["flushes"] == 2
     finally:
         eng.stop()
 
