@@ -14,9 +14,16 @@ bytes:
 ``new_obj is None`` leaves the object untouched; otherwise the OSD
 writes it back through the normal versioned replication path. The PG
 executes ops serially, so read-modify-write methods are atomic exactly
-like the reference's cls handlers. Built-ins mirror reference modules:
-``lock`` (cls_lock: advisory object locks) and ``log`` (cls_log:
-append-only timestamped records).
+like the reference's cls handlers.
+
+Built-in families mirror 14 of the reference's 17 cls modules:
+lock, log, version, refcount, numops, timeindex, statelog, hello,
+rgw (bucket index + multipart), rbd (image directory), user (rgw
+account stats), cas (dedup chunk refs), otp (in-OSD TOTP), and fs
+(the cephfs dirop/ino methods, src/cls/cephfs role). Deliberate
+cuts: ``lua`` (no Lua runtime in this image), ``sdk`` (a reference
+test scaffold), ``journal`` (our journal keeps its state in plain
+objects + omap; see services/journal.py).
 """
 
 from __future__ import annotations

@@ -221,3 +221,186 @@ def _hello_replay(inp: bytes, obj: bytes | None):
     if obj is None:
         return -2, b"", None
     return 0, bytes(obj), None
+
+
+# -- cls_rbd (src/cls/rbd/cls_rbd.cc): image header + directory
+# management. The directory methods are what make concurrent clients
+# safe: image create/remove/rename mutate the shared rbd_directory
+# ATOMICALLY in-OSD instead of a client-side read-modify-write --------
+
+@register("rbd", "dir_add_image")
+def _rbd_dir_add(inp: bytes, obj: bytes | None):
+    """input: {"name", "meta"} -> -EEXIST when present."""
+    req = json.loads(inp)
+    d = _state(obj, {})
+    if req["name"] in d:
+        return -17, b"", None
+    d[req["name"]] = req.get("meta", {})
+    return 0, b"", json.dumps(d, sort_keys=True).encode()
+
+
+@register("rbd", "dir_remove_image")
+def _rbd_dir_remove(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    d = _state(obj, {})
+    if req["name"] not in d:
+        return -2, b"", None
+    del d[req["name"]]
+    return 0, b"", json.dumps(d, sort_keys=True).encode()
+
+
+@register("rbd", "dir_rename_image")
+def _rbd_dir_rename(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    d = _state(obj, {})
+    if req["src"] not in d:
+        return -2, b"", None
+    if req["dst"] in d:
+        return -17, b"", None
+    d[req["dst"]] = d.pop(req["src"])
+    return 0, b"", json.dumps(d, sort_keys=True).encode()
+
+
+@register("rbd", "dir_update_image")
+def _rbd_dir_update(inp: bytes, obj: bytes | None):
+    """Merge metadata keys into an existing entry (size bumps)."""
+    req = json.loads(inp)
+    d = _state(obj, {})
+    ent = d.get(req["name"])
+    if ent is None:
+        return -2, b"", None
+    ent.update(req.get("meta", {}))
+    return 0, b"", json.dumps(d, sort_keys=True).encode()
+
+
+@register("rbd", "dir_list")
+def _rbd_dir_list(inp: bytes, obj: bytes | None):
+    return 0, json.dumps(_state(obj, {}), sort_keys=True).encode(), \
+        None
+
+
+# -- cls_user (src/cls/user/cls_user.cc): per-user bucket accounting
+# for rgw (the user's bucket list + usage header) ----------------------
+
+@register("user", "add_bucket")
+def _user_add_bucket(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    st = _state(obj, {"buckets": {}, "stats": {"count": 0, "bytes": 0}})
+    b = st["buckets"].setdefault(
+        req["bucket"], {"count": 0, "bytes": 0})
+    b["count"] += int(req.get("count", 0))
+    b["bytes"] += int(req.get("bytes", 0))
+    st["stats"]["count"] = sum(x["count"]
+                               for x in st["buckets"].values())
+    st["stats"]["bytes"] = sum(x["bytes"]
+                               for x in st["buckets"].values())
+    return 0, b"", json.dumps(st, sort_keys=True).encode()
+
+
+@register("user", "remove_bucket")
+def _user_remove_bucket(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    st = _state(obj, {"buckets": {}, "stats": {"count": 0, "bytes": 0}})
+    if st["buckets"].pop(req["bucket"], None) is None:
+        return -2, b"", None
+    st["stats"]["count"] = sum(x["count"]
+                               for x in st["buckets"].values())
+    st["stats"]["bytes"] = sum(x["bytes"]
+                               for x in st["buckets"].values())
+    return 0, b"", json.dumps(st, sort_keys=True).encode()
+
+
+@register("user", "get_header")
+def _user_get_header(inp: bytes, obj: bytes | None):
+    st = _state(obj, {"buckets": {}, "stats": {"count": 0, "bytes": 0}})
+    return 0, json.dumps(
+        {"stats": st["stats"],
+         "buckets": sorted(st["buckets"])}).encode(), None
+
+
+# -- cls_cas (src/cls/cas/cls_cas.cc): content-addressed chunk
+# refcounting — a dedup chunk object lives while references exist ------
+
+@register("cas", "chunk_create_or_get_ref")
+def _cas_get_ref(inp: bytes, obj: bytes | None):
+    """input: {"source"}: take a reference on this chunk (creating
+    the ref set on first use)."""
+    req = json.loads(inp)
+    st = _state(obj, {"refs": []})
+    if req["source"] not in st["refs"]:
+        st["refs"].append(req["source"])
+    return 0, b"", json.dumps(st, sort_keys=True).encode()
+
+
+@register("cas", "chunk_put_ref")
+def _cas_put_ref(inp: bytes, obj: bytes | None):
+    """Drop a reference; the LAST one removes the chunk object."""
+    req = json.loads(inp)
+    st = _state(obj, {"refs": []})
+    if req["source"] not in st["refs"]:
+        return -2, b"", None
+    st["refs"].remove(req["source"])
+    if not st["refs"]:
+        return 0, b"", REMOVE
+    return 0, b"", json.dumps(st, sort_keys=True).encode()
+
+
+@register("cas", "references")
+def _cas_refs(inp: bytes, obj: bytes | None):
+    return 0, json.dumps(_state(obj, {"refs": []})).encode(), None
+
+
+# -- cls_otp (src/cls/otp/cls_otp.cc): server-side TOTP secrets; the
+# check runs IN the OSD so the secret never leaves it -------------------
+
+def _totp(secret_hex: str, t: int, step: int = 30,
+          digits: int = 6) -> str:
+    import hashlib
+    import hmac as _hmac
+    counter = int(t // step).to_bytes(8, "big")
+    mac = _hmac.new(bytes.fromhex(secret_hex), counter,
+                    hashlib.sha1).digest()
+    off = mac[-1] & 0xF
+    code = (int.from_bytes(mac[off:off + 4], "big") & 0x7FFFFFFF) \
+        % (10 ** digits)
+    return f"{code:0{digits}d}"
+
+
+@register("otp", "create")
+def _otp_create(inp: bytes, obj: bytes | None):
+    """input: {"id", "secret" (hex), "step"?, "digits"?}."""
+    req = json.loads(inp)
+    st = _state(obj, {})
+    if req["id"] in st:
+        return -17, b"", None
+    st[req["id"]] = {"secret": req["secret"],
+                     "step": int(req.get("step", 30)),
+                     "digits": int(req.get("digits", 6))}
+    return 0, b"", json.dumps(st, sort_keys=True).encode()
+
+
+@register("otp", "remove")
+def _otp_remove(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    st = _state(obj, {})
+    if st.pop(req["id"], None) is None:
+        return -2, b"", None
+    return 0, b"", json.dumps(st, sort_keys=True).encode()
+
+
+@register("otp", "check")
+def _otp_check(inp: bytes, obj: bytes | None):
+    """input: {"id", "token", "t"}: verify with a ±1-step window (the
+    reference tolerates clock skew the same way)."""
+    req = json.loads(inp)
+    st = _state(obj, {})
+    ent = st.get(req["id"])
+    if ent is None:
+        return -2, b"", None
+    t = float(req["t"])
+    # tolerate integer tokens: '12345' must match code '012345'
+    token = str(req["token"]).zfill(ent["digits"])
+    ok = any(_totp(ent["secret"], t + d * ent["step"], ent["step"],
+                   ent["digits"]) == token
+             for d in (-1, 0, 1))
+    return 0, json.dumps({"ok": ok}).encode(), None

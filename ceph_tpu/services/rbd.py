@@ -51,14 +51,28 @@ class RBDError(Exception):
 
 
 def _load_dir(io) -> dict:
+    """Directory view via the in-OSD rbd class (cls_rbd dir_list)."""
     try:
-        return json.loads(io.read(DIRECTORY_OID))
+        return json.loads(io.execute(DIRECTORY_OID, "rbd", "dir_list"))
     except Exception:
         return {}
 
 
-def _save_dir(io, d: dict) -> None:
-    io.write_full(DIRECTORY_OID, json.dumps(d, sort_keys=True).encode())
+def _dir_call(io, method: str, **args) -> None:
+    """One atomic rbd_directory mutation (cls_rbd dir_* role): two
+    clients creating/removing images concurrently can never lose each
+    other's entries the way a client-side read-modify-write of the
+    directory blob could."""
+    from ceph_tpu.client.rados import RadosError
+    try:
+        io.execute(DIRECTORY_OID, "rbd", method,
+                   json.dumps(args).encode())
+    except RadosError as exc:
+        if exc.code == -17:
+            raise RBDError("image exists") from None
+        if exc.code == -2:
+            raise RBDError("no such image") from None
+        raise
 
 
 class RBD:
@@ -71,22 +85,31 @@ class RBD:
                layout: FileLayout | None = None,
                journaling: bool = False,
                primary: bool = True) -> "Image":
-        d = _load_dir(self.io)
-        if name in d:
-            raise RBDError(f"image {name!r} exists")
-        layout = layout or FileLayout(stripe_unit=1 << 20,
-                                      stripe_count=1,
-                                      object_size=1 << 20)
-        header = {"size": size, "su": layout.stripe_unit,
-                  "sc": layout.stripe_count, "os": layout.object_size,
-                  "snaps": {}, "journaling": journaling,
-                  "primary": primary}
-        if journaling:
-            Journaler(self.io, f"rbd.{name}").create()
-        self.io.write_full(f"rbd_header.{name}",
-                           json.dumps(header).encode())
-        d[name] = {"size": size}
-        _save_dir(self.io, d)
+        # reserve the directory entry FIRST (atomic in-OSD -EEXIST):
+        # a racing create of the same name loses cleanly. A failure
+        # AFTER the reservation rolls it back, so a half-created
+        # image never wedges the name.
+        _dir_call(self.io, "dir_add_image", name=name,
+                  meta={"size": size})
+        try:
+            layout = layout or FileLayout(stripe_unit=1 << 20,
+                                          stripe_count=1,
+                                          object_size=1 << 20)
+            header = {"size": size, "su": layout.stripe_unit,
+                      "sc": layout.stripe_count,
+                      "os": layout.object_size,
+                      "snaps": {}, "journaling": journaling,
+                      "primary": primary}
+            if journaling:
+                Journaler(self.io, f"rbd.{name}").create()
+            self.io.write_full(f"rbd_header.{name}",
+                               json.dumps(header).encode())
+        except Exception:
+            try:
+                _dir_call(self.io, "dir_remove_image", name=name)
+            except RBDError:
+                pass
+            raise
         return Image(self.io, name)
 
     def list(self) -> list[str]:
@@ -118,9 +141,10 @@ class RBD:
             self.io.remove(f"rbd_header.{name}")
         except Exception:
             pass
-        d = _load_dir(self.io)
-        d.pop(name, None)
-        _save_dir(self.io, d)
+        try:
+            _dir_call(self.io, "dir_remove_image", name=name)
+        except RBDError:
+            pass
 
     def open(self, name: str, read_only: bool = False) -> "Image":
         """Open an image. The writing open (default) replays any
@@ -161,10 +185,11 @@ class Image:
     def _save_header(self) -> None:
         self.io.write_full(f"rbd_header.{self.name}",
                            json.dumps(self._header).encode())
-        d = _load_dir(self.io)
-        if self.name in d:
-            d[self.name]["size"] = self._header["size"]
-            _save_dir(self.io, d)
+        try:
+            _dir_call(self.io, "dir_update_image", name=self.name,
+                      meta={"size": self._header["size"]})
+        except RBDError:
+            pass                 # entry gone (concurrent remove)
 
     def size(self) -> int:
         return self._header["size"]

@@ -159,3 +159,92 @@ def test_hello_end_to_end(io):
     io.execute("greet", "hello", "record_hello", b"tpu")
     assert io.execute("greet", "hello", "replay", b"") == \
         b"Hello, tpu!"
+
+
+def test_cls_rbd_directory_atomicity(io):
+    """cls_rbd directory methods: concurrent image creates/removes
+    mutate the shared rbd_directory atomically in-OSD — the RBD
+    service rebased its (previously client-RMW) directory onto them."""
+    import concurrent.futures
+
+    from ceph_tpu.services.rbd import RBD, RBDError
+    rbd = RBD(io)
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(lambda i: rbd.create(f"img{i}", 1 << 20),
+                      range(12)))
+    assert rbd.list() == sorted(f"img{i}" for i in range(12))
+    # duplicate create loses atomically
+    import pytest
+    with pytest.raises(RBDError):
+        rbd.create("img0", 1 << 20)
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(lambda i: rbd.remove(f"img{i}"), range(12)))
+    assert rbd.list() == []
+    # rename method (dir_rename_image)
+    rbd.create("old", 4096)
+    io.execute("rbd_directory", "rbd", "dir_rename_image",
+               json.dumps({"src": "old", "dst": "new"}).encode())
+    assert rbd.list() == ["new"]
+    io.execute("rbd_directory", "rbd", "dir_remove_image",
+               json.dumps({"name": "new"}).encode())
+
+
+def test_cls_user_accounting(io):
+    for b, cnt, size in (("b1", 3, 300), ("b2", 1, 50), ("b1", 2, 10)):
+        io.execute(".user.alice", "user", "add_bucket",
+                   json.dumps({"bucket": b, "count": cnt,
+                               "bytes": size}).encode())
+    hdr = json.loads(io.execute(".user.alice", "user", "get_header"))
+    assert hdr["stats"] == {"count": 6, "bytes": 360}
+    assert hdr["buckets"] == ["b1", "b2"]
+    io.execute(".user.alice", "user", "remove_bucket",
+               json.dumps({"bucket": "b2"}).encode())
+    hdr = json.loads(io.execute(".user.alice", "user", "get_header"))
+    assert hdr["stats"] == {"count": 5, "bytes": 310}
+
+
+def test_cls_cas_chunk_refcounting(io):
+    import pytest
+
+    from ceph_tpu.client.rados import RadosError
+    oid = "chunk.abc123"
+    for src in ("obj1", "obj2", "obj1"):      # idempotent per source
+        io.execute(oid, "cas", "chunk_create_or_get_ref",
+                   json.dumps({"source": src}).encode())
+    refs = json.loads(io.execute(oid, "cas", "references"))
+    assert refs["refs"] == ["obj1", "obj2"]
+    io.execute(oid, "cas", "chunk_put_ref",
+               json.dumps({"source": "obj1"}).encode())
+    # last ref removes the chunk object entirely (cls_cas contract)
+    io.execute(oid, "cas", "chunk_put_ref",
+               json.dumps({"source": "obj2"}).encode())
+    with pytest.raises(RadosError):
+        io.read(oid)
+
+
+def test_cls_otp_totp(io):
+    import time as _t
+
+    from ceph_tpu.cls.classes import _totp
+    secret = "3132333435363738393031323334353637383930"  # RFC6238 key
+    io.execute(".otp.box", "otp", "create",
+               json.dumps({"id": "admin", "secret": secret}).encode())
+    now = _t.time()
+    good = _totp(secret, now)
+    out = json.loads(io.execute(".otp.box", "otp", "check",
+                                json.dumps({"id": "admin",
+                                            "token": good,
+                                            "t": now}).encode()))
+    assert out["ok"] is True
+    # previous window tolerated (clock skew), garbage rejected
+    prev = _totp(secret, now - 30)
+    out = json.loads(io.execute(".otp.box", "otp", "check",
+                                json.dumps({"id": "admin",
+                                            "token": prev,
+                                            "t": now}).encode()))
+    assert out["ok"] is True
+    out = json.loads(io.execute(".otp.box", "otp", "check",
+                                json.dumps({"id": "admin",
+                                            "token": "000000",
+                                            "t": now}).encode()))
+    assert out["ok"] is False or good == "000000"
