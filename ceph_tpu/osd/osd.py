@@ -127,6 +127,81 @@ class _WQShard:
         self.credits = dict(weights)
 
 
+class _MClockShard:
+    """One worker's dmclock state (src/dmclock + osd_op_queue=
+    mclock_* role): per class a (reservation ρ, weight w, limit λ)
+    triple and three tag clocks. Each enqueue stamps the item with
+
+        R = max(now, R_prev + 1/ρ)   (reservation clock; ∞ if ρ=0)
+        P = max(now, P_prev + 1/w)   (proportional clock)
+        L = max(now, L_prev + 1/λ)   (limit clock; item INELIGIBLE
+                                      before its L — λ=0 means none)
+
+    and dequeue serves (1) the smallest R-tag at or past now — the
+    RESERVATION phase, which is what turns 'recovery still trickles'
+    into 'recovery gets ≥ρ ops/s, guaranteed'; else (2) the smallest
+    P-tag among classes whose head is limit-eligible; else sleeps to
+    the earliest R/L tag. That is the dual-clock guarantee/limit
+    structure WPQ's proportional shares cannot express."""
+
+    __slots__ = ("cv", "queues", "clocks", "profile")
+
+    def __init__(self, profile: dict[str, tuple]) -> None:
+        self.cv = threading.Condition()
+        self.profile = dict(profile)
+        #: cls -> deque of (r_tag, p_tag, l_tag, fn)
+        self.queues = {cls: collections.deque() for cls in profile}
+        #: cls -> [last_r, last_p, last_l]
+        self.clocks = {cls: [0.0, 0.0, 0.0] for cls in profile}
+
+    def stamp(self, cls: str, fn) -> None:
+        res, wgt, lim = self.profile[cls]
+        now = time.monotonic()
+        ck = self.clocks[cls]
+        r = max(now, ck[0] + 1.0 / res) if res > 0 else float("inf")
+        p = max(now, ck[1] + 1.0 / max(wgt, 1e-9))
+        li = max(now, ck[2] + 1.0 / lim) if lim > 0 else 0.0
+        if res > 0:
+            ck[0] = r
+        ck[1] = p
+        if lim > 0:
+            ck[2] = li
+        self.queues[cls].append((r, p, li, fn))
+
+    def pick(self, pace: bool = True):
+        """(fn, None) when runnable now, (None, wake_at) when only
+        future-eligible work exists, (None, None) when empty.
+        ``pace=False`` (drain/shutdown): serve any head immediately,
+        ignoring reservation/limit clocks — a limited backlog must
+        not outlive the daemon and race its store teardown."""
+        now = time.monotonic()
+        if not pace:
+            for q in self.queues.values():
+                if q:
+                    return q.popleft()[3], None
+            return None, None
+        best_r = best_p = None
+        wake = None
+        for cls, q in self.queues.items():
+            if not q:
+                continue
+            r, p, li, _fn = q[0]
+            if r <= now:
+                if best_r is None or r < best_r[0]:
+                    best_r = (r, cls)
+            if li <= now:
+                if best_p is None or p < best_p[0]:
+                    best_p = (p, cls)
+            else:
+                wake = li if wake is None else min(wake, li)
+            if r != float("inf"):
+                wake = r if wake is None else min(wake, r)
+        choice = best_r or best_p
+        if choice is not None:
+            return self.queues[choice[1]].popleft()[3], None
+        return None, wake
+
+
 class ShardedOpWQ:
     """The sharded op queue (OSD.cc:2095): work is hashed by pgid onto
     one of N worker threads, giving per-PG ordering with cross-PG
@@ -137,15 +212,36 @@ class ShardedOpWQ:
     the property the reference gets from its mClock/WPQ queue."""
 
     def __init__(self, name: str, num_shards: int,
-                 weights: dict[str, int] | None = None) -> None:
+                 weights: dict[str, int] | None = None,
+                 mode: str | None = None) -> None:
         conf = g_conf()
+        self.mode = mode or conf["osd_op_queue"]
         self._weights = weights or {
             QOS_CLIENT: max(1, conf["osd_client_op_priority"]),
             QOS_RECOVERY: max(1, conf["osd_recovery_op_priority"]),
             QOS_SCRUB: max(1, conf["osd_scrub_priority"]),
         }
-        self._shards = [_WQShard(self._weights)
-                        for _ in range(num_shards)]
+        if self.mode == "mclock_scheduler":
+            def _cls(prefix: str) -> tuple:
+                # res/lim are OSD-wide ops/s; tag clocks are per
+                # shard, so distribute the rates across shards (the
+                # reference divides configured IOPS the same way)
+                return (conf[f"{prefix}_res"] / num_shards,
+                        conf[f"{prefix}_wgt"],
+                        conf[f"{prefix}_lim"] / num_shards)
+
+            self._profile = {
+                QOS_CLIENT: _cls("osd_mclock_scheduler_client"),
+                QOS_RECOVERY: _cls(
+                    "osd_mclock_scheduler_background_recovery"),
+                QOS_SCRUB: _cls(
+                    "osd_mclock_scheduler_background_best_effort"),
+            }
+            self._shards = [_MClockShard(self._profile)
+                            for _ in range(num_shards)]
+        else:
+            self._shards = [_WQShard(self._weights)
+                            for _ in range(num_shards)]
         self._running = True
         self._threads = [
             threading.Thread(target=self._worker, args=(sh,),
@@ -159,7 +255,10 @@ class ShardedOpWQ:
             return
         sh = self._shards[hash(key) % len(self._shards)]
         with sh.cv:
-            sh.queues.get(qos, sh.queues[QOS_CLIENT]).append(fn)
+            if isinstance(sh, _MClockShard):
+                sh.stamp(qos if qos in sh.queues else QOS_CLIENT, fn)
+            else:
+                sh.queues.get(qos, sh.queues[QOS_CLIENT]).append(fn)
             sh.cv.notify()
 
     def _dequeue(self, sh: _WQShard):
@@ -180,18 +279,31 @@ class ShardedOpWQ:
                 continue
             return None
 
-    def _worker(self, sh: _WQShard) -> None:
+    def _worker(self, sh) -> None:
+        mclock = isinstance(sh, _MClockShard)
         while True:
             with sh.cv:
-                fn = self._dequeue(sh)
-                while fn is None:
-                    # queues fully drained (every class): exit only
-                    # then, so no queued recovery/scrub item is
-                    # abandoned on shutdown
-                    if not self._running:
-                        return
-                    sh.cv.wait()
+                if mclock:
+                    fn, wake = sh.pick(pace=self._running)
+                    while fn is None:
+                        if not self._running:
+                            return         # fully drained
+                        # sleep to the earliest tag eligibility (the
+                        # dual-clock pacing), or until new work
+                        timeout = None if wake is None else max(
+                            wake - time.monotonic(), 0.0)
+                        sh.cv.wait(timeout)
+                        fn, wake = sh.pick(pace=self._running)
+                else:
                     fn = self._dequeue(sh)
+                    while fn is None:
+                        # queues fully drained (every class): exit
+                        # only then, so no queued recovery/scrub item
+                        # is abandoned on shutdown
+                        if not self._running:
+                            return
+                        sh.cv.wait()
+                        fn = self._dequeue(sh)
             try:
                 fn()
             except Exception as exc:
@@ -250,7 +362,12 @@ class OSD:
         # read-only and must never starve behind a primary-side task
         # blocked in a fan-out wait on the same op_wq shard — they get
         # their own workers (the reference's fast-dispatch isolation)
-        self.reader_wq = ShardedOpWQ(f"osd.{osd_id}-svc", 2)
+        # always WPQ: these are INTERNAL sub-op reads/peering queries
+        # on the critical path of every client op — a configured
+        # client limit must throttle clients, not the fan-outs
+        # serving them
+        self.reader_wq = ShardedOpWQ(f"osd.{osd_id}-svc", 2,
+                                     mode="wpq")
         # completed-mutation replies by (client, tid): a client resend
         # of an already-applied write/remove gets the cached reply
         # instead of re-executing (the reference's dup-op detection via

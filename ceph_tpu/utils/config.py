@@ -274,6 +274,32 @@ for _o in [
            "within this many seconds"),
     Option("mon_election_timeout", float, 2.0, "advanced",
            "mon election timeout seconds"),
+    Option("osd_op_queue", str, "wpq", "advanced",
+           "op scheduler: wpq (weighted round-robin shares) or "
+           "mclock_scheduler (dmclock reservation/weight/limit — "
+           "src/dmclock + options.cc osd_op_queue)",
+           enum_allowed=("wpq", "mclock_scheduler")),
+    Option("osd_mclock_scheduler_client_res", float, 0.0, "advanced",
+           "client reservation, ops/s (0 = none)"),
+    Option("osd_mclock_scheduler_client_wgt", float, 63.0, "advanced",
+           "client proportional weight"),
+    Option("osd_mclock_scheduler_client_lim", float, 0.0, "advanced",
+           "client limit, ops/s (0 = unlimited)"),
+    Option("osd_mclock_scheduler_background_recovery_res", float,
+           10.0, "advanced",
+           "recovery reservation, ops/s — the GUARANTEE wpq shares "
+           "cannot express (recovery proceeds at >= this rate under "
+           "any client load)"),
+    Option("osd_mclock_scheduler_background_recovery_wgt", float,
+           3.0, "advanced", "recovery proportional weight"),
+    Option("osd_mclock_scheduler_background_recovery_lim", float,
+           0.0, "advanced", "recovery limit, ops/s (0 = unlimited)"),
+    Option("osd_mclock_scheduler_background_best_effort_res", float,
+           0.0, "advanced", "scrub/best-effort reservation, ops/s"),
+    Option("osd_mclock_scheduler_background_best_effort_wgt", float,
+           1.0, "advanced", "scrub/best-effort weight"),
+    Option("osd_mclock_scheduler_background_best_effort_lim", float,
+           0.0, "advanced", "scrub/best-effort limit, ops/s"),
     Option("crimson_smp", int, 3, "advanced",
            "crimson prototype reactor count (seastar --smp role)",
            min=1, max=64),
