@@ -1,0 +1,75 @@
+"""ObjectCacher — client-side object/extent cache (src/osdc/
+ObjectCacher.h role, reduced).
+
+The reference's ObjectCacher sits under librbd/cephfs and keeps
+recently-read object extents (plus write buffering) so repeated I/O
+does not hit the cluster. This lite keeps the READ cache with
+write-through invalidation — the coherence story is the caller's,
+exactly as in the reference:
+
+- librbd enables the cache only while it owns the image (our rbd
+  Image attaches one per open handle and drops everything on a
+  header watch/notify — other writers announce changes through the
+  image watcher, the same channel the reference uses);
+- cephfs caches under its capability leases (services/cephfs.py)
+  and does not use this layer.
+
+Entries are whole piece-reads keyed (oid, off, len); bytes-bounded
+LRU; thread-safe. Write paths call ``invalidate_object`` for every
+object they touch BEFORE issuing the write (write-through: the next
+read refills from the cluster)."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class ObjectCacher:
+    def __init__(self, max_bytes: int = 32 << 20) -> None:
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._lru: OrderedDict[tuple, bytes] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, oid: str, off: int, length: int) -> bytes | None:
+        key = (oid, off, length)
+        with self._lock:
+            data = self._lru.get(key)
+            if data is None:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return data
+
+    def put(self, oid: str, off: int, length: int,
+            data: bytes) -> None:
+        key = (oid, off, length)
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._lru[key] = data
+            self._bytes += len(data)
+            while self._bytes > self.max_bytes and self._lru:
+                _k, v = self._lru.popitem(last=False)
+                self._bytes -= len(v)
+
+    def invalidate_object(self, oid: str) -> None:
+        """Drop every cached extent of one object (write-through)."""
+        with self._lock:
+            for key in [k for k in self._lru if k[0] == oid]:
+                self._bytes -= len(self._lru.pop(key))
+
+    def invalidate_all(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"bytes": self._bytes, "entries": len(self._lru),
+                    "hits": self.hits, "misses": self.misses}

@@ -221,6 +221,25 @@ class IoCtx:
         self._submit(oid, M.OSD_OP_OMAPRMKEYS,
                      data=json.dumps(list(keys)).encode())
 
+    # -- watch/notify (rados_watch / rados_notify roles) --------------
+    def watch(self, oid: str, callback) -> int:
+        """Register ``callback(payload: bytes)`` to fire on every
+        notify against ``oid``; returns the watch cookie (pass to
+        unwatch). Watches are connection-scoped on the primary: a
+        primary change drops them and this client RE-WATCHES
+        automatically on the next map epoch (the linger behavior)."""
+        return self.client._watch(self, oid, callback)
+
+    def unwatch(self, cookie: int) -> None:
+        self.client._unwatch(cookie)
+
+    def notify(self, oid: str, payload: bytes = b"",
+               timeout_ms: int = 5000) -> tuple[int, int]:
+        """Deliver ``payload`` to every watcher of ``oid``; returns
+        (acked, missed) once every watcher answered or the timeout
+        passed — the caller KNOWS who saw it (notify contract)."""
+        return self.client._notify(self, oid, payload, timeout_ms)
+
     def create(self, oid: str, exclusive: bool = False,
                guard=None) -> int:
         """Materialize an empty object (CEPH_OSD_OP_CREATE);
@@ -261,6 +280,14 @@ class RadosClient:
         self.objecter: Objecter | None = None
         self._auth = auth          # (entity, secret) for cephx clusters
         self._connected = False
+        # watch/notify client state
+        import threading as _th
+        self._wn_lock = _th.Lock()
+        self._wn_seq = 0
+        #: cookie -> {"pool", "oid", "cb", "osd", "epoch"}
+        self._watches: dict[int, dict] = {}
+        #: tid -> [Event, reply]
+        self._wn_waits: dict[int, list] = {}
 
     def connect(self, timeout: float = 10.0) -> "RadosClient":
         self.msgr.set_dispatcher(self._dispatch)
@@ -285,10 +312,168 @@ class RadosClient:
         self._connected = False
 
     def _dispatch(self, msg, conn) -> None:
+        if isinstance(msg, M.MWatchNotify):
+            self._on_watch_notify(msg, conn)
+            return
+        if isinstance(msg, (M.MWatchAck, M.MNotifyComplete)):
+            with self._wn_lock:
+                ent = self._wn_waits.get(msg.tid)
+            if ent is not None:
+                ent[1] = msg
+                ent[0].set()
+            return
+        if isinstance(msg, M.MOSDMap):
+            # piggyback on the map push: re-establish watches whose
+            # primary moved (linger re-registration). Off-thread: the
+            # re-watch BLOCKS on acks that arrive through this very
+            # dispatcher.
+            self.monc.handle_message(msg, conn)
+            with self._wn_lock:
+                have = bool(self._watches)
+            if have:
+                import threading as _th
+                _th.Thread(target=self._rewatch,
+                           name="rados-rewatch", daemon=True).start()
+            return
         if self.monc.handle_message(msg, conn):
             return
         if self.objecter and self.objecter.handle_message(msg, conn):
             return
+
+    # -- watch/notify plumbing ----------------------------------------
+    def _primary_addr(self, pool: int, oid: str) -> tuple[str, int, int]:
+        osdmap = self.monc.osdmap
+        ps = osdmap.object_to_pg(pool, oid)
+        _, _, primary = osdmap.pg_to_up_acting(pool, ps)
+        info = osdmap.osds.get(primary)
+        if primary < 0 or info is None or not info.up or not info.addr:
+            raise RadosError(-110, f"no primary for {oid!r}")
+        return info.addr, ps, primary
+
+    def _wn_call(self, msg, addr: str, timeout: float = 10.0):
+        import threading as _th
+        ev = _th.Event()
+        with self._wn_lock:
+            self._wn_waits[msg.tid] = ent = [ev, None]
+        try:
+            self.msgr.send_message(msg, addr)
+            if not ev.wait(timeout):
+                raise RadosError(-110, "watch/notify op timed out")
+            return ent[1]
+        finally:
+            with self._wn_lock:
+                self._wn_waits.pop(msg.tid, None)
+
+    def _watch(self, io: IoCtx, oid: str, callback) -> int:
+        addr, ps, primary = self._primary_addr(io.pool_id, oid)
+        with self._wn_lock:
+            self._wn_seq += 1
+            cookie = self._wn_seq
+            tid = 1_000_000 + cookie
+            # register BEFORE the wire round trip: the OSD adds the
+            # watcher before acking, so a notify fanned out in that
+            # window must find the callback (a silent ack-without-
+            # callback would count an unseen notify as seen)
+            self._watches[cookie] = {
+                "pool": io.pool_id, "oid": oid, "cb": callback,
+                "osd": primary, "addr": addr}
+        try:
+            rep = self._wn_call(M.MWatch(
+                tid=tid, pool=io.pool_id, ps=ps, oid=oid,
+                cookie=cookie, watch=True), addr)
+        except RadosError:
+            with self._wn_lock:
+                self._watches.pop(cookie, None)
+            raise
+        if rep.code != 0:
+            with self._wn_lock:
+                self._watches.pop(cookie, None)
+            raise RadosError(rep.code, "watch refused")
+        return cookie
+
+    def _unwatch(self, cookie: int) -> None:
+        with self._wn_lock:
+            w = self._watches.pop(cookie, None)
+        if w is None:
+            return
+        try:
+            addr, ps, _ = self._primary_addr(w["pool"], w["oid"])
+            self._wn_call(M.MWatch(
+                tid=2_000_000 + cookie, pool=w["pool"], ps=ps,
+                oid=w["oid"], cookie=cookie, watch=False), addr,
+                timeout=3.0)
+        except RadosError:
+            pass                      # primary gone: nothing to drop
+
+    def _notify(self, io: IoCtx, oid: str, payload: bytes,
+                timeout_ms: int) -> tuple[int, int]:
+        addr, ps, _ = self._primary_addr(io.pool_id, oid)
+        with self._wn_lock:
+            self._wn_seq += 1
+            tid = 3_000_000 + self._wn_seq
+        rep = self._wn_call(M.MNotify(
+            tid=tid, pool=io.pool_id, ps=ps, oid=oid,
+            payload=bytes(payload), timeout_ms=timeout_ms), addr,
+            timeout=timeout_ms / 1000.0 + 5.0)
+        return rep.acked, rep.missed
+
+    def _on_watch_notify(self, msg: M.MWatchNotify, conn) -> None:
+        # callbacks run OFF the messenger dispatch loop: they may do
+        # blocking I/O (reload a header) whose replies arrive through
+        # this very dispatcher; the ack follows the callback (ack ==
+        # 'watcher processed it', the notify contract)
+        import threading as _th
+
+        def run():
+            with self._wn_lock:
+                w = self._watches.get(msg.cookie)
+            if w is not None:
+                try:
+                    w["cb"](bytes(msg.payload))
+                except Exception:
+                    pass
+            # ack regardless: a dead callback must not stall the
+            # notifier
+            try:
+                conn.send_message(M.MWatchNotifyAck(
+                    notify_id=msg.notify_id, cookie=msg.cookie))
+            except Exception:
+                pass
+
+        _th.Thread(target=run, name="rados-watch-cb",
+                   daemon=True).start()
+
+    def _rewatch(self) -> None:
+        """Re-register every watch whose primary moved (the Objecter
+        linger resend on map change)."""
+        with self._wn_lock:
+            watches = dict(self._watches)
+        for cookie, w in watches.items():
+            try:
+                addr, ps, primary = self._primary_addr(w["pool"],
+                                                       w["oid"])
+            except RadosError:
+                continue
+            if primary == w["osd"] and addr == w["addr"]:
+                # same osd at the SAME address: nothing moved. A
+                # restarted osd (same id, wiped in-memory watch
+                # table) rebinds to a new addr, so the addr compare
+                # is what makes 're-watches automatically' true
+                continue
+            try:
+                rep = self._wn_call(M.MWatch(
+                    tid=4_000_000 + cookie, pool=w["pool"], ps=ps,
+                    oid=w["oid"], cookie=cookie, watch=True), addr,
+                    timeout=3.0)
+                if rep.code == 0:
+                    with self._wn_lock:
+                        if cookie in self._watches:
+                            self._watches[cookie]["osd"] = primary
+                            self._watches[cookie]["addr"] = addr
+            except RadosError:
+                pass                  # next map push retries
+
+
 
     # -- admin --------------------------------------------------------
     def mon_command(self, cmd: dict, timeout: float = 10.0

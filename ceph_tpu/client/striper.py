@@ -71,9 +71,13 @@ class StripedObject:
     META_SUFFIX = ".meta"
 
     def __init__(self, ioctx, soid: str,
-                 layout: FileLayout | None = None) -> None:
+                 layout: FileLayout | None = None,
+                 cache=None) -> None:
         self.io = ioctx
         self.soid = soid
+        #: optional ObjectCacher (osdc/ObjectCacher role): piece
+        #: reads fill it, piece writes invalidate write-through
+        self.cache = cache
         existing = self._read_meta()
         if existing is not None:
             self.layout, self.size = existing
@@ -107,13 +111,25 @@ class StripedObject:
     def _piece(self, objectno: int) -> str:
         return f"{self.soid}.{objectno:016x}"
 
+    def refresh(self) -> None:
+        """Re-read the stored meta (another handle may have grown the
+        stream since this one opened)."""
+        existing = self._read_meta()
+        if existing is not None:
+            self.layout, self.size = existing
+
     # -- I/O -----------------------------------------------------------
     def write(self, data: bytes, offset: int = 0) -> None:
         pos = 0
         for objectno, obj_off, n in file_to_extents(
                 self.layout, offset, len(data)):
-            self.io.write(self._piece(objectno), data[pos:pos + n],
-                          offset=obj_off)
+            oid = self._piece(objectno)
+            self.io.write(oid, data[pos:pos + n], offset=obj_off)
+            if self.cache is not None:
+                # write-through: invalidate AFTER the write lands —
+                # invalidating before would let a concurrent reader
+                # refill pre-write bytes and pin them stale
+                self.cache.invalidate_object(oid)
             pos += n
         self.size = max(self.size, offset + len(data))
         self._write_meta()
@@ -128,10 +144,16 @@ class StripedObject:
         pos = 0
         for objectno, obj_off, n in file_to_extents(
                 self.layout, offset, length):
-            try:
-                piece = self.io.read(self._piece(objectno), n, obj_off)
-            except Exception:
-                piece = b""          # sparse hole reads as zeros
+            oid = self._piece(objectno)
+            piece = self.cache.get(oid, obj_off, n) \
+                if self.cache is not None else None
+            if piece is None:
+                try:
+                    piece = self.io.read(oid, n, obj_off)
+                except Exception:
+                    piece = b""      # sparse hole reads as zeros
+                if self.cache is not None:
+                    self.cache.put(oid, obj_off, n, piece)
             out[pos:pos + len(piece)] = piece
             pos += n
         return bytes(out)
@@ -140,6 +162,8 @@ class StripedObject:
         return self.size
 
     def remove(self) -> None:
+        if self.cache is not None:
+            self.cache.invalidate_all()
         objectnos = sorted({e[0] for e in file_to_extents(
             self.layout, 0, self.size)}) if self.size else []
         for objectno in objectnos:

@@ -356,6 +356,11 @@ class OSD:
         self._inflight: dict[int, InflightWrite] = {}
         self._waits: dict[int, SubOpWait] = {}
         self._sub_lock = threading.Lock()
+        # watch/notify state (Watch.h role; in-memory, see
+        # _handle_watch): (pool, oid) -> {(peer, cookie): conn}
+        self._watch_lock = threading.Lock()
+        self._watchers: dict[tuple, dict] = {}
+        self._notifies: dict[int, dict] = {}
         self.op_wq = ShardedOpWQ(f"osd.{osd_id}",
                                  g_conf()["osd_op_num_shards"])
         # replica-side service ops (shard reads, peering queries) are
@@ -759,8 +764,118 @@ class OSD:
             self.op_wq.enqueue(pgid,
                                lambda: self._handle_pg_push(msg, conn),
                                qos=QOS_RECOVERY)
+        elif isinstance(msg, M.MWatch):
+            self._handle_watch(msg, conn)
+        elif isinstance(msg, M.MNotify):
+            self._handle_notify(msg, conn)
+        elif isinstance(msg, M.MWatchNotifyAck):
+            self._handle_notify_ack(msg, conn)
         else:
             log(5, f"unhandled message {msg!r}")
+
+    # -- watch/notify (Watch.h / rados_watch+notify roles) ------------
+    def _handle_watch(self, msg: M.MWatch, conn: Connection) -> None:
+        """Register/unregister a watcher on this primary. Watch state
+        is IN-MEMORY and connection-scoped (documented lite of the
+        reference's per-obc persisted watches): a primary change or
+        OSD restart drops it, and clients re-watch on the epoch bump
+        their map subscription delivers."""
+        key = (msg.pool, msg.oid)
+        with self._watch_lock:
+            if msg.watch:
+                self._watchers.setdefault(key, {})[
+                    (conn.peer_name, msg.cookie)] = conn
+            else:
+                watchers = self._watchers.get(key, {})
+                watchers.pop((conn.peer_name, msg.cookie), None)
+                if not watchers:
+                    self._watchers.pop(key, None)
+        conn.send_message(M.MWatchAck(tid=msg.tid, code=0))
+
+    def _handle_notify(self, msg: M.MNotify, conn: Connection) -> None:
+        """Fan the payload to every watcher; answer the notifier once
+        every watcher acked or the timeout passed (notify semantics:
+        the caller knows watchers SAW it — or which count did not)."""
+        key = (msg.pool, msg.oid)
+        dead = 0
+        with self._watch_lock:
+            watchers = dict(self._watchers.get(key, {}))
+            # age out watchers whose connection already closed (the
+            # reference discards un-pinging watchers the same way):
+            # counted MISSED once, then gone
+            for who, wconn in list(watchers.items()):
+                if getattr(wconn, "closed", False):
+                    watchers.pop(who)
+                    dead += 1
+                    ws = self._watchers.get(key, {})
+                    ws.pop(who, None)
+                    if not ws:
+                        self._watchers.pop(key, None)
+            if not watchers:
+                conn.send_message(M.MNotifyComplete(
+                    tid=msg.tid, code=0, acked=0, missed=dead))
+                return
+            notify_id = self.new_tid()
+            self._notifies[notify_id] = {
+                "conn": conn, "tid": msg.tid,
+                "pending": set(watchers),
+                "acked": 0, "missed": dead,
+                "deadline": time.monotonic() +
+                (msg.timeout_ms or 5000) / 1000.0,
+            }
+        for (peer, cookie), wconn in watchers.items():
+            try:
+                wconn.send_message(M.MWatchNotify(
+                    notify_id=notify_id, pool=msg.pool, oid=msg.oid,
+                    cookie=cookie, payload=msg.payload))
+            except Exception:
+                # provably-dead watcher: count it MISSED (never
+                # 'acked' — the notify contract is 'watchers SAW
+                # it') and prune the corpse from the watch table
+                self._notify_resolve(notify_id, (peer, cookie),
+                                     acked=False)
+                with self._watch_lock:
+                    ws = self._watchers.get(key, {})
+                    ws.pop((peer, cookie), None)
+                    if not ws:
+                        self._watchers.pop(key, None)
+
+    def _handle_notify_ack(self, msg: M.MWatchNotifyAck,
+                           conn: Connection) -> None:
+        # acks match on (peer, cookie): cookies are PER-CLIENT
+        # counters, so two clients' cookies collide routinely
+        self._notify_resolve(msg.notify_id,
+                             (conn.peer_name, msg.cookie), acked=True)
+
+    def _notify_resolve(self, notify_id: int, who: tuple,
+                        acked: bool) -> None:
+        with self._watch_lock:
+            ent = self._notifies.get(notify_id)
+            if ent is None or who not in ent["pending"]:
+                return
+            ent["pending"].discard(who)
+            ent["acked" if acked else "missed"] += 1
+            if ent["pending"]:
+                return
+            del self._notifies[notify_id]
+        ent["conn"].send_message(M.MNotifyComplete(
+            tid=ent["tid"], code=0, acked=ent["acked"],
+            missed=ent["missed"]))
+
+    def _sweep_notifies(self) -> None:
+        """Timeout expiry (run from the tick): a dead watcher must not
+        block the notifier forever."""
+        now = time.monotonic()
+        done = []
+        with self._watch_lock:
+            for nid, ent in list(self._notifies.items()):
+                if now >= ent["deadline"]:
+                    done.append(ent)
+                    del self._notifies[nid]
+        for ent in done:
+            ent["conn"].send_message(M.MNotifyComplete(
+                tid=ent["tid"], code=0, acked=ent["acked"],
+                missed=ent["missed"] + len(ent["pending"])))
 
     # -- replica-side handlers ----------------------------------------
     def _handle_sub_write(self, msg: M.MECSubWrite, conn: Connection
@@ -2164,6 +2279,7 @@ class OSD:
             self.monc.beacon(self.whoami, osdmap.epoch)
             now = time.monotonic()
             self._expire_inflight(now)
+            self._sweep_notifies()
             self._kick_recovery()
             self.op_tracker.check_slow()
             self._report_pg_stats(osdmap.epoch)

@@ -429,3 +429,50 @@ class MPGNotify(Message):
               ("versions", "u64_list"), ("last_version", "u64"),
               ("tid", "u64"), ("log_versions", "u64_list"),
               ("log_ops", "i32_list"), ("log_oids", "str_list")]
+
+
+# -- watch/notify (librados rados_watch/rados_notify roles) ------------
+
+class MWatch(Message):
+    """Client -> primary OSD: (un)register a watch on an object
+    (Objecter::linger_register / CEPH_OSD_OP_WATCH role). The OSD
+    keeps the watcher on the RECEIVING connection; a peering change
+    drops it and the client re-watches on the map epoch bump (the
+    documented lite of the reference's persisted watch state)."""
+    MSG_TYPE = 50
+    FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
+              ("oid", "str"), ("cookie", "u64"), ("watch", "bool")]
+
+
+class MWatchAck(Message):
+    MSG_TYPE = 51
+    FIELDS = [("tid", "u64"), ("code", "i32")]
+
+
+class MNotify(Message):
+    """Client -> primary OSD: deliver ``payload`` to every watcher of
+    ``oid`` and reply once all acked (or timeout_ms passed)."""
+    MSG_TYPE = 52
+    FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
+              ("oid", "str"), ("payload", "bytes"),
+              ("timeout_ms", "u32")]
+
+
+class MNotifyComplete(Message):
+    """OSD -> notifier: watchers that acked / that timed out."""
+    MSG_TYPE = 53
+    FIELDS = [("tid", "u64"), ("code", "i32"), ("acked", "u32"),
+              ("missed", "u32")]
+
+
+class MWatchNotify(Message):
+    """OSD -> watcher: a notify fired on an object you watch; reply
+    with MWatchNotifyAck (rados_notify_ack role)."""
+    MSG_TYPE = 54
+    FIELDS = [("notify_id", "u64"), ("pool", "i32"), ("oid", "str"),
+              ("cookie", "u64"), ("payload", "bytes")]
+
+
+class MWatchNotifyAck(Message):
+    MSG_TYPE = 55
+    FIELDS = [("notify_id", "u64"), ("cookie", "u64")]

@@ -37,6 +37,7 @@ from ceph_tpu.client.striper import (
     file_to_extents,
 )
 from ceph_tpu.services.journal import Journaler, JournalError
+from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 DIRECTORY_OID = "rbd_directory"
@@ -156,9 +157,19 @@ class RBD:
 
 
 class Image:
-    """One open image (librbd::Image role)."""
+    """One open image (librbd::Image role).
 
-    def __init__(self, ioctx, name: str, replay: bool = False) -> None:
+    ``cache=True`` attaches an :class:`ObjectCacher` to the data
+    striper (rbd_cache role) AND a header WATCH: another handle's
+    structural change (resize, snapshot, promote/demote) notifies
+    the image header object, and this handle reloads the header and
+    drops its cache — the librbd ImageWatcher coherence channel.
+    As in the reference, the data cache assumes a single writer
+    (exclusive-lock discipline); concurrent writers should open
+    uncached."""
+
+    def __init__(self, ioctx, name: str, replay: bool = False,
+                 cache: bool | None = None) -> None:
         self.io = ioctx
         self.name = name
         try:
@@ -167,9 +178,26 @@ class Image:
             raise RBDError(f"no such image {name!r}")
         layout = FileLayout(self._header["su"], self._header["sc"],
                             self._header["os"])
-        self._data = StripedObject(self.io, f"rbd_data.{name}", layout)
+        if cache is None:
+            cache = bool(g_conf()["rbd_cache"])
+        self.cache = None
+        self._watch_cookie = None
+        if cache:
+            from ceph_tpu.client.object_cacher import ObjectCacher
+            self.cache = ObjectCacher(g_conf()["rbd_cache_size"])
+        self._data = StripedObject(self.io, f"rbd_data.{name}", layout,
+                                   cache=self.cache)
         self.journal = Journaler(self.io, f"rbd.{name}") \
             if self._header.get("journaling") else None
+        if cache:
+            # watch LAST: a notify can fire the callback the moment
+            # the watch registers, and the callback touches
+            # self._data — which must exist by then
+            try:
+                self._watch_cookie = self.io.watch(
+                    f"rbd_header.{name}", self._on_header_notify)
+            except Exception:
+                self._watch_cookie = None   # cache still works solo
         #: next journal position the WRITER expects to commit; advances
         #: only contiguously (see _journal_committed)
         self._local_pos = 0
@@ -182,6 +210,36 @@ class Image:
             self._replay_local_tail()
 
     # -- header --------------------------------------------------------
+    def _on_header_notify(self, payload: bytes) -> None:
+        """Another handle changed the image structurally: reload the
+        header and drop the data cache (ImageWatcher role)."""
+        try:
+            self._header = json.loads(
+                self.io.read(f"rbd_header.{self.name}"))
+        except Exception:
+            pass
+        self._data.refresh()
+        if self.cache is not None:
+            self.cache.invalidate_all()
+
+    def _notify_header(self) -> None:
+        """Announce a structural header change to other open handles
+        (resize/snapshot/promote — NOT per-write size bumps)."""
+        try:
+            self.io.notify(f"rbd_header.{self.name}", b"header",
+                           timeout_ms=3000)
+        except Exception:
+            pass               # no watchers / primary briefly gone
+
+    def close(self) -> None:
+        """Drop the header watch (librbd close role)."""
+        if self._watch_cookie is not None:
+            try:
+                self.io.unwatch(self._watch_cookie)
+            except Exception:
+                pass
+            self._watch_cookie = None
+
     def _save_header(self) -> None:
         self.io.write_full(f"rbd_header.{self.name}",
                            json.dumps(self._header).encode())
@@ -208,10 +266,12 @@ class Image:
     def promote(self) -> None:
         self._header["primary"] = True
         self._save_header()
+        self._notify_header()
 
     def demote(self) -> None:
         self._header["primary"] = False
         self._save_header()
+        self._notify_header()
 
     def _replay_local_tail(self) -> None:
         """Close the write-ahead window on open: mutations journal
@@ -291,6 +351,7 @@ class Image:
         pos = self._journal_event("resize", new_size)
         self._resize_apply(new_size)
         self._journal_committed(pos)
+        self._notify_header()
 
     def _resize_apply(self, new_size: int) -> None:
         old = self._header["size"]
@@ -503,6 +564,7 @@ class Image:
         pos = self._journal_event("snap_create", arg=snap)
         self._snap_create_apply(snap)
         self._journal_committed(pos)
+        self._notify_header()
 
     def _snap_create_apply(self, snap: str) -> None:
         # O(1): record the layer; data objects are copied lazily on
@@ -542,6 +604,7 @@ class Image:
         pos = self._journal_event("snap_remove", arg=snap)
         self._snap_remove_apply(snap)
         self._journal_committed(pos)
+        self._notify_header()
 
     def _snap_remove_apply(self, snap: str) -> None:
         meta = self._header["snaps"][snap]

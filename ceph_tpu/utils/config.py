@@ -274,6 +274,14 @@ for _o in [
            "within this many seconds"),
     Option("mon_election_timeout", float, 2.0, "advanced",
            "mon election timeout seconds"),
+    Option("rbd_cache", bool, False, "advanced",
+           "attach an ObjectCacher to opened rbd images "
+           "(osdc/ObjectCacher + rbd_cache roles). Default off: the "
+           "reference defaults on but pairs it with exclusive-lock "
+           "ownership; enable per open(cache=True) or here when a "
+           "single writer per image is guaranteed"),
+    Option("rbd_cache_size", int, 32 << 20, "advanced",
+           "ObjectCacher capacity per opened image, bytes"),
     Option("osd_op_queue", str, "wpq", "advanced",
            "op scheduler: wpq (weighted round-robin shares) or "
            "mclock_scheduler (dmclock reservation/weight/limit — "

@@ -1,0 +1,148 @@
+"""watch/notify (rados_watch / rados_notify roles) + the ObjectCacher
+(osdc/ObjectCacher role) and the rbd ImageWatcher coherence channel
+built on them."""
+
+import time
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        rados = c.client()
+        c.create_pool("wnpool", pg_num=4, size=2)
+        yield c
+
+
+def test_watch_notify_end_to_end(cluster):
+    rados = cluster.client()
+    io = rados.open_ioctx("wnpool")
+    io.write_full("watched", b"w")
+
+    c2 = cluster.client()
+    io2 = c2.open_ioctx("wnpool")
+    got: list[bytes] = []
+    cookie = io2.watch("watched", got.append)
+
+    acked, missed = io.notify("watched", b"ping-1")
+    assert (acked, missed) == (1, 0)
+    assert got == [b"ping-1"]
+
+    # two watchers, both see it; notifier counts both acks
+    got_b: list[bytes] = []
+    cookie_b = io.watch("watched", got_b.append)
+    acked, missed = io.notify("watched", b"ping-2")
+    assert (acked, missed) == (2, 0)
+    assert got[-1] == b"ping-2" and got_b == [b"ping-2"]
+
+    # unwatch: the dropped watcher no longer receives or acks
+    io2.unwatch(cookie)
+    acked, missed = io.notify("watched", b"ping-3")
+    assert (acked, missed) == (1, 0)
+    assert got[-1] == b"ping-2"
+    io.unwatch(cookie_b)
+    # no watchers at all: notify returns immediately, nothing acked
+    assert io.notify("watched", b"ping-4") == (0, 0)
+
+
+def test_notify_acks_keyed_per_client_cookie(cluster):
+    """Cookies are PER-CLIENT counters, so two clients' first watches
+    share cookie 1: acks must match on (client, cookie) — one ack
+    must not clear both pending watchers."""
+    ca, cb = cluster.client(), cluster.client()
+    ioa = ca.open_ioctx("wnpool")
+    iob = cb.open_ioctx("wnpool")
+    ioa.write_full("dup", b"x")
+    got_a, got_b = [], []
+    cka = ioa.watch("dup", got_a.append)   # each client's first watch
+    ckb = iob.watch("dup", got_b.append)
+    assert cka == ckb == 1                 # the collision under test
+    notifier = cluster.client().open_ioctx("wnpool")
+    acked, missed = notifier.notify("dup", b"both")
+    assert (acked, missed) == (2, 0)
+    assert got_a == [b"both"] and got_b == [b"both"]
+    ioa.unwatch(cka)
+    iob.unwatch(ckb)
+
+
+def test_notify_counts_dead_watcher_missed(cluster):
+    """A watcher that died without unwatching is reported MISSED,
+    never acked (the notifier must know who did NOT see it)."""
+    rados = cluster.client()
+    io = rados.open_ioctx("wnpool")
+    io.write_full("mort", b"x")
+    dead = cluster.client()
+    iod = dead.open_ioctx("mort-pool") if False else \
+        dead.open_ioctx("wnpool")
+    iod.watch("mort", lambda p: None)
+    dead.shutdown()                        # watcher dies, no unwatch
+    import time as _t
+    _t.sleep(0.2)
+    acked, missed = io.notify("mort", b"gone?", timeout_ms=3000)
+    assert acked == 0 and missed == 1, (acked, missed)
+    # the corpse was pruned: the next notify sees no watchers at all
+    assert io.notify("mort", b"again") == (0, 0)
+
+
+def test_object_cacher_hits_and_write_through(cluster):
+    from ceph_tpu.client.object_cacher import ObjectCacher
+    from ceph_tpu.client.striper import FileLayout, StripedObject
+    rados = cluster.client()
+    io = rados.open_ioctx("wnpool")
+    cache = ObjectCacher(max_bytes=1 << 20)
+    so = StripedObject(io, "cached", FileLayout(65536, 2, 65536),
+                       cache=cache)
+    so.write(b"A" * 200_000)
+    first = so.read(200_000, 0)
+    s0 = cache.stats()
+    again = so.read(200_000, 0)
+    s1 = cache.stats()
+    assert first == again == b"A" * 200_000
+    assert s1["hits"] > s0["hits"]          # second read from cache
+    # write-through: overwrite invalidates the touched objects only
+    so.write(b"B" * 100, 0)
+    assert so.read(100, 0) == b"B" * 100
+    assert so.read(100, 150_000) == b"A" * 100
+    # LRU bound holds
+    assert cache.stats()["bytes"] <= 1 << 20
+
+
+def test_rbd_cache_and_header_watch_coherence(cluster):
+    """Two cached handles on one image: a structural change (resize)
+    through one handle notifies the header watcher, and the other
+    handle reloads its header and drops its cache — the librbd
+    ImageWatcher channel."""
+    from ceph_tpu.services.rbd import RBD, Image
+    rados = cluster.client()
+    io = rados.open_ioctx("wnpool")
+    rbd = RBD(io)
+    rbd.create("cachimg", 4 << 20)
+
+    c2 = cluster.client()
+    io2 = c2.open_ioctx("wnpool")
+    a = Image(io, "cachimg", cache=True)
+    a.write(0, b"hot" * 1000)
+    # second cached handle opens AFTER the write (the exclusive-
+    # writer contract: the data cache assumes one writer; structural
+    # changes — which this test exercises — flow via the watcher)
+    b = Image(io2, "cachimg", replay=False, cache=True)
+    try:
+        assert b.read(0, 3000) == b"hot" * 1000
+        before = b.cache.stats()
+        assert b.read(0, 3000) == b"hot" * 1000    # cached
+        assert b.cache.stats()["hits"] > before["hits"]
+
+        a.resize(8 << 20)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and b.size() != 8 << 20:
+            time.sleep(0.05)
+        assert b.size() == 8 << 20      # header reloaded via notify
+        assert b.cache.stats()["entries"] == 0   # cache dropped
+        # and reads still work after the invalidation
+        assert b.read(0, 3000) == b"hot" * 1000
+    finally:
+        a.close()
+        b.close()
