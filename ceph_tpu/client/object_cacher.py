@@ -33,6 +33,15 @@ class ObjectCacher:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        #: bumped on every invalidation: a fill that STARTED before
+        #: an invalidation must not land after it (the put would pin
+        #: pre-invalidation bytes forever) — callers snapshot
+        #: generation() before fetching and pass it to put()
+        self._gen = 0
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._gen
 
     def get(self, oid: str, off: int, length: int) -> bytes | None:
         key = (oid, off, length)
@@ -45,10 +54,12 @@ class ObjectCacher:
             self.hits += 1
             return data
 
-    def put(self, oid: str, off: int, length: int,
-            data: bytes) -> None:
+    def put(self, oid: str, off: int, length: int, data: bytes,
+            gen: int | None = None) -> None:
         key = (oid, off, length)
         with self._lock:
+            if gen is not None and gen != self._gen:
+                return               # invalidated while fetching
             old = self._lru.pop(key, None)
             if old is not None:
                 self._bytes -= len(old)
@@ -61,11 +72,13 @@ class ObjectCacher:
     def invalidate_object(self, oid: str) -> None:
         """Drop every cached extent of one object (write-through)."""
         with self._lock:
+            self._gen += 1
             for key in [k for k in self._lru if k[0] == oid]:
                 self._bytes -= len(self._lru.pop(key))
 
     def invalidate_all(self) -> None:
         with self._lock:
+            self._gen += 1
             self._lru.clear()
             self._bytes = 0
 

@@ -92,6 +92,18 @@ class IoCtx:
     def remove(self, oid: str) -> None:
         self._submit(oid, M.OSD_OP_REMOVE, **self._snapc())
 
+    def truncate(self, oid: str, size: int) -> int:
+        """rados_trunc: shrink or zero-extend to ``size`` (creates a
+        zero-filled object when absent, like the reference's
+        write-class truncate)."""
+        return self._submit(oid, M.OSD_OP_TRUNCATE, offset=size,
+                            **self._snapc()).version
+
+    def zero(self, oid: str, offset: int, length: int) -> int:
+        """rados write-op zero: clear [offset, offset+length)."""
+        return self._submit(oid, M.OSD_OP_ZERO, offset=offset,
+                            length=length, **self._snapc()).version
+
     # -- pool snapshots (librados snap API role) ----------------------
     def snap_create(self, name: str) -> int:
         """Pool snapshot (rados_ioctx_snap_create): returns the snap
@@ -427,13 +439,25 @@ class RadosClient:
         def run():
             with self._wn_lock:
                 w = self._watches.get(msg.cookie)
-            if w is not None:
+            if w is None:
+                # GHOST watch (the OSD registered it but our watch()
+                # call gave up/timed out): do NOT ack — the notifier
+                # must never be told an unseen notify was processed —
+                # and purge the stale registration
                 try:
-                    w["cb"](bytes(msg.payload))
+                    conn.send_message(M.MWatch(
+                        tid=5_000_000 + msg.cookie, pool=msg.pool,
+                        ps=0, oid=msg.oid, cookie=msg.cookie,
+                        watch=False))
                 except Exception:
                     pass
-            # ack regardless: a dead callback must not stall the
-            # notifier
+                return
+            try:
+                w["cb"](bytes(msg.payload))
+            except Exception:
+                pass
+            # ack even on a failing callback: a buggy callback must
+            # not stall the notifier (the watch itself processed it)
             try:
                 conn.send_message(M.MWatchNotifyAck(
                     notify_id=msg.notify_id, cookie=msg.cookie))

@@ -148,12 +148,16 @@ class StripedObject:
             piece = self.cache.get(oid, obj_off, n) \
                 if self.cache is not None else None
             if piece is None:
+                gen = self.cache.generation() \
+                    if self.cache is not None else 0
                 try:
                     piece = self.io.read(oid, n, obj_off)
                 except Exception:
                     piece = b""      # sparse hole reads as zeros
                 if self.cache is not None:
-                    self.cache.put(oid, obj_off, n, piece)
+                    # gen guards the fill/invalidate race: a fetch
+                    # that began before an invalidation is dropped
+                    self.cache.put(oid, obj_off, n, piece, gen=gen)
             out[pos:pos + len(piece)] = piece
             pos += n
         return bytes(out)

@@ -296,6 +296,36 @@ class ECBackend(PGBackend):
             return
         run()
 
+    def submit_truncate(self, pg: PG, oid: str, new_size: int,
+                        version: int,
+                        on_commit: Callable[[int], None]) -> None:
+        """Truncate = ordered read + full rewrite. On the device path
+        the read DEFERS behind an engine barrier, exactly like
+        submit_remove/partial-write: a pipelined in-flight write of
+        this object fans out first, and the version-agreement retry
+        in _read_shards then sees its bytes — no lost update."""
+        def run() -> None:
+            try:
+                cur = self.read_object(pg, oid)
+            except (NoSuchObject, NoSuchCollection):
+                cur = b""
+            except StoreError:
+                on_commit(-5)
+                return
+            if new_size <= len(cur):
+                data = bytes(cur[:new_size])
+            else:
+                data = bytes(cur) + b"\x00" * (new_size - len(cur))
+            self.submit_write(pg, oid, data, version, on_commit)
+
+        if self.device is not None:
+            def barrier(pg=pg) -> None:
+                with pg.lock:
+                    run()
+            self.device.stage_barrier(pg.pgid, barrier)
+            return
+        run()
+
     def submit_setattrs(self, pg: PG, oid: str,
                         sets: dict[str, bytes], rms: list[str],
                         version: int,

@@ -823,22 +823,13 @@ class OSD:
                 "deadline": time.monotonic() +
                 (msg.timeout_ms or 5000) / 1000.0,
             }
-        for (peer, cookie), wconn in watchers.items():
-            try:
-                wconn.send_message(M.MWatchNotify(
-                    notify_id=notify_id, pool=msg.pool, oid=msg.oid,
-                    cookie=cookie, payload=msg.payload))
-            except Exception:
-                # provably-dead watcher: count it MISSED (never
-                # 'acked' — the notify contract is 'watchers SAW
-                # it') and prune the corpse from the watch table
-                self._notify_resolve(notify_id, (peer, cookie),
-                                     acked=False)
-                with self._watch_lock:
-                    ws = self._watchers.get(key, {})
-                    ws.pop((peer, cookie), None)
-                    if not ws:
-                        self._watchers.pop(key, None)
+        # fan out (fire-and-forget sends: a dead-but-not-yet-closed
+        # connection surfaces through the timeout sweep as MISSED;
+        # already-closed connections were aged out above)
+        for (_peer, cookie), wconn in watchers.items():
+            wconn.send_message(M.MWatchNotify(
+                notify_id=notify_id, pool=msg.pool, oid=msg.oid,
+                cookie=cookie, payload=msg.payload))
 
     def _handle_notify_ack(self, msg: M.MWatchNotifyAck,
                            conn: Connection) -> None:
@@ -986,7 +977,8 @@ class OSD:
                      M.OSD_OP_APPEND, M.OSD_OP_REMOVE, M.OSD_OP_CALL,
                      M.OSD_OP_SETXATTR, M.OSD_OP_RMXATTR,
                      M.OSD_OP_OMAPSET, M.OSD_OP_OMAPRMKEYS,
-                     M.OSD_OP_CREATE)
+                     M.OSD_OP_CREATE, M.OSD_OP_TRUNCATE,
+                     M.OSD_OP_ZERO)
     _OP_CACHE_MAX = 10000
 
     def _handle_osd_op(self, msg: M.MOSDOp, conn: Connection) -> None:
@@ -1129,7 +1121,9 @@ class OSD:
             if msg.snap_seq and op in (M.OSD_OP_WRITE_FULL,
                                        M.OSD_OP_WRITE,
                                        M.OSD_OP_APPEND,
-                                       M.OSD_OP_REMOVE):
+                                       M.OSD_OP_REMOVE,
+                                       M.OSD_OP_TRUNCATE,
+                                       M.OSD_OP_ZERO):
                 # snapshot COW (PrimaryLogPG::make_writeable role):
                 # first mutation under a newer snap context clones the
                 # head before the write lands
@@ -1334,6 +1328,47 @@ class OSD:
                     be.submit_omap(
                         pg, msg.oid, {}, list(keys), version,
                         lambda code, v=version: reply(code, b"", v))
+            elif op == M.OSD_OP_ZERO:
+                # CEPH_OSD_OP_ZERO = a ranged write of zeros, riding
+                # the SAME RMW/extent-cache path as OSD_OP_WRITE so
+                # pipelined in-flight writes order correctly; zeroing
+                # past the end never extends (reference semantics)
+                try:
+                    old_size = be.stat_object(pg, msg.oid)
+                except (NoSuchObject, NoSuchCollection):
+                    reply(ENOENT)
+                    return
+                old_size = pg.extent_cache.effective_size(
+                    msg.oid, old_size, -1)
+                if msg.offset >= old_size or not msg.length:
+                    reply(0)
+                    return
+                zlen = min(msg.length, old_size - msg.offset)
+                self.logger.inc("op_w")
+                version = pg.alloc_version()
+                zeros = b"\x00" * zlen
+                if isinstance(be, ECBackend):
+                    be.submit_partial_write(
+                        pg, msg.oid, msg.offset, zeros, version,
+                        lambda code, v=version: reply(code, b"", v),
+                        old_size=old_size)
+                else:
+                    cur = bytearray(be.read_object(pg, msg.oid))
+                    cur[msg.offset:msg.offset + zlen] = zeros
+                    be.submit_write(
+                        pg, msg.oid, bytes(cur), version,
+                        lambda code, v=version: reply(code, b"", v))
+            elif op == M.OSD_OP_TRUNCATE:
+                # CEPH_OSD_OP_TRUNCATE as a versioned full rewrite —
+                # correct under EC stripe alignment (no stale bytes
+                # survive in the final partial stripe for a later
+                # append to leak). The backend orders it behind any
+                # pipelined in-flight writes (EC: engine barrier).
+                self.logger.inc("op_w")
+                version = pg.alloc_version()
+                be.submit_truncate(
+                    pg, msg.oid, msg.offset, version,
+                    lambda code, v=version: reply(code, b"", v))
             elif op == M.OSD_OP_CREATE:
                 try:
                     be.stat_object(pg, msg.oid)

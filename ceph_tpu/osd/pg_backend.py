@@ -221,6 +221,29 @@ class PGBackend:
         rollback does not apply / state is unknown."""
         return None
 
+    def submit_truncate(self, pg: PG, oid: str, new_size: int,
+                        version: int,
+                        on_commit: Callable[[int], None]) -> None:
+        """Shrink/zero-extend to ``new_size`` (CEPH_OSD_OP_TRUNCATE;
+        absent objects are created zero-filled, write-op semantics).
+        Default: synchronous read + full rewrite."""
+        from ceph_tpu.store.object_store import (
+            NoSuchCollection,
+            NoSuchObject,
+        )
+        try:
+            cur = self.read_object(pg, oid)
+        except (NoSuchObject, NoSuchCollection):
+            cur = b""                  # create zero-filled
+        except StoreError:
+            on_commit(-5)              # transient read failure: fail,
+            return                     # never silently zero the object
+        if new_size <= len(cur):
+            data = bytes(cur[:new_size])
+        else:
+            data = bytes(cur) + b"\x00" * (new_size - len(cur))
+        self.submit_write(pg, oid, data, version, on_commit)
+
     # -- client xattrs/omap (do_osd_ops attr families) ----------------
     def submit_setattrs(self, pg: PG, oid: str,
                         sets: dict[str, bytes], rms: list[str],

@@ -184,6 +184,8 @@ OSD_OP_OMAPSET = 15    # data = json {k: v_hex}
 OSD_OP_OMAPRMKEYS = 16  # data = json [keys]
 OSD_OP_OMAPGETKEYS = 17  # reply data = json [keys]
 OSD_OP_CREATE = 18     # xop=1: exclusive (-EEXIST if present)
+OSD_OP_TRUNCATE = 19   # offset = new size (grow fills zeros)
+OSD_OP_ZERO = 20       # zero [offset, offset+length)
 
 # cmpxattr / guard comparison modes (CEPH_OSD_CMPXATTR_OP_*,
 # src/include/rados.h): EQ..LTE compare the stored value against the

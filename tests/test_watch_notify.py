@@ -75,8 +75,7 @@ def test_notify_counts_dead_watcher_missed(cluster):
     io = rados.open_ioctx("wnpool")
     io.write_full("mort", b"x")
     dead = cluster.client()
-    iod = dead.open_ioctx("mort-pool") if False else \
-        dead.open_ioctx("wnpool")
+    iod = dead.open_ioctx("wnpool")
     iod.watch("mort", lambda p: None)
     dead.shutdown()                        # watcher dies, no unwatch
     import time as _t

@@ -177,3 +177,60 @@ def test_xattr_omap_survive_recovery(cluster):
     assert ioe.getxattr("rec-e", "who") == b"survivor"
     c.revive_osd(2)
     c.wait_for_clean(timeout=60)
+
+
+def test_truncate_and_zero_ops(cluster):
+    """CEPH_OSD_OP_TRUNCATE / ZERO on EC and replicated pools: shrink
+    drops the tail for good (an append after shrink must never leak
+    pre-truncate bytes), grow reads back zeros, zero clears a range
+    in place."""
+    c, rados = cluster
+    for pool in ("xec", "xrep"):
+        io = rados.open_ioctx(pool)
+        oid = f"trunc-{pool}"
+        io.write_full(oid, b"ABCDEFGH" * 4096)       # 32 KiB
+        io.truncate(oid, 10_000)
+        assert io.stat(oid) == 10_000
+        assert io.read(oid) == (b"ABCDEFGH" * 4096)[:10_000]
+        # append after shrink: the gap must NOT resurrect old bytes
+        io.append(oid, b"XY")
+        got = io.read(oid)
+        assert got[:10_000] == (b"ABCDEFGH" * 4096)[:10_000]
+        assert got[10_000:] == b"XY"
+        # grow: zero-filled tail
+        io.truncate(oid, 20_000)
+        got = io.read(oid)
+        assert len(got) == 20_000
+        assert got[10_002:] == b"\x00" * (20_000 - 10_002)
+        # zero a range in place
+        io.zero(oid, 4, 100)
+        got = io.read(oid)
+        assert got[:4] == b"ABCD" and \
+            got[4:104] == b"\x00" * 100 and got[104:110] == \
+            (b"ABCDEFGH" * 4096)[104:110]
+        # truncate of a missing object creates zeros; zero -> ENOENT
+        io.truncate(f"born-{pool}", 128)
+        assert io.read(f"born-{pool}") == b"\x00" * 128
+        with pytest.raises(RadosError) as ei:
+            io.zero(f"ghost-{pool}", 0, 10)
+        assert ei.value.code == -2
+
+
+def test_truncate_zero_respect_snapshots(cluster):
+    """TRUNCATE/ZERO are write-class ops: the first one under a newer
+    snap context must COW the head first, so snap reads keep the
+    pre-truncate content (the r3 review's data-loss scenario)."""
+    c, rados = cluster
+    io = rados.open_ioctx("xrep")
+    io.write_full("snapt", b"PRECIOUS" * 1000)
+    snapid = io.snap_create("before-trunc")
+    io.truncate("snapt", 8)
+    assert io.read("snapt") == b"PRECIOUS"
+    # the snapshot still sees the full pre-truncate object
+    assert io.read("snapt", snap=snapid) == b"PRECIOUS" * 1000
+    snap2 = io.snap_create("before-zero")
+    io.zero("snapt", 0, 4)
+    assert io.read("snapt") == b"\x00\x00\x00\x00IOUS"
+    assert io.read("snapt", snap=snap2) == b"PRECIOUS"
+    io.snap_remove("before-trunc")
+    io.snap_remove("before-zero")
