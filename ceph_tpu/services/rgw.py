@@ -54,11 +54,33 @@ class RGWGateway:
     gateway then routes per bucket, so mixed-era buckets and
     gateways can never split one index across two formats."""
 
-    def __init__(self, ioctx) -> None:
+    def __init__(self, ioctx, zone_log: bool = False) -> None:
         self.io = ioctx
         self._layout = FileLayout(stripe_unit=1 << 20, stripe_count=1,
                                   object_size=1 << 20)
         self._fmt_cache: dict[str, str] = {}
+        #: multisite source role (src/rgw/rgw_sync.cc, reduced):
+        #: every mutation appends a replication-log entry (cls log,
+        #: atomic in-OSD) that RGWSyncAgent tails into another zone
+        self.zone_log = zone_log
+
+    def _log_mutation(self, bucket: str, op: str, key: str,
+                      etag: str = "") -> None:
+        """Append one SEQUENCED replication-log entry: an atomic cls
+        numops counter assigns the seq, the entry rides an omap key
+        (zero-padded seq) — O(1) appends, PAGED tailing, and markers
+        keyed by seq survive trims (a positional index would not).
+        zone_log therefore needs an omap-capable (replicated) pool,
+        like the reference's log pools."""
+        if not self.zone_log:
+            return
+        oid = f".rgwlog.{bucket}"
+        out = self.io.execute(oid, "numops", "add",
+                              json.dumps({"key": "seq",
+                                          "value": 1}).encode())
+        seq = int(json.loads(out)["seq"])
+        self.io.omap_set(oid, {f"{seq:016d}": json.dumps(
+            {"op": op, "key": key, "etag": etag}).encode()})
 
     # -- bucket index (cls_rgw bucket-index role) ----------------------
     def _pool_omap(self) -> bool:
@@ -176,15 +198,24 @@ class RGWGateway:
             raise RGWError(404, "NoSuchBucket")
 
     # -- objects -------------------------------------------------------
-    def put_object(self, bucket: str, key: str, data: bytes) -> str:
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   etag: str | None = None, _log: bool = True) -> str:
+        """``etag`` overrides the computed md5 (replication must
+        carry the SOURCE etag — multipart objects have 'md5-N' etags
+        a re-hash cannot reproduce); ``_log=False`` suppresses the
+        replication-log entry for internal writes that log once
+        themselves (multipart complete)."""
         self._check_bucket(bucket)
         so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
         so.remove()                    # replace semantics
         so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
         if data:
             so.write(data)
-        etag = hashlib.md5(data).hexdigest()
+        if etag is None:
+            etag = hashlib.md5(data).hexdigest()
         self._index_add(bucket, key, len(data), etag)
+        if _log:
+            self._log_mutation(bucket, "put", key, etag)
         return etag
 
     def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
@@ -200,6 +231,7 @@ class RGWGateway:
         self._check_bucket(bucket)
         self._index_rm(bucket, key)
         StripedObject(self.io, f"{bucket}/{key}").remove()
+        self._log_mutation(bucket, "del", key)
 
     def list_objects(self, bucket: str, prefix: str = "",
                      max_keys: int = 1000, marker: str = "") -> dict:
@@ -315,11 +347,12 @@ class RGWGateway:
                           self._mp_oid(bucket, key, upload_id,
                                        num)).read()
             for num, _ in parts)
-        self.put_object(bucket, key, body)
+        self.put_object(bucket, key, body, _log=False)
         final_etag = (hashlib.md5(digests).hexdigest()
                       + f"-{len(parts)}")
         # the S3 multipart etag replaces the plain-md5 one
         self._index_add(bucket, key, len(body), final_etag)
+        self._log_mutation(bucket, "put", key, final_etag)
         self.abort_multipart(bucket, key, upload_id)
         return final_etag
 
@@ -933,8 +966,9 @@ class RGWServer:
 
     def __init__(self, ioctx, host: str = "127.0.0.1",
                  port: int = 0,
-                 auth: dict[str, str] | None = None) -> None:
-        gw = RGWGateway(ioctx)
+                 auth: dict[str, str] | None = None,
+                 zone_log: bool = False) -> None:
+        gw = RGWGateway(ioctx, zone_log=zone_log)
         handler = type("BoundHandler", (_Handler,),
                        {"gw": gw, "auth": auth,
                         "swift_tokens": {},
