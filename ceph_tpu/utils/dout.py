@@ -73,7 +73,12 @@ class Dout:
             with _lock:
                 _ring.append(record)
         if level <= get_subsys_level(self.subsys):
-            print(record, file=self.stream)
+            try:
+                print(record, file=self.stream)
+            except ValueError:
+                pass     # stream closed (interpreter/test teardown):
+                # a daemon thread's last log line must not raise into
+                # its caller; the ring above still has the record
 
     def error(self, *parts) -> None:
         self(-1, *parts)
