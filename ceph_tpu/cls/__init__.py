@@ -122,6 +122,26 @@ def _lock_unlock(inp: bytes, obj: bytes | None):
     return 0, b"", json.dumps(st).encode()
 
 
+@register("lock", "break_lock")
+def _lock_break(inp: bytes, obj: bytes | None):
+    """Forcibly remove ANOTHER holder's lock (src/cls/lock break_lock
+    role — the admin/fencing path). input: {"name", "cookie"};
+    cookie "*" breaks every holder of ``name``."""
+    req = json.loads(inp)
+    st = _lock_state(obj)
+    prefix = f"{req['name']}/"
+    if req.get("cookie", "*") == "*":
+        victims = [k for k in st["lockers"] if k.startswith(prefix)]
+    else:
+        key = f"{req['name']}/{req['cookie']}"
+        victims = [key] if key in st["lockers"] else []
+    if not victims:
+        return -2, b"", None          # -ENOENT
+    for k in victims:
+        del st["lockers"][k]
+    return 0, b"", json.dumps(st).encode()
+
+
 @register("lock", "info")
 def _lock_info(inp: bytes, obj: bytes | None):
     st = _lock_state(obj)

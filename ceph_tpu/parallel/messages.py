@@ -478,3 +478,33 @@ class MWatchNotify(Message):
 class MWatchNotifyAck(Message):
     MSG_TYPE = 55
     FIELDS = [("notify_id", "u64"), ("cookie", "u64")]
+
+
+# -- MDS protocol (src/messages/MClientRequest.h, MClientReply.h,
+#    MClientCaps.h roles) ------------------------------------------------
+
+class MMDSOp(Message):
+    """Client -> MDS: one metadata request. ``op`` selects the handler
+    (mkdir/create/rename/cap_acquire/...), ``args`` is a json blob —
+    the MClientRequest role with the reference's ~40 typed request
+    structs collapsed onto one json surface. ``client`` + ``tid``
+    identify the request for the MDS's completed-request dedup
+    (src/mds/SessionMap.h trim_completed_requests role)."""
+    MSG_TYPE = 60
+    FIELDS = [("tid", "u64"), ("client", "str"), ("op", "str"),
+              ("args", "bytes")]
+
+
+class MMDSOpReply(Message):
+    """MDS -> client (MClientReply role): negative errno in ``code``,
+    json result in ``data``."""
+    MSG_TYPE = 61
+    FIELDS = [("tid", "u64"), ("code", "i32"), ("data", "bytes")]
+
+
+class MMDSCapRevoke(Message):
+    """MDS -> client (MClientCaps CAP_OP_REVOKE role): give back your
+    cap on ``ino`` (flush dirty state first); ``keep`` is the strongest
+    cap type the client may retain ("" = none, "shared")."""
+    MSG_TYPE = 62
+    FIELDS = [("ino", "u64"), ("keep", "str"), ("epoch", "u32")]

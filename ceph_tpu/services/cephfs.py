@@ -109,6 +109,13 @@ class CephFS:
         #: handle's write must be visible to every reader of this
         #: mount, cap or no cap (same-client coherence).
         self._ino_cache: dict[int, dict] = {}
+        #: (client, tid) -> journal record, for every journaled intent
+        #: seen during replay that carried a request id. The MDS daemon
+        #: seeds its completed-request dedup from this (the reference
+        #: journals completed_requests in the MDLog for the same
+        #: reason: a client retrying across MDS failover must get the
+        #: completed reply, not a re-execution — SessionMap.h role).
+        self.replayed_requests: dict[tuple[str, int], dict] = {}
         if self.journal is not None:
             if not self.journal.exists():
                 self.journal.create()
@@ -177,10 +184,14 @@ class CephFS:
         self._mds_pos = applied
         self.journal.commit(self.client_id, applied)
 
-    def _mds_event(self, op: str, **args) -> int | None:
+    def _mds_event(self, op: str, req: tuple[str, int] | None = None,
+                   **args) -> int | None:
         if self.journal is None:
             return None
-        payload = json.dumps({"op": op, **args}).encode()
+        rec = {"op": op, **args}
+        if req is not None:
+            rec["req"] = list(req)
+        payload = json.dumps(rec).encode()
         with self._mds_lock:
             pos = self.journal.append(payload)
             self._mds_pending.add(pos)
@@ -225,6 +236,9 @@ class CephFS:
 
     def _apply_mds_event(self, rec: dict) -> None:
         op = rec["op"]
+        if "req" in rec:
+            client, tid = rec["req"]
+            self.replayed_requests[(client, int(tid))] = rec
         if op in ("mkdir", "create"):
             kind = "dir" if op == "mkdir" else "file"
             inode = {"type": kind, "mtime": time.time()}
@@ -284,6 +298,13 @@ class CephFS:
         return ino, inode
 
     def _resolve_parent(self, path: str) -> tuple[int, str]:
+        ino, name, _ = self._resolve_parent3(path)
+        return ino, name
+
+    def _resolve_parent3(self, path: str) -> tuple[int, str, dict]:
+        """Like _resolve_parent but also hands back the parent inode
+        already read during resolution (saves callers that need its
+        entries a second round trip)."""
         parts = [p for p in path.split("/") if p]
         if not parts:
             raise FSError(errno.EINVAL, "root has no parent")
@@ -291,7 +312,7 @@ class CephFS:
         ino, inode = self._resolve(parent)
         if inode["type"] != "dir":
             raise FSError(errno.ENOTDIR, parent)
-        return ino, parts[-1]
+        return ino, parts[-1], inode
 
     def _dir_link(self, dir_ino: int, name: str, ino: int) -> None:
         from ceph_tpu.client.rados import RadosError
@@ -313,11 +334,14 @@ class CephFS:
         return json.loads(out)["ino"]
 
     # -- namespace ops (libcephfs surface) ----------------------------
-    def mkdir(self, path: str) -> None:
-        parent, name = self._resolve_parent(path)
+    def mkdir(self, path: str,
+              req: tuple[str, int] | None = None) -> None:
+        parent, name, pinode = self._resolve_parent3(path)
+        if name in pinode.get("entries", {}):
+            raise FSError(errno.EEXIST, path)
         ino = self._alloc_ino()
         pos = self._mds_event("mkdir", parent=parent, name=name,
-                              ino=ino)
+                              ino=ino, req=req)
         try:
             self._write_inode(ino, {"type": "dir", "entries": {},
                                     "mtime": time.time()})
@@ -341,7 +365,8 @@ class CephFS:
             out["nentries"] = len(inode["entries"])
         return out
 
-    def rmdir(self, path: str) -> None:
+    def rmdir(self, path: str,
+              req: tuple[str, int] | None = None) -> None:
         ino, inode = self._resolve(path)
         if inode["type"] != "dir":
             raise FSError(errno.ENOTDIR, path)
@@ -349,18 +374,21 @@ class CephFS:
             raise FSError(errno.ENOTEMPTY, path)
         parent, name = self._resolve_parent(path)
         pos = self._mds_event("rmdir", parent=parent, name=name,
-                              ino=ino)
+                              ino=ino, req=req)
         try:
             self._dir_unlink(parent, name)
             self.io.remove(f"inode.{ino}")
         finally:
             self._mds_committed(pos)
 
-    def create(self, path: str) -> "File":
-        parent, name = self._resolve_parent(path)
+    def create(self, path: str,
+               req: tuple[str, int] | None = None) -> "File":
+        parent, name, pinode = self._resolve_parent3(path)
+        if name in pinode.get("entries", {}):
+            raise FSError(errno.EEXIST, path)
         ino = self._alloc_ino()
         pos = self._mds_event("create", parent=parent, name=name,
-                              ino=ino)
+                              ino=ino, req=req)
         try:
             self._write_inode(ino, {"type": "file", "size": 0,
                                     "mtime": time.time()})
@@ -477,13 +505,14 @@ class CephFS:
             if cur is not None and time.time() < cur[1]:
                 self._ino_cache[ino] = inode
 
-    def unlink(self, path: str) -> None:
+    def unlink(self, path: str,
+               req: tuple[str, int] | None = None) -> None:
         ino, inode = self._resolve(path)
         if inode["type"] == "dir":
             raise FSError(errno.EISDIR, path)
         parent, name = self._resolve_parent(path)
         pos = self._mds_event("unlink", parent=parent, name=name,
-                              ino=ino)
+                              ino=ino, req=req)
         try:
             self._dir_unlink(parent, name)
             StripedObject(self.io, f"fsdata.{ino}").remove()
@@ -491,7 +520,8 @@ class CephFS:
         finally:
             self._mds_committed(pos)
 
-    def rename(self, old: str, new: str) -> None:
+    def rename(self, old: str, new: str,
+               req: tuple[str, int] | None = None) -> None:
         """Link under the new name, then unlink the old. The journaled
         intent makes the pair crash-atomic: a mount after a crash
         between the steps replays the intent and finishes the unlink
@@ -502,7 +532,7 @@ class CephFS:
         pos = self._mds_event(
             "rename", ino=ino, new_parent=new_parent,
             new_name=new_name, old_parent=old_parent,
-            old_name=old_name)
+            old_name=old_name, req=req)
         try:
             self._dir_link(new_parent, new_name, ino)
             self._dir_unlink(old_parent, old_name)
@@ -584,6 +614,10 @@ class File:
         self._acquire_cap("shared")
         inode = self._inode()
         size = inode.get("size", 0)
+        # inode size is authoritative: sync the striper handle's
+        # cached stream size, or a handle opened before another
+        # client grew the file clamps its reads short
+        self._data.size = size
         if length is None:
             length = max(size - offset, 0)
         length = min(length, max(size - offset, 0))

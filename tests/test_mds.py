@@ -1,0 +1,239 @@
+"""MDS daemon (src/mds/MDSDaemon.cc, Server.cc, Locker.cc roles):
+namespace ops over the wire, server-driven cap recall, journaled
+failover with completed-request dedup."""
+
+import errno
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.services.cephfs import FSError
+from ceph_tpu.services.mds import MDSDaemon
+from ceph_tpu.services.mds_client import CephFSMount
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        c.client()
+        c.create_pool("fsmeta", pg_num=4, size=2)
+        c.create_pool("fsfail", pg_num=4, size=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def mds(cluster):
+    d = MDSDaemon("a", cluster.mon_addr, "fsmeta",
+                  active_ttl=1.5).start(wait_active=True)
+    yield d
+    d.stop()
+
+
+def _mount(cluster, pool="fsmeta", **kw) -> CephFSMount:
+    io = cluster._clients[0].open_ioctx(pool)
+    return CephFSMount(io, **kw)
+
+
+def test_namespace_over_the_wire(cluster, mds):
+    with _mount(cluster) as m:
+        m.mkdir("/a")
+        m.mkdir("/a/b")
+        assert m.readdir("/") == ["a"]
+        assert m.readdir("/a") == ["b"]
+        assert m.stat("/a")["type"] == "dir"
+        with pytest.raises(FSError) as ei:
+            m.mkdir("/a")
+        assert ei.value.errno == errno.EEXIST
+        with pytest.raises(FSError) as ei:
+            m.readdir("/nope")
+        assert ei.value.errno == errno.ENOENT
+        f = m.create("/a/f.txt")
+        f.write(b"hello mds")
+        assert m.stat("/a/f.txt")["size"] == 9
+        assert m.open("/a/f.txt").read() == b"hello mds"
+        m.rename("/a/f.txt", "/a/b/g.txt")
+        assert m.readdir("/a") == ["b"]
+        assert m.open("/a/b/g.txt").read() == b"hello mds"
+        m.unlink("/a/b/g.txt")
+        with pytest.raises(FSError):
+            m.open("/a/b/g.txt")
+        m.rmdir("/a/b")
+        assert m.readdir("/a") == []
+
+
+def test_second_mount_sees_first(cluster, mds):
+    with _mount(cluster) as m1, _mount(cluster) as m2:
+        m1.mkdir("/shared")
+        f = m1.open("/shared/x", create=True)
+        f.write(b"from-m1")
+        f.release()
+        assert m2.readdir("/shared") == ["x"]
+        assert m2.open("/shared/x").read() == b"from-m1"
+
+
+def test_server_driven_cap_revoke(cluster, mds):
+    """The Locker.cc recall: m1 holds an exclusive cap; m2's read
+    makes the MDS push a revoke to m1, m1 flushes + releases, m2
+    proceeds — no lease-expiry wait."""
+    with _mount(cluster) as m1, _mount(cluster) as m2:
+        f1 = m1.open("/capfile", create=True)
+        f1.write(b"v1")                 # m1 now holds exclusive
+        ino = f1.ino
+        assert mds.cap_holders(ino) == {m1.client_id: "exclusive"}
+        t0 = time.monotonic()
+        f2 = m2.open("/capfile")
+        assert f2.read() == b"v1"       # forced a revoke of m1's cap
+        elapsed = time.monotonic() - t0
+        # revoke round-trip, NOT the 2 s lease expiry backstop
+        assert elapsed < 1.5, f"revoke took {elapsed:.2f}s (lease-" \
+            "expiry path?)"
+        holders = mds.cap_holders(ino)
+        assert holders.get(m2.client_id) == "shared"
+        assert m1.client_id not in holders
+
+
+def test_exclusive_blocks_until_release(cluster, mds):
+    with _mount(cluster) as m1, _mount(cluster) as m2:
+        f1 = m1.open("/excl", create=True)
+        f1.write(b"a" * 8)
+        got = []
+
+        def writer():
+            f2 = m2.open("/excl")
+            f2.write(b"b" * 4, offset=8)
+            got.append(f2.read())
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert got == [b"a" * 8 + b"b" * 4]
+
+
+def test_stale_handle_sees_growth(cluster, mds):
+    """A handle opened while the file was small must see bytes another
+    mount appended later (its striper size cache is NOT authoritative
+    — the MDS inode is)."""
+    with _mount(cluster) as m1, _mount(cluster) as m2:
+        f1 = m1.open("/grow", create=True)
+        f1.write(b"A" * 64)
+        f2 = m2.open("/grow")
+        assert f2.read() == b"A" * 64   # f2 striper cached at size 64
+        f1.write(b"B" * 32, offset=64)  # m1 grows the file to 96
+        assert f2.read()[64:] == b"B" * 32
+        assert f2.size() == 96
+
+
+def test_setattr_requires_exclusive_cap(cluster, mds):
+    """A writer whose cap was revoked (or never granted) cannot flush
+    attributes — the Locker gate on cap flush."""
+    with _mount(cluster) as m:
+        f = m.open("/gate", create=True)
+        f.write(b"x")
+        ino = f.ino
+        f.release()                      # cap gone server-side
+        with pytest.raises(FSError) as ei:
+            m._rpc("setattr", {"ino": ino, "size": 999})
+        assert ei.value.errno == errno.EPERM
+
+
+def test_dead_client_lease_expiry(cluster, mds):
+    """Session-death backstop: a mount that vanishes without releasing
+    its exclusive cap stops blocking writers once its lease lapses."""
+    m1 = _mount(cluster)
+    with _mount(cluster) as m2:
+        f1 = m1.open("/deadcap", create=True)
+        f1.write(b"before crash")
+        # hard-kill m1: no release, no session_close
+        m1._revoker.shutdown(wait=False)
+        m1.msgr.shutdown()
+        t0 = time.monotonic()
+        f2 = m2.open("/deadcap")
+        f2.write(b"after", offset=0)
+        assert time.monotonic() - t0 < 8.0
+        assert f2.read(5) == b"after"
+
+
+def test_failover_replays_half_done_rename(cluster):
+    """Kill the active MDS between rename's link and unlink steps; the
+    standby replays the journal intent and finishes the op, and the
+    client's retried request gets its COMPLETED reply from the
+    journal-seeded dedup table instead of a re-execution
+    (src/mds/Server.cc handle_client_rename + completed_requests)."""
+    a = MDSDaemon("fa", cluster.mon_addr, "fsfail",
+                  active_ttl=1.5).start(wait_active=True)
+    m = _mount(cluster, pool="fsfail", op_timeout=30.0)
+    try:
+        m.mkdir("/d1")
+        m.mkdir("/d2")
+        f = m.create("/d1/victim")
+        f.write(b"payload")
+        f.release()
+        # wedge the active MDS inside rename: link done, unlink never
+        # runs (the reference's crash window the MDS journal closes)
+        wedged = threading.Event()
+        orig_unlink = a.fs._dir_unlink
+
+        def stuck_unlink(dir_ino, name):
+            wedged.set()
+            threading.Event().wait()      # never returns
+
+        a.fs._dir_unlink = stuck_unlink
+        result = []
+
+        def do_rename():
+            m.rename("/d1/victim", "/d2/victim")
+            result.append("ok")
+
+        t = threading.Thread(target=do_rename, daemon=True)
+        t.start()
+        assert wedged.wait(timeout=10), "rename never reached unlink"
+        a.kill()                          # lock still held: real crash
+        b = MDSDaemon("fb", cluster.mon_addr, "fsfail",
+                      active_ttl=1.5).start(wait_active=True,
+                                            timeout=30.0)
+        try:
+            t.join(timeout=30)
+            assert result == ["ok"], "retried rename did not complete"
+            assert m.readdir("/d1") == []          # unlink replayed
+            assert m.readdir("/d2") == ["victim"]  # link kept
+            assert m.open("/d2/victim").read() == b"payload"
+            # and the namespace keeps working on the new active
+            m.mkdir("/after-failover")
+            assert "after-failover" in m.readdir("/")
+        finally:
+            b.stop()
+    finally:
+        m.umount()
+        a.kill()
+
+
+def test_deposed_mds_fences_itself(cluster):
+    """A stalled active whose lease a standby stole must refuse ops
+    (ESTALE) rather than serve split-brain."""
+    a = MDSDaemon("za", cluster.mon_addr, "fsfail",
+                  active_ttl=1.0).start(wait_active=True)
+    try:
+        # steal the active lock out from under a (what a standby does
+        # after a's lease lapses; break_lock compresses the wait)
+        import json as _json
+        io = cluster._clients[0].open_ioctx("fsfail")
+        io.execute("mdsmap.lock", "lock", "break_lock",
+                   _json.dumps({"name": "mds_active",
+                                "cookie": "za"}).encode())
+        io.execute("mdsmap.lock", "lock", "lock",
+                   _json.dumps({"name": "mds_active",
+                                "cookie": "thief",
+                                "type": "exclusive",
+                                "duration": 30}).encode())
+        deadline = time.monotonic() + 10
+        while not a._deposed:
+            assert time.monotonic() < deadline, "never deposed"
+            time.sleep(0.1)
+        assert not a.is_active()
+    finally:
+        a.kill()
+        io.execute("mdsmap.lock", "lock", "unlock",
+                   _json.dumps({"name": "mds_active",
+                                "cookie": "thief"}).encode())
