@@ -33,6 +33,13 @@ from ceph_tpu.client.striper import FileLayout, StripedObject
 
 BUCKETS_OID = ".buckets"
 
+#: canned ACLs (src/rgw/rgw_acl_s3.cc rgw_canned_acl role)
+CANNED_ACLS = ("private", "public-read", "public-read-write",
+               "authenticated-read")
+
+#: requester sentinel for unauthenticated requests
+ANONYMOUS = None
+
 
 class RGWError(Exception):
     def __init__(self, status: int, message: str) -> None:
@@ -59,13 +66,27 @@ class RGWGateway:
         self._layout = FileLayout(stripe_unit=1 << 20, stripe_count=1,
                                   object_size=1 << 20)
         self._fmt_cache: dict[str, str] = {}
+        #: version id assigned by the most recent put_object/
+        #: delete_object on THIS THREAD (x-amz-version-id) — thread
+        #: local because ThreadingHTTPServer handlers share one
+        #: gateway and must not read each other's ids
+        import threading as _th
+        self._tls = _th.local()
         #: multisite source role (src/rgw/rgw_sync.cc, reduced):
         #: every mutation appends a replication-log entry (cls log,
         #: atomic in-OSD) that RGWSyncAgent tails into another zone
         self.zone_log = zone_log
 
+    @property
+    def last_version_id(self) -> str | None:
+        return getattr(self._tls, "vid", None)
+
+    @last_version_id.setter
+    def last_version_id(self, vid: str | None) -> None:
+        self._tls.vid = vid
+
     def _log_mutation(self, bucket: str, op: str, key: str,
-                      etag: str = "") -> None:
+                      etag: str = "", vid: str | None = None) -> None:
         """Append one SEQUENCED replication-log entry: an atomic cls
         numops counter assigns the seq, the entry rides an omap key
         (zero-padded seq) — O(1) appends, PAGED tailing, and markers
@@ -79,8 +100,10 @@ class RGWGateway:
                               json.dumps({"key": "seq",
                                           "value": 1}).encode())
         seq = int(json.loads(out)["seq"])
-        self.io.omap_set(oid, {f"{seq:016d}": json.dumps(
-            {"op": op, "key": key, "etag": etag}).encode()})
+        ent = {"op": op, "key": key, "etag": etag}
+        if vid is not None:
+            ent["vid"] = vid
+        self.io.omap_set(oid, {f"{seq:016d}": json.dumps(ent).encode()})
 
     # -- bucket index (cls_rgw bucket-index role) ----------------------
     def _pool_omap(self) -> bool:
@@ -100,11 +123,20 @@ class RGWGateway:
         return fmt
 
     def _index_add(self, bucket: str, key: str, size: int,
-                   etag: str) -> None:
+                   etag: str, **extra) -> None:
+        """``extra`` carries optional per-object metadata (mtime, acl,
+        owner, version id) — omap-format entries are json and
+        extensible; the cls blob path (EC pools) keeps the classic
+        size/etag/mtime triple (versioning requires omap, see
+        set_versioning)."""
         if self._bucket_fmt(bucket) == "omap":
+            import time as _t
+            ent = {"size": size, "etag": etag,
+                   "mtime": extra.pop("mtime", None) or _t.time()}
+            ent.update({k: v for k, v in extra.items()
+                        if v is not None})
             self.io.omap_set(
-                f".bucket.{bucket}",
-                {key: json.dumps({"size": size, "etag": etag}).encode()})
+                f".bucket.{bucket}", {key: json.dumps(ent).encode()})
         else:
             self.io.execute(f".bucket.{bucket}", "rgw", "bucket_add",
                             json.dumps({"key": key, "size": size,
@@ -167,13 +199,33 @@ class RGWGateway:
     def list_buckets(self) -> list[str]:
         return sorted(self._buckets())
 
-    def create_bucket(self, name: str) -> None:
+    def bucket_meta(self, name: str) -> dict:
+        """Bucket metadata record (owner/acl/versioning/lifecycle —
+        the RGWBucketInfo role)."""
+        b = self._buckets()
+        if name not in b:
+            raise RGWError(404, "NoSuchBucket")
+        return b[name] or {}
+
+    def _update_bucket_meta(self, name: str, **fields) -> None:
+        b = self._buckets()
+        if name not in b:
+            raise RGWError(404, "NoSuchBucket")
+        meta = b[name] or {}
+        meta.update(fields)
+        b[name] = meta
+        self.io.write_full(BUCKETS_OID, json.dumps(b).encode())
+
+    def create_bucket(self, name: str, owner: str = "",
+                      acl: str = "private") -> None:
         if not name or "/" in name or name.startswith("."):
             raise RGWError(400, f"invalid bucket name {name!r}")
+        if acl not in CANNED_ACLS:
+            raise RGWError(400, "InvalidArgument")
         b = self._buckets()
         if name in b:
             return                     # S3 PUT bucket is idempotent
-        b[name] = {}
+        b[name] = {"owner": owner, "acl": acl}
         self.io.write_full(BUCKETS_OID, json.dumps(b).encode())
         self.io.write_full(f".bucket.{name}", b"{}")
         fmt = "omap" if self._pool_omap() else "cls"
@@ -186,57 +238,381 @@ class RGWGateway:
             raise RGWError(404, "NoSuchBucket")
         if self.list_objects(name):
             raise RGWError(409, "BucketNotEmpty")
+        if (b[name] or {}).get("versioning") and \
+                self.list_versions(name):
+            # S3: hidden generations (incl. delete markers) also
+            # block bucket deletion
+            raise RGWError(409, "BucketNotEmpty")
         del b[name]
         self.io.write_full(BUCKETS_OID, json.dumps(b).encode())
-        try:
-            self.io.remove(f".bucket.{name}")
-        except Exception:
-            pass
+        for oid in (f".bucket.{name}", self._ver_oid(name)):
+            try:
+                self.io.remove(oid)
+            except Exception:
+                pass
 
     def _check_bucket(self, bucket: str) -> None:
         if bucket not in self._buckets():
             raise RGWError(404, "NoSuchBucket")
 
+    # -- ACLs (src/rgw/rgw_acl_s3.cc canned-ACL role) ------------------
+    # Canned ACLs enforced per request at the REST layer (the
+    # reference's RGWOp::verify_permission seat). Internal actors
+    # (sync agent, lifecycle processor) call gateway methods directly
+    # and bypass ACLs, exactly as the reference's system user does.
+
+    def check_access(self, bucket: str, requester: str | None,
+                     want: str, key: str = "") -> None:
+        """Raise 403 unless ``requester`` (an access key, or None for
+        anonymous) may perform ``want`` ('read' | 'write' | 'owner')
+        on the bucket (or on ``key``, whose own ACL — when set —
+        overrides the bucket ACL for object reads)."""
+        meta = self.bucket_meta(bucket)
+        owner = meta.get("owner", "")
+        if not owner:
+            # legacy/ownerless bucket (pre-ACL, or created through
+            # the library API): ANY authenticated principal has full
+            # access — exactly the pre-ACL authed-server behavior —
+            # but anonymous stays out
+            if requester is not None:
+                return
+            raise RGWError(403, "AccessDenied")
+        if requester is not None and requester == owner:
+            return
+        acl = meta.get("acl", "private")
+        if want == "read" and key:
+            oacl = self._object_acl(bucket, key)
+            if oacl is not None:
+                acl = oacl
+        if want == "owner":
+            raise RGWError(403, "AccessDenied")
+        if want == "write":
+            if acl == "public-read-write":
+                return
+            raise RGWError(403, "AccessDenied")
+        # want == "read"
+        if acl in ("public-read", "public-read-write"):
+            return
+        if acl == "authenticated-read" and requester is not None:
+            return
+        raise RGWError(403, "AccessDenied")
+
+    def _object_acl(self, bucket: str, key: str) -> str | None:
+        try:
+            ent = self.list_objects(bucket, prefix=key).get(key)
+        except RGWError:
+            return None
+        return (ent or {}).get("acl")
+
+    def set_object_acl(self, bucket: str, key: str, acl: str) -> None:
+        if acl not in CANNED_ACLS:
+            raise RGWError(400, "InvalidArgument")
+        if self._bucket_fmt(bucket) != "omap":
+            raise RGWError(501, "NotImplemented")
+        ent = self.list_objects(bucket, prefix=key).get(key)
+        if ent is None:
+            raise RGWError(404, "NoSuchKey")
+        ent["acl"] = acl
+        self.io.omap_set(f".bucket.{bucket}",
+                         {key: json.dumps(ent).encode()})
+
+    def set_bucket_acl(self, bucket: str, acl: str) -> None:
+        if acl not in CANNED_ACLS:
+            raise RGWError(400, "InvalidArgument")
+        self._update_bucket_meta(bucket, acl=acl)
+
+    # -- versioning (src/rgw/rgw_op.cc versioned-object role) ----------
+    # A versioned bucket keeps every object generation: the CURRENT
+    # generation stays in the main index (so plain GET/list see it),
+    # and every generation (including delete markers) lives in the
+    # bucket's versions omap, keyed "<key>\0<vid>". Version data
+    # objects are "<bucket>/<key>\0<vid>"; the pre-versioning
+    # generation of a key keeps its plain oid and appears as vid
+    # "null" (S3's null-version semantics).
+
+    def set_versioning(self, bucket: str, status: str) -> None:
+        if status not in ("Enabled", "Suspended"):
+            raise RGWError(400, "IllegalVersioningConfiguration")
+        if self._bucket_fmt(bucket) != "omap":
+            # EC-pool cls-blob indexes have no versions omap; the
+            # reference keeps bucket indexes on replicated pools and
+            # so never hits this (documented reduction)
+            raise RGWError(501, "NotImplemented")
+        self._update_bucket_meta(bucket, versioning=status)
+
+    def get_versioning(self, bucket: str) -> str | None:
+        return self.bucket_meta(bucket).get("versioning")
+
+    def _ver_oid(self, bucket: str) -> str:
+        return f".versions.{bucket}"
+
+    def _ver_data_oid(self, bucket: str, key: str, vid: str) -> str:
+        return f"{bucket}/{key}" if vid == "null" \
+            else f"{bucket}/{key}\x00{vid}"
+
+    def _alloc_vseq(self, bucket: str) -> int:
+        out = self.io.execute(self._ver_oid(bucket), "numops", "add",
+                              json.dumps({"key": "seq",
+                                          "value": 1}).encode())
+        return int(json.loads(out)["seq"])
+
+    def _ver_omap(self, bucket: str, prefix: str) -> dict:
+        from ceph_tpu.client.rados import RadosError
+        try:
+            return self.io.omap_get(self._ver_oid(bucket),
+                                    prefix=prefix)
+        except RadosError as exc:
+            if exc.code == -2:
+                return {}              # never versioned: no omap yet
+            raise
+
+    def _ver_entries(self, bucket: str, key: str) -> dict[str, dict]:
+        """{vid: meta} for every recorded generation of ``key``."""
+        page = self._ver_omap(bucket, f"{key}\x00")
+        return {json.loads(v)["vid"]: json.loads(v)
+                for v in page.values()}
+
+    def _ver_put_entry(self, bucket: str, key: str,
+                       meta: dict) -> None:
+        self.io.omap_set(
+            self._ver_oid(bucket),
+            {f"{key}\x00{meta['vid']}": json.dumps(meta).encode()})
+
+    def _ver_rm_entry(self, bucket: str, key: str, vid: str) -> None:
+        self.io.omap_rm_keys(self._ver_oid(bucket), [f"{key}\x00{vid}"])
+
+    def _preserve_null_version(self, bucket: str, key: str) -> None:
+        """First versioned mutation of a pre-versioning key: record
+        its existing generation as the 'null' version so it survives
+        (S3: enabling versioning never destroys data)."""
+        ent = self.list_objects(bucket, prefix=key).get(key)
+        if ent is None or ent.get("vid"):
+            return
+        if "null" in self._ver_entries(bucket, key):
+            return
+        import time as _t
+        self._ver_put_entry(bucket, key, {
+            "vid": "null", "seq": 0, "size": ent["size"],
+            "etag": ent["etag"],
+            # a legacy entry without mtime gets preserved-at time:
+            # stamping 0.0 would let the first noncurrent-expiry
+            # lifecycle pass reap the very data this preserves
+            "mtime": ent.get("mtime") or _t.time(),
+            "dm": False})
+
     # -- objects -------------------------------------------------------
     def put_object(self, bucket: str, key: str, data: bytes,
-                   etag: str | None = None, _log: bool = True) -> str:
+                   etag: str | None = None, _log: bool = True,
+                   acl: str | None = None, owner: str | None = None,
+                   version_id: str | None = None) -> str:
         """``etag`` overrides the computed md5 (replication must
         carry the SOURCE etag — multipart objects have 'md5-N' etags
         a re-hash cannot reproduce); ``_log=False`` suppresses the
         replication-log entry for internal writes that log once
-        themselves (multipart complete)."""
+        themselves (multipart complete). On a versioning-enabled
+        bucket every put mints a new version (``version_id``
+        overrides the minted id — the sync agent preserves source
+        ids); on a suspended bucket puts overwrite the 'null'
+        version. Returns the etag; the assigned version id is left in
+        ``self.last_version_id``."""
         self._check_bucket(bucket)
+        if acl is not None and acl not in CANNED_ACLS:
+            raise RGWError(400, "InvalidArgument")
+        status = self.get_versioning(bucket)
+        self.last_version_id = None
+        if etag is None:
+            etag = hashlib.md5(data).hexdigest()
+        if status is not None:
+            self._preserve_null_version(bucket, key)
+            seq = self._alloc_vseq(bucket)
+            vid = version_id or (f"v{seq:012d}"
+                                 if status == "Enabled" else "null")
+            doid = self._ver_data_oid(bucket, key, vid)
+            StripedObject(self.io, doid).remove()
+            so = StripedObject(self.io, doid, self._layout)
+            if data:
+                so.write(data)
+            import time as _t
+            mtime = _t.time()
+            self._ver_put_entry(bucket, key, {
+                "vid": vid, "seq": seq, "size": len(data),
+                "etag": etag, "mtime": mtime, "dm": False})
+            self._index_add(bucket, key, len(data), etag,
+                            mtime=mtime, acl=acl, owner=owner,
+                            vid=vid)
+            self.last_version_id = vid
+            if _log:
+                self._log_mutation(bucket, "put", key, etag, vid=vid)
+            return etag
         so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
         so.remove()                    # replace semantics
         so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
         if data:
             so.write(data)
-        if etag is None:
-            etag = hashlib.md5(data).hexdigest()
-        self._index_add(bucket, key, len(data), etag)
+        self._index_add(bucket, key, len(data), etag,
+                        acl=acl, owner=owner)
         if _log:
             self._log_mutation(bucket, "put", key, etag)
         return etag
 
-    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+    def get_object(self, bucket: str, key: str,
+                   version_id: str | None = None
+                   ) -> tuple[bytes, dict]:
         self._check_bucket(bucket)
+        if version_id is not None:
+            ent = self._ver_entries(bucket, key).get(version_id)
+            if ent is None:
+                raise RGWError(404, "NoSuchVersion")
+            if ent.get("dm"):
+                raise RGWError(405, "MethodNotAllowed")
+            so = StripedObject(
+                self.io, self._ver_data_oid(bucket, key, version_id))
+            return so.read(), ent
         idx = self.list_objects(bucket, prefix=key)
         meta = idx.get(key)
         if meta is None:
             raise RGWError(404, "NoSuchKey")
-        so = StripedObject(self.io, f"{bucket}/{key}")
+        doid = self._ver_data_oid(bucket, key, meta["vid"]) \
+            if meta.get("vid") else f"{bucket}/{key}"
+        so = StripedObject(self.io, doid)
         return so.read(), meta
 
-    def delete_object(self, bucket: str, key: str) -> None:
+    def delete_object(self, bucket: str, key: str,
+                      version_id: str | None = None,
+                      _log: bool = True,
+                      _marker_vid: str | None = None) -> str | None:
+        """Unversioned: remove for good. Versioning enabled, no
+        version_id: lay a DELETE MARKER (the data stays; GETs 404
+        until the marker is deleted). With version_id: permanently
+        remove that generation; removing the current one surfaces the
+        next-newest. Returns the delete-marker version id when one
+        was created."""
         self._check_bucket(bucket)
-        self._index_rm(bucket, key)
-        StripedObject(self.io, f"{bucket}/{key}").remove()
-        self._log_mutation(bucket, "del", key)
+        status = self.get_versioning(bucket)
+        if status is None and version_id is None:
+            self._index_rm(bucket, key)
+            StripedObject(self.io, f"{bucket}/{key}").remove()
+            if _log:
+                self._log_mutation(bucket, "del", key)
+            return None
+        if status is None:
+            raise RGWError(400, "InvalidArgument")
+        if version_id is None:
+            # delete marker (rgw_op.cc RGWDeleteObj versioned path;
+            # S3 lays one even for a nonexistent key). On a SUSPENDED
+            # bucket the marker takes version id 'null', overwriting
+            # any null generation — repeated deletes must not
+            # accumulate marker entries
+            self._preserve_null_version(bucket, key)
+            seq = self._alloc_vseq(bucket)
+            vid = _marker_vid or (
+                "null" if status == "Suspended" else f"v{seq:012d}")
+            if vid == "null":
+                old = self._ver_entries(bucket, key).get("null")
+                if old is not None and not old.get("dm"):
+                    StripedObject(self.io, self._ver_data_oid(
+                        bucket, key, "null")).remove()
+            self._ver_put_entry(bucket, key, {
+                "vid": vid, "seq": seq, "size": 0, "etag": "",
+                "mtime": __import__("time").time(), "dm": True})
+            try:
+                self._index_rm(bucket, key)
+            except RGWError:
+                pass
+            if _log:
+                self._log_mutation(bucket, "dm", key, vid=vid)
+            return vid
+        # permanent delete of one generation
+        ents = self._ver_entries(bucket, key)
+        ent = ents.get(version_id)
+        if ent is None:
+            raise RGWError(404, "NoSuchVersion")
+        if not ent.get("dm"):
+            StripedObject(self.io, self._ver_data_oid(
+                bucket, key, version_id)).remove()
+        self._ver_rm_entry(bucket, key, version_id)
+        del ents[version_id]
+        cur = self.list_objects(bucket, prefix=key).get(key)
+        cur_vid = (cur or {}).get("vid") or \
+            ("null" if cur is not None else None)
+        if cur_vid == version_id:
+            # the visible generation died: surface the next-newest
+            # non-marker one, or nothing
+            self._reindex_current(bucket, key, ents)
+        elif cur is None and ent.get("dm"):
+            # removed a delete marker: if it was the newest entry the
+            # key resurfaces (reindex picks the newest non-marker)
+            self._reindex_current(bucket, key, ents)
+        if _log:
+            self._log_mutation(bucket, "delver", key, vid=version_id)
+        return None
+
+    def _reindex_current(self, bucket: str, key: str,
+                         ents: dict[str, dict]) -> None:
+        """Point the main index at the newest remaining non-marker
+        generation (or drop the key when a marker — or nothing — is
+        newest)."""
+        try:
+            self._index_rm(bucket, key)
+        except RGWError:
+            pass
+        if not ents:
+            return
+        newest = max(ents.values(), key=lambda e: e["seq"])
+        if newest.get("dm"):
+            return
+        self._index_add(bucket, key, newest["size"], newest["etag"],
+                        mtime=newest.get("mtime"), vid=newest["vid"])
+
+    def list_versions(self, bucket: str, prefix: str = "") -> list:
+        """Every generation of every key (newest first per key) —
+        ListObjectVersions role. Unversioned-era objects appear as
+        vid 'null' only once the key has a versioned mutation."""
+        self._check_bucket(bucket)
+        if self._bucket_fmt(bucket) != "omap":
+            return []
+        page = self._ver_omap(bucket, prefix)
+        by_key: dict[str, list] = {}
+        for k, v in page.items():
+            key = k.split("\x00", 1)[0]
+            by_key.setdefault(key, []).append(json.loads(v))
+        out = []
+        for key in sorted(by_key):
+            # IsLatest = the newest generation by seq — a delete
+            # marker that is newest IS the latest (it just hides the
+            # key from plain listings)
+            latest = max(e["seq"] for e in by_key[key])
+            for ent in sorted(by_key[key], key=lambda e: -e["seq"]):
+                out.append({"key": key, **ent,
+                            "is_current": ent["seq"] == latest})
+        return out
 
     def list_objects(self, bucket: str, prefix: str = "",
                      max_keys: int = 1000, marker: str = "") -> dict:
         self._check_bucket(bucket)
         return self._index_list(bucket, prefix, max_keys, marker)
+
+    # -- lifecycle config (src/rgw/rgw_lc.cc RGWLifecycleConfiguration)
+    def set_lifecycle(self, bucket: str, rules: list[dict]) -> None:
+        """rules: [{"id", "prefix", "status", "days",
+        "noncurrent_days"}] — current-version expiry after ``days``,
+        noncurrent-generation expiry after ``noncurrent_days``."""
+        for r in rules:
+            if r.get("status", "Enabled") not in ("Enabled",
+                                                  "Disabled"):
+                raise RGWError(400, "MalformedXML")
+            if not (r.get("days") or r.get("noncurrent_days")):
+                raise RGWError(400, "MalformedXML")
+        self._update_bucket_meta(bucket, lifecycle=rules)
+
+    def get_lifecycle(self, bucket: str) -> list[dict]:
+        rules = self.bucket_meta(bucket).get("lifecycle")
+        if not rules:
+            raise RGWError(404, "NoSuchLifecycleConfiguration")
+        return rules
+
+    def delete_lifecycle(self, bucket: str) -> None:
+        self._update_bucket_meta(bucket, lifecycle=None)
 
     # -- multipart uploads (src/rgw/rgw_multi.cc roles) ----------------
     # Parts land as independent striped objects under a hidden
@@ -348,11 +724,20 @@ class RGWGateway:
                                        num)).read()
             for num, _ in parts)
         self.put_object(bucket, key, body, _log=False)
+        vid = self.last_version_id
         final_etag = (hashlib.md5(digests).hexdigest()
                       + f"-{len(parts)}")
-        # the S3 multipart etag replaces the plain-md5 one
-        self._index_add(bucket, key, len(body), final_etag)
-        self._log_mutation(bucket, "put", key, final_etag)
+        # the S3 multipart etag replaces the plain-md5 one — in the
+        # index entry AND (versioned buckets) the generation record,
+        # keeping the vid pointer so GETs keep reading the versioned
+        # data object and replication carries the multipart etag
+        self._index_add(bucket, key, len(body), final_etag, vid=vid)
+        if vid:
+            ent = self._ver_entries(bucket, key).get(vid)
+            if ent is not None:
+                ent["etag"] = final_etag
+                self._ver_put_entry(bucket, key, ent)
+        self._log_mutation(bucket, "put", key, final_etag, vid=vid)
         self.abort_multipart(bucket, key, upload_id)
         return final_etag
 
@@ -467,6 +852,125 @@ def _xml_error(code: str, message: str) -> bytes:
             f"</Error>").encode()
 
 
+def _xml_versioning(status: str | None) -> bytes:
+    inner = f"<Status>{status}</Status>" if status else ""
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<VersioningConfiguration>{inner}"
+            f"</VersioningConfiguration>").encode()
+
+
+def _xml_versions(bucket: str, entries: list) -> bytes:
+    rows = []
+    for e in entries:
+        tag = "DeleteMarker" if e.get("dm") else "Version"
+        latest = "true" if e["is_current"] else "false"
+        size = f"<Size>{e['size']}</Size>" if not e.get("dm") else ""
+        etag = (f"<ETag>&quot;{e['etag']}&quot;</ETag>"
+                if not e.get("dm") else "")
+        rows.append(
+            f"<{tag}><Key>{_xml_escape(e['key'])}</Key>"
+            f"<VersionId>{e['vid']}</VersionId>"
+            f"<IsLatest>{latest}</IsLatest>{size}{etag}</{tag}>")
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<ListVersionsResult><Name>{_xml_escape(bucket)}</Name>"
+            f"{''.join(rows)}</ListVersionsResult>").encode()
+
+
+def _xml_lifecycle(rules: list[dict]) -> bytes:
+    rows = []
+    for r in rules:
+        exp = (f"<Expiration><Days>{r['days']}</Days></Expiration>"
+               if r.get("days") else "")
+        nce = (f"<NoncurrentVersionExpiration><NoncurrentDays>"
+               f"{r['noncurrent_days']}</NoncurrentDays>"
+               f"</NoncurrentVersionExpiration>"
+               if r.get("noncurrent_days") else "")
+        rows.append(
+            f"<Rule><ID>{_xml_escape(r.get('id', ''))}</ID>"
+            f"<Filter><Prefix>{_xml_escape(r.get('prefix', ''))}"
+            f"</Prefix></Filter>"
+            f"<Status>{r.get('status', 'Enabled')}</Status>"
+            f"{exp}{nce}</Rule>")
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<LifecycleConfiguration>{''.join(rows)}"
+            f"</LifecycleConfiguration>").encode()
+
+
+def _xml_acl(owner: str, acl: str) -> bytes:
+    """Canned ACL rendered as an AccessControlPolicy document (the
+    grants a canned ACL expands to in rgw_acl_s3.cc)."""
+    grants = [f"<Grant><Grantee><ID>{_xml_escape(owner)}</ID>"
+              f"</Grantee><Permission>FULL_CONTROL</Permission>"
+              f"</Grant>"]
+    if acl in ("public-read", "public-read-write"):
+        grants.append("<Grant><Grantee><URI>http://acs.amazonaws.com"
+                      "/groups/global/AllUsers</URI></Grantee>"
+                      "<Permission>READ</Permission></Grant>")
+    if acl == "public-read-write":
+        grants.append("<Grant><Grantee><URI>http://acs.amazonaws.com"
+                      "/groups/global/AllUsers</URI></Grantee>"
+                      "<Permission>WRITE</Permission></Grant>")
+    if acl == "authenticated-read":
+        grants.append("<Grant><Grantee><URI>http://acs.amazonaws.com"
+                      "/groups/global/AuthenticatedUsers</URI>"
+                      "</Grantee><Permission>READ</Permission>"
+                      "</Grant>")
+    return (f'<?xml version="1.0" encoding="UTF-8"?>'
+            f"<AccessControlPolicy><Owner><ID>{_xml_escape(owner)}"
+            f"</ID></Owner><AccessControlList>{''.join(grants)}"
+            f"</AccessControlList></AccessControlPolicy>").encode()
+
+
+def _xml_find(body: bytes, tag: str) -> list[str]:
+    """All text values of ``tag`` anywhere in the document,
+    namespace-blind (the S3-client xmlns folds into tag names)."""
+    import xml.etree.ElementTree as ET
+    try:
+        root = ET.fromstring(body.decode())
+    except Exception:
+        return []
+    out = []
+    for el in root.iter():
+        if el.tag.rsplit("}", 1)[-1] == tag:
+            out.append((el.text or "").strip())
+    return out
+
+
+def _parse_lifecycle_xml(body: bytes) -> list[dict]:
+    import xml.etree.ElementTree as ET
+    try:
+        root = ET.fromstring(body.decode())
+    except Exception:
+        raise RGWError(400, "MalformedXML") from None
+    rules = []
+    for el in root.iter():
+        if el.tag.rsplit("}", 1)[-1] != "Rule":
+            continue
+        r: dict = {}
+        for sub in el.iter():
+            tag = sub.tag.rsplit("}", 1)[-1]
+            text = (sub.text or "").strip()
+            if tag == "ID":
+                r["id"] = text
+            elif tag == "Prefix":
+                r["prefix"] = text
+            elif tag == "Status":
+                r["status"] = text
+            elif tag in ("Days", "NoncurrentDays"):
+                try:
+                    days = float(text)
+                except ValueError:
+                    raise RGWError(400, "MalformedXML") from None
+                if days <= 0:
+                    raise RGWError(400, "MalformedXML")
+                r["days" if tag == "Days"
+                  else "noncurrent_days"] = days
+        rules.append(r)
+    if not rules:
+        raise RGWError(400, "MalformedXML")
+    return rules
+
+
 # -- AWS Signature Version 4 (S3 request signing) ----------------------
 
 def _sigv4_key(secret: str, date: str, region: str,
@@ -525,9 +1029,10 @@ def sign_request(method: str, path: str, query: str,
 
 
 def verify_sigv4(handler, auth: dict[str, str],
-                 payload: bytes) -> None:
+                 payload: bytes) -> str:
     """Server side: recompute the signature from the request and the
-    stored secret; raises RGWError(403) on any mismatch."""
+    stored secret; raises RGWError(403) on any mismatch. Returns the
+    authenticated access key (the request's identity for ACLs)."""
     hdr = handler.headers.get("Authorization", "")
     if not hdr.startswith("AWS4-HMAC-SHA256 "):
         raise RGWError(403, "AccessDenied")
@@ -579,6 +1084,7 @@ def verify_sigv4(handler, auth: dict[str, str],
                     to_sign.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, given_sig):
         raise RGWError(403, "SignatureDoesNotMatch")
+    return access
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -808,8 +1314,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _run(self, fn, payload: bytes = b"") -> None:
         try:
-            if self.auth is not None:
-                verify_sigv4(self, self.auth, payload)
+            # identity (RGWOp::verify_requester role): a signed
+            # request authenticates to its access key; an UNSIGNED
+            # request on an authed server is ANONYMOUS — allowed only
+            # where a bucket/object ACL grants public access (before
+            # ACLs landed, every request had to be signed)
+            self.requester = None
+            if self.auth is not None and \
+                    self.headers.get("Authorization"):
+                self.requester = verify_sigv4(self, self.auth,
+                                              payload)
             fn()
         except RGWError as exc:
             # S3 Error document; the message doubles as the Code when
@@ -823,6 +1337,18 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:  # pragma: no cover
             self._reply(500, _xml_error("InternalError", repr(exc)))
 
+    def _access(self, bucket: str, want: str, key: str = "") -> None:
+        """ACL gate (RGWOp::verify_permission seat). Open servers
+        (no auth table) enforce nothing, as before."""
+        if self.auth is None:
+            return
+        self.gw.check_access(bucket, self.requester, want, key)
+
+    def _require_auth(self) -> None:
+        """Account-level ops (list/create bucket) need an identity."""
+        if self.auth is not None and self.requester is None:
+            raise RGWError(403, "AccessDenied")
+
     def do_GET(self) -> None:  # noqa: N802
         if self._swift_dispatch("GET", b""):
             return
@@ -830,12 +1356,46 @@ class _Handler(BaseHTTPRequestHandler):
 
         def run() -> None:
             if not bucket:
+                self._require_auth()
                 self._reply(200, _xml_buckets(self.gw.list_buckets()))
+            elif not key and "versioning" in q:
+                self._access(bucket, "read")
+                self._reply(200, _xml_versioning(
+                    self.gw.get_versioning(bucket)))
+            elif not key and "lifecycle" in q:
+                self._access(bucket, "owner")
+                self._reply(200, _xml_lifecycle(
+                    self.gw.get_lifecycle(bucket)))
+            elif not key and "versions" in q:
+                self._access(bucket, "read")
+                self._reply(200, _xml_versions(
+                    bucket, self.gw.list_versions(
+                        bucket, prefix=q.get("prefix", ""))))
+            elif "acl" in q:
+                self._access(bucket, "owner")
+                meta = self.gw.bucket_meta(bucket)
+                acl = meta.get("acl", "private")
+                if key:
+                    acl = self.gw._object_acl(bucket, key) or acl
+                self._reply(200, _xml_acl(meta.get("owner", ""),
+                                          acl))
+            elif key and "versionId" in q:
+                self._access(bucket, "read", key)
+                data, meta = self.gw.get_object(
+                    bucket, key, version_id=q["versionId"])
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("ETag", f'"{meta["etag"]}"')
+                self.send_header("x-amz-version-id", meta["vid"])
+                self.end_headers()
+                self.wfile.write(data)
             elif key and "uploadId" in q:
+                self._access(bucket, "read", key)
                 parts = self.gw.list_parts(bucket, key, q["uploadId"])
                 self._reply(200, _xml_parts(bucket, key,
                                             q["uploadId"], parts))
             elif not key:
+                self._access(bucket, "read")
                 prefix = q.get("prefix", "")
                 marker = q.get("marker", "")
                 try:
@@ -867,10 +1427,13 @@ class _Handler(BaseHTTPRequestHandler):
                                               max_keys, idx,
                                               truncated, marker))
             else:
+                self._access(bucket, "read", key)
                 data, meta = self.gw.get_object(bucket, key)
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("ETag", f'"{meta["etag"]}"')
+                if meta.get("vid"):
+                    self.send_header("x-amz-version-id", meta["vid"])
                 self.send_header("Content-Type",
                                  "application/octet-stream")
                 self.end_headers()
@@ -885,10 +1448,33 @@ class _Handler(BaseHTTPRequestHandler):
         bucket, key, q = self._split()
 
         def run() -> None:
-            if not key:
-                self.gw.create_bucket(bucket)
+            if not key and "versioning" in q:
+                self._access(bucket, "owner")
+                status = next(iter(_xml_find(body, "Status")), "")
+                self.gw.set_versioning(bucket, status)
+                self._reply(200)
+            elif not key and "lifecycle" in q:
+                self._access(bucket, "owner")
+                self.gw.set_lifecycle(bucket,
+                                      _parse_lifecycle_xml(body))
+                self._reply(200)
+            elif "acl" in q:
+                canned = self.headers.get("x-amz-acl", "") or \
+                    next(iter(_xml_find(body, "Canned")), "private")
+                self._access(bucket, "owner")
+                if key:
+                    self.gw.set_object_acl(bucket, key, canned)
+                else:
+                    self.gw.set_bucket_acl(bucket, canned)
+                self._reply(200)
+            elif not key:
+                self._require_auth()
+                self.gw.create_bucket(
+                    bucket, owner=self.requester or "",
+                    acl=self.headers.get("x-amz-acl", "private"))
                 self._reply(200)
             elif "uploadId" in q and "partNumber" in q:
+                self._access(bucket, "write")
                 try:
                     part_no = int(q["partNumber"])
                 except ValueError:
@@ -900,9 +1486,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Content-Length", "0")
                 self.end_headers()
             else:
-                etag = self.gw.put_object(bucket, key, body)
+                self._access(bucket, "write")
+                etag = self.gw.put_object(
+                    bucket, key, body,
+                    acl=self.headers.get("x-amz-acl") or None,
+                    owner=self.requester or None)
                 self.send_response(200)
                 self.send_header("ETag", f'"{etag}"')
+                if self.gw.last_version_id:
+                    self.send_header("x-amz-version-id",
+                                     self.gw.last_version_id)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
         self._run(run, payload=body)
@@ -916,9 +1509,11 @@ class _Handler(BaseHTTPRequestHandler):
 
         def run() -> None:
             if "uploads" in q and key:
+                self._access(bucket, "write")
                 upload_id = self.gw.initiate_multipart(bucket, key)
                 self._reply(200, _xml_initiate(bucket, key, upload_id))
             elif "uploadId" in q and key:
+                self._access(bucket, "write")
                 parts = _parse_complete_xml(body)
                 etag = self.gw.complete_multipart(
                     bucket, key, q["uploadId"], parts)
@@ -934,11 +1529,33 @@ class _Handler(BaseHTTPRequestHandler):
 
         def run() -> None:
             if key and "uploadId" in q:
+                self._access(bucket, "write")
                 self.gw.abort_multipart(bucket, key, q["uploadId"])
+            elif not key and "lifecycle" in q:
+                self._access(bucket, "owner")
+                self.gw.delete_lifecycle(bucket)
             elif not key:
+                self._access(bucket, "owner")
                 self.gw.delete_bucket(bucket)
+            elif "versionId" in q:
+                self._access(bucket, "write")
+                self.gw.delete_object(bucket, key,
+                                      version_id=q["versionId"])
+                self.send_response(204)
+                self.send_header("x-amz-version-id", q["versionId"])
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
             else:
-                self.gw.delete_object(bucket, key)
+                self._access(bucket, "write")
+                marker_vid = self.gw.delete_object(bucket, key)
+                if marker_vid is not None:
+                    self.send_response(204)
+                    self.send_header("x-amz-delete-marker", "true")
+                    self.send_header("x-amz-version-id", marker_vid)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
             self._reply(204)
         self._run(run)
 
@@ -948,10 +1565,13 @@ class _Handler(BaseHTTPRequestHandler):
         bucket, key, _ = self._split()
 
         def run() -> None:
+            self._access(bucket, "read", key)
             _, meta = self.gw.get_object(bucket, key)
             self.send_response(200)
             self.send_header("Content-Length", str(meta["size"]))
             self.send_header("ETag", f'"{meta["etag"]}"')
+            if meta.get("vid"):
+                self.send_header("x-amz-version-id", meta["vid"])
             self.end_headers()
         self._run(run)
 

@@ -87,23 +87,61 @@ class RGWSyncAgent:
 
     # -- sync ---------------------------------------------------------
     def _apply(self, bucket: str, ent: dict) -> None:
+        vid = ent.get("vid")
         if ent["op"] == "put":
             try:
-                data, meta = self.src.get_object(bucket, ent["key"])
+                data, meta = self.src.get_object(
+                    bucket, ent["key"], version_id=vid)
             except RGWError:
                 return          # superseded by a later delete: the
                 # delete entry follows in the log and converges
+            # version ids REPLICATE (the reference carries the source
+            # instance id through data sync): dst mints nothing
             self.dst.put_object(bucket, ent["key"], data,
-                                etag=meta.get("etag") or None)
+                                etag=meta.get("etag") or None,
+                                version_id=vid)
         elif ent["op"] == "del":
             try:
                 self.dst.delete_object(bucket, ent["key"])
             except RGWError:
                 pass            # already absent: idempotent
+        elif ent["op"] == "dm":
+            try:
+                self.dst.delete_object(bucket, ent["key"],
+                                       _marker_vid=vid)
+            except RGWError:
+                pass
+        elif ent["op"] == "delver":
+            try:
+                self.dst.delete_object(bucket, ent["key"],
+                                       version_id=vid)
+            except RGWError:
+                pass            # that generation never made it here
 
     def _full_sync(self, bucket: str) -> None:
         """Bootstrap: copy the source bucket wholesale (the FULL SYNC
-        phase), carrying each object's source etag."""
+        phase), carrying each object's source etag. Versioned buckets
+        copy every generation oldest-first so the destination's
+        current-version resolution (arrival order) lands on the same
+        generation the source shows."""
+        if self.src.get_versioning(bucket) is not None:
+            gens = sorted(self.src.list_versions(bucket),
+                          key=lambda e: e["seq"])
+            for ent in gens:
+                if ent.get("dm"):
+                    self.dst.delete_object(bucket, ent["key"],
+                                           _marker_vid=ent["vid"],
+                                           _log=False)
+                    continue
+                try:
+                    data, meta = self.src.get_object(
+                        bucket, ent["key"], version_id=ent["vid"])
+                except RGWError:
+                    continue    # reaped mid-enumeration
+                self.dst.put_object(bucket, ent["key"], data,
+                                    etag=meta.get("etag") or None,
+                                    version_id=ent["vid"])
+            return
         marker = ""
         while True:
             page = self.src.list_objects(bucket, max_keys=1000,
@@ -127,6 +165,12 @@ class RGWSyncAgent:
             if bucket not in dst_buckets:
                 self.dst.create_bucket(bucket)
                 dst_buckets.add(bucket)
+            # metadata sync: mirror the versioning state (a versioned
+            # source must replicate into a versioned destination or
+            # generation ids are lost)
+            sv = self.src.get_versioning(bucket)
+            if sv is not None and self.dst.get_versioning(bucket) != sv:
+                self.dst.set_versioning(bucket, sv)
             marker = self._marker(bucket)
             if marker is None:
                 # FULL SYNC: snapshot the head seq FIRST — entries

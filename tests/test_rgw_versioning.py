@@ -1,0 +1,379 @@
+"""rgw versioning + lifecycle + ACLs (src/rgw/rgw_op.cc versioned
+object paths, rgw_lc.cc RGWLC::process, rgw_acl_s3.cc canned ACLs)."""
+
+import os
+import time
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.services.rgw import (RGWError, RGWGateway, RGWServer,
+                                   sign_request)
+from ceph_tpu.services.rgw_lc import LifecycleProcessor
+
+
+@pytest.fixture(scope="module")
+def setup():
+    with MiniCluster(n_osds=3) as c:
+        rados = c.client()
+        c.create_pool("rgwver", pg_num=4, size=2)
+        io = rados.open_ioctx("rgwver")
+        srv = RGWServer(io)
+        port = srv.start()
+        yield io, srv.gateway, f"http://127.0.0.1:{port}"
+        srv.stop()
+
+
+def _req(url, method="GET", data=None, headers=None):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=10)
+
+
+# -- versioning ---------------------------------------------------------
+
+def test_versioned_put_get_delete_cycle(setup):
+    _, gw, _ = setup
+    gw.create_bucket("vb")
+    gw.put_object("vb", "pre", b"pre-versioning")   # null version era
+    gw.set_versioning("vb", "Enabled")
+    assert gw.get_versioning("vb") == "Enabled"
+    gw.put_object("vb", "k", b"one")
+    v1 = gw.last_version_id
+    gw.put_object("vb", "k", b"two")
+    v2 = gw.last_version_id
+    assert v1 != v2
+    # plain GET -> latest; by-id GET -> that generation
+    assert gw.get_object("vb", "k")[0] == b"two"
+    assert gw.get_object("vb", "k", version_id=v1)[0] == b"one"
+    assert gw.get_object("vb", "k", version_id=v2)[0] == b"two"
+    # delete -> marker; data retained
+    marker = gw.delete_object("vb", "k")
+    assert marker is not None
+    with pytest.raises(RGWError) as ei:
+        gw.get_object("vb", "k")
+    assert ei.value.status == 404
+    assert gw.get_object("vb", "k", version_id=v1)[0] == b"one"
+    # removing the marker resurfaces the latest generation
+    gw.delete_object("vb", "k", version_id=marker)
+    assert gw.get_object("vb", "k")[0] == b"two"
+    # permanently deleting the current surfaces the previous
+    gw.delete_object("vb", "k", version_id=v2)
+    assert gw.get_object("vb", "k")[0] == b"one"
+    with pytest.raises(RGWError):
+        gw.get_object("vb", "k", version_id=v2)
+
+
+def test_null_version_preserved_on_enable(setup):
+    """S3: the pre-versioning generation survives as version 'null'."""
+    _, gw, _ = setup
+    gw.put_object("vb", "pre", b"pre-versioning-2") \
+        if "pre" not in gw.list_objects("vb") else None
+    gw.put_object("vb", "pre", b"after-enable")
+    vids = {e["vid"]: e for e in gw.list_versions("vb", prefix="pre")}
+    assert "null" in vids
+    assert gw.get_object("vb", "pre", version_id="null")[0] == \
+        b"pre-versioning"
+    assert gw.get_object("vb", "pre")[0] == b"after-enable"
+
+
+def test_suspended_overwrites_null_only(setup):
+    _, gw, _ = setup
+    gw.create_bucket("sb")
+    gw.set_versioning("sb", "Enabled")
+    gw.put_object("sb", "x", b"kept")
+    kept = gw.last_version_id
+    gw.set_versioning("sb", "Suspended")
+    gw.put_object("sb", "x", b"null-1")
+    assert gw.last_version_id == "null"
+    gw.put_object("sb", "x", b"null-2")
+    vids = [e["vid"] for e in gw.list_versions("sb", prefix="x")]
+    assert vids.count("null") == 1          # null overwritten in place
+    assert gw.get_object("sb", "x")[0] == b"null-2"
+    assert gw.get_object("sb", "x", version_id=kept)[0] == b"kept"
+
+
+def test_versioning_over_http(setup):
+    _, _, base = setup
+    _req(f"{base}/hv", "PUT")
+    body = (b'<VersioningConfiguration>'
+            b'<Status>Enabled</Status></VersioningConfiguration>')
+    _req(f"{base}/hv?versioning", "PUT", data=body)
+    doc = ET.fromstring(_req(f"{base}/hv?versioning").read())
+    assert doc.findtext("Status") == "Enabled"
+    r = _req(f"{base}/hv/doc.txt", "PUT", data=b"v1")
+    vid1 = r.headers["x-amz-version-id"]
+    r = _req(f"{base}/hv/doc.txt", "PUT", data=b"v2")
+    vid2 = r.headers["x-amz-version-id"]
+    assert vid1 != vid2
+    assert _req(f"{base}/hv/doc.txt").read() == b"v2"
+    assert _req(f"{base}/hv/doc.txt?versionId={vid1}").read() == b"v1"
+    # DELETE lays a marker and says so
+    r = _req(f"{base}/hv/doc.txt", "DELETE")
+    assert r.headers["x-amz-delete-marker"] == "true"
+    marker = r.headers["x-amz-version-id"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(f"{base}/hv/doc.txt")
+    assert ei.value.code == 404
+    # ListObjectVersions shows both generations + the marker
+    doc = ET.fromstring(_req(f"{base}/hv?versions").read())
+    vids = [v.findtext("VersionId") for v in doc.findall("Version")]
+    dms = [d.findtext("VersionId")
+           for d in doc.findall("DeleteMarker")]
+    assert set(vids) == {vid1, vid2} and dms == [marker]
+    # delete the marker by id -> key resurfaces
+    _req(f"{base}/hv/doc.txt?versionId={marker}", "DELETE")
+    assert _req(f"{base}/hv/doc.txt").read() == b"v2"
+
+
+# -- lifecycle ----------------------------------------------------------
+
+def test_lifecycle_expires_current_and_noncurrent(setup):
+    _, gw, _ = setup
+    gw.create_bucket("lc")
+    gw.set_versioning("lc", "Enabled")
+    gw.put_object("lc", "logs/old", b"gen1")
+    gw.put_object("lc", "logs/old", b"gen2")
+    gw.put_object("lc", "keep/fresh", b"fresh")
+    # 1 "day" = 0.1 s so the test compresses time like the
+    # reference's rgw_lc_debug_interval
+    proc = LifecycleProcessor(gw, day_seconds=0.1)
+    gw.set_lifecycle("lc", [
+        {"id": "expire-logs", "prefix": "logs/", "status": "Enabled",
+         "days": 1, "noncurrent_days": 2}])
+    time.sleep(0.12)                      # older than 1 day, not 2
+    stats = proc.process()
+    assert stats["expired"] == 1          # marker laid on logs/old
+    with pytest.raises(RGWError):
+        gw.get_object("lc", "logs/old")
+    assert gw.get_object("lc", "keep/fresh")[0] == b"fresh"
+    gens = [e for e in gw.list_versions("lc", prefix="logs/old")
+            if not e.get("dm")]
+    assert len(gens) == 2                 # data retained
+    time.sleep(0.12)                      # now older than 2 days
+    stats = proc.process()
+    assert stats["noncurrent_reaped"] == 2
+    # the same pass sweeps the now-orphaned delete marker
+    assert stats["markers_cleaned"] == 1
+    assert gw.list_versions("lc", prefix="logs/old") == []
+    assert proc.process() == {"expired": 0, "noncurrent_reaped": 0,
+                              "markers_cleaned": 0}
+
+
+def test_lifecycle_unversioned_deletes_for_good(setup):
+    _, gw, _ = setup
+    gw.create_bucket("lcu")
+    gw.put_object("lcu", "tmp/a", b"x")
+    gw.put_object("lcu", "data/b", b"y")
+    proc = LifecycleProcessor(gw, day_seconds=0.1)
+    gw.set_lifecycle("lcu", [
+        {"id": "tmp", "prefix": "tmp/", "status": "Enabled",
+         "days": 1}])
+    time.sleep(0.12)
+    stats = proc.process()
+    assert stats["expired"] == 1
+    assert "tmp/a" not in gw.list_objects("lcu")
+    assert "data/b" in gw.list_objects("lcu")
+
+
+def test_lifecycle_over_http(setup):
+    _, _, base = setup
+    _req(f"{base}/hlc", "PUT")
+    body = (b"<LifecycleConfiguration><Rule><ID>r1</ID>"
+            b"<Filter><Prefix>tmp/</Prefix></Filter>"
+            b"<Status>Enabled</Status>"
+            b"<Expiration><Days>30</Days></Expiration>"
+            b"</Rule></LifecycleConfiguration>")
+    _req(f"{base}/hlc?lifecycle", "PUT", data=body)
+    doc = ET.fromstring(_req(f"{base}/hlc?lifecycle").read())
+    rule = doc.find("Rule")
+    assert rule.findtext("ID") == "r1"
+    assert rule.find("Filter").findtext("Prefix") == "tmp/"
+    assert rule.find("Expiration").findtext("Days") == "30.0"
+    _req(f"{base}/hlc?lifecycle", "DELETE")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(f"{base}/hlc?lifecycle")
+    assert ei.value.code == 404
+    assert ET.fromstring(ei.value.read()).findtext("Code") == \
+        "NoSuchLifecycleConfiguration"
+
+
+# -- ACLs ---------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def authed(setup):
+    io, _, _ = setup
+    creds = {"OWNER": "s1", "OTHER": "s2"}
+    srv = RGWServer(io, auth=creds)
+    port = srv.start()
+    yield srv.gateway, f"http://127.0.0.1:{port}", port, creds
+    srv.stop()
+
+
+def _signed(base, port, access, secret, path, method="GET", data=b"",
+            query="", headers=None):
+    url = f"{base}{path}" + (f"?{query}" if query else "")
+    h = {"Host": f"127.0.0.1:{port}"}
+    h.update(headers or {})
+    h.update(sign_request(method, path, query, h, data, access,
+                          secret))
+    req = urllib.request.Request(url, data=data or None,
+                                 method=method, headers=h)
+    return urllib.request.urlopen(req, timeout=10)
+
+
+def _status(fn):
+    try:
+        fn()
+        return 200
+    except urllib.error.HTTPError as exc:
+        return exc.code
+
+
+def test_canned_acls_enforced(authed):
+    gw, base, port, creds = authed
+
+    def owner(path, method="GET", data=b"", query="", headers=None):
+        return _signed(base, port, "OWNER", "s1", path, method, data,
+                       query, headers)
+
+    def other(path, method="GET", data=b"", query="", headers=None):
+        return _signed(base, port, "OTHER", "s2", path, method, data,
+                       query, headers)
+
+    owner("/private", "PUT")
+    owner("/private/secret.txt", "PUT", data=b"classified")
+    # owner full access; other keyholder and anonymous: denied
+    assert owner("/private/secret.txt").read() == b"classified"
+    assert _status(lambda: other("/private/secret.txt")) == 403
+    assert _status(lambda: _req(f"{base}/private/secret.txt")) == 403
+    assert _status(lambda: other("/private", "DELETE")) == 403
+
+    # public-read: anyone reads, only the owner writes
+    owner("/pub", "PUT", headers={"x-amz-acl": "public-read"})
+    owner("/pub/page.html", "PUT", data=b"<html>")
+    assert _req(f"{base}/pub/page.html").read() == b"<html>"
+    assert other("/pub/page.html").read() == b"<html>"
+    assert _status(lambda: other("/pub/x", "PUT", data=b"no")) == 403
+    assert _status(
+        lambda: _req(f"{base}/pub/x", "PUT", data=b"no")) == 403
+
+    # public-read-write: any keyholder and anonymous may write
+    owner("/drop", "PUT", headers={"x-amz-acl": "public-read-write"})
+    other("/drop/from-other", "PUT", data=b"o")
+    _req(f"{base}/drop/from-anon", "PUT", data=b"a")
+    assert _req(f"{base}/drop/from-other").read() == b"o"
+
+    # authenticated-read: any keyholder reads, anonymous does not
+    owner("/ar", "PUT", headers={"x-amz-acl": "authenticated-read"})
+    owner("/ar/f", "PUT", data=b"members-only")
+    assert other("/ar/f").read() == b"members-only"
+    assert _status(lambda: _req(f"{base}/ar/f")) == 403
+
+    # owner-only subresources
+    assert _status(lambda: other(
+        "/private", "PUT",
+        data=b"<VersioningConfiguration><Status>Enabled</Status>"
+             b"</VersioningConfiguration>",
+        query="versioning")) == 403
+    assert _status(lambda: other("/private", "GET",
+                                 query="acl")) == 403
+    # object ACL override: one public object in a private bucket
+    owner("/private/open.txt", "PUT", data=b"open",
+          headers={"x-amz-acl": "public-read"})
+    assert _req(f"{base}/private/open.txt").read() == b"open"
+    assert _status(lambda: _req(f"{base}/private/secret.txt")) == 403
+    # ACL document shape
+    doc = ET.fromstring(owner("/pub", query="acl").read())
+    assert doc.find("Owner").findtext("ID") == "OWNER"
+    uris = [g.findtext("Grantee/URI")
+            for g in doc.find("AccessControlList")]
+    assert any(u and u.endswith("AllUsers") for u in uris)
+    # anonymous bucket creation: denied
+    assert _status(lambda: _req(f"{base}/anonbkt", "PUT")) == 403
+
+
+def test_multipart_into_versioned_bucket(setup):
+    """Multipart complete must keep the versioned data pointer and
+    carry the multipart etag into the generation record."""
+    _, gw, _ = setup
+    gw.create_bucket("mpv")
+    gw.set_versioning("mpv", "Enabled")
+    up = gw.initiate_multipart("mpv", "big")
+    p1 = os.urandom(1 << 20)
+    p2 = os.urandom(100)
+    e1 = gw.upload_part("mpv", "big", up, 1, p1)
+    e2 = gw.upload_part("mpv", "big", up, 2, p2)
+    etag = gw.complete_multipart("mpv", "big", up, [(1, e1), (2, e2)])
+    assert etag.endswith("-2")
+    data, meta = gw.get_object("mpv", "big")
+    assert data == p1 + p2
+    assert meta["etag"] == etag and meta.get("vid")
+    gens = {e["vid"]: e for e in gw.list_versions("mpv",
+                                                  prefix="big")}
+    assert gens[meta["vid"]]["etag"] == etag
+
+
+def test_suspended_deletes_do_not_accumulate_markers(setup):
+    _, gw, _ = setup
+    gw.create_bucket("sdm")
+    gw.set_versioning("sdm", "Suspended")
+    gw.put_object("sdm", "k", b"data")
+    for _ in range(3):
+        assert gw.delete_object("sdm", "k") == "null"
+    vers = gw.list_versions("sdm", prefix="k")
+    assert len(vers) == 1 and vers[0]["dm"] \
+        and vers[0]["vid"] == "null"
+
+
+def test_anonymous_denied_on_ownerless_bucket(authed):
+    """An authed server never serves anonymous requests to buckets
+    without ACL metadata (the pre-ACL always-signed behavior)."""
+    gw, base, port, _ = authed
+    gw.create_bucket("legacy")          # library API: no owner
+    gw.put_object("legacy", "o", b"x")
+    assert _status(lambda: _req(f"{base}/legacy/o")) == 403
+    # ...but any authenticated principal still has full access
+    assert _signed(base, port, "OTHER", "s2",
+                   "/legacy/o").read() == b"x"
+
+
+# -- multisite replication of versioned objects -------------------------
+
+def test_multisite_replicates_versions(setup):
+    io, _, _ = setup
+    from ceph_tpu.services.rgw_sync import RGWSyncAgent
+    src = RGWGateway(io.client.open_ioctx("rgwver"), zone_log=True)
+    # second zone in its own pool
+    io.client.mon_command({"prefix": "osd pool create",
+                           "pool": "rgwver2", "pg_num": 4,
+                           "size": 2})
+    dst = RGWGateway(io.client.open_ioctx("rgwver2"))
+    agent = RGWSyncAgent(src, dst)
+
+    src.create_bucket("ms")
+    src.set_versioning("ms", "Enabled")
+    src.put_object("ms", "doc", b"gen-1")
+    v1 = src.last_version_id
+    agent.sync_once()                    # full sync of generation 1
+    assert dst.get_versioning("ms") == "Enabled"
+    assert dst.get_object("ms", "doc")[0] == b"gen-1"
+    # incremental: new generation + delete marker, ids preserved
+    src.put_object("ms", "doc", b"gen-2")
+    v2 = src.last_version_id
+    marker = src.delete_object("ms", "doc")
+    agent.sync_once()
+    dst_vers = {e["vid"]: e for e in dst.list_versions("ms",
+                                                       prefix="doc")}
+    assert set(dst_vers) == {v1, v2, marker}
+    assert dst_vers[marker]["dm"] and dst_vers[marker]["is_current"]
+    with pytest.raises(RGWError):
+        dst.get_object("ms", "doc")
+    assert dst.get_object("ms", "doc", version_id=v2)[0] == b"gen-2"
+    # marker removal replicates; latest resurfaces in the peer zone
+    src.delete_object("ms", "doc", version_id=marker)
+    agent.sync_once()
+    assert dst.get_object("ms", "doc")[0] == b"gen-2"
