@@ -97,6 +97,7 @@ class Objecter:
                   snap_seq: int = 0, snaps: list | tuple = (),
                   snapid: int = 0, xname: str = "", xop: int = 0,
                   gname: str = "", gop: int = 0, gval: bytes = b"",
+                  gflags: int = 0,
                   timeout: float = 30.0) -> M.MOSDOpReply:
         """Synchronous submit (the aio variant is just this on a
         thread); raises ObjecterError on errno replies."""
@@ -112,7 +113,8 @@ class Objecter:
                        trace=span.wire(), cls=cls, method=method,
                        snap_seq=snap_seq, snaps=list(snaps),
                        snapid=snapid, xname=xname, xop=xop,
-                       gname=gname, gop=gop, gval=bytes(gval))
+                       gname=gname, gop=gop, gval=bytes(gval),
+                       gflags=gflags)
         rec = _Op(tid, msg)
         with self._lock:
             self._pending[tid] = rec

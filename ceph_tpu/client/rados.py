@@ -137,10 +137,13 @@ class IoCtx:
         raise RadosError(-2, f"no snap {name!r}")
 
     def snap_rollback(self, oid: str, name: str) -> None:
-        """Restore the head to its state at the snapshot
-        (rados_ioctx_snap_rollback: copy the covering clone up)."""
-        data = self.read(oid, snap=self.snap_lookup(name))
-        self.write_full(oid, data)
+        """Restore the head to its state at the snapshot — ONE
+        server-side op (CEPH_OSD_OP_ROLLBACK, PrimaryLogPG::
+        _rollback_to), atomic under the PG lock, instead of the old
+        client-side read+rewrite which could interleave with other
+        writers."""
+        self._submit(oid, M.OSD_OP_ROLLBACK,
+                     snapid=self.snap_lookup(name), **self._snapc())
 
     def _wait_map(self, pred, timeout: float = 10.0) -> None:
         import time as _time
@@ -164,11 +167,16 @@ class IoCtx:
     def _guard_kw(guard) -> dict:
         """``guard=(name, op, value)`` attaches an atomic cmpxattr
         guard to any op (the reference couples a CMPXATTR to the ops
-        after it in one transaction); op is a M.CMPXATTR_* mode."""
+        after it in one transaction); op is a M.CMPXATTR_* mode. A
+        4th element ``"omap"`` compares an omap value instead (the
+        CEPH_OSD_OP_OMAP_CMP guard)."""
         if guard is None:
             return {}
-        name, gop, gval = guard
-        return {"gname": name, "gop": int(gop), "gval": bytes(gval)}
+        name, gop, gval = guard[:3]
+        kw = {"gname": name, "gop": int(gop), "gval": bytes(gval)}
+        if len(guard) > 3 and guard[3] == "omap":
+            kw["gflags"] = M.GUARD_OMAP
+        return kw
 
     def getxattr(self, oid: str, name: str) -> bytes:
         return self._submit(oid, M.OSD_OP_GETXATTR, xname=name).data
@@ -232,6 +240,61 @@ class IoCtx:
     def omap_rm_keys(self, oid: str, keys: list[str]) -> None:
         self._submit(oid, M.OSD_OP_OMAPRMKEYS,
                      data=json.dumps(list(keys)).encode())
+
+    def omap_get_header(self, oid: str) -> bytes:
+        """rados_omap_get_header: the object's omap header blob
+        (b"" when never set)."""
+        return self._submit(oid, M.OSD_OP_OMAPGETHEADER).data
+
+    def omap_set_header(self, oid: str, data: bytes,
+                        guard=None) -> int:
+        return self._submit(oid, M.OSD_OP_OMAPSETHEADER,
+                            data=bytes(data),
+                            **self._guard_kw(guard)).version
+
+    def omap_cmp(self, oid: str, key: str, op: int,
+                 value: bytes) -> bool:
+        """CEPH_OSD_OP_OMAP_CMP as a standalone check: True when the
+        comparison holds, False on -ECANCELED mismatch."""
+        try:
+            self._submit(oid, M.OSD_OP_OMAPCMP, xname=key,
+                         xop=int(op), data=bytes(value))
+            return True
+        except RadosError as exc:
+            if exc.code == -125:
+                return False
+            raise
+
+    # -- sparse / pattern I/O (round-4 do_osd_ops widening) ------------
+    def sparse_read(self, oid: str, length: int = 0, offset: int = 0,
+                    snap: int = 0) -> list[tuple[int, bytes]]:
+        """CEPH_OSD_OP_SPARSE_READ: [(offset, bytes), ...] — only the
+        allocated (non-hole) extents of the range come back."""
+        rep = self._submit(oid, M.OSD_OP_SPARSE_READ, length=length,
+                           offset=offset, snapid=snap)
+        doc = json.loads(rep.data)
+        blob = bytes.fromhex(doc["data"])
+        out, pos = [], 0
+        for off, n in doc["extents"]:
+            out.append((off, blob[pos:pos + n]))
+            pos += n
+        return out
+
+    def writesame(self, oid: str, data: bytes, length: int,
+                  offset: int = 0, guard=None) -> int:
+        """CEPH_OSD_OP_WRITESAME: tile ``data`` across
+        [offset, offset+length); length must be a multiple of
+        len(data)."""
+        return self._submit(oid, M.OSD_OP_WRITESAME, data=bytes(data),
+                            length=length, offset=offset,
+                            **self._guard_kw(guard),
+                            **self._snapc()).version
+
+    def list_snaps(self, oid: str) -> dict:
+        """CEPH_OSD_OP_LIST_SNAPS: the object's snapset — {"seq",
+        "clones": [{"id", "snaps", "size"}], "head_exists"}."""
+        return json.loads(self._submit(oid,
+                                       M.OSD_OP_LIST_SNAPS).data)
 
     # -- watch/notify (rados_watch / rados_notify roles) --------------
     def watch(self, oid: str, callback) -> int:

@@ -108,6 +108,13 @@ def snapset_oid(oid: str) -> str:
     return f"{oid}{SNAP_SEP}ss"
 
 
+#: reserved omap key carrying the OMAP HEADER (the reference keeps the
+#: header in its own kv row; riding a reserved key lets recovery,
+#: scrub and EC-rejection apply unchanged). Filtered from every
+#: key/value listing the client sees.
+OMAP_HDR_KEY = "\x00hdr"
+
+
 #: QoS classes of the sharded queue (the reference's op classes:
 #: client ops vs recovery vs scrub, src/osd/OSD.cc:2095 + dmclock)
 QOS_CLIENT = "client"
@@ -978,7 +985,8 @@ class OSD:
                      M.OSD_OP_SETXATTR, M.OSD_OP_RMXATTR,
                      M.OSD_OP_OMAPSET, M.OSD_OP_OMAPRMKEYS,
                      M.OSD_OP_CREATE, M.OSD_OP_TRUNCATE,
-                     M.OSD_OP_ZERO)
+                     M.OSD_OP_ZERO, M.OSD_OP_ROLLBACK,
+                     M.OSD_OP_WRITESAME, M.OSD_OP_OMAPSETHEADER)
     _OP_CACHE_MAX = 10000
 
     def _handle_osd_op(self, msg: M.MOSDOp, conn: Connection) -> None:
@@ -1105,14 +1113,26 @@ class OSD:
         op = msg.op
         try:
             if msg.gname:
-                # optional xattr guard, evaluated atomically with the
-                # op under pg.lock (the single-guard reduction of the
-                # reference's op vectors, where a failed CMPXATTR
-                # aborts the ops after it)
-                try:
-                    stored = be.get_xattrs(pg, msg.oid).get(msg.gname)
-                except (NoSuchObject, NoSuchCollection):
-                    stored = None
+                # optional guard, evaluated atomically with the op
+                # under pg.lock (the single-guard reduction of the
+                # reference's op vectors, where a failed CMPXATTR /
+                # OMAP_CMP aborts the ops after it). GUARD_OMAP
+                # compares an omap value instead of an xattr.
+                if msg.gflags & M.GUARD_OMAP:
+                    if not be.omap_supported():
+                        reply(EOPNOTSUPP)
+                        return
+                    try:
+                        stored = be.get_omap(
+                            pg, msg.oid, [msg.gname]).get(msg.gname)
+                    except (NoSuchObject, NoSuchCollection):
+                        stored = None
+                else:
+                    try:
+                        stored = be.get_xattrs(pg,
+                                               msg.oid).get(msg.gname)
+                    except (NoSuchObject, NoSuchCollection):
+                        stored = None
                 code = self._cmpxattr(stored, msg.gop or M.CMPXATTR_EQ,
                                       msg.gval)
                 if code != 0:
@@ -1123,7 +1143,9 @@ class OSD:
                                        M.OSD_OP_APPEND,
                                        M.OSD_OP_REMOVE,
                                        M.OSD_OP_TRUNCATE,
-                                       M.OSD_OP_ZERO):
+                                       M.OSD_OP_ZERO,
+                                       M.OSD_OP_ROLLBACK,
+                                       M.OSD_OP_WRITESAME):
                 # snapshot COW (PrimaryLogPG::make_writeable role):
                 # first mutation under a newer snap context clones the
                 # head before the write lands
@@ -1149,7 +1171,19 @@ class OSD:
                 version = pg.alloc_version()
                 be.submit_write(pg, msg.oid, msg.data, version,
                                 lambda code, v=version: reply(code, b"", v))
-            elif op in (M.OSD_OP_WRITE, M.OSD_OP_APPEND):
+            elif op in (M.OSD_OP_WRITE, M.OSD_OP_APPEND,
+                        M.OSD_OP_WRITESAME):
+                wdata = bytes(msg.data)
+                if op == M.OSD_OP_WRITESAME:
+                    # CEPH_OSD_OP_WRITESAME: tile the pattern across
+                    # [offset, offset+length) (length must be a
+                    # positive multiple of the pattern), then ride
+                    # the ordinary ranged-write path
+                    if not wdata or not msg.length or \
+                            msg.length % len(wdata):
+                        reply(EINVAL)
+                        return
+                    wdata = wdata * (msg.length // len(wdata))
                 self.logger.inc("op_w")
                 version = pg.alloc_version()
                 if isinstance(be, ECBackend):
@@ -1173,7 +1207,7 @@ class OSD:
                     off = old_size if op == M.OSD_OP_APPEND \
                         else msg.offset
                     be.submit_partial_write(
-                        pg, msg.oid, off, msg.data, version,
+                        pg, msg.oid, off, wdata, version,
                         lambda code, v=version: reply(code, b"", v),
                         old_size=old_size)
                 else:
@@ -1186,7 +1220,7 @@ class OSD:
                         else msg.offset
                     if off > len(cur):
                         cur.extend(b"\x00" * (off - len(cur)))
-                    cur[off:off + len(msg.data)] = msg.data
+                    cur[off:off + len(wdata)] = wdata
                     be.submit_write(
                         pg, msg.oid, bytes(cur), version,
                         lambda code, v=version: reply(code, b"", v))
@@ -1296,6 +1330,8 @@ class OSD:
                         mx = int(spec.get("max", 0)) or len(omap)
                         page = {}
                         for k in sorted(omap):
+                            if k == OMAP_HDR_KEY:
+                                continue
                             if len(page) >= mx:
                                 break
                             if k <= start or not k.startswith(pref):
@@ -1304,15 +1340,21 @@ class OSD:
                         omap = page
                     else:
                         omap = be.get_omap(pg, msg.oid, spec or None)
+                        omap.pop(OMAP_HDR_KEY, None)
                     reply(0, json.dumps({k: v.hex() for k, v in
                                          omap.items()}).encode())
                 elif op == M.OSD_OP_OMAPGETKEYS:
                     omap = be.get_omap(pg, msg.oid)
-                    reply(0, json.dumps(sorted(omap)).encode())
+                    reply(0, json.dumps(
+                        sorted(k for k in omap
+                               if k != OMAP_HDR_KEY)).encode())
                 elif op == M.OSD_OP_OMAPSET:
                     kv = {k: bytes.fromhex(v) for k, v in
                           json.loads(msg.data).items()}
-                    if not kv:
+                    if not kv or OMAP_HDR_KEY in kv:
+                        # the reserved header key is invisible to
+                        # listings, so letting a client write it
+                        # would silently clobber the omap header
                         reply(EINVAL)
                         return
                     self.logger.inc("op_w")
@@ -1322,6 +1364,9 @@ class OSD:
                         lambda code, v=version: reply(code, b"", v))
                 else:                      # OMAPRMKEYS
                     keys = json.loads(msg.data) if msg.data else []
+                    if OMAP_HDR_KEY in keys:
+                        reply(EINVAL)
+                        return
                     be.get_omap(pg, msg.oid)     # ENOENT check
                     self.logger.inc("op_w")
                     version = pg.alloc_version()
@@ -1386,6 +1431,102 @@ class OSD:
                 be.submit_write(
                     pg, msg.oid, b"", version,
                     lambda code, v=version: reply(code, b"", v))
+            elif op == M.OSD_OP_SPARSE_READ:
+                # CEPH_OSD_OP_SPARSE_READ: extent map + data. Stores
+                # here keep objects as full buffers, so the extent map
+                # is the ZERO-SUPPRESSED runs of the requested range —
+                # holes read back as absent extents, exactly what a
+                # sparse-aware client (rbd export-diff role) wants.
+                self.logger.inc("op_r")
+                oid = msg.oid
+                if msg.snapid:
+                    oid = self._resolve_snap_oid(pg, be, msg.oid,
+                                                 msg.snapid)
+                data = bytes(be.read_object(pg, oid))
+                end = min(len(data), msg.offset + msg.length) \
+                    if msg.length else len(data)
+                start = min(msg.offset, len(data))
+                extents, payload = [], []
+                run_start = None
+                for i in range(start, end):
+                    nz = data[i] != 0
+                    if nz and run_start is None:
+                        run_start = i
+                    elif not nz and run_start is not None:
+                        extents.append([run_start, i - run_start])
+                        payload.append(data[run_start:i])
+                        run_start = None
+                if run_start is not None:
+                    extents.append([run_start, end - run_start])
+                    payload.append(data[run_start:end])
+                reply(0, json.dumps(
+                    {"extents": extents,
+                     "data": b"".join(payload).hex()}).encode())
+            elif op == M.OSD_OP_ROLLBACK:
+                # CEPH_OSD_OP_ROLLBACK (PrimaryLogPG::_rollback_to):
+                # restore the head from the clone covering snapid —
+                # SERVER-side and atomic under pg.lock, replacing the
+                # old client-side read+rewrite. _make_writeable above
+                # already preserved the pre-rollback head if the snap
+                # context calls for it. Reduction (clones carry data
+                # only here): attrs/omap are untouched; no covering
+                # clone means the head already has the snap state.
+                src = self._resolve_snap_oid(pg, be, msg.oid,
+                                             msg.snapid)
+                if src == msg.oid:
+                    be.stat_object(pg, msg.oid)   # ENOENT check
+                    reply(0)
+                    return
+                data = bytes(be.read_object(pg, src))
+                self.logger.inc("op_w")
+                version = pg.alloc_version()
+                be.submit_write(
+                    pg, msg.oid, data, version,
+                    lambda code, v=version: reply(code, b"", v))
+            elif op == M.OSD_OP_LIST_SNAPS:
+                # CEPH_OSD_OP_LIST_SNAPS: the object's snapset
+                ss = self._load_snapset(pg, be, msg.oid)
+                try:
+                    be.stat_object(pg, msg.oid)
+                    head = True
+                except (NoSuchObject, NoSuchCollection):
+                    head = False
+                if not head and not ss.get("clones"):
+                    reply(ENOENT)
+                    return
+                reply(0, json.dumps(
+                    {"seq": ss.get("seq", 0),
+                     "clones": ss.get("clones", []),
+                     "head_exists": head}).encode())
+            elif op == M.OSD_OP_OMAPGETHEADER:
+                if not be.omap_supported():
+                    reply(EOPNOTSUPP)
+                    return
+                hdr = be.get_omap(pg, msg.oid,
+                                  [OMAP_HDR_KEY]).get(OMAP_HDR_KEY)
+                reply(0, hdr or b"")
+            elif op == M.OSD_OP_OMAPSETHEADER:
+                if not be.omap_supported():
+                    reply(EOPNOTSUPP)
+                    return
+                self.logger.inc("op_w")
+                version = pg.alloc_version()
+                be.submit_omap(
+                    pg, msg.oid, {OMAP_HDR_KEY: bytes(msg.data)}, [],
+                    version,
+                    lambda code, v=version: reply(code, b"", v))
+            elif op == M.OSD_OP_OMAPCMP:
+                if not be.omap_supported():
+                    reply(EOPNOTSUPP)
+                    return
+                try:
+                    stored = be.get_omap(
+                        pg, msg.oid, [msg.xname]).get(msg.xname)
+                except (NoSuchObject, NoSuchCollection):
+                    stored = None
+                reply(self._cmpxattr(stored,
+                                     msg.xop or M.CMPXATTR_EQ,
+                                     msg.data))
             else:
                 reply(EINVAL)
         except (NoSuchObject, NoSuchCollection):

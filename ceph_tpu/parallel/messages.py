@@ -186,6 +186,18 @@ OSD_OP_OMAPGETKEYS = 17  # reply data = json [keys]
 OSD_OP_CREATE = 18     # xop=1: exclusive (-EEXIST if present)
 OSD_OP_TRUNCATE = 19   # offset = new size (grow fills zeros)
 OSD_OP_ZERO = 20       # zero [offset, offset+length)
+# round-4 widening toward do_osd_ops (PrimaryLogPG.cc:5664):
+OSD_OP_ROLLBACK = 21       # snapid: restore head from covering clone
+OSD_OP_SPARSE_READ = 22    # reply json {extents: [[off,len]..], data}
+OSD_OP_WRITESAME = 23      # tile data over [offset, offset+length)
+OSD_OP_OMAPGETHEADER = 24  # reply = header bytes ("" when unset)
+OSD_OP_OMAPSETHEADER = 25  # data = new header bytes
+OSD_OP_LIST_SNAPS = 26     # reply json snapset (seq/clones/head)
+OSD_OP_OMAPCMP = 27        # xname=omap key, xop, operand in data
+
+#: gflags bit: the gname/gop/gval guard compares an OMAP value
+#: instead of an xattr (CEPH_OSD_OP_OMAP_CMP as a guard)
+GUARD_OMAP = 1
 
 # cmpxattr / guard comparison modes (CEPH_OSD_CMPXATTR_OP_*,
 # src/include/rados.h): EQ..LTE compare the stored value against the
@@ -220,7 +232,10 @@ class MOSDOp(Message):
               # reference's multi-op transaction vectors, where a
               # failed CMPXATTR aborts the ops after it
               ("xname", "str"), ("xop", "u8"),
-              ("gname", "str"), ("gop", "u8"), ("gval", "bytes")]
+              ("gname", "str"), ("gop", "u8"), ("gval", "bytes"),
+              # appended round 4 (old readers skip): guard flags
+              # (GUARD_OMAP selects the omap namespace for the guard)
+              ("gflags", "u8")]
 
 
 class MOSDOpReply(Message):

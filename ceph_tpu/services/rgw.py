@@ -315,6 +315,13 @@ class RGWGateway:
         ent["acl"] = acl
         self.io.omap_set(f".bucket.{bucket}",
                          {key: json.dumps(ent).encode()})
+        if ent.get("vid"):
+            # keep the generation record in step, so reindexing after
+            # a by-id delete restores this ACL
+            gen = self._ver_entries(bucket, key).get(ent["vid"])
+            if gen is not None:
+                gen["acl"] = acl
+                self._ver_put_entry(bucket, key, gen)
 
     def set_bucket_acl(self, bucket: str, acl: str) -> None:
         if acl not in CANNED_ACLS:
@@ -434,9 +441,16 @@ class RGWGateway:
                 so.write(data)
             import time as _t
             mtime = _t.time()
-            self._ver_put_entry(bucket, key, {
-                "vid": vid, "seq": seq, "size": len(data),
-                "etag": etag, "mtime": mtime, "dm": False})
+            ent = {"vid": vid, "seq": seq, "size": len(data),
+                   "etag": etag, "mtime": mtime, "dm": False}
+            # acl/owner ride the generation record so a resurfaced
+            # older generation keeps its object ACL (reindex restores
+            # from here)
+            if acl is not None:
+                ent["acl"] = acl
+            if owner is not None:
+                ent["owner"] = owner
+            self._ver_put_entry(bucket, key, ent)
             self._index_add(bucket, key, len(data), etag,
                             mtime=mtime, acl=acl, owner=owner,
                             vid=vid)
@@ -562,7 +576,9 @@ class RGWGateway:
         if newest.get("dm"):
             return
         self._index_add(bucket, key, newest["size"], newest["etag"],
-                        mtime=newest.get("mtime"), vid=newest["vid"])
+                        mtime=newest.get("mtime"), vid=newest["vid"],
+                        acl=newest.get("acl"),
+                        owner=newest.get("owner"))
 
     def list_versions(self, bucket: str, prefix: str = "") -> list:
         """Every generation of every key (newest first per key) —

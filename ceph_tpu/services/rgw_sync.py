@@ -170,7 +170,14 @@ class RGWSyncAgent:
             # generation ids are lost)
             sv = self.src.get_versioning(bucket)
             if sv is not None and self.dst.get_versioning(bucket) != sv:
-                self.dst.set_versioning(bucket, sv)
+                try:
+                    self.dst.set_versioning(bucket, sv)
+                except RGWError:
+                    # destination bucket rides a cls (EC-pool) index:
+                    # no versions omap there. Degrade to replicating
+                    # current data only rather than wedging the whole
+                    # zone's sync pass.
+                    pass
             marker = self._marker(bucket)
             if marker is None:
                 # FULL SYNC: snapshot the head seq FIRST — entries

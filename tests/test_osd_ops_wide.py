@@ -1,0 +1,160 @@
+"""Round-4 do_osd_ops widening (PrimaryLogPG.cc:5664):
+ROLLBACK, SPARSE_READ, WRITESAME, OMAP header get/set, OMAP-cmp
+guards, LIST_SNAPS — each end-to-end through MiniCluster, replicated
+AND EC pools where the op is supported."""
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.parallel import messages as M
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        c.create_pool("wide", pg_num=4, size=2)
+        c.create_ec_pool("wideec", k=2, m=1, pg_num=4)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def rados(cluster):
+    return cluster.client()
+
+
+@pytest.mark.parametrize("pool", ["wide", "wideec"])
+def test_sparse_read_returns_allocated_extents(rados, pool):
+    io = rados.open_ioctx(pool)
+    # a hole: data at [0,100) and [5000,5100), zeros between
+    io.write_full("sparse", b"A" * 100 + b"\x00" * 4900 + b"B" * 100)
+    ext = io.sparse_read("sparse")
+    assert ext == [(0, b"A" * 100), (5000, b"B" * 100)]
+    # ranged: only extents inside the window, trimmed
+    ext = io.sparse_read("sparse", length=80, offset=5020)
+    assert ext == [(5020, b"B" * 80)]
+    # fully-zero window -> no extents
+    assert io.sparse_read("sparse", length=1000, offset=1000) == []
+    with pytest.raises(RadosError):
+        io.sparse_read("nope-sparse")
+
+
+@pytest.mark.parametrize("pool", ["wide", "wideec"])
+def test_writesame_tiles_pattern(rados, pool):
+    io = rados.open_ioctx(pool)
+    io.write_full("ws", b"x" * 64)
+    io.writesame("ws", b"abcd", 32, offset=8)
+    data = io.read("ws")
+    assert data == b"x" * 8 + b"abcd" * 8 + b"x" * 24
+    # grows the object when tiling past the end
+    io.writesame("ws", b"Z", 16, offset=64)
+    assert io.read("ws")[64:] == b"Z" * 16
+    # length must be a positive multiple of the pattern
+    with pytest.raises(RadosError):
+        io.writesame("ws", b"abc", 32)
+    with pytest.raises(RadosError):
+        io.writesame("ws", b"", 32)
+
+
+def test_omap_header_roundtrip(rados):
+    io = rados.open_ioctx("wide")
+    io.omap_set("hdr", {"k1": b"v1"})
+    assert io.omap_get_header("hdr") == b""      # never set
+    io.omap_set_header("hdr", b"header-blob")
+    assert io.omap_get_header("hdr") == b"header-blob"
+    # the header never leaks into key/value listings
+    assert io.omap_get_keys("hdr") == ["k1"]
+    assert set(io.omap_get("hdr")) == {"k1"}
+    assert set(io.omap_get("hdr", prefix="")) == {"k1"} or \
+        io.omap_get("hdr", max_return=10).keys() == {"k1"}
+    # header survives alongside later key writes
+    io.omap_set("hdr", {"k2": b"v2"})
+    assert io.omap_get_header("hdr") == b"header-blob"
+
+
+def test_omap_header_key_rejected_on_write_path(rados):
+    """The reserved header key is invisible to listings, so user
+    writes/deletes of it must be rejected, not silently absorbed."""
+    from ceph_tpu.osd.osd import OMAP_HDR_KEY
+    io = rados.open_ioctx("wide")
+    io.omap_set("hdrguard", {"k": b"v"})
+    io.omap_set_header("hdrguard", b"real-header")
+    with pytest.raises(RadosError) as ei:
+        io.omap_set("hdrguard", {OMAP_HDR_KEY: b"clobber"})
+    assert ei.value.code == -22                  # EINVAL
+    with pytest.raises(RadosError):
+        io.omap_rm_keys("hdrguard", [OMAP_HDR_KEY])
+    assert io.omap_get_header("hdrguard") == b"real-header"
+
+
+def test_omap_header_rejected_on_ec(rados):
+    io = rados.open_ioctx("wideec")
+    io.write_full("o", b"x")
+    with pytest.raises(RadosError) as ei:
+        io.omap_set_header("o", b"h")
+    assert ei.value.code == -95                  # EOPNOTSUPP
+    with pytest.raises(RadosError):
+        io.omap_get_header("o")
+
+
+def test_omap_cmp_and_omap_guard(rados):
+    io = rados.open_ioctx("wide")
+    io.omap_set("g", {"state": b"ready", "n": b"5"})
+    assert io.omap_cmp("g", "state", M.CMPXATTR_EQ, b"ready")
+    assert not io.omap_cmp("g", "state", M.CMPXATTR_EQ, b"busy")
+    assert io.omap_cmp("g", "n", M.CMPXATTR_GTE, b"5")
+    assert not io.omap_cmp("g", "n", M.CMPXATTR_GT, b"5")
+    # guard couples atomically to a mutation: pass then fail
+    io.omap_set("g", {"state": b"busy"},
+                guard=("state", M.CMPXATTR_EQ, b"ready", "omap"))
+    with pytest.raises(RadosError) as ei:
+        io.omap_set("g", {"state": b"zombie"},
+                    guard=("state", M.CMPXATTR_EQ, b"ready", "omap"))
+    assert ei.value.code == -125                 # ECANCELED
+    assert io.omap_get("g", ["state"])["state"] == b"busy"
+    # omap guard on a data write too
+    io.write_full_guarded("g", b"payload",
+                          ("state", M.CMPXATTR_EQ, b"busy", "omap"))
+    assert io.read("g") == b"payload"
+
+
+@pytest.mark.parametrize("pool", ["wide", "wideec"])
+def test_rollback_restores_snapshot_state(rados, pool):
+    io = rados.open_ioctx(pool)
+    io.write_full("rb", b"generation-1" * 100)
+    io.snap_create(f"{pool}-rb1")
+    io.write_full("rb", b"generation-2" * 100)
+    io.write_full("rb", b"generation-3" * 100)
+    io.snap_rollback("rb", f"{pool}-rb1")
+    assert io.read("rb") == b"generation-1" * 100
+    # rollback is itself snapshot-aware: the pre-rollback head was
+    # preserved for any snap taken between
+    io.snap_remove(f"{pool}-rb1")
+
+
+def test_rollback_preserves_prerollback_head_for_snaps(rados):
+    io = rados.open_ioctx("wide")
+    io.write_full("rb2", b"old")
+    s1 = io.snap_create("wide-rb2a")
+    io.write_full("rb2", b"new")
+    s2 = io.snap_create("wide-rb2b")
+    io.snap_rollback("rb2", "wide-rb2a")         # head back to "old"
+    assert io.read("rb2") == b"old"
+    # the "new" generation still serves reads at s2
+    assert io.read("rb2", snap=s2) == b"new"
+    assert io.read("rb2", snap=s1) == b"old"
+
+
+def test_list_snaps_reports_snapset(rados):
+    io = rados.open_ioctx("wide")
+    io.write_full("ls", b"v1")
+    s1 = io.snap_create("wide-ls1")
+    io.write_full("ls", b"v2-longer")
+    ss = io.list_snaps("ls")
+    assert ss["head_exists"]
+    assert len(ss["clones"]) == 1
+    clone = ss["clones"][0]
+    assert s1 in clone["snaps"] and clone["size"] == 2
+    with pytest.raises(RadosError) as ei:
+        io.list_snaps("never-existed")
+    assert ei.value.code == -2

@@ -329,6 +329,25 @@ def test_suspended_deletes_do_not_accumulate_markers(setup):
         and vers[0]["vid"] == "null"
 
 
+def test_object_acl_survives_version_resurface(authed):
+    """Deleting the current generation by id must not strip the
+    resurfaced generation's object ACL back to the bucket default."""
+    gw, base, port, _ = authed
+    gw.create_bucket("aclver", owner="OWNER", acl="public-read")
+    gw.set_versioning("aclver", "Enabled")
+    gw.put_object("aclver", "k", b"gen1", acl="private",
+                  owner="OWNER")
+    v1 = gw.last_version_id
+    gw.put_object("aclver", "k", b"gen2", acl="private",
+                  owner="OWNER")
+    v2 = gw.last_version_id
+    assert _status(lambda: _req(f"{base}/aclver/k")) == 403
+    gw.delete_object("aclver", "k", version_id=v2)
+    # gen1 resurfaced — still private, despite the public-read bucket
+    assert gw.get_object("aclver", "k")[0] == b"gen1"
+    assert _status(lambda: _req(f"{base}/aclver/k")) == 403
+
+
 def test_anonymous_denied_on_ownerless_bucket(authed):
     """An authed server never serves anonymous requests to buckets
     without ACL metadata (the pre-ACL always-signed behavior)."""
