@@ -333,6 +333,7 @@ class OSD:
         self.whoami = osd_id
         self.store = store
         self.msgr = Messenger(f"osd.{osd_id}")
+        self._keyring = keyring
         if keyring is not None:
             from ceph_tpu.parallel import auth as A
             A.daemon_auth(self.msgr, keyring, f"osd.{osd_id}")
@@ -458,6 +459,8 @@ class OSD:
         _tp.register_asok(self.asok)
         self.asok.start()
         self.addr = self.msgr.bind(host, port)
+        self._refresh_rotating()   # before boot: fetched-mode daemons
+        # cannot sign a single frame until the window arrives
         self.monc.subscribe()
         # boot must land on a live (leader-reachable) mon: retry until
         # a map shows us up at this address (the MonClient rotates
@@ -1446,19 +1449,14 @@ class OSD:
                 end = min(len(data), msg.offset + msg.length) \
                     if msg.length else len(data)
                 start = min(msg.offset, len(data))
+                # C-speed run detection (a per-byte Python loop under
+                # pg.lock would stall the whole PG on MB objects)
+                import re as _re
                 extents, payload = [], []
-                run_start = None
-                for i in range(start, end):
-                    nz = data[i] != 0
-                    if nz and run_start is None:
-                        run_start = i
-                    elif not nz and run_start is not None:
-                        extents.append([run_start, i - run_start])
-                        payload.append(data[run_start:i])
-                        run_start = None
-                if run_start is not None:
-                    extents.append([run_start, end - run_start])
-                    payload.append(data[run_start:end])
+                for m in _re.finditer(rb"[^\x00]+", data[start:end]):
+                    extents.append([start + m.start(),
+                                    m.end() - m.start()])
+                    payload.append(m.group())
                 reply(0, json.dumps(
                     {"extents": extents,
                      "data": b"".join(payload).hex()}).encode())
@@ -2444,6 +2442,26 @@ class OSD:
                        stats=json.dumps(stats).encode()),
             self.monc.mon_addr)
 
+    def _refresh_rotating(self) -> None:
+        """Keep a fetched-mode rotating-key window warm (the
+        reference daemon's periodic rotating-secrets refresh). A
+        denial means WE were revoked: keep running — once the cached
+        window ages out, peers refuse our frames (the fence)."""
+        from ceph_tpu.parallel import auth as A
+        provider = getattr(self.msgr, "rotating_provider", None)
+        if not isinstance(provider, A.FetchedKeyProvider) or \
+                not provider.needs_refresh():
+            return
+        entity = f"osd.{self.whoami}"
+        try:
+            gens = self.monc.fetch_rotating(
+                entity, self._keyring.get(entity))
+            provider.install(gens)
+        except A.AuthError as exc:
+            log(1, f"rotating-key refresh denied (revoked?): {exc}")
+        except Exception as exc:
+            log(5, f"rotating-key refresh failed: {exc!r}")
+
     # -- heartbeats ----------------------------------------------------
     def _heartbeat_loop(self) -> None:
         interval = g_conf()["osd_heartbeat_interval"]
@@ -2452,6 +2470,7 @@ class OSD:
             osdmap = self.get_osdmap()
             if osdmap is None:
                 continue
+            self._refresh_rotating()
             self.monc.beacon(self.whoami, osdmap.epoch)
             now = time.monotonic()
             self._expire_inflight(now)

@@ -105,34 +105,159 @@ class Keyring:
         return kr
 
 
+# -- rotating service keys (src/auth/cephx/CephxKeyServer.h role) -----
+# The reference's KeyServer keeps rotating_secrets per service — a
+# previous/current/next triple — and tickets reference the secret that
+# sealed them; a secret aging out of the triple invalidates every
+# ticket it sealed. Here generations derive DETERMINISTICALLY from the
+# base service key and wall-clock time (secret_g = HMAC(base, g), g =
+# now // period), so every base-key holder agrees on the window with
+# zero coordination messages; daemons WITHOUT the base key cache a
+# fetched window and fall off it when their fetch source revokes them.
+
+class RotatingKeyProvider:
+    """Generation source for base-key holders (mons, trusted
+    daemons)."""
+
+    def __init__(self, base_key: bytes, period: float | None = None,
+                 clock=time.time) -> None:
+        self.base_key = base_key
+        from ceph_tpu.utils.config import g_conf
+        self.period = period or g_conf()["auth_rotation_period"]
+        self._clock = clock
+
+    def current_gen(self) -> int:
+        return int(self._clock() // self.period)
+
+    def window(self) -> tuple[int, int, int]:
+        g = self.current_gen()
+        return (g - 1, g, g + 1)
+
+    def secret_for(self, gen: int) -> bytes | None:
+        """The generation's secret, or None once it left the
+        {previous, current, next} window — the expiry that makes old
+        tickets die at the rotation horizon."""
+        if gen not in self.window():
+            return None
+        return _mac(self.base_key, b"rot", struct.pack("<q", gen))
+
+    def export_window(self) -> dict[int, bytes]:
+        return {g: self.secret_for(g) for g in self.window()}
+
+
+class FetchedKeyProvider:
+    """Generation cache for daemons that do NOT hold the base key:
+    they fetch the current window from the mon (sealed with their own
+    entity key) and re-fetch each rotation. A daemon whose entity the
+    mon revoked gets no new generations; once its cached window ages
+    out it can neither sign acceptably nor validate peers — fenced."""
+
+    def __init__(self, period: float | None = None,
+                 clock=time.time) -> None:
+        from ceph_tpu.utils.config import g_conf
+        self.period = period or g_conf()["auth_rotation_period"]
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._gens: dict[int, bytes] = {}
+
+    def current_gen(self) -> int:
+        return int(self._clock() // self.period)
+
+    def window(self) -> tuple[int, int, int]:
+        g = self.current_gen()
+        return (g - 1, g, g + 1)
+
+    def install(self, gens: dict[int, bytes]) -> None:
+        with self._lock:
+            self._gens.update(gens)
+            live = self.window()
+            for g in [g for g in self._gens if g not in live]:
+                del self._gens[g]
+
+    def secret_for(self, gen: int) -> bytes | None:
+        if gen not in self.window():
+            return None
+        with self._lock:
+            return self._gens.get(gen)
+
+    def needs_refresh(self) -> bool:
+        """True when the cache misses any generation of the live
+        window (fetch before the NEXT rotation strands us)."""
+        with self._lock:
+            return any(g not in self._gens for g in self.window())
+
+
+class StaticKeyProvider:
+    """Pre-rotation behavior: one immortal generation (gen 0)."""
+
+    def __init__(self, key: bytes) -> None:
+        self.key = key
+
+    def current_gen(self) -> int:
+        return 0
+
+    def secret_for(self, gen: int) -> bytes | None:
+        return self.key if gen == 0 else None
+
+
 # -- tickets ----------------------------------------------------------
 
-def grant_ticket(service_key: bytes, entity: str,
+def grant_ticket(provider, entity: str,
                  ttl: float = TICKET_TTL) -> tuple[bytes, bytes]:
-    """Mon side: returns (ticket_blob, session_key). The blob is
-    readable by any daemon holding the service key and unforgeable
-    without it."""
+    """Mon side: returns (ticket_blob, session_key). The blob carries
+    the sealing generation; it is readable by any holder of that
+    generation's secret and unforgeable without it. ``provider`` may
+    also be raw key bytes (static, gen-0 sealing)."""
+    if isinstance(provider, (bytes, bytearray)):
+        provider = StaticKeyProvider(bytes(provider))
+    gen = provider.current_gen()
+    secret = provider.secret_for(gen)
+    if secret is None:
+        raise AuthError("no current service-key generation "
+                        "(rotating window not fetched?)")
+    # the ticket must outlive its sealing generation's residence in
+    # the window (2 periods), or a long rotation period would leave
+    # daemons signing with expired-body tickets mid-generation
+    ttl = max(ttl, 2 * getattr(provider, "period", 0.0))
     session_key = os.urandom(32)
     body = json.dumps({
         "entity": entity,
         "expires": time.time() + ttl,
         "session_key": base64.b64encode(session_key).decode(),
     }).encode()
-    sealed = seal(service_key, b"ticket", body)
-    blob = struct.pack("<I", len(sealed)) + sealed + \
-        _mac(service_key, body)
+    sealed = seal(secret, b"ticket", body)
+    blob = struct.pack("<qI", gen, len(sealed)) + sealed + \
+        _mac(secret, body)
     return blob, session_key
 
 
-def verify_ticket(service_key: bytes, blob: bytes
-                  ) -> tuple[str, bytes] | None:
-    """Daemon side: (entity, session_key) or None if invalid/expired."""
+def ticket_gen(blob: bytes) -> int | None:
+    """The generation that sealed a ticket blob (single decoder for
+    the '<qI' header — keep AuthVerifier's cache keying in step with
+    the wire format)."""
     try:
-        (n,) = struct.unpack_from("<I", blob)
-        sealed = blob[4:4 + n]
-        mac = blob[4 + n:]
-        body = unseal(service_key, b"ticket", sealed)
-        if not hmac.compare_digest(_mac(service_key, body), mac):
+        (gen,) = struct.unpack_from("<q", blob)
+        return gen
+    except struct.error:
+        return None
+
+
+def verify_ticket(provider, blob: bytes
+                  ) -> tuple[str, bytes] | None:
+    """Daemon side: (entity, session_key), or None if forged, expired,
+    or sealed by a generation outside the provider's live window."""
+    if isinstance(provider, (bytes, bytearray)):
+        provider = StaticKeyProvider(bytes(provider))
+    try:
+        gen, n = struct.unpack_from("<qI", blob)
+        secret = provider.secret_for(gen)
+        if secret is None:
+            return None               # generation rotated out
+        off = struct.calcsize("<qI")
+        sealed = blob[off:off + n]
+        mac = blob[off + n:]
+        body = unseal(secret, b"ticket", sealed)
+        if not hmac.compare_digest(_mac(secret, body), mac):
             return None
         d = json.loads(body)
         if d["expires"] < time.time():
@@ -157,34 +282,75 @@ class AuthSigner:
         return self._ticket_b64 + ":" + sig.hex()
 
 
+class RotatingSigner:
+    """Daemon-side signer that RE-GRANTS its own ticket whenever the
+    service-key generation advances (the reference's rotating-key
+    ticket renewal): a daemon signing with a rotated-out ticket would
+    be refused by every peer."""
+
+    def __init__(self, provider, entity: str) -> None:
+        self._provider = provider
+        self.entity = entity
+        self._lock = threading.Lock()
+        self._gen: int | None = None
+        self._inner: AuthSigner | None = None
+
+    def sign(self, payload: bytes) -> str:
+        gen = self._provider.current_gen()
+        with self._lock:
+            if self._inner is None or gen != self._gen:
+                try:
+                    ticket, sk = grant_ticket(self._provider,
+                                              self.entity)
+                    self._inner = AuthSigner(ticket, sk)
+                    self._gen = gen
+                except AuthError:
+                    # no current secret (revoked fetched daemon):
+                    # keep signing with the stale ticket — peers
+                    # reject it, which IS the fencing
+                    pass
+            inner = self._inner
+        return inner.sign(payload) if inner else ""
+
+
 class AuthVerifier:
     """Installed on a daemon's messenger: validates the frame stamp.
     Ticket validation is cached per blob (the reference validates the
-    authorizer once per connection; we key by ticket)."""
+    authorizer once per connection; we key by ticket); a cached
+    ticket is re-checked once its sealing generation could have
+    rotated out."""
 
-    def __init__(self, service_key: bytes) -> None:
-        self._service_key = service_key
+    def __init__(self, provider) -> None:
+        if isinstance(provider, (bytes, bytearray)):
+            provider = StaticKeyProvider(bytes(provider))
+        self._provider = provider
         self._lock = threading.Lock()
-        self._cache: dict[str, tuple[str, bytes]] = {}
+        #: ticket_b64 -> (entity, session_key, sealing_gen)
+        self._cache: dict[str, tuple[str, bytes, int]] = {}
 
     def verify(self, auth_field: str, payload: bytes) -> str | None:
         """Returns the authenticated entity, or None."""
         if ":" not in auth_field:
             return None
         ticket_b64, sig_hex = auth_field.split(":", 1)
+        live = getattr(self._provider, "window", lambda: (0,))()
         with self._lock:
             entry = self._cache.get(ticket_b64)
+            if entry is not None and entry[2] not in live:
+                del self._cache[ticket_b64]   # generation rotated out
+                entry = None
         if entry is None:
-            got = verify_ticket(self._service_key,
-                                base64.b64decode(ticket_b64))
-            if got is None:
+            blob = base64.b64decode(ticket_b64)
+            got = verify_ticket(self._provider, blob)
+            gen = ticket_gen(blob)
+            if got is None or gen is None:
                 return None
-            entry = got
+            entry = (got[0], got[1], gen)
             with self._lock:
                 if len(self._cache) > 1024:
                     self._cache.clear()
                 self._cache[ticket_b64] = entry
-        entity, session_key = entry
+        entity, session_key, _ = entry
         want = _mac(session_key, payload)[:SIG_LEN].hex()
         if not hmac.compare_digest(want, sig_hex):
             return None
@@ -194,9 +360,11 @@ class AuthVerifier:
 # -- mon-side auth service (AuthMonitor role) -------------------------
 
 class AuthService:
-    def __init__(self, keyring: Keyring) -> None:
+    def __init__(self, keyring: Keyring,
+                 period: float | None = None) -> None:
         self.keyring = keyring
-        self.service_key = keyring.get(SERVICE_ENTITY)
+        self.provider = RotatingKeyProvider(
+            keyring.get(SERVICE_ENTITY), period=period)
 
     def handle_request(self, entity: str, nonce_hex: str
                        ) -> tuple[bytes, bytes] | None:
@@ -206,10 +374,26 @@ class AuthService:
         the request yields a blob the replayer cannot unseal)."""
         if entity not in self.keyring:
             return None
-        ticket, session_key = grant_ticket(self.service_key, entity)
+        ticket, session_key = grant_ticket(self.provider, entity)
         sealed = seal(self.keyring.get(entity),
                       bytes.fromhex(nonce_hex), session_key)
         return ticket, sealed
+
+    def handle_rotating(self, entity: str,
+                        nonce_hex: str) -> bytes | None:
+        """Rotating-secrets fetch (KeyServer get_rotating_secrets
+        role): the current generation window, sealed with the
+        ENTITY's key — only a keyring member can read it, and
+        REMOVING an entity is revocation: no new generations, fenced
+        at the rotation horizon."""
+        if entity not in self.keyring:
+            return None
+        payload = json.dumps(
+            {str(g): s.hex()
+             for g, s in self.provider.export_window().items()
+             if s is not None}).encode()
+        return seal(self.keyring.get(entity),
+                    bytes.fromhex(nonce_hex), payload)
 
 
 def unseal_session_key(entity_secret: bytes, nonce: bytes,
@@ -217,11 +401,25 @@ def unseal_session_key(entity_secret: bytes, nonce: bytes,
     return unseal(entity_secret, nonce, sealed)
 
 
-def daemon_auth(msgr, keyring: Keyring, entity: str) -> None:
-    """Arm a daemon's messenger: daemons hold the service key, so they
-    self-grant a ticket (signer) and validate everyone else's
-    (verifier)."""
-    service_key = keyring.get(SERVICE_ENTITY)
-    ticket, session_key = grant_ticket(service_key, entity)
-    msgr.signer = AuthSigner(ticket, session_key)
-    msgr.verifier = AuthVerifier(service_key)
+def decode_rotating(entity_secret: bytes, nonce: bytes,
+                    sealed: bytes) -> dict[int, bytes]:
+    payload = unseal(entity_secret, nonce, sealed)
+    return {int(g): bytes.fromhex(s)
+            for g, s in json.loads(payload).items()}
+
+
+def daemon_auth(msgr, keyring: Keyring, entity: str,
+                period: float | None = None) -> None:
+    """Arm a daemon's messenger. A keyring holding the service key
+    self-derives every generation (rotation still applies — the
+    signer re-grants per generation); one holding only the daemon's
+    OWN key gets a FetchedKeyProvider the daemon must keep fed from
+    the mon (MAuthRotating) — see OSD._refresh_rotating."""
+    if SERVICE_ENTITY in keyring:
+        provider = RotatingKeyProvider(keyring.get(SERVICE_ENTITY),
+                                       period=period)
+    else:
+        provider = FetchedKeyProvider(period=period)
+    msgr.signer = RotatingSigner(provider, entity)
+    msgr.verifier = AuthVerifier(provider)
+    msgr.rotating_provider = provider

@@ -523,3 +523,16 @@ class MMDSCapRevoke(Message):
     cap type the client may retain ("" = none, "shared")."""
     MSG_TYPE = 62
     FIELDS = [("ino", "u64"), ("keep", "str"), ("epoch", "u32")]
+
+
+class MAuthRotating(Message):
+    """Daemon -> mon: fetch the rotating service-key window
+    (CephxKeyServer get_rotating_secrets role). Reply is sealed with
+    the entity's own key, so only a keyring member can read it."""
+    MSG_TYPE = 63
+    FIELDS = [("entity", "str"), ("nonce", "str"), ("tid", "u64")]
+
+
+class MAuthRotatingReply(Message):
+    MSG_TYPE = 64
+    FIELDS = [("tid", "u64"), ("code", "i32"), ("sealed", "bytes")]

@@ -41,7 +41,7 @@ _MAGIC = 0xCE9FA127
 _HDR = struct.Struct("<IQH")   # magic, seq, msg type
 
 #: message types allowed before authentication (the MAuth exchange)
-_PREAUTH_TYPES = (38, 39)
+_PREAUTH_TYPES = (38, 39, 63, 64)
 
 
 class Connection:

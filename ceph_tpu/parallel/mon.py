@@ -743,6 +743,25 @@ class Monitor:
                 return
             if isinstance(msg, M.MAuth):
                 self._handle_auth(msg, conn)
+            elif isinstance(msg, M.MAuthRotating):
+                # rotating service-key fetch (KeyServer role): reply
+                # sealed with the entity's own key; an entity outside
+                # the keyring (revoked) gets EACCES — its cached
+                # window ages out and fences it
+                if self.auth_service is None:
+                    conn.send_message(M.MAuthRotatingReply(
+                        tid=msg.tid, code=0, sealed=b""))
+                else:
+                    sealed = self.auth_service.handle_rotating(
+                        msg.entity, msg.nonce)
+                    if sealed is None:
+                        log(1, "auth: rotating-key fetch denied for "
+                            f"{msg.entity!r}")
+                        conn.send_message(M.MAuthRotatingReply(
+                            tid=msg.tid, code=-13, sealed=b""))
+                    else:
+                        conn.send_message(M.MAuthRotatingReply(
+                            tid=msg.tid, code=0, sealed=sealed))
             elif isinstance(msg, M.MPGStats):
                 # soft state: every mon keeps what it hears AND relays
                 # to the leader (whose status answers commands)

@@ -56,7 +56,7 @@ class MonClient:
     def handle_message(self, msg: M.Message, conn: Connection) -> bool:
         """Returns True when the message was mon-plane and consumed."""
         if isinstance(msg, (M.MOSDMap, M.MMonCommandReply,
-                            M.MAuthReply)):
+                            M.MAuthReply, M.MAuthRotatingReply)):
             self._last_rx = time.monotonic()
         if isinstance(msg, M.MOSDMap):
             newmap = OSDMap.decode(msg.map_bytes)
@@ -78,7 +78,8 @@ class MonClient:
             from ceph_tpu.utils.config import g_conf
             g_conf().set_mon_layer(dict(msg.config))
             return True
-        if isinstance(msg, (M.MMonCommandReply, M.MAuthReply)):
+        if isinstance(msg, (M.MMonCommandReply, M.MAuthReply,
+                            M.MAuthRotatingReply)):
             with self._lock:
                 ent = self._pending.pop(msg.tid, None)
             if ent:
@@ -132,6 +133,71 @@ class MonClient:
             secret, bytes.fromhex(nonce), reply.sealed_session_key)
         self.msgr.signer = A.AuthSigner(reply.ticket, session_key)
         log(5, f"{entity}: authenticated, message signing enabled")
+        # ticket renewal (MonClient::tick _check_auth_tickets role):
+        # tickets die at the service-key rotation horizon, so a
+        # long-lived client must re-authenticate each generation or
+        # daemons start dropping its frames as unauthenticated
+        self._auth_creds = (entity, secret)
+        if getattr(self, "_renew_thread", None) is None:
+            self._renew_thread = threading.Thread(
+                target=self._renew_loop, name="monc-renew",
+                daemon=True)
+            self._renew_thread.start()
+
+    def _renew_loop(self) -> None:
+        last_gen = None
+        while True:
+            period = g_conf()["auth_rotation_period"]
+            time.sleep(min(period / 4, 60.0))
+            if not self.msgr._running:
+                return
+            gen = int(time.time() // period)
+            if gen == last_gen:
+                continue        # one handshake per generation, not
+                # one per wakeup (60 no-op re-auths/hour otherwise)
+            try:
+                self.authenticate(*self._auth_creds, timeout=10.0)
+                last_gen = gen
+            except Exception as exc:
+                log(5, f"ticket renewal failed: {exc!r}")
+
+    def fetch_rotating(self, entity: str, secret: bytes,
+                       timeout: float = 10.0) -> "dict[int, bytes]":
+        """Fetch the rotating service-key window from the mon
+        (KeyServer get_rotating_secrets role). Raises AuthError on
+        denial — the caller IS revoked."""
+        import os
+
+        from ceph_tpu.parallel import auth as A
+        nonce = os.urandom(16).hex()
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                tid = self._next_tid
+                self._next_tid += 1
+                ent = [threading.Event(), None]
+                self._pending[tid] = ent
+            self.msgr.send_message(
+                M.MAuthRotating(entity=entity, nonce=nonce, tid=tid),
+                self.mon_addr)
+            step = min(max(timeout / 4, 0.5),
+                       max(deadline - time.monotonic(), 0.05))
+            if ent[0].wait(step):
+                reply = ent[1]
+                break
+            with self._lock:
+                self._pending.pop(tid, None)
+            if len(self.mon_addrs) > 1:
+                self._rotate()
+            if time.monotonic() >= deadline:
+                raise TimeoutError("rotating-key fetch timed out")
+        if reply.code != 0:
+            raise A.AuthError(
+                f"rotating-key fetch denied ({reply.code})")
+        if not reply.sealed:
+            return {}                 # auth disabled cluster-side
+        return A.decode_rotating(secret, bytes.fromhex(nonce),
+                                 reply.sealed)
 
     def subscribe(self) -> None:
         """Ask for the current map + pushes on every epoch."""

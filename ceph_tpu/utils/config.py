@@ -274,6 +274,11 @@ for _o in [
            "within this many seconds"),
     Option("mon_election_timeout", float, 2.0, "advanced",
            "mon election timeout seconds"),
+    Option("auth_rotation_period", float, 3600.0, "advanced",
+           "service-key generation length, seconds (CephxKeyServer "
+           "rotating-secrets role): tickets carry their generation "
+           "and validate only while it is inside the 3-generation "
+           "window {previous, current, next}"),
     Option("rbd_cache", bool, False, "advanced",
            "attach an ObjectCacher to opened rbd images "
            "(osdc/ObjectCacher + rbd_cache roles). Default off: the "
