@@ -47,9 +47,20 @@ class IoCtx:
     def _submit(self, oid: str, op: int, **kw) -> M.MOSDOpReply:
         if self.op_timeout is not None:
             kw.setdefault("timeout", self.op_timeout)
+        # cache-tier overlay (OSDMap read_tier/write_tier role): object
+        # ops against a base pool with an overlay go to the CACHE pool;
+        # its OSDs promote on miss and the agent writes back. PGLS
+        # stays on the opened pool (reference behavior: the redirect is
+        # an object-op affair).
+        pool_id = self.pool_id
+        m = self.client.monc.osdmap
+        p = m.pools.get(pool_id) if m else None
+        if p is not None and p.read_tier >= 0 and \
+                op != M.OSD_OP_LIST:
+            pool_id = p.read_tier
         try:
             return self.client.objecter.op_submit(
-                self.pool_id, oid, op, **kw)
+                pool_id, oid, op, **kw)
         except ObjecterError as exc:
             raise RadosError(exc.code, str(exc)) from None
 

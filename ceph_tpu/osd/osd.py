@@ -371,6 +371,8 @@ class OSD:
         self._notifies: dict[int, dict] = {}
         self.op_wq = ShardedOpWQ(f"osd.{osd_id}",
                                  g_conf()["osd_op_num_shards"])
+        from ceph_tpu.osd.tiering import TierService
+        self.tier = TierService(self)
         # replica-side service ops (shard reads, peering queries) are
         # read-only and must never starve behind a primary-side task
         # blocked in a fan-out wait on the same op_wq shard — they get
@@ -419,6 +421,12 @@ class OSD:
                              "reads (clay repair-bandwidth path)")
         perf.add_u64_counter("snap_clones", "snapshot COW clones made")
         perf.add_u64_counter("snap_trims", "snapshot clones trimmed")
+        perf.add_u64_counter("tier_promote",
+                             "cache-tier objects promoted from base")
+        perf.add_u64_counter("tier_flush",
+                             "cache-tier objects flushed to base")
+        perf.add_u64_counter("tier_evict",
+                             "cache-tier clean objects evicted")
         perf.add_u64_counter("device_batches",
                              "stripe-batch device kernel launches")
         perf.add_u64_counter("device_batch_ops",
@@ -494,6 +502,7 @@ class OSD:
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=5)
+        self.tier.shutdown()
         if self._device_engine is not None:
             self._device_engine.stop()
         self.op_wq.drain_stop()
@@ -734,6 +743,11 @@ class OSD:
             return
         if isinstance(msg, M.MPingReply):
             self._hb_last_rx[msg.osd_id] = time.monotonic()
+            return
+        if isinstance(msg, M.MOSDOpReply):
+            # replies to our INTERNAL client (cache-tier promote /
+            # flush ops against the base pool)
+            self.tier.handle_reply(msg, conn)
             return
         if isinstance(msg, M.MECSubWriteReply):
             self._handle_sub_write_reply(msg)
@@ -1072,6 +1086,18 @@ class OSD:
                 return
             track.mark_event("reached_pg")
             span.event("reached_pg")
+            if pool.is_cache_tier:
+                handled = self.tier.intercept(pg, pool, msg, conn,
+                                              reply)
+                if handled == "parked":
+                    # the promote's requeue tracks a fresh op; this
+                    # entry must not linger as in-flight forever
+                    track.mark_event("waiting_for_tier_promote")
+                    track.finish()
+                    span.finish()
+                    return
+                if handled:
+                    return        # replied by the intercept
             tracing.set_current(span)
             try:
                 self._execute_op(pg, msg, reply)
@@ -2471,6 +2497,7 @@ class OSD:
             if osdmap is None:
                 continue
             self._refresh_rotating()
+            self.tier.agent_tick()
             self.monc.beacon(self.whoami, osdmap.epoch)
             now = time.monotonic()
             self._expire_inflight(now)

@@ -178,6 +178,10 @@ class PG:
         # in-flight write content for overlapping RMW (ExtentCache role)
         from ceph_tpu.osd.extent_cache import ExtentCache
         self.extent_cache = ExtentCache()
+        # cache-tier state (osd/tiering.py): ops parked behind a
+        # promote, and recent promote outcomes (suppress re-promote)
+        self.tier_parked: dict[str, list] = {}
+        self.tier_recent: dict[str, float] = {}
         self.backend = None       # set by the OSD when instantiated
         # version allocation cursor: versions are handed out when an op
         # is ACCEPTED (under pg.lock), not when its log entry stages.

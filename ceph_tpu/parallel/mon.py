@@ -1105,6 +1105,89 @@ class Monitor:
                 del pool.snaps[sid]
                 self._commit()   # OSD trimmers react to the new map
                 return 0, f"removed pool snap {cmd['snap']!r}", b""
+            if prefix == "osd tier add":
+                # cache tiering plumbing (OSDMonitor "osd tier *"
+                # command family, src/mon/OSDMonitor.cc)
+                base = self._resolve_pool(cmd["pool"])
+                tier = self._resolve_pool(cmd["tierpool"])
+                tp = self.osdmap.pools[tier]
+                if base == tier:
+                    return -22, "pool cannot tier itself", b""
+                if tp.is_ec:
+                    return -22, "an EC pool cannot be a cache tier", \
+                        b""
+                if tp.tier_of >= 0:
+                    return -17, f"{cmd['tierpool']} is already a " \
+                        "tier", b""
+                tp.tier_of = base
+                self._commit()
+                return 0, f"pool {cmd['tierpool']!r} is now (or " \
+                    f"already was) a tier of {cmd['pool']!r}", b""
+            if prefix == "osd tier cache-mode":
+                tier = self._resolve_pool(cmd["pool"])
+                mode = cmd["mode"]
+                if mode not in ("none", "writeback"):
+                    return -22, f"unsupported cache mode {mode!r}", b""
+                tp = self.osdmap.pools[tier]
+                if tp.tier_of < 0:
+                    return -22, f"{cmd['pool']!r} is not a tier", b""
+                bp = self.osdmap.pools.get(tp.tier_of)
+                if mode == "none" and bp is not None and \
+                        (bp.read_tier == tier or bp.write_tier == tier):
+                    # clients still redirect here; turning the OSD
+                    # machinery off now would serve whiteouts as
+                    # empty objects and orphan dirty data
+                    return -16, "remove the overlay first", b""
+                tp.cache_mode = mode
+                self._commit()
+                return 0, f"set cache-mode of {cmd['pool']!r} to " \
+                    f"{mode}", b""
+            if prefix == "osd tier set-overlay":
+                base = self._resolve_pool(cmd["pool"])
+                tier = self._resolve_pool(cmd["overlaypool"])
+                tp = self.osdmap.pools[tier]
+                if tp.tier_of != base:
+                    return -22, f"{cmd['overlaypool']!r} is not a " \
+                        f"tier of {cmd['pool']!r}", b""
+                bp = self.osdmap.pools[base]
+                bp.read_tier = bp.write_tier = tier
+                self._commit()
+                return 0, f"overlay for {cmd['pool']!r} is now " \
+                    f"{cmd['overlaypool']!r}", b""
+            if prefix == "osd tier remove-overlay":
+                base = self._resolve_pool(cmd["pool"])
+                bp = self.osdmap.pools[base]
+                bp.read_tier = bp.write_tier = -1
+                self._commit()
+                return 0, f"removed overlay for {cmd['pool']!r}", b""
+            if prefix == "osd tier remove":
+                base = self._resolve_pool(cmd["pool"])
+                tier = self._resolve_pool(cmd["tierpool"])
+                tp = self.osdmap.pools[tier]
+                bp = self.osdmap.pools[base]
+                if tp.tier_of != base:
+                    return -22, f"{cmd['tierpool']!r} is not a tier " \
+                        f"of {cmd['pool']!r}", b""
+                if bp.read_tier == tier or bp.write_tier == tier:
+                    return -16, "remove the overlay first", b""
+                tp.tier_of = -1
+                tp.cache_mode = "none"
+                self._commit()
+                return 0, f"pool {cmd['tierpool']!r} is no longer a " \
+                    f"tier of {cmd['pool']!r}", b""
+            if prefix == "osd pool set":
+                pid = self._resolve_pool(cmd["pool"])
+                pool = self.osdmap.pools[pid]
+                var, val = cmd["var"], cmd["val"]
+                if var == "target_max_objects":
+                    pool.target_max_objects = int(val)
+                elif var == "target_max_bytes":
+                    pool.target_max_bytes = int(val)
+                else:
+                    return -22, f"unsettable pool var {var!r}", b""
+                self._commit()
+                return 0, f"set pool {cmd['pool']!r} {var} = {val}", \
+                    b""
             if prefix == "config set":
                 from ceph_tpu.utils.config import SCHEMA
                 name, value = cmd["name"], cmd["value"]

@@ -43,6 +43,20 @@ class PoolInfo:
     #: snap trimmers reclaim clones whose snaps no longer exist
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)       # snapid -> name
+    #: cache tiering (pg_pool_t tier_of/read_tier/write_tier/
+    #: cache_mode roles, src/osd/osd_types.h): a CACHE pool records
+    #: its base pool in ``tier_of``; the BASE pool records the
+    #: overlay in read_tier/write_tier so clients redirect to it
+    tier_of: int = -1
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = "none"
+    target_max_objects: int = 0
+    target_max_bytes: int = 0
+
+    @property
+    def is_cache_tier(self) -> bool:
+        return self.tier_of >= 0 and self.cache_mode != "none"
 
     @property
     def is_ec(self) -> bool:
@@ -241,12 +255,20 @@ class OSDMap:
                  lambda en, p: (en.u64(p.snap_seq),
                                 en.map(p.snaps, Encoder.u64,
                                        Encoder.str)))
-        e.section(3, body)
+        # v4: cache tiering (appended)
+        body.map({pid: p for pid, p in self.pools.items()},
+                 Encoder.i32,
+                 lambda en, p: (en.i64(p.tier_of), en.i64(p.read_tier),
+                                en.i64(p.write_tier),
+                                en.str(p.cache_mode),
+                                en.u64(p.target_max_objects),
+                                en.u64(p.target_max_bytes)))
+        e.section(4, body)
         return e.getvalue()
 
     @classmethod
     def decode(cls, buf: bytes) -> "OSDMap":
-        version, d = Decoder(buf).section(3)
+        version, d = Decoder(buf).section(4)
         m = cls()
         m.epoch = d.u32()
 
@@ -296,4 +318,16 @@ class OSDMap:
                 if pid in m.pools:
                     m.pools[pid].snap_seq = seq
                     m.pools[pid].snaps = dict(snaps)
+        if version >= 4:
+            tierinfo = d.map(
+                Decoder.i32,
+                lambda dd: (dd.i64(), dd.i64(), dd.i64(), dd.str(),
+                            dd.u64(), dd.u64()))
+            for pid, (tof, rt, wt, mode, tmo, tmb) in tierinfo.items():
+                if pid in m.pools:
+                    p = m.pools[pid]
+                    p.tier_of, p.read_tier, p.write_tier = tof, rt, wt
+                    p.cache_mode = mode
+                    p.target_max_objects = tmo
+                    p.target_max_bytes = tmb
         return m

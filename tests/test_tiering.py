@@ -1,0 +1,220 @@
+"""Cache tiering (PrimaryLogPG.cc:2754 maybe_handle_cache_detail +
+:13842 agent_work, reduced to a writeback tier): overlay redirect,
+promote on miss, whiteout deletes, flush/evict agent."""
+
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.qa.cluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        c.create_pool("base", pg_num=4, size=2)
+        c.create_pool("hot", pg_num=4, size=2)
+        rados = c.client()
+        for cmd in (
+            {"prefix": "osd tier add", "pool": "base",
+             "tierpool": "hot"},
+            {"prefix": "osd tier cache-mode", "pool": "hot",
+             "mode": "writeback"},
+            {"prefix": "osd tier set-overlay", "pool": "base",
+             "overlaypool": "hot"},
+        ):
+            code, outs, _ = rados.mon_command(cmd)
+            assert code == 0, outs
+        yield c
+
+
+@pytest.fixture(scope="module")
+def rados(cluster):
+    return cluster._clients[0]
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timeout: {msg}"
+        time.sleep(0.3)
+
+
+def _tier_counter(cluster, name) -> int:
+    total = 0
+    for osd in cluster.osds.values():
+        try:
+            total += osd.logger.get(name)
+        except Exception:
+            pass
+    return total
+
+
+def test_write_lands_in_cache_and_agent_flushes(cluster, rados):
+    """Write through the overlay -> object lives in the cache pool;
+    the agent writes it back to base."""
+    base_io = rados.open_ioctx("base")
+    hot_io = rados.open_ioctx("hot")
+    base_io.write_full("obj1", b"tiered-payload")   # redirected
+    # the object materialized in the CACHE pool, not base (PGLS is
+    # not redirected, so the two listings tell them apart)
+    assert "obj1" in hot_io.list_objects()
+    assert "obj1" not in base_io.list_objects()
+    # reads through the overlay serve from cache
+    assert base_io.read("obj1") == b"tiered-payload"
+    # agent flush propagates to base
+    _wait(lambda: "obj1" in base_io.list_objects(),
+          msg="agent flush to base")
+    # base copy is bit-identical (read the BASE pool object directly
+    # via a non-overlay path: list caught it; compare through the
+    # cache which is authoritative)
+    assert base_io.read("obj1") == b"tiered-payload"
+
+
+def test_read_miss_promotes_from_base(cluster, rados):
+    base_io = rados.open_ioctx("base")
+    hot_io = rados.open_ioctx("hot")
+    base_io.write_full("obj2", b"x" * 4096)
+    base_io.setxattr("obj2", "color", b"blue")
+    _wait(lambda: "obj2" in base_io.list_objects(),
+          msg="flush of obj2")
+    # evict it from the cache by hand (simulates agent eviction)
+    before = _tier_counter(cluster, "tier_promote")
+    for osd in cluster.osds.values():
+        for pg in list(osd.pgs.values()):
+            if pg.pool == hot_io.pool_id:
+                with pg.lock:
+                    try:
+                        v = pg.alloc_version()
+                        pg.backend.submit_remove(pg, "obj2", v,
+                                                 lambda c: None)
+                    except Exception:
+                        pass
+    time.sleep(0.3)
+    assert "obj2" not in hot_io.list_objects()
+    # read through the overlay: MISS -> promote -> serve
+    assert base_io.read("obj2") == b"x" * 4096
+    assert base_io.getxattr("obj2", "color") == b"blue"
+    assert "obj2" in hot_io.list_objects()
+    assert _tier_counter(cluster, "tier_promote") > before
+
+
+def test_partial_write_miss_promotes_first(cluster, rados):
+    base_io = rados.open_ioctx("base")
+    hot_io = rados.open_ioctx("hot")
+    base_io.write_full("obj3", b"A" * 100)
+    _wait(lambda: "obj3" in base_io.list_objects(),
+          msg="flush of obj3")
+    for osd in cluster.osds.values():
+        for pg in list(osd.pgs.values()):
+            if pg.pool == hot_io.pool_id:
+                with pg.lock:
+                    try:
+                        v = pg.alloc_version()
+                        pg.backend.submit_remove(pg, "obj3", v,
+                                                 lambda c: None)
+                    except Exception:
+                        pass
+    time.sleep(0.3)
+    # offset write on a cache miss must splice into the BASE content
+    base_io.write("obj3", b"B" * 10, offset=50)
+    got = base_io.read("obj3")
+    assert got == b"A" * 50 + b"B" * 10 + b"A" * 40
+
+
+def test_delete_is_whiteout_and_propagates(cluster, rados):
+    base_io = rados.open_ioctx("base")
+    base_io.write_full("doomed", b"bye")
+    _wait(lambda: "doomed" in base_io.list_objects(),
+          msg="flush of doomed")
+    base_io.remove("doomed")
+    # immediately deleted from the client's view — no promote-through
+    with pytest.raises(RadosError) as ei:
+        base_io.read("doomed")
+    assert ei.value.code == -2
+    # the agent propagates the delete to the base pool
+    _wait(lambda: "doomed" not in base_io.list_objects(),
+          msg="whiteout propagation")
+    with pytest.raises(RadosError):
+        base_io.read("doomed")    # still gone (no resurrection)
+
+
+def test_eviction_respects_target_and_keeps_dirty(cluster, rados):
+    code, outs, _ = rados.mon_command(
+        {"prefix": "osd pool set", "pool": "hot",
+         "var": "target_max_objects", "val": "1"})
+    assert code == 0, outs
+    base_io = rados.open_ioctx("base")
+    hot_io = rados.open_ioctx("hot")
+    for i in range(8):
+        base_io.write_full(f"ev{i}", bytes([i]) * 512)
+    # all 8 flush to base, then eviction drains the cache toward the
+    # (tiny) target; nothing is lost — reads promote back
+    _wait(lambda: all(f"ev{i}" in base_io.list_objects()
+                      for i in range(8)),
+          msg="flush of ev*")
+    _wait(lambda: len([o for o in hot_io.list_objects()
+                       if o.startswith("ev")]) <= 4,
+          msg="eviction under target")
+    assert _tier_counter(cluster, "tier_evict") > 0
+    for i in range(8):
+        assert base_io.read(f"ev{i}") == bytes([i]) * 512
+
+
+def test_deleted_xattr_stays_deleted_across_flush_cycles(cluster,
+                                                         rados):
+    """The flush rebuilds the base object from scratch: an xattr
+    removed in the cache must not resurrect after evict + promote."""
+    base_io = rados.open_ioctx("base")
+    hot_io = rados.open_ioctx("hot")
+    base_io.write_full("meta", b"m")
+    base_io.setxattr("meta", "keep", b"1")
+    base_io.setxattr("meta", "drop", b"1")
+    _wait(lambda: "meta" in base_io.list_objects(),
+          msg="first flush of meta")
+    base_io.rmxattr("meta", "drop")          # marks dirty again
+    # wait until re-flushed clean, then force-evict and re-promote
+    def reflushed():
+        for osd in cluster.osds.values():
+            for pg in osd.pgs.values():
+                if pg.pool != hot_io.pool_id:
+                    continue
+                with pg.lock:
+                    try:
+                        a = pg.backend.get_xattrs(pg, "meta")
+                    except Exception:
+                        continue
+                    return "t/c" in a and "t/d" not in a
+        return False
+    _wait(reflushed, msg="re-flush after rmxattr")
+    for osd in cluster.osds.values():
+        for pg in list(osd.pgs.values()):
+            if pg.pool == hot_io.pool_id:
+                with pg.lock:
+                    try:
+                        v = pg.alloc_version()
+                        pg.backend.submit_remove(pg, "meta", v,
+                                                 lambda c: None)
+                    except Exception:
+                        pass
+    time.sleep(0.3)
+    # promote pulls from base: 'drop' must NOT come back
+    assert base_io.getxattr("meta", "keep") == b"1"
+    with pytest.raises(RadosError):
+        base_io.getxattr("meta", "drop")
+
+
+def test_tier_commands_validate(cluster, rados):
+    code, outs, _ = rados.mon_command(
+        {"prefix": "osd tier remove", "pool": "base",
+         "tierpool": "hot"})
+    assert code == -16 and "overlay" in outs   # overlay still set
+    code, _, _ = rados.mon_command(
+        {"prefix": "osd tier cache-mode", "pool": "base",
+         "mode": "writeback"})
+    assert code == -22                         # base is not a tier
+    code, outs, _ = rados.mon_command(
+        {"prefix": "osd tier cache-mode", "pool": "hot",
+         "mode": "none"})
+    assert code == -16 and "overlay" in outs   # clients still redirect
