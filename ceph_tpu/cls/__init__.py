@@ -220,6 +220,38 @@ def _rgw_mp_add_part(inp: bytes, obj: bytes | None):
     return 0, b"", json.dumps(meta).encode()
 
 
+@register("rgw", "pair_advance")
+def _rgw_pair_advance(inp: bytes, obj: bytes | None):
+    """Multisite conflict pairs (rgw_data_sync resolution state):
+    advance one key's (epoch, zone) pair ATOMICALLY under the PG
+    lock. input {"key", "zone", "pair": optional}: no pair = local
+    mutation, mint [cur_epoch+1, zone]; with pair = remote apply,
+    install only if it beats the current pair lexicographically
+    (-ECANCELED when it loses — the caller skips the mutation).
+    Client-side read-modify-write here would let two concurrent local
+    puts mint IDENTICAL pairs and permanently diverge the zones."""
+    req = json.loads(inp)
+    table = json.loads(obj) if obj else {}
+    cur = table.get(req["key"], [0, ""])
+    if req.get("pair") is None:
+        new = [int(cur[0]) + 1, req["zone"]]
+    else:
+        new = [int(req["pair"][0]), str(req["pair"][1])]
+        if (new[0], new[1]) <= (int(cur[0]), str(cur[1])):
+            return -125, b"", None          # -ECANCELED: lost
+    table[req["key"]] = new
+    return 0, json.dumps({"pair": new}).encode(), \
+        json.dumps(table).encode()
+
+
+@register("rgw", "pair_get")
+def _rgw_pair_get(inp: bytes, obj: bytes | None):
+    req = json.loads(inp)
+    table = json.loads(obj) if obj else {}
+    return 0, json.dumps(
+        {"pair": table.get(req["key"], [0, ""])}).encode(), None
+
+
 @register("rgw", "bucket_list")
 def _rgw_bucket_list(inp: bytes, obj: bytes | None):
     req = json.loads(inp) if inp else {}

@@ -137,14 +137,20 @@ class TierService:
             return False
         if op == M.OSD_OP_REMOVE:
             # whiteout conversion (the reference's writeback delete):
-            # the object appears gone; the agent propagates
+            # the object appears gone; the agent propagates. REMOVE
+            # first so the dead object's xattrs AND omap go with it —
+            # a later write onto the whiteout must not resurrect the
+            # deleted generation's metadata
             version = pg.alloc_version()
-            be.submit_write(pg, msg.oid, b"", version,
+            be.submit_remove(pg, msg.oid, version,
+                             lambda code: None)
+            v1 = pg.alloc_version()
+            be.submit_write(pg, msg.oid, b"", v1,
                             lambda code: None)
             v2 = pg.alloc_version()
             be.submit_setattrs(
                 pg, msg.oid, {WHITEOUT_ATTR: b"1", DIRTY_ATTR: b"1"},
-                [CLEAN_ATTR], v2,
+                [], v2,
                 lambda code, v=v2: reply(code, b"", v))
             return True
         if mutating and DIRTY_ATTR not in attrs:
@@ -162,8 +168,14 @@ class TierService:
         now = time.monotonic()
         recent = pg.tier_recent.get(msg.oid, 0.0)
         if now - recent < PROMOTE_RECENT:
-            return False          # promote just ran (or base-missed):
-            # run the op against what the cache now holds
+            return False          # base-miss just recorded: run the
+            # op against what the cache holds (natural ENOENT). Only
+            # FAILED promotes park here — a successful promote leaves
+            # no marker, so an object evicted right after promotion
+            # re-promotes instead of spuriously ENOENTing.
+            # (A REMOVE miss promotes the full object only to white
+            # it out — wasteful but correct; the remove must answer
+            # ENOENT truthfully when the base never had the key.)
         parked = pg.tier_parked.setdefault(msg.oid, [])
         parked.append((msg, conn))
         if len(parked) == 1:
@@ -207,7 +219,12 @@ class TierService:
         from ceph_tpu.store.object_store import (NoSuchCollection,
                                                  NoSuchObject)
         with pg.lock:
-            pg.tier_recent[oid] = time.monotonic()
+            if data is None:
+                # record FAILED promotes only: the requeued ops run
+                # against the cache (natural ENOENT) instead of
+                # re-parking forever; successful promotes leave no
+                # marker so post-eviction misses re-promote
+                pg.tier_recent[oid] = time.monotonic()
             if len(pg.tier_recent) > 10000:
                 cutoff = time.monotonic() - PROMOTE_RECENT
                 for k in [k for k, t in pg.tier_recent.items()

@@ -1119,6 +1119,26 @@ class Monitor:
                 if tp.tier_of >= 0:
                     return -17, f"{cmd['tierpool']} is already a " \
                         "tier", b""
+                if self.osdmap.pools[base].tier_of >= 0:
+                    return -22, "base pool is itself a tier", b""
+                if not cmd.get("force_nonempty"):
+                    # pre-existing objects in the tier pool would
+                    # SHADOW base objects once the overlay lands (and
+                    # the agent would flush them over the real base
+                    # copies) — the reference mon refuses the same
+                    # way without --force-nonempty
+                    seen: set[str] = set()
+                    objs = 0
+                    for _osd, (_ts, stats) in self._pg_stats.items():
+                        for s in stats:
+                            if s["pgid"] in seen:
+                                continue
+                            seen.add(s["pgid"])
+                            if s["pgid"].startswith(f"{tier}."):
+                                objs += s.get("objects", 0)
+                    if objs:
+                        return -39, "tier pool is non-empty (pass " \
+                            "force_nonempty to override)", b""
                 tp.tier_of = base
                 self._commit()
                 return 0, f"pool {cmd['tierpool']!r} is now (or " \

@@ -61,8 +61,17 @@ class RGWGateway:
     gateway then routes per bucket, so mixed-era buckets and
     gateways can never split one index across two formats."""
 
-    def __init__(self, ioctx, zone_log: bool = False) -> None:
+    def __init__(self, ioctx, zone_log: bool = False,
+                 zone_name: str = "default") -> None:
         self.io = ioctx
+        #: this gateway's zone (rgw_zone role). Multisite conflict
+        #: resolution and echo suppression key on it: log entries
+        #: carry their ORIGIN zone and a per-object (epoch, zone)
+        #: version pair (a Lamport pair — lexicographic comparison is
+        #: symmetric, so concurrently-writing zones converge on the
+        #: same winner, the reference's rgw_data_sync mtime+squash
+        #: resolution made deterministic).
+        self.zone = zone_name
         self._layout = FileLayout(stripe_unit=1 << 20, stripe_count=1,
                                   object_size=1 << 20)
         self._fmt_cache: dict[str, str] = {}
@@ -85,8 +94,56 @@ class RGWGateway:
     def last_version_id(self, vid: str | None) -> None:
         self._tls.vid = vid
 
+    # -- per-object version pairs (multisite conflict state) -----------
+    def _pair_oid(self, bucket: str) -> str:
+        return f".rgwver2.{bucket}"
+
+    def _get_pair(self, bucket: str, key: str) -> list:
+        """Current [epoch, zone] of the key (covers live AND deleted
+        keys — the tombstone state that stops a stale remote put from
+        resurrecting a deleted object)."""
+        from ceph_tpu.client.rados import RadosError
+        try:
+            out = self.io.execute(self._pair_oid(bucket), "rgw",
+                                  "pair_get",
+                                  json.dumps({"key": key}).encode())
+        except RadosError as exc:
+            if exc.code == -2:
+                return [0, ""]
+            raise
+        return json.loads(out)["pair"]
+
+    @staticmethod
+    def _pair_wins(new: list, cur: list) -> bool:
+        return (int(new[0]), str(new[1])) > (int(cur[0]), str(cur[1]))
+
+    def _advance_pair(self, bucket: str, key: str,
+                      pair: list | None) -> list | None:
+        """Local mutation: mint the next pair. Remote apply (``pair``
+        given): advance only if it beats the current pair; returns
+        None when the remote mutation LOST the conflict (the caller
+        skips it — both zones keep the same winner). The advance runs
+        as an in-OSD cls method under the PG lock: a client-side
+        read-modify-write would let two concurrent local puts mint
+        identical pairs and diverge the zones permanently."""
+        if not self.zone_log:
+            return None            # not a multisite zone: no pairs
+        from ceph_tpu.client.rados import RadosError
+        try:
+            out = self.io.execute(
+                self._pair_oid(bucket), "rgw", "pair_advance",
+                json.dumps({"key": key, "zone": self.zone,
+                            "pair": pair}).encode())
+        except RadosError as exc:
+            if exc.code == -125:
+                return None        # lost the conflict
+            raise
+        return json.loads(out)["pair"]
+
     def _log_mutation(self, bucket: str, op: str, key: str,
-                      etag: str = "", vid: str | None = None) -> None:
+                      etag: str = "", vid: str | None = None,
+                      pair: list | None = None,
+                      origin: str | None = None) -> None:
         """Append one SEQUENCED replication-log entry: an atomic cls
         numops counter assigns the seq, the entry rides an omap key
         (zero-padded seq) — O(1) appends, PAGED tailing, and markers
@@ -100,9 +157,12 @@ class RGWGateway:
                               json.dumps({"key": "seq",
                                           "value": 1}).encode())
         seq = int(json.loads(out)["seq"])
-        ent = {"op": op, "key": key, "etag": etag}
+        ent = {"op": op, "key": key, "etag": etag,
+               "zone": origin or self.zone}
         if vid is not None:
             ent["vid"] = vid
+        if pair is not None:
+            ent["pair"] = pair
         self.io.omap_set(oid, {f"{seq:016d}": json.dumps(ent).encode()})
 
     # -- bucket index (cls_rgw bucket-index role) ----------------------
@@ -411,7 +471,9 @@ class RGWGateway:
     def put_object(self, bucket: str, key: str, data: bytes,
                    etag: str | None = None, _log: bool = True,
                    acl: str | None = None, owner: str | None = None,
-                   version_id: str | None = None) -> str:
+                   version_id: str | None = None,
+                   pair: list | None = None,
+                   origin: str | None = None) -> str | None:
         """``etag`` overrides the computed md5 (replication must
         carry the SOURCE etag — multipart objects have 'md5-N' etags
         a re-hash cannot reproduce); ``_log=False`` suppresses the
@@ -429,10 +491,22 @@ class RGWGateway:
         self.last_version_id = None
         if etag is None:
             etag = hashlib.md5(data).hexdigest()
+        applied_pair = None
+        if self.zone_log and status is None:
+            # multisite conflict state (unversioned path; versioned
+            # buckets converge on the GENERATION SET instead — vids
+            # are unique, every zone accumulates every generation)
+            applied_pair = self._advance_pair(bucket, key, pair)
+            if applied_pair is None and pair is not None:
+                return None        # remote mutation lost the conflict
         if status is not None:
             self._preserve_null_version(bucket, key)
             seq = self._alloc_vseq(bucket)
-            vid = version_id or (f"v{seq:012d}"
+            # multisite zones qualify minted ids with the zone name:
+            # two zones' per-bucket seq counters would otherwise mint
+            # COLLIDING ids for concurrently-created generations
+            suffix = f"-{self.zone}" if self.zone_log else ""
+            vid = version_id or (f"v{seq:012d}{suffix}"
                                  if status == "Enabled" else "null")
             doid = self._ver_data_oid(bucket, key, vid)
             StripedObject(self.io, doid).remove()
@@ -456,7 +530,8 @@ class RGWGateway:
                             vid=vid)
             self.last_version_id = vid
             if _log:
-                self._log_mutation(bucket, "put", key, etag, vid=vid)
+                self._log_mutation(bucket, "put", key, etag, vid=vid,
+                                   origin=origin)
             return etag
         so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
         so.remove()                    # replace semantics
@@ -466,7 +541,8 @@ class RGWGateway:
         self._index_add(bucket, key, len(data), etag,
                         acl=acl, owner=owner)
         if _log:
-            self._log_mutation(bucket, "put", key, etag)
+            self._log_mutation(bucket, "put", key, etag,
+                               pair=applied_pair, origin=origin)
         return etag
 
     def get_object(self, bucket: str, key: str,
@@ -494,7 +570,9 @@ class RGWGateway:
     def delete_object(self, bucket: str, key: str,
                       version_id: str | None = None,
                       _log: bool = True,
-                      _marker_vid: str | None = None) -> str | None:
+                      _marker_vid: str | None = None,
+                      pair: list | None = None,
+                      origin: str | None = None) -> str | None:
         """Unversioned: remove for good. Versioning enabled, no
         version_id: lay a DELETE MARKER (the data stays; GETs 404
         until the marker is deleted). With version_id: permanently
@@ -504,10 +582,24 @@ class RGWGateway:
         self._check_bucket(bucket)
         status = self.get_versioning(bucket)
         if status is None and version_id is None:
+            applied_pair = None
+            if self.zone_log:
+                if pair is None and \
+                        self.list_objects(bucket,
+                                          prefix=key).get(key) is None:
+                    # a failed LOCAL delete must not mint a tombstone
+                    # pair: the phantom tombstone would silently veto
+                    # replicated puts on this zone only — divergence
+                    raise RGWError(404, "NoSuchKey")
+                applied_pair = self._advance_pair(bucket, key, pair)
+                if applied_pair is None and pair is not None:
+                    return None    # remote delete lost the conflict:
+                    # a newer local write keeps the object
             self._index_rm(bucket, key)
             StripedObject(self.io, f"{bucket}/{key}").remove()
             if _log:
-                self._log_mutation(bucket, "del", key)
+                self._log_mutation(bucket, "del", key,
+                                   pair=applied_pair, origin=origin)
             return None
         if status is None:
             raise RGWError(400, "InvalidArgument")
@@ -519,8 +611,10 @@ class RGWGateway:
             # accumulate marker entries
             self._preserve_null_version(bucket, key)
             seq = self._alloc_vseq(bucket)
+            suffix = f"-{self.zone}" if self.zone_log else ""
             vid = _marker_vid or (
-                "null" if status == "Suspended" else f"v{seq:012d}")
+                "null" if status == "Suspended"
+                else f"v{seq:012d}{suffix}")
             if vid == "null":
                 old = self._ver_entries(bucket, key).get("null")
                 if old is not None and not old.get("dm"):
@@ -534,7 +628,8 @@ class RGWGateway:
             except RGWError:
                 pass
             if _log:
-                self._log_mutation(bucket, "dm", key, vid=vid)
+                self._log_mutation(bucket, "dm", key, vid=vid,
+                                   origin=origin)
             return vid
         # permanent delete of one generation
         ents = self._ver_entries(bucket, key)
@@ -558,7 +653,8 @@ class RGWGateway:
             # key resurfaces (reindex picks the newest non-marker)
             self._reindex_current(bucket, key, ents)
         if _log:
-            self._log_mutation(bucket, "delver", key, vid=version_id)
+            self._log_mutation(bucket, "delver", key,
+                               vid=version_id, origin=origin)
         return None
 
     def _reindex_current(self, bucket: str, key: str,

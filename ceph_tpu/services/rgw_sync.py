@@ -19,9 +19,20 @@ keeps exactly that shape over two :class:`RGWGateway` instances:
   marker — safe because markers are seqs, not positions (with
   multiple destination zones, run it at the minimum marker).
 
-Deliberate cuts vs the 130 kLoC reference sync machinery: one
-direction per agent (run two agents for bidirectional), no shard
-fan-out of the data log, no metadata sync beyond bucket existence.
+BIDIRECTIONAL (active-active) multisite: run one agent per direction.
+Log entries carry their ORIGIN zone (echo suppression: an agent skips
+entries that originated at its destination) and, for unversioned
+objects, a per-object (epoch, zone) version PAIR — a Lamport pair
+whose lexicographic comparison makes conflict resolution symmetric:
+both zones deterministically keep the same winner for concurrent
+writes, and tombstone pairs stop a stale remote put from resurrecting
+a deleted key. Versioned buckets converge on the generation SET
+(version ids are globally unique), with per-zone current-pointer
+arrival order as the documented reduction.
+
+Deliberate cuts vs the 130 kLoC reference sync machinery: no shard
+fan-out of the data log, no metadata sync beyond bucket existence +
+versioning state.
 """
 
 from __future__ import annotations
@@ -86,37 +97,64 @@ class RGWSyncAgent:
             return 0
 
     # -- sync ---------------------------------------------------------
-    def _apply(self, bucket: str, ent: dict) -> None:
+    def _apply(self, bucket: str, ent: dict) -> bool:
+        """Returns True when the destination was actually mutated
+        (echo-skips and conflict losses return False, so callers can
+        detect quiescence)."""
+        if ent.get("zone") and ent["zone"] == self.dst.zone and \
+                self.dst.zone != self.src.zone:
+            # echo suppression (the reference's zone short-id check
+            # in rgw_data_sync): this entry ORIGINATED at the
+            # destination and came back around a bidirectional (or
+            # ring) topology — applying it would loop forever. Only
+            # meaningful when the deployment actually names distinct
+            # zones (legacy one-way setups leave both at "default").
+            return False
         vid = ent.get("vid")
+        pair = ent.get("pair")
+        origin = ent.get("zone")
         if ent["op"] == "put":
             try:
                 data, meta = self.src.get_object(
                     bucket, ent["key"], version_id=vid)
             except RGWError:
-                return          # superseded by a later delete: the
+                return False    # superseded by a later delete: the
                 # delete entry follows in the log and converges
             # version ids REPLICATE (the reference carries the source
-            # instance id through data sync): dst mints nothing
-            self.dst.put_object(bucket, ent["key"], data,
-                                etag=meta.get("etag") or None,
-                                version_id=vid)
+            # instance id through data sync): dst mints nothing.
+            # put_object returns None when the entry LOST a
+            # bidirectional conflict (destination holds a newer pair)
+            return self.dst.put_object(
+                bucket, ent["key"], data,
+                etag=meta.get("etag") or None,
+                version_id=vid, pair=pair,
+                origin=origin) is not None
         elif ent["op"] == "del":
+            if pair is not None and not self.dst._pair_wins(
+                    pair, self.dst._get_pair(bucket, ent["key"])):
+                return False    # conflict loss: dst keeps its newer
+                # object (delete_object returns None either way, so
+                # the applied count needs this explicit check)
             try:
-                self.dst.delete_object(bucket, ent["key"])
+                self.dst.delete_object(bucket, ent["key"],
+                                       pair=pair, origin=origin)
             except RGWError:
-                pass            # already absent: idempotent
+                return False    # already absent: idempotent
         elif ent["op"] == "dm":
             try:
                 self.dst.delete_object(bucket, ent["key"],
-                                       _marker_vid=vid)
+                                       _marker_vid=vid,
+                                       origin=origin)
             except RGWError:
-                pass
+                return False
         elif ent["op"] == "delver":
             try:
                 self.dst.delete_object(bucket, ent["key"],
-                                       version_id=vid)
+                                       version_id=vid,
+                                       origin=origin)
             except RGWError:
-                pass            # that generation never made it here
+                return False    # that generation never made it here
+        return True
 
     def _full_sync(self, bucket: str) -> None:
         """Bootstrap: copy the source bucket wholesale (the FULL SYNC
@@ -153,8 +191,15 @@ class RGWSyncAgent:
                     data, meta = self.src.get_object(bucket, key)
                 except RGWError:
                     continue    # deleted mid-enumeration
-                self.dst.put_object(bucket, key, data,
-                                    etag=meta.get("etag") or None)
+                # bootstrap carries the source's CURRENT pair so a
+                # bidirectional peer resolves conflicts against it
+                pair = self.src._get_pair(bucket, key) \
+                    if self.src.zone_log else [0, ""]
+                self.dst.put_object(
+                    bucket, key, data,
+                    etag=meta.get("etag") or None,
+                    pair=pair if pair[0] else None,
+                    origin=self.src.zone if pair[0] else None)
             marker = max(page)
 
     def sync_once(self) -> dict:
@@ -194,8 +239,8 @@ class RGWSyncAgent:
                 if not page:
                     break
                 for seq, ent in page:
-                    self._apply(bucket, ent)
-                    applied += 1
+                    if self._apply(bucket, ent):
+                        applied += 1
                     marker = seq
                     self._save_marker(bucket, marker)
             report[bucket] = applied
