@@ -266,7 +266,12 @@ class MMonHB(Message):
               # leader that itself sees a quorum hands these out — a
               # deposed-but-unaware minority leader must not keep its
               # peons' read leases alive (Paxos.cc extend_lease role)
-              ("lease", "f64")]
+              ("lease", "f64"),
+              # appended (Elector epochs): the sender's election
+              # epoch and who it believes leads (rank+1; 0 =
+              # unknown) — a healed split-brain leader at an OLDER
+              # epoch learns it was deposed from the first HB
+              ("election_epoch", "u32"), ("leader_p1", "i32")]
 
 
 class MPaxosCommit(Message):
@@ -277,7 +282,17 @@ class MPaxosCommit(Message):
     peon adopt the CURRENT leader's state even at an equal version
     (split-brain heal)."""
     MSG_TYPE = 41
-    FIELDS = [("version", "u64"), ("state", "bytes"), ("rank", "i32")]
+    FIELDS = [("version", "u64"), ("state", "bytes"), ("rank", "i32"),
+              # appended (share_state role): when ``delta`` is
+              # non-empty the message carries only the chunks that
+              # CHANGED since ``base`` — a peon at base applies the
+              # delta; anyone else falls back to ``state`` or a pull
+              ("base", "u64"), ("delta", "bytes"),
+              # pn of the proposal being committed (0 = catch-up
+              # chain): a peon may commit its PENDING value only when
+              # both version AND pn match — a deposed leader's own
+              # pending at the same version must never slip in
+              ("pn", "u64")]
 
 
 class MPaxosPull(Message):
@@ -325,7 +340,10 @@ class MPaxosBegin(Message):
     leader's collect recover it."""
     MSG_TYPE = 47
     FIELDS = [("pn", "u64"), ("version", "u64"), ("state", "bytes"),
-              ("rank", "i32")]
+              ("rank", "i32"),
+              # appended (share_state role): delta vs ``base``; a
+              # peon at base reconstructs the full value locally
+              ("base", "u64"), ("delta", "bytes")]
 
 
 class MPaxosAccept(Message):
@@ -536,3 +554,20 @@ class MAuthRotating(Message):
 class MAuthRotatingReply(Message):
     MSG_TYPE = 64
     FIELDS = [("tid", "u64"), ("code", "i32"), ("sealed", "bytes")]
+
+
+class MMonElection(Message):
+    """Mon election rounds (src/mon/Elector.cc): op 1 = PROPOSE (a
+    candidate stands, advertising its commit progress), 2 = DEFER
+    (acknowledge a better candidate), 3 = VICTORY (the winner
+    announces the quorum; its epoch is the new even election epoch).
+    Candidates order by (last_committed, -rank): most-advanced first,
+    lowest rank breaking ties — a stale rejoiner can never win."""
+    MSG_TYPE = 65
+    FIELDS = [("op", "u8"), ("epoch", "u32"), ("rank", "i32"),
+              ("last_committed", "u64"), ("quorum", "i32_list")]
+
+
+ELECTION_PROPOSE = 1
+ELECTION_DEFER = 2
+ELECTION_VICTORY = 3

@@ -148,7 +148,27 @@ class Monitor:
         #: Paxos lease role; the leader's own lease is quorum
         #: visibility, see _lease_valid)
         self._lease_until = 0.0
+        #: COMMITTED state as a chunk table (per-value log transfer:
+        #: deltas are diffs of this table; see _state_chunks_of)
+        self._chunks: dict[str, bytes] = {}
+        #: wire accounting for the share_state discipline (tests
+        #: assert catch-up rides deltas, not snapshots)
+        self.paxos_stats = {"delta_sent": 0, "full_sent": 0,
+                            "delta_applied": 0, "full_applied": 0}
+        # -- elector state (src/mon/Elector.cc roles) --
+        #: active candidacy: {"epoch", "ts", "defers": set} while WE
+        #: stand in an election round
+        self._election: dict | None = None
+        #: sticky deferral for the current epoch: {"epoch", "rank",
+        #: "key"} — re-defer within an epoch only to a strictly
+        #: better candidate, so two majorities can never form
+        self._deferred: dict | None = None
+        #: the quorum the last victory announced (introspection)
+        self._quorum: list[int] = []
         self._replay()
+        self._chunks = self._state_chunks_of(
+            self.osdmap, self.ec_profiles, self._cmd_replies,
+            self._central_config)
 
     # -- lifecycle ----------------------------------------------------
     def prebind(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -161,7 +181,9 @@ class Monitor:
     def set_monmap(self, monmap: dict[int, str], rank: int) -> None:
         self.monmap = dict(monmap)
         self.rank = rank
-        self._leader_rank = min(self.monmap) if self.monmap else rank
+        # multi-mon: leadership is EARNED through an election round
+        # (propose/defer/victory), never assumed at boot
+        self._leader_rank = rank if len(self.monmap) <= 1 else -1
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         # the grace countdown for every replayed-up osd starts now: a
@@ -294,56 +316,218 @@ class Monitor:
                 alive[rank] = lc
         return alive
 
-    def _elect(self, now: float) -> None:
-        """Every mon independently derives the leader: most-advanced
-        commit log first (a stale rejoiner must not clobber newer
-        state), lowest rank second (the reference's Elector rule)."""
-        alive = self._alive_ranks(now)
-        if len(alive) < self._majority():
-            # no quorum visible: nobody may (re-)elect — a freshly
-            # revived or partitioned-minority mon seeing only itself
-            # must not take over and start collecting (the reference
-            # mon drops to probing without a quorum). An existing
-            # leader keeps its seat, but its proposals can never
-            # gather a quorum, so safety holds either way.
+    # -- elector (src/mon/Elector.cc propose/defer/victory) -----------
+    def _election_epoch(self) -> int:
+        raw = self.db.get("paxos/election_epoch")
+        return int(raw.decode()) if raw else 0
+
+    def _set_election_epoch(self, ep: int) -> None:
+        if ep <= self._election_epoch():
             return
-        new_leader = min(alive, key=lambda r: (-alive[r], r))
-        if new_leader != self._leader_rank:
-            log(1, f"mon.{self.name}: leader mon rank "
-                f"{self._leader_rank} -> {new_leader} "
-                f"(alive={sorted(alive)})")
-            was_leader = self._leader_rank == self.rank
-            self._leader_rank = new_leader
-            if was_leader and new_leader != self.rank:
-                # deposed: any in-flight proposal cannot be OUR
-                # commit any more (the successor may still complete
-                # it via collect; the replicated dedup then answers
-                # client retries)
+        batch = WriteBatch()
+        batch.put("paxos/election_epoch", str(ep).encode())
+        self.db.submit(batch, sync=True)
+
+    def _cand_key(self) -> tuple:
+        """Candidate ordering: most-advanced commit log first (a stale
+        rejoiner can never win), lowest rank breaking ties."""
+        return (self._last_committed(), -self.rank)
+
+    def _start_election(self, now: float) -> None:
+        ep = self._election_epoch()
+        ep = ep + 1 if ep % 2 == 0 else ep + 2   # next ODD epoch
+        self._set_election_epoch(ep)
+        self._election = {"epoch": ep, "ts": now,
+                          "defers": {self.rank}}
+        self._deferred = None
+        log(1, f"mon.{self.name}: proposing election epoch {ep}")
+        for rank, addr in self.monmap.items():
+            if rank != self.rank:
+                self.msgr.send_message(M.MMonElection(
+                    op=M.ELECTION_PROPOSE, epoch=ep, rank=self.rank,
+                    last_committed=self._last_committed()), addr)
+
+    def _handle_election(self, msg: M.MMonElection,
+                         now: float) -> None:
+        if msg.op == M.ELECTION_PROPOSE:
+            my_ep = self._election_epoch()
+            if msg.epoch < my_ep:
+                # stale candidate: educate it. A sitting leader
+                # re-asserts its victory; anyone else answers with a
+                # proposal at the current epoch height
+                addr = self.monmap.get(msg.rank)
+                if addr is None:
+                    return
+                if self.is_leader():
+                    self.msgr.send_message(M.MMonElection(
+                        op=M.ELECTION_VICTORY, epoch=my_ep,
+                        rank=self.rank, quorum=self._quorum), addr)
+                return
+            self._set_election_epoch(msg.epoch)
+            theirs = (msg.last_committed, -msg.rank)
+            mine = self._cand_key()
+            if theirs > mine:
+                # defer — STICKY within the epoch (re-defer only to a
+                # strictly better candidate, so no two candidates can
+                # both assemble a majority)
+                d = self._deferred
+                if d is not None and d["epoch"] == msg.epoch and \
+                        d["key"] >= theirs:
+                    return
+                self._deferred = {"epoch": msg.epoch,
+                                  "rank": msg.rank, "key": theirs,
+                                  "ts": now}
+                if self._election is not None and \
+                        self._election["epoch"] <= msg.epoch:
+                    self._election = None      # stand down
+                addr = self.monmap.get(msg.rank)
+                if addr:
+                    self.msgr.send_message(M.MMonElection(
+                        op=M.ELECTION_DEFER, epoch=msg.epoch,
+                        rank=self.rank,
+                        last_committed=self._last_committed()), addr)
+            else:
+                # we are the better candidate: contest this epoch.
+                # BROADCAST the candidacy (answering only the proposer
+                # would strand our defers at 1 while worse candidates
+                # keep churning epochs — the boot-race livelock)
+                if self._election is None or \
+                        self._election["epoch"] < msg.epoch:
+                    self._election = {"epoch": msg.epoch, "ts": now,
+                                      "defers": {self.rank}}
+                    for rank, addr in self.monmap.items():
+                        if rank != self.rank:
+                            self.msgr.send_message(M.MMonElection(
+                                op=M.ELECTION_PROPOSE,
+                                epoch=self._election["epoch"],
+                                rank=self.rank,
+                                last_committed=self._last_committed()),
+                                addr)
+                else:
+                    addr = self.monmap.get(msg.rank)
+                    if addr:
+                        self.msgr.send_message(M.MMonElection(
+                            op=M.ELECTION_PROPOSE,
+                            epoch=self._election["epoch"],
+                            rank=self.rank,
+                            last_committed=self._last_committed()),
+                            addr)
+        elif msg.op == M.ELECTION_DEFER:
+            el = self._election
+            if el is None or msg.epoch != el["epoch"]:
+                return
+            el["defers"].add(msg.rank)
+            self._maybe_win(now)
+        elif msg.op == M.ELECTION_VICTORY:
+            if msg.epoch < self._election_epoch():
+                return
+            if msg.epoch == self._election_epoch() and \
+                    self._leader_rank >= 0 and \
+                    msg.rank > self._leader_rank:
+                # equal-epoch victory collision (possible under an
+                # asymmetric partition where a mon deferred to two
+                # candidates): the LOWER-ranked winner prevails
+                # deterministically on every mon — the higher-ranked
+                # one deposes itself when it hears the lower victory,
+                # never the cross-deposition livelock
+                return
+            self._set_election_epoch(msg.epoch)
+            self._election = None
+            self._deferred = None
+            self._quorum = list(msg.quorum)
+            old = self._leader_rank
+            self._leader_rank = msg.rank
+            if old == self.rank and msg.rank != self.rank:
+                # deposed: any in-flight proposal cannot be OUR commit
+                # any more (the successor may still complete it via
+                # collect; the replicated dedup answers retries)
+                log(1, f"mon.{self.name}: deposed by election epoch "
+                    f"{msg.epoch} (leader rank {msg.rank})")
                 self._fail_proposal()
                 self._leader_pn = 0
                 self._collect = None
-            if new_leader == self.rank:
-                # taking over: (a) every up OSD gets a fresh beacon
-                # grace window — as a peon we forwarded beacons instead
-                # of recording them, so whatever is in _last_beacon is
-                # stale and would mark healthy OSDs down instantly;
-                # (b) push our state to every peer so a healed
-                # split-brain twin at an EQUAL version adopts the
-                # elected leader's truth; (c) run the collect phase to
-                # establish a pn and recover the predecessor's
-                # in-flight proposal (Paxos leader takeover)
-                for osd, info in self.osdmap.osds.items():
-                    if info.up:
-                        self._last_beacon[osd] = time.monotonic()
-                state = self._encode_state()
-                for rank, addr in self.monmap.items():
-                    if rank != self.rank:
-                        self.msgr.send_message(M.MPaxosCommit(
-                            version=self._last_committed(),
-                            state=state, rank=self.rank), addr)
-                self._leader_pn = 0
-                self._start_collect(now)
-        # lagging behind a live peer: pull its latest commit
+
+    def _maybe_win(self, now: float) -> None:
+        """Win once every mon we can SEE has deferred (dead mons are
+        excused; a live better candidate never defers, so it blocks
+        us exactly as it should). The election-timeout fallback in
+        _election_tick covers a wrong liveness view."""
+        el = self._election
+        if el is None or len(el["defers"]) < self._majority():
+            return
+        alive = set(self._alive_ranks(now))
+        if alive <= el["defers"]:
+            self._declare_victory(now)
+
+    def _declare_victory(self, now: float) -> None:
+        el = self._election
+        ep = el["epoch"] + 1                     # even: stable
+        self._set_election_epoch(ep)
+        self._election = None
+        self._deferred = None
+        self._quorum = sorted(el["defers"])
+        log(1, f"mon.{self.name}: election epoch {ep} won "
+            f"(quorum {self._quorum})")
+        for rank, addr in self.monmap.items():
+            if rank != self.rank:
+                self.msgr.send_message(M.MMonElection(
+                    op=M.ELECTION_VICTORY, epoch=ep, rank=self.rank,
+                    quorum=self._quorum), addr)
+        was_leader = self._leader_rank == self.rank
+        self._leader_rank = self.rank
+        if not was_leader:
+            # taking over: (a) every up OSD gets a fresh beacon grace
+            # window — as a peon we forwarded beacons instead of
+            # recording them; (b) push our state to every peer so a
+            # healed split-brain twin at an EQUAL version adopts the
+            # elected leader's truth; (c) run the collect phase to
+            # establish a pn and recover the predecessor's in-flight
+            # proposal (Paxos leader takeover)
+            for osd, info in self.osdmap.osds.items():
+                if info.up:
+                    self._last_beacon[osd] = time.monotonic()
+            state = self._encode_state()
+            for rank, addr in self.monmap.items():
+                if rank != self.rank:
+                    self.paxos_stats["full_sent"] += 1
+                    self.msgr.send_message(M.MPaxosCommit(
+                        version=self._last_committed(),
+                        state=state, rank=self.rank), addr)
+        self._leader_pn = 0
+        self._start_collect(now)
+
+    def _election_tick(self, now: float) -> None:
+        """Election upkeep + catch-up pull (runs from tick)."""
+        el = self._election
+        if el is not None:
+            # a mon that fell out of the alive view since our last
+            # defer may unblock the everyone-alive-deferred fast path
+            self._maybe_win(now)
+        el = self._election
+        if el is not None and \
+                now - el["ts"] > g_conf()["mon_election_timeout"]:
+            if len(el["defers"]) >= self._majority():
+                # window closed with a majority deferring and no
+                # better candidate surfaced: win (the equal-epoch
+                # tie-break above resolves the rare dual victory)
+                self._declare_victory(now)
+            else:
+                self._election = None        # round died: try again
+        alive = self._alive_ranks(now)
+        if self._election is None:
+            leader = self._leader_rank
+            no_leader = leader < 0 or (
+                leader != self.rank and leader not in alive)
+            # deferred recently: hold off — OUR candidate's round is
+            # in flight; re-proposing every tick would reset its
+            # election window forever (the boot-race livelock)
+            d = self._deferred
+            deferred_fresh = d is not None and \
+                now - d.get("ts", 0.0) < g_conf()["mon_election_timeout"]
+            if no_leader and not deferred_fresh and \
+                    len(alive) >= self._majority():
+                self._start_election(now)
+        # lagging behind a live peer: pull the missing values
         best = max(alive.values())
         if best > self._last_committed():
             ahead = min(r for r, lc in alive.items() if lc == best)
@@ -486,30 +670,50 @@ class Monitor:
                     done(True)
             self._pump_proposals(now)
             return
-        state = self._encode_state_of(*scratch)
-        self._begin(state, self._last_committed() + 1, scratch, dones)
+        chunks = self._state_chunks_of(*scratch)
+        self._begin(self._encode_chunks(chunks),
+                    self._last_committed() + 1, scratch, dones,
+                    chunks=chunks)
 
     def _begin(self, state: bytes, version: int, scratch,
-               entries: list) -> None:
+               entries: list, chunks=None) -> None:
         pn = self._leader_pn
         self._set_pending(pn, version, state)    # leader self-accept
+        # the VALUE travels as a delta against the committed chunk
+        # table (share_state discipline): quorum peons sit at our
+        # last_committed, reconstruct the full value locally, and the
+        # wire cost scales with the change, not the map
+        new_chunks = chunks if chunks is not None \
+            else self._decode_chunks(state)
+        delta = self._chunks_delta(new_chunks)
         self._proposal = {"pn": pn, "version": version, "state": state,
-                         "scratch": scratch, "entries": entries,
-                         "acks": {self.rank}, "ts": time.monotonic()}
+                          "chunks": new_chunks, "delta": delta,
+                          "scratch": scratch, "entries": entries,
+                          "acks": {self.rank}, "ts": time.monotonic()}
         if len(self._proposal["acks"]) >= self._majority():
             self._commit_proposal()              # single-mon fast path
             return
+        base = self._last_committed()
         for rank, addr in self.monmap.items():
             if rank != self.rank:
+                self.paxos_stats["delta_sent"] += 1
                 self.msgr.send_message(M.MPaxosBegin(
-                    pn=pn, version=version, state=state,
-                    rank=self.rank), addr)
+                    pn=pn, version=version, state=b"",
+                    rank=self.rank, base=base, delta=delta), addr)
 
     def _handle_begin(self, msg: M.MPaxosBegin) -> None:
-        ok = msg.pn >= self._accepted_pn() and \
+        state = msg.state
+        if not state and msg.delta:
+            if msg.base == self._last_committed():
+                self.paxos_stats["delta_applied"] += 1
+                state = self._encode_chunks(
+                    self._apply_delta_to(self._chunks, msg.delta))
+            # else: we lag the leader's base — cannot materialize the
+            # value; NAK below and catch up via pull
+        ok = bool(state) and msg.pn >= self._accepted_pn() and \
             msg.version > self._last_committed()
         if ok:
-            self._set_pending(msg.pn, msg.version, msg.state)
+            self._set_pending(msg.pn, msg.version, state)
         addr = self.monmap.get(msg.rank)
         if addr:
             self.msgr.send_message(M.MPaxosAccept(
@@ -543,21 +747,32 @@ class Monitor:
         prop = self._proposal
         self._proposal = None
         version, state = prop["version"], prop["state"]
+        base = self._last_committed()
         (self.osdmap, self.ec_profiles, self._cmd_replies,
          self._central_config) = prop["scratch"]
+        delta = prop.get("delta") or self._chunks_delta(
+            prop.get("chunks") or self._decode_chunks(state))
         batch = WriteBatch()
         batch.put(f"paxos/{version:016d}", state)
+        batch.put(f"paxos/delta/{version:016d}", delta)
         batch.put("paxos/last_committed", str(version).encode())
         batch.delete("paxos/pending")
         self.db.submit(batch, sync=True)
+        self._chunks = prop.get("chunks") or \
+            self._decode_chunks(state)
+        self._trim_values(version)
         log(10, f"committed version {version} "
             f"(epoch {self.osdmap.epoch})")
         self._publish()
         for rank, addr in self.monmap.items():
             if rank != self.rank:
+                # the commit is DELTA-sized: quorum peons hold the
+                # full value as pending (from the begin) or sit at
+                # base and apply the delta; stragglers pull
+                self.paxos_stats["delta_sent"] += 1
                 self.msgr.send_message(M.MPaxosCommit(
-                    version=version, state=state, rank=self.rank),
-                    addr)
+                    version=version, state=b"", rank=self.rank,
+                    base=base, delta=delta, pn=prop["pn"]), addr)
         for done in prop["entries"]:
             if done is not None:
                 done(True)
@@ -577,10 +792,12 @@ class Monitor:
                 done(False)
 
     def _apply_remote_commit(self, msg: M.MPaxosCommit) -> None:
-        """Adopt a commit from a more advanced mon. States are full
-        snapshots, so any newer version applies directly. An EQUAL
-        version from the mon we recognize as leader also applies —
-        that heals a split-brain where both sides committed the same
+        """Adopt a commit from a more advanced mon. The common case is
+        DELTA-sized (share_state): our pending value from the begin
+        phase IS the full value, or the delta applies to our chunk
+        table at ``base``. Full snapshots heal everything else. An
+        EQUAL version from the mon we recognize as leader also applies
+        — that heals a split-brain where both sides committed the same
         version number with different states."""
         if msg.version < self._last_committed():
             return
@@ -591,20 +808,68 @@ class Monitor:
         if msg.version == self._last_committed() and (
                 self.is_leader() or msg.rank != self._leader_rank):
             return
-        self._adopt_state(msg.version, msg.state)
+        state = msg.state
+        if not state:
+            pend = self._pending()
+            if pend is not None and pend[1] == msg.version and \
+                    msg.pn and pend[0] == msg.pn:
+                # we durably accepted this exact PROPOSAL (version AND
+                # pn match) in the begin phase: commit what we hold —
+                # a deposed leader's own same-version pending never
+                # matches the majority's pn and falls through
+                state = pend[2]
+                self.paxos_stats["delta_applied"] += 1
+            elif msg.delta and msg.base == self._last_committed():
+                state = self._encode_chunks(
+                    self._apply_delta_to(self._chunks, msg.delta))
+                self.paxos_stats["delta_applied"] += 1
+            else:
+                # can't materialize the value: we lag — pull a
+                # catch-up chain from the committer
+                addr = self.monmap.get(msg.rank)
+                if addr:
+                    self.msgr.send_message(M.MPaxosPull(
+                        rank=self.rank,
+                        from_version=self._last_committed()), addr)
+                return
+        else:
+            self.paxos_stats["full_applied"] += 1
+        if msg.version == self._last_committed():
+            # split-brain heal at an equal version: equal-version
+            # deltas don't exist; only full states land here
+            pass
+        self._adopt_state(msg.version, state)
 
     def _adopt_state(self, version: int, state: bytes) -> None:
-        """Install a committed snapshot (remote commit / catch-up /
+        """Install a committed value (remote commit / catch-up /
         collect recovery). Caller holds the lock."""
-        (self.osdmap, self.ec_profiles, self._cmd_replies,
-         self._central_config) = self._decode_state(state)
+        new_chunks = self._decode_chunks(state)
         batch = WriteBatch()
         batch.put(f"paxos/{version:016d}", state)
+        if version == self._last_committed() + 1:
+            # contiguous: record the per-value delta so WE can serve
+            # delta catch-up chains to mons behind us
+            batch.put(f"paxos/delta/{version:016d}",
+                      self._chunks_delta(new_chunks))
+        else:
+            # equal-version heal or snapshot jump: any delta we
+            # recorded for this version described a DIFFERENT history
+            # — serving it to a puller would fork the quorum's state
+            batch.delete(f"paxos/delta/{version:016d}")
+            # and everything below is unservable as a chain anyway
+            # (we never held the intermediate deltas): advance the
+            # trim floor so _trim_values stays O(actual log)
+            if version > self._trim_floor():
+                batch.put("paxos/trimmed_to", str(version).encode())
         batch.put("paxos/last_committed", str(version).encode())
         pend = self._pending()
         if pend is not None and pend[1] <= version:
             batch.delete("paxos/pending")    # superseded
         self.db.submit(batch, sync=True)
+        (self.osdmap, self.ec_profiles, self._cmd_replies,
+         self._central_config) = self._state_from_chunks(new_chunks)
+        self._chunks = new_chunks
+        self._trim_values(version)
         log(10, f"mon.{self.name}: adopted commit v{version} "
             f"(epoch {self.osdmap.epoch})")
         self._publish()
@@ -622,36 +887,142 @@ class Monitor:
                                     self._cmd_replies,
                                     self._central_config)
         self._last_state_bytes = len(raw)
-        if len(raw) > self.STATE_SIZE_WARN and \
-                not Monitor._state_size_warned:
-            Monitor._state_size_warned = True
-            log(0, f"mon.{self.name}: replicated state is "
-                f"{len(raw) >> 20} MiB — full-snapshot commit "
-                "replication is O(state) per commit per peon; the "
-                "per-value log transfer rework (Paxos.cc share_state "
-                "role) is due")
         return raw
 
+    # -- chunked state + per-value deltas (Paxos.cc share_state role) -
+    # The replicated state is a CHUNK TABLE (osdmap chunks per osd /
+    # pool / crush / meta, plus profiles, config, and one chunk per
+    # dedup reply). A committed value's wire form is the DELTA —
+    # chunks changed/removed since the previous version — so commit
+    # replication and catch-up cost scale with the change, not the
+    # map. Full snapshots (the encoded chunk table) remain the
+    # bootstrap / trimmed-log fallback.
+
     @staticmethod
-    def _encode_state_of(osdmap, ec_profiles, cmd_replies,
+    def _state_chunks_of(osdmap, ec_profiles, cmd_replies,
+                         central_config) -> dict[str, bytes]:
+        chunks = {f"map/{k}": v
+                  for k, v in osdmap.to_chunks().items()}
+        chunks["profiles"] = json.dumps(ec_profiles,
+                                        sort_keys=True).encode()
+        chunks["config"] = json.dumps(central_config,
+                                      sort_keys=True).encode()
+        for k, v in cmd_replies.items():
+            chunks[f"reply/{k}"] = json.dumps(
+                v, sort_keys=True).encode()
+        return chunks
+
+    @staticmethod
+    def _state_from_chunks(chunks: dict[str, bytes]):
+        osdmap = OSDMap.from_chunks(
+            {k[4:]: v for k, v in chunks.items()
+             if k.startswith("map/")})
+        profiles = json.loads(chunks.get("profiles", b"{}"))
+        config = json.loads(chunks.get("config", b"{}"))
+        replies = {k[6:]: json.loads(v) for k, v in chunks.items()
+                   if k.startswith("reply/")}
+        return osdmap, profiles, replies, config
+
+    @classmethod
+    def _encode_state_of(cls, osdmap, ec_profiles, cmd_replies,
                          central_config) -> bytes:
+        return cls._encode_chunks(cls._state_chunks_of(
+            osdmap, ec_profiles, cmd_replies, central_config))
+
+    @classmethod
+    def _decode_state(cls, raw: bytes):
+        return cls._state_from_chunks(cls._decode_chunks(raw))
+
+    @staticmethod
+    def _encode_chunks(chunks: dict[str, bytes]) -> bytes:
         from ceph_tpu.utils.encoding import Encoder
         e = Encoder()
-        e.bytes(osdmap.encode())
-        e.str(json.dumps(ec_profiles))
-        e.str(json.dumps(cmd_replies))
-        e.str(json.dumps(central_config))
+        e.map(chunks, Encoder.str, Encoder.bytes)
         return e.getvalue()
 
     @staticmethod
-    def _decode_state(raw: bytes):
+    def _decode_chunks(raw: bytes) -> dict[str, bytes]:
+        from ceph_tpu.utils.encoding import Decoder
+        return Decoder(raw).map(Decoder.str, Decoder.bytes)
+
+    @staticmethod
+    def _encode_delta(changed: dict[str, bytes],
+                      removed: list[str]) -> bytes:
+        from ceph_tpu.utils.encoding import Encoder
+        e = Encoder()
+        e.map(changed, Encoder.str, Encoder.bytes)
+        e.list(sorted(removed), Encoder.str)
+        return e.getvalue()
+
+    @staticmethod
+    def _decode_delta(raw: bytes) -> tuple[dict[str, bytes],
+                                           list[str]]:
         from ceph_tpu.utils.encoding import Decoder
         d = Decoder(raw)
-        osdmap = OSDMap.decode(d.bytes())
-        profiles = json.loads(d.str())
-        replies = json.loads(d.str()) if not d.eof() else {}
-        config = json.loads(d.str()) if not d.eof() else {}
-        return osdmap, profiles, replies, config
+        return d.map(Decoder.str, Decoder.bytes), d.list(Decoder.str)
+
+    def _chunks_delta(self, new_chunks: dict[str, bytes]) -> bytes:
+        """Delta from the committed chunk table to ``new_chunks``."""
+        old = self._chunks
+        changed = {k: v for k, v in new_chunks.items()
+                   if old.get(k) != v}
+        removed = [k for k in old if k not in new_chunks]
+        return self._encode_delta(changed, removed)
+
+    def _apply_delta_to(self, chunks: dict[str, bytes],
+                        delta: bytes) -> dict[str, bytes]:
+        changed, removed = self._decode_delta(delta)
+        out = dict(chunks)
+        out.update(changed)
+        for k in removed:
+            out.pop(k, None)
+        return out
+
+    #: per-value log length (mon_max_log_epochs role): catch-up below
+    #: the floor falls back to a full snapshot
+    PAXOS_KEEP = 512
+
+    def _trim_floor(self) -> int:
+        raw = self.db.get("paxos/trimmed_to")
+        return int(raw.decode()) if raw else 0
+
+    def _trim_values(self, version: int) -> None:
+        """Drop values/deltas older than PAXOS_KEEP (Paxos::trim):
+        the log stays bounded; deep catch-up uses a snapshot."""
+        floor = self._trim_floor()
+        new_floor = version - self.PAXOS_KEEP
+        if new_floor <= floor:
+            return
+        batch = WriteBatch()
+        for v in range(floor, new_floor):
+            batch.delete(f"paxos/{v:016d}")
+            batch.delete(f"paxos/delta/{v:016d}")
+        batch.put("paxos/trimmed_to", str(new_floor).encode())
+        self.db.submit(batch)
+
+    def _send_catchup(self, peer: str, from_version: int) -> None:
+        """share_state: send the missing committed values as a chain
+        of per-value deltas (each tiny); a gap (trimmed / adopted
+        non-contiguously) falls back to ONE full snapshot."""
+        lc = self._last_committed()
+        deltas = []
+        for v in range(from_version + 1, lc + 1):
+            d = self.db.get(f"paxos/delta/{v:016d}")
+            if d is None:
+                deltas = None
+                break
+            deltas.append((v, d))
+        if deltas is None:
+            self.paxos_stats["full_sent"] += 1
+            self.msgr.send_message(M.MPaxosCommit(
+                version=lc, state=self._encode_state(),
+                rank=self.rank), peer)
+            return
+        for v, d in deltas:
+            self.paxos_stats["delta_sent"] += 1
+            self.msgr.send_message(M.MPaxosCommit(
+                version=v, state=b"", rank=self.rank,
+                base=v - 1, delta=d), peer)
 
     def _replay(self) -> None:
         last = self._last_committed()
@@ -700,6 +1071,24 @@ class Monitor:
                 self._peer_seen[msg.rank] = (now, msg.last_committed)
                 if msg.addr:     # revived mons rebind to a new port
                     self.monmap[msg.rank] = msg.addr
+                if msg.election_epoch > self._election_epoch():
+                    # the cluster elected past us (healed partition /
+                    # long sleep): adopt the newer epoch's view; a
+                    # stale "leader" deposes itself here
+                    self._set_election_epoch(msg.election_epoch)
+                    self._election = None
+                    self._deferred = None
+                    new_leader = msg.leader_p1 - 1
+                    if new_leader >= 0:
+                        old = self._leader_rank
+                        self._leader_rank = new_leader
+                        if old == self.rank and \
+                                new_leader != self.rank:
+                            log(1, f"mon.{self.name}: deposed (saw "
+                                f"election epoch {msg.election_epoch})")
+                            self._fail_proposal()
+                            self._leader_pn = 0
+                            self._collect = None
                 if msg.rank == self._leader_rank and \
                         msg.rank != self.rank and msg.lease > 0 and \
                         msg.last_committed <= self._last_committed():
@@ -711,6 +1100,9 @@ class Monitor:
                     # of us grants nothing either (we are stale; the
                     # elect pump pulls its commit first).
                     self._lease_until = now + msg.lease
+                return
+            if isinstance(msg, M.MMonElection):
+                self._handle_election(msg, time.monotonic())
                 return
             if isinstance(msg, M.MPaxosCommit):
                 # the committer provably has this version: advance our
@@ -736,10 +1128,7 @@ class Monitor:
             if isinstance(msg, M.MPaxosPull):
                 peer = self.monmap.get(msg.rank)
                 if peer and self._last_committed() > msg.from_version:
-                    self.msgr.send_message(M.MPaxosCommit(
-                        version=self._last_committed(),
-                        state=self._encode_state(),
-                        rank=self.rank), peer)
+                    self._send_catchup(peer, msg.from_version)
                 return
             if isinstance(msg, M.MAuth):
                 self._handle_auth(msg, conn)
@@ -775,8 +1164,12 @@ class Monitor:
             elif isinstance(msg, (M.MOSDBoot, M.MOSDFailure,
                                   M.MOSDAlive)) and not self.is_leader():
                 # only the leader mutates cluster state; relay the
-                # report to it (the reference forwards to the leader)
-                self.msgr.send_message(msg, self.leader_addr())
+                # report to it (the reference forwards to the leader).
+                # No leader yet (election in flight): DROP — relaying
+                # to leader_addr's self-fallback would loop the
+                # message back to us forever; daemons re-send
+                if self._leader_rank >= 0:
+                    self.msgr.send_message(msg, self.leader_addr())
             elif isinstance(msg, M.MOSDBoot):
                 self._enqueue_mutation(
                     lambda: self._handle_boot(msg, conn))
@@ -1005,9 +1398,11 @@ class Monitor:
                     self.msgr.send_message(M.MMonHB(
                         rank=self.rank, name=self.name,
                         last_committed=self._last_committed(),
-                        addr=self.addr, lease=grant), addr)
+                        addr=self.addr, lease=grant,
+                        election_epoch=self._election_epoch(),
+                        leader_p1=self._leader_rank + 1), addr)
             if len(self.monmap) > 1:
-                self._elect(now)
+                self._election_tick(now)
             # paxos upkeep: a proposal that cannot gather a quorum
             # (minority leader, fenced pn) times out WITHOUT touching
             # state; a stalled collect retries; queued mutations that

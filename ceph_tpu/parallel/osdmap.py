@@ -15,6 +15,7 @@ Mapping pipeline (as in the reference):
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 
 from ceph_tpu.parallel import crush
@@ -265,6 +266,78 @@ class OSDMap:
                                 en.u64(p.target_max_bytes)))
         e.section(4, body)
         return e.getvalue()
+
+    # -- chunked encoding (per-value Paxos log / share_state role) ----
+    # The mon's delta replication diffs states at CHUNK granularity:
+    # one chunk per OSD, per pool, plus crush and a small meta chunk —
+    # an osd flap or pool create touches one tiny chunk, so a commit's
+    # wire cost scales with the CHANGE, not the map. Keep these in
+    # step with encode()/decode() above when fields are added.
+    def to_chunks(self) -> dict[str, bytes]:
+        from dataclasses import asdict
+        ch: dict[str, bytes] = {}
+        for oid, info in self.osds.items():
+            ch[f"osd/{oid}"] = json.dumps(asdict(info),
+                                          sort_keys=True).encode()
+        for pid, p in self.pools.items():
+            ch[f"pool/{pid}"] = json.dumps(asdict(p),
+                                           sort_keys=True).encode()
+        ch["crush"] = json.dumps({
+            "buckets": {str(b.id): [b.name, b.type, b.items,
+                                           b.weights]
+                        for b in self.crush.buckets.values()},
+            "devices": {str(k): v
+                        for k, v in self.crush.device_weights.items()},
+            "rules": {n: [r.root, r.failure_domain, r.mode]
+                      for n, r in self.crush.rules.items()},
+        }, sort_keys=True).encode()
+        ch["meta"] = json.dumps({
+            "epoch": self.epoch,
+            "next_pool_id": self._next_pool_id,
+            "pg_temp": {f"{k[0]}.{k[1]}": v
+                        for k, v in self.pg_temp.items()},
+            "upmap": {f"{k[0]}.{k[1]}": v
+                      for k, v in self.pg_upmap_items.items()},
+        }, sort_keys=True).encode()
+        return ch
+
+    @classmethod
+    def from_chunks(cls, ch: dict[str, bytes]) -> "OSDMap":
+        m = cls()
+        meta = json.loads(ch["meta"])
+        m.epoch = meta["epoch"]
+        m._next_pool_id = meta["next_pool_id"]
+        m.pg_temp = {tuple(int(x) for x in k.split(".")): v
+                     for k, v in meta["pg_temp"].items()}
+        m.pg_upmap_items = {
+            tuple(int(x) for x in k.split(".")):
+                [tuple(p) for p in v]
+            for k, v in meta["upmap"].items()}
+        cr = json.loads(ch["crush"])
+        for bid_s, (name, btype, items, weights) in \
+                cr["buckets"].items():
+            bid = int(bid_s)
+            m.crush.buckets[bid] = crush.Bucket(bid, name, btype,
+                                                items, weights)
+            m.crush.by_name[name] = bid
+            m.crush._next_bucket_id = min(m.crush._next_bucket_id,
+                                          bid - 1)
+        m.crush.device_weights = {int(k): v
+                                  for k, v in cr["devices"].items()}
+        for n, (root, fd, mode) in cr["rules"].items():
+            m.crush.rules[n] = crush.Rule(n, root, fd, mode)
+        for name, raw in ch.items():
+            if name.startswith("osd/"):
+                d = json.loads(raw)
+                m.osds[int(name[4:])] = OSDInfo(**d)
+            elif name.startswith("pool/"):
+                d = json.loads(raw)
+                d["snaps"] = {int(k): v
+                              for k, v in d["snaps"].items()}
+                m.pools[int(name[5:])] = PoolInfo(**d)
+        for pid, p in m.pools.items():
+            m.pool_by_name[p.name] = pid
+        return m
 
     @classmethod
     def decode(cls, buf: bytes) -> "OSDMap":

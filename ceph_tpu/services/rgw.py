@@ -593,8 +593,11 @@ class RGWGateway:
                     raise RGWError(404, "NoSuchKey")
                 applied_pair = self._advance_pair(bucket, key, pair)
                 if applied_pair is None and pair is not None:
-                    return None    # remote delete lost the conflict:
-                    # a newer local write keeps the object
+                    # remote delete lost the conflict: a newer local
+                    # write keeps the object. Distinguishable from
+                    # success so the sync agent's applied count stays
+                    # truthful (only the agent ever passes a pair)
+                    raise RGWError(409, "RemoteStale")
             self._index_rm(bucket, key)
             StripedObject(self.io, f"{bucket}/{key}").remove()
             if _log:

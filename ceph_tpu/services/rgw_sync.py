@@ -130,16 +130,12 @@ class RGWSyncAgent:
                 version_id=vid, pair=pair,
                 origin=origin) is not None
         elif ent["op"] == "del":
-            if pair is not None and not self.dst._pair_wins(
-                    pair, self.dst._get_pair(bucket, ent["key"])):
-                return False    # conflict loss: dst keeps its newer
-                # object (delete_object returns None either way, so
-                # the applied count needs this explicit check)
             try:
                 self.dst.delete_object(bucket, ent["key"],
                                        pair=pair, origin=origin)
             except RGWError:
-                return False    # already absent: idempotent
+                return False    # absent (idempotent) or conflict
+                # loss (RemoteStale) — either way nothing mutated
         elif ent["op"] == "dm":
             try:
                 self.dst.delete_object(bucket, ent["key"],

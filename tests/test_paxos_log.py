@@ -1,0 +1,184 @@
+"""Per-value Paxos log (Paxos.cc share_state role) + real Elector
+(src/mon/Elector.cc propose/defer/victory): commit replication and
+rejoin catch-up ride per-value DELTAS sized by the change, and
+leadership moves through election epochs."""
+
+import time
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.utils.config import g_conf
+
+
+@pytest.fixture
+def fast():
+    conf = g_conf()
+    keys = ("osd_heartbeat_interval", "osd_heartbeat_grace",
+            "mon_election_timeout", "mon_commit_timeout")
+    old = {k: conf[k] for k in keys}
+    conf.set("osd_heartbeat_interval", 0.25)
+    conf.set("osd_heartbeat_grace", 2.0)
+    conf.set("mon_election_timeout", 0.8)
+    conf.set("mon_commit_timeout", 1.5)
+    yield
+    for k, v in old.items():
+        conf.set(k, v)
+
+
+def _wait(pred, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(msg)
+
+
+def test_rejoin_catchup_rides_deltas_not_snapshots(fast):
+    """Partition one mon away, commit K map changes, heal: the
+    laggard catches up via K per-value deltas; nobody ships a
+    snapshot (the share_state discipline)."""
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        cluster.create_pool("base", pg_num=2, size=2)
+        _wait(lambda: len({m._last_committed()
+                           for m in cluster.mons.values()}) == 1)
+        mons = cluster.mons
+        full_before = {r: m.paxos_stats["full_sent"]
+                       for r, m in mons.items()}
+        lagger = mons[2]
+        applied_before = dict(lagger.paxos_stats)
+        cluster.partition_mons([0, 1], [2])
+        for i in range(5):
+            code, outs, _ = cluster.mon_cmd(
+                prefix="osd pool create", pool=f"delta{i}",
+                pg_num=2, size=2)
+            assert code == 0, outs
+        cluster.heal_mons()
+        _wait(lambda: lagger._last_committed() ==
+              mons[0]._last_committed(),
+              msg="laggard never caught up")
+        assert all(f"delta{i}" in lagger.osdmap.pool_by_name
+                   for i in range(5))
+        # the catch-up was DELTA transfer: the laggard applied >= 5
+        # deltas and zero snapshots; no mon shipped a snapshot
+        d_applied = lagger.paxos_stats["delta_applied"] - \
+            applied_before["delta_applied"]
+        f_applied = lagger.paxos_stats["full_applied"] - \
+            applied_before["full_applied"]
+        assert d_applied >= 5, d_applied
+        assert f_applied == 0, f_applied
+        for r, m in mons.items():
+            assert m.paxos_stats["full_sent"] == full_before[r], \
+                f"mon rank {r} shipped a snapshot during catch-up"
+
+
+def test_steady_state_commits_are_delta_replicated(fast):
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        cluster.create_pool("p0", pg_num=2, size=2)
+        _wait(lambda: len({m._last_committed()
+                           for m in cluster.mons.values()}) == 1)
+        peons = [m for m in cluster.mons.values()
+                 if not m.is_leader()]
+        leader = next(m for m in cluster.mons.values()
+                      if m.is_leader())
+        before = [dict(p.paxos_stats) for p in peons]
+        full_before = leader.paxos_stats["full_sent"]
+        for i in range(3):
+            code, _, _ = cluster.mon_cmd(
+                prefix="osd pool create", pool=f"st{i}", pg_num=2,
+                size=2)
+            assert code == 0
+        _wait(lambda: len({m._last_committed()
+                           for m in cluster.mons.values()}) == 1)
+        assert leader.paxos_stats["full_sent"] == full_before
+        for p, b in zip(peons, before):
+            assert p.paxos_stats["delta_applied"] > \
+                b["delta_applied"]
+            assert p.paxos_stats["full_applied"] == b["full_applied"]
+
+
+def test_trimmed_log_falls_back_to_snapshot(fast):
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        for m in cluster.mons.values():
+            m.PAXOS_KEEP = 3               # tiny log for the test
+        cluster.create_pool("base", pg_num=2, size=2)
+        _wait(lambda: len({m._last_committed()
+                           for m in cluster.mons.values()}) == 1)
+        lagger = cluster.mons[2]
+        cluster.partition_mons([0, 1], [2])
+        for i in range(6):                 # > PAXOS_KEEP: log trims
+            code, _, _ = cluster.mon_cmd(
+                prefix="osd pool create", pool=f"tr{i}", pg_num=2,
+                size=2)
+            assert code == 0
+        leader = next(m for m in cluster.mons.values()
+                      if m.is_leader())
+        assert leader._trim_floor() > 0    # the log really trimmed
+        before_full = lagger.paxos_stats["full_applied"]
+        cluster.heal_mons()
+        _wait(lambda: lagger._last_committed() ==
+              leader._last_committed(),
+              msg="laggard never caught up past the trim")
+        assert all(f"tr{i}" in lagger.osdmap.pool_by_name
+                   for i in range(6))
+        assert lagger.paxos_stats["full_applied"] > before_full
+
+
+def test_election_epochs_advance_through_failover(fast):
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        mons = cluster.mons
+        # stable: every mon agrees on an EVEN epoch and the quorum
+        _wait(lambda: len({m._election_epoch()
+                           for m in mons.values()}) == 1)
+        ep0 = mons[0]._election_epoch()
+        assert ep0 % 2 == 0 and ep0 > 0
+        leader = next(m for m in mons.values() if m.is_leader())
+        assert sorted(leader._quorum) == [0, 1, 2]
+        # kill the leader: the survivors elect through a NEWER epoch
+        dead = leader.rank
+        cluster.kill_mon(dead)
+        _wait(lambda: sum(m.is_leader() for r, m in
+                          cluster.mons.items() if r != dead) == 1,
+              msg="no successor elected")
+        successor = next(m for r, m in cluster.mons.items()
+                         if r != dead and m.is_leader())
+        ep1 = successor._election_epoch()
+        assert ep1 > ep0 and ep1 % 2 == 0
+        assert dead not in successor._quorum
+        # commits still flow under the new reign
+        code, outs, _ = cluster.mon_cmd(
+            prefix="osd pool create", pool="after", pg_num=2, size=2)
+        assert code == 0, outs
+
+
+def test_healed_stale_leader_deposes_on_epoch(fast):
+    """An isolated old leader must step down the moment it hears a
+    NEWER election epoch — no dual-leader window survives a heal."""
+    with MiniCluster(n_osds=2, n_mons=3) as cluster:
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1)
+        leader = next(m for m in cluster.mons.values()
+                      if m.is_leader())
+        others = [r for r in cluster.mons if r != leader.rank]
+        cluster.partition_mons([leader.rank], others)
+        # majority side elects a new reign
+        _wait(lambda: sum(cluster.mons[r].is_leader()
+                          for r in others) == 1,
+              msg="majority never elected")
+        assert leader.is_leader()          # stale belief, minority
+        cluster.heal_mons()
+        _wait(lambda: sum(m.is_leader() for m in
+                          cluster.mons.values()) == 1,
+              msg="dual leaders survived the heal")
+        assert not leader.is_leader() or \
+            all(m._leader_rank == leader.rank
+                for m in cluster.mons.values())
