@@ -16,14 +16,15 @@ writes it back through the normal versioned replication path. The PG
 executes ops serially, so read-modify-write methods are atomic exactly
 like the reference's cls handlers.
 
-Built-in families mirror 14 of the reference's 17 cls modules:
+Built-in families mirror 15 of the reference's 17 cls modules:
 lock, log, version, refcount, numops, timeindex, statelog, hello,
 rgw (bucket index + multipart), rbd (image directory), user (rgw
-account stats), cas (dedup chunk refs), otp (in-OSD TOTP), and fs
-(the cephfs dirop/ino methods, src/cls/cephfs role). Deliberate
-cuts: ``lua`` (no Lua runtime in this image), ``sdk`` (a reference
-test scaffold), ``journal`` (our journal keeps its state in plain
-objects + omap; see services/journal.py).
+account stats), cas (dedup chunk refs), otp (in-OSD TOTP), fs
+(the cephfs dirop/ino methods, src/cls/cephfs role), and journal
+(client registry / commit positions / trim floor,
+src/cls/journal/cls_journal.cc — the client-side Journaler drives
+these, the reference's layering). Deliberate cuts: ``lua`` (no Lua
+runtime in this image), ``sdk`` (a reference test scaffold).
 """
 
 from __future__ import annotations
@@ -309,3 +310,83 @@ def _fs_dir_unlink(inp: bytes, obj: bytes | None):
 # cls_timeindex, cls_statelog, cls_hello) live in classes.py — split
 # so this framework file stays readable
 from ceph_tpu.cls import classes as _classes  # noqa: E402,F401
+
+
+# -- cls_journal (src/cls/journal/cls_journal.cc role) -----------------
+# The journal's CONTROL PLANE lives in-OSD: client registry, per-client
+# commit positions, and the trim floor mutate atomically under the PG
+# lock, exactly as the reference's Journaler drives cls_journal. Data
+# chunks stay ordinary objects (services/journal.py).
+
+def _journal_meta(obj: bytes | None) -> dict:
+    if not obj:
+        return {"clients": {}, "minimum": 0}
+    return json.loads(obj)
+
+
+@register("journal", "client_register")
+def _journal_client_register(inp: bytes, obj: bytes | None):
+    """input {"id"}: add a client at position 0. Registering an
+    ACTIVE id again is idempotent-ok (a restarted consumer); a
+    RETIRED id stays retired (-EEXIST) — resurrecting it would
+    re-pin the trim floor the unregister released."""
+    req = json.loads(inp)
+    meta = _journal_meta(obj)
+    ent = meta["clients"].get(req["id"])
+    if ent is not None:
+        if ent.get("retired"):
+            return -17, b"", None          # -EEXIST
+        return 0, b"", None                # already active: no-op
+    meta["clients"][req["id"]] = {"pos": 0}
+    return 0, b"", json.dumps(meta).encode()
+
+
+@register("journal", "client_commit")
+def _journal_client_commit(inp: bytes, obj: bytes | None):
+    """input {"id", "pos"}: advance (monotonically) the client's
+    commit position; -ENOENT for unknown/retired clients."""
+    req = json.loads(inp)
+    meta = _journal_meta(obj)
+    ent = meta["clients"].get(req["id"])
+    if ent is None or ent.get("retired"):
+        return -2, b"", None
+    pos = int(req["pos"])
+    if pos <= ent["pos"]:
+        return 0, b"", None                # stale: no regression
+    ent["pos"] = pos
+    return 0, b"", json.dumps(meta).encode()
+
+
+@register("journal", "client_unregister")
+def _journal_client_unregister(inp: bytes, obj: bytes | None):
+    """input {"id"}: retire a client for good — its position stops
+    pinning trim, and the id can never resurrect (tombstone)."""
+    req = json.loads(inp)
+    meta = _journal_meta(obj)
+    ent = meta["clients"].get(req["id"])
+    if ent is None:
+        return -2, b"", None
+    meta["clients"][req["id"]] = {"retired": True}
+    return 0, b"", json.dumps(meta).encode()
+
+
+@register("journal", "client_list")
+def _journal_client_list(inp: bytes, obj: bytes | None):
+    meta = _journal_meta(obj)
+    return 0, json.dumps({
+        "clients": {cid: ent["pos"]
+                    for cid, ent in meta["clients"].items()
+                    if not ent.get("retired")},
+        "minimum": meta.get("minimum", 0)}).encode(), None
+
+
+@register("journal", "set_minimum")
+def _journal_set_minimum(inp: bytes, obj: bytes | None):
+    """input {"pos"}: advance the trim floor (monotonic)."""
+    req = json.loads(inp)
+    meta = _journal_meta(obj)
+    pos = int(req["pos"])
+    if pos <= meta.get("minimum", 0):
+        return 0, b"", None
+    meta["minimum"] = pos
+    return 0, b"", json.dumps(meta).encode()

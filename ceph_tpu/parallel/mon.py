@@ -353,8 +353,10 @@ class Monitor:
             my_ep = self._election_epoch()
             if msg.epoch < my_ep:
                 # stale candidate: educate it. A sitting leader
-                # re-asserts its victory; anyone else answers with a
-                # proposal at the current epoch height
+                # re-asserts its victory; a mon that is itself mid-
+                # election answers with its candidacy at the current
+                # height; a settled peon stays quiet (the rejoiner
+                # converges via the HB election-epoch sync)
                 addr = self.monmap.get(msg.rank)
                 if addr is None:
                     return
@@ -362,6 +364,12 @@ class Monitor:
                     self.msgr.send_message(M.MMonElection(
                         op=M.ELECTION_VICTORY, epoch=my_ep,
                         rank=self.rank, quorum=self._quorum), addr)
+                elif self._election is not None:
+                    self.msgr.send_message(M.MMonElection(
+                        op=M.ELECTION_PROPOSE,
+                        epoch=self._election["epoch"],
+                        rank=self.rank,
+                        last_committed=self._last_committed()), addr)
                 return
             self._set_election_epoch(msg.epoch)
             theirs = (msg.last_committed, -msg.rank)
@@ -816,9 +824,9 @@ class Monitor:
                 # we durably accepted this exact PROPOSAL (version AND
                 # pn match) in the begin phase: commit what we hold —
                 # a deposed leader's own same-version pending never
-                # matches the majority's pn and falls through
+                # matches the majority's pn and falls through.
+                # (_handle_begin already counted the delta apply)
                 state = pend[2]
-                self.paxos_stats["delta_applied"] += 1
             elif msg.delta and msg.base == self._last_committed():
                 state = self._encode_chunks(
                     self._apply_delta_to(self._chunks, msg.delta))
@@ -834,10 +842,8 @@ class Monitor:
                 return
         else:
             self.paxos_stats["full_applied"] += 1
-        if msg.version == self._last_committed():
-            # split-brain heal at an equal version: equal-version
-            # deltas don't exist; only full states land here
-            pass
+        # (an equal-version split-brain heal can only arrive as a full
+        # state — equal-version deltas don't exist)
         self._adopt_state(msg.version, state)
 
     def _adopt_state(self, version: int, state: bytes) -> None:
@@ -873,14 +879,6 @@ class Monitor:
         log(10, f"mon.{self.name}: adopted commit v{version} "
             f"(epoch {self.osdmap.epoch})")
         self._publish()
-
-    #: replication-cost guard (the reference ships per-value Paxos log
-    #: txns, src/mon/Paxos.cc share_state; we ship full snapshots —
-    #: O(state) per commit per peon. Fine while the state is small;
-    #: this warns ONCE when it stops being small so the bound is
-    #: monitored, not silent)
-    STATE_SIZE_WARN = 4 << 20
-    _state_size_warned = False
 
     def _encode_state(self) -> bytes:
         raw = self._encode_state_of(self.osdmap, self.ec_profiles,
@@ -1206,6 +1204,16 @@ class Monitor:
                             data=b""))
                     return
                 if not self.is_leader():
+                    if self._leader_rank < 0:
+                        # election in flight: a NOTLEADER pointing at
+                        # OURSELVES would hot-loop the client; EAGAIN
+                        # makes it back off and rotate instead
+                        conn.send_message(M.MMonCommandReply(
+                            tid=msg.tid, code=-11,
+                            outs="EAGAIN no leader "
+                                 "(election in progress)",
+                            data=b""))
+                        return
                     # clients re-target on this redirect
                     conn.send_message(M.MMonCommandReply(
                         tid=msg.tid, code=-11,

@@ -11,11 +11,15 @@ positions are tracked, and trim removes chunks every client has fully
 committed.
 
 Single-writer by default (the image holds the exclusive lock in the
-reference; our writer is the opened primary image). Writer and reader
-state are SEPARATE objects — the writer owns the header ({entries}),
-each reader owns its commit-position object, and the trimmer owns the
-floor object — so a replayer running concurrently with the writer
-never read-modify-writes the other side's state.
+reference; our writer is the opened primary image). The journal's
+CONTROL PLANE — client registry, per-client commit positions, trim
+floor — lives in the in-OSD ``journal`` object class on the
+``<name>.cls`` metadata object (src/cls/journal/cls_journal.cc: the
+client-side Journaler drives cls_journal, the reference's layering),
+so registrations/commits/floor advances from any number of clients
+mutate atomically under the PG lock; the writer's header ({entries})
+stays a separate object, so appends never read-modify-write reader
+state.
 
 ``multi_writer=True`` (the cephfs mdslog: several mounts journal
 dirops concurrently) replaces the header read-modify-write with an
@@ -76,49 +80,26 @@ class Journaler:
         self.io.write_full(self.header_oid,
                            json.dumps(h, sort_keys=True).encode())
 
-    def _client_oid(self, client: str) -> str:
-        return f"{self.header_oid}.client.{client}"
-
     @property
-    def _registry_oid(self) -> str:
-        return f"{self.header_oid}.clients"
+    def _meta_oid(self) -> str:
+        """The cls_journal metadata object (client registry + commit
+        positions + trim floor, all mutated by in-OSD ``journal``
+        class methods — the reference's Journaler/cls_journal
+        layering, src/cls/journal/cls_journal.cc)."""
+        return f"{self.header_oid}.cls"
 
-    def _registry(self) -> list[str]:
-        """Registered client ids. The registry is a cls_log object:
-        registration appends server-side ATOMICALLY (the method runs
-        under the PG lock on the OSD), so two clients' concurrent
-        first commits cannot lose each other — a lost registration
-        would let trim() drop chunks the missing client still needs."""
+    def _cls_meta(self) -> dict:
+        """{"clients": {id: pos}, "minimum": n} from cls_journal."""
+        from ceph_tpu.client.rados import RadosError
         try:
-            out = self.io.execute(self._registry_oid, "log", "list",
-                                  b"")
-            entries = json.loads(out)
-        except Exception:
-            return []
-        seen, retired = [], set()
-        for entry in entries:
-            # dict = cls_log entry; tolerate plain strings (a registry
-            # object written by an older format must not crash commit)
-            if isinstance(entry, dict):
-                cid = entry.get("data", "")
-            else:
-                cid = str(entry)
-            if cid.startswith("retired/"):
-                retired.add(cid[len("retired/"):])
-            elif cid and cid not in seen:
-                seen.append(cid)
-        return [c for c in seen if c not in retired]
-
-    @property
-    def _trim_oid(self) -> str:
-        return f"{self.header_oid}.trimmed"
+            out = self.io.execute(self._meta_oid, "journal",
+                                  "client_list", b"")
+            return json.loads(out)
+        except RadosError:
+            return {"clients": {}, "minimum": 0}
 
     def _trimmed_to(self) -> int:
-        try:
-            return int.from_bytes(self.io.read(self._trim_oid),
-                                  "little")
-        except Exception:
-            return 0
+        return int(self._cls_meta().get("minimum", 0))
 
     def trim_floor(self) -> int:
         """Lowest position still readable (positions below were
@@ -128,7 +109,6 @@ class Journaler:
 
     def create(self) -> None:
         self._save({"entries": 0})
-        self.io.write_full(self._trim_oid, (0).to_bytes(8, "little"))
 
     def exists(self) -> bool:
         try:
@@ -146,12 +126,7 @@ class Journaler:
                 self.io.remove(self._chunk_oid(chunk))
             except Exception:
                 pass
-        for client in self._registry():
-            try:
-                self.io.remove(self._client_oid(client))
-            except Exception:
-                pass
-        for oid in (self._registry_oid, self._trim_oid, self._seq_oid):
+        for oid in (self._meta_oid, self._seq_oid):
             try:
                 self.io.remove(oid)
             except Exception:
@@ -278,61 +253,74 @@ class Journaler:
 
     # -- commit positions / trim ---------------------------------------
     def commit(self, client: str, pos: int) -> None:
-        """Advance (monotonically) this client's commit position. Each
-        client owns its position object — no shared header RMW with
-        the writer's append path. First commit registers the client id
-        (registry RMW happens once per client, not per commit)."""
+        """Advance (monotonically) this client's commit position via
+        cls_journal — the register + commit run as in-OSD methods
+        under the PG lock (client_register once per client, then
+        client_commit per advance; the server enforces monotonicity
+        too)."""
+        from ceph_tpu.client.rados import RadosError
         if client not in self._registered:
-            if client not in self._registry():
-                self.io.execute(self._registry_oid, "log", "add",
-                                client.encode())
+            try:
+                self.io.execute(
+                    self._meta_oid, "journal", "client_register",
+                    json.dumps({"id": client}).encode())
+            except RadosError as exc:
+                if exc.code != -17:
+                    raise               # -EEXIST = retired tombstone:
+                # a resurrected id must not re-pin trim — surface it
+                raise JournalError(
+                    f"journal client {client!r} was retired") from None
             self._registered.add(client)
         prev = self._commit_cache.get(client)
-        if prev is None:
-            prev = self.committed(client)
-        pos = max(pos, prev)
-        if pos != prev or prev == 0:
-            self.io.write_full(self._client_oid(client),
-                               pos.to_bytes(8, "little"))
-        self._commit_cache[client] = pos
+        if prev is not None and pos <= prev:
+            return                      # the server would no-op too
+        try:
+            self.io.execute(self._meta_oid, "journal",
+                            "client_commit",
+                            json.dumps({"id": client,
+                                        "pos": pos}).encode())
+        except RadosError as exc:
+            if exc.code == -2:
+                # retired out from under our local register cache
+                self._registered.discard(client)
+                raise JournalError(
+                    f"journal client {client!r} was retired") from None
+            raise
+        self._commit_cache[client] = max(pos, prev or 0)
 
     def retire(self, client: str) -> None:
         """Deregister a client for good (clean unmount / session
-        eviction role): its position no longer pins trim() and its
-        position object is removed. Tombstones ride the same atomic
-        registry log, so a concurrent registration cannot resurrect
-        it."""
+        eviction role): its position no longer pins trim(). The
+        tombstone lives in the cls metadata, so a concurrent
+        registration cannot resurrect it."""
+        from ceph_tpu.client.rados import RadosError
         try:
-            self.io.execute(self._registry_oid, "log", "add",
-                            f"retired/{client}".encode())
-        except Exception:
-            return                      # registry gone: nothing pins
-        try:
-            self.io.remove(self._client_oid(client))
-        except Exception:
-            pass
+            self.io.execute(self._meta_oid, "journal",
+                            "client_unregister",
+                            json.dumps({"id": client}).encode())
+        except RadosError:
+            pass                        # unknown id: nothing pins
         self._registered.discard(client)
         self._commit_cache.pop(client, None)
 
     def committed(self, client: str) -> int:
-        try:
-            return int.from_bytes(
-                self.io.read(self._client_oid(client)), "little")
-        except Exception:
-            return 0
+        return int(self._cls_meta()["clients"].get(client, 0))
 
     def clients(self) -> dict[str, int]:
-        return {c: self.committed(c) for c in self._registry()}
+        return {c: int(p)
+                for c, p in self._cls_meta()["clients"].items()}
 
     def trim(self) -> int:
         """Remove chunk objects every registered client has fully
-        consumed; returns the new floor position. Single trimmer by
-        design (the mirror daemon)."""
-        clients = self.clients()
-        trimmed = self._trimmed_to()
+        consumed; returns the new floor position. The floor advance is
+        a cls_journal set_minimum (monotonic in-OSD). Single trimmer
+        by design (the mirror daemon)."""
+        meta = self._cls_meta()
+        clients = meta["clients"]
+        trimmed = int(meta.get("minimum", 0))
         if not clients:
             return trimmed
-        floor = min(clients.values())
+        floor = min(int(p) for p in clients.values())
         new_floor_chunk = floor // SPLAY
         for chunk in range(trimmed // SPLAY, new_floor_chunk):
             try:
@@ -341,6 +329,6 @@ class Journaler:
                 pass
         new_floor = new_floor_chunk * SPLAY
         if new_floor > trimmed:
-            self.io.write_full(self._trim_oid,
-                               new_floor.to_bytes(8, "little"))
+            self.io.execute(self._meta_oid, "journal", "set_minimum",
+                            json.dumps({"pos": new_floor}).encode())
         return max(new_floor, trimmed)

@@ -248,3 +248,41 @@ def test_cls_otp_totp(io):
                                             "token": "000000",
                                             "t": now}).encode()))
     assert out["ok"] is False or good == "000000"
+
+
+def test_cls_journal_control_plane(io):
+    """cls_journal (src/cls/journal/cls_journal.cc role): registry,
+    monotonic commit positions, retirement tombstones, trim floor —
+    all atomic in-OSD, driven through the Journaler."""
+    import threading
+
+    from ceph_tpu.services.journal import Journaler, JournalError
+    j = Journaler(io, "clsjrn")
+    j.create()
+    for i in range(10):
+        j.append(f"entry-{i}".encode())
+    # concurrent first-commits: the in-OSD registry must not lose any
+    js = [Journaler(io, "clsjrn") for _ in range(4)]
+    ts = [threading.Thread(target=js[i].commit,
+                           args=(f"reader-{i}", i + 1))
+          for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert j.clients() == {f"reader-{i}": i + 1 for i in range(4)}
+    # monotonic: a stale commit cannot regress the server position
+    Journaler(io, "clsjrn").commit("reader-3", 1)
+    assert j.committed("reader-3") == 4
+    # retirement tombstone: the id stops pinning trim and can never
+    # come back
+    for i in range(4):
+        Journaler(io, "clsjrn").commit(f"reader-{i}", 200)
+    j.retire("reader-0")
+    fresh = Journaler(io, "clsjrn")
+    with pytest.raises(JournalError):
+        fresh.commit("reader-0", 5)
+    # trim floor advances via set_minimum and survives new readers
+    floor = j.trim()
+    assert floor > 0 and j.trim_floor() == floor
+    assert "reader-0" not in j.clients()
