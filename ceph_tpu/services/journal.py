@@ -89,14 +89,87 @@ class Journaler:
         return f"{self.header_oid}.cls"
 
     def _cls_meta(self) -> dict:
-        """{"clients": {id: pos}, "minimum": n} from cls_journal."""
+        """{"clients": {id: pos}, "minimum": n} from cls_journal.
+        First touch of a journal written by the PREVIOUS format
+        (registry log + per-client position objects + trim-floor
+        object) migrates that state into the cls meta object — a
+        replayer must resume from its real position, not restart at 0
+        below an already-trimmed floor."""
         from ceph_tpu.client.rados import RadosError
         try:
             out = self.io.execute(self._meta_oid, "journal",
                                   "client_list", b"")
-            return json.loads(out)
+            meta = json.loads(out)
         except RadosError:
             return {"clients": {}, "minimum": 0}
+        if not meta["clients"] and not meta.get("minimum"):
+            legacy = self._migrate_legacy()
+            if legacy is not None:
+                return legacy
+        return meta
+
+    def _migrate_legacy(self) -> dict | None:
+        """One-shot import of pre-cls journal control state; returns
+        the migrated view, or None when there is nothing legacy."""
+        legacy_reg = f"{self.header_oid}.clients"
+        legacy_trim = f"{self.header_oid}.trimmed"
+        try:
+            out = self.io.execute(legacy_reg, "log", "list", b"")
+            entries = json.loads(out)
+        except Exception:
+            entries = []
+        try:
+            floor = int.from_bytes(self.io.read(legacy_trim),
+                                   "little")
+        except Exception:
+            floor = 0
+        if not entries and not floor:
+            return None
+        seen, retired = [], set()
+        for entry in entries:
+            cid = entry.get("data", "") if isinstance(entry, dict) \
+                else str(entry)
+            if cid.startswith("retired/"):
+                retired.add(cid[len("retired/"):])
+            elif cid and cid not in seen:
+                seen.append(cid)
+        clients = {}
+        for cid in seen:
+            if cid in retired:
+                continue
+            try:
+                clients[cid] = int.from_bytes(
+                    self.io.read(f"{self.header_oid}.client.{cid}"),
+                    "little")
+            except Exception:
+                clients[cid] = 0
+        for cid, pos in clients.items():
+            self.io.execute(self._meta_oid, "journal",
+                            "client_register",
+                            json.dumps({"id": cid}).encode())
+            if pos:
+                self.io.execute(self._meta_oid, "journal",
+                                "client_commit",
+                                json.dumps({"id": cid,
+                                            "pos": pos}).encode())
+        for cid in retired:
+            self.io.execute(self._meta_oid, "journal",
+                            "client_register",
+                            json.dumps({"id": cid}).encode())
+            self.io.execute(self._meta_oid, "journal",
+                            "client_unregister",
+                            json.dumps({"id": cid}).encode())
+        if floor:
+            self.io.execute(self._meta_oid, "journal", "set_minimum",
+                            json.dumps({"pos": floor}).encode())
+        # retire the legacy objects so the migration never re-runs
+        for oid in [legacy_reg, legacy_trim] + \
+                [f"{self.header_oid}.client.{c}" for c in seen]:
+            try:
+                self.io.remove(oid)
+            except Exception:
+                pass
+        return {"clients": clients, "minimum": floor}
 
     def _trimmed_to(self) -> int:
         return int(self._cls_meta().get("minimum", 0))
@@ -126,7 +199,9 @@ class Journaler:
                 self.io.remove(self._chunk_oid(chunk))
             except Exception:
                 pass
-        for oid in (self._meta_oid, self._seq_oid):
+        for oid in (self._meta_oid, self._seq_oid,
+                    f"{self.header_oid}.clients",
+                    f"{self.header_oid}.trimmed"):
             try:
                 self.io.remove(oid)
             except Exception:

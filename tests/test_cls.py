@@ -286,3 +286,33 @@ def test_cls_journal_control_plane(io):
     floor = j.trim()
     assert floor > 0 and j.trim_floor() == floor
     assert "reader-0" not in j.clients()
+
+
+def test_cls_journal_migrates_legacy_control_state(io):
+    """A journal written by the pre-cls format (registry log +
+    per-client position objects + trim-floor object) migrates into
+    the cls meta on first touch — a replayer resumes from its real
+    position instead of restarting at 0 below a trimmed floor."""
+    import json as _json
+
+    from ceph_tpu.services.journal import Journaler
+    j = Journaler(io, "legacyjrn")
+    j.create()
+    for i in range(5):
+        j.append(f"e{i}".encode())
+    hdr = j.header_oid
+    # hand-write the LEGACY control state
+    io.execute(f"{hdr}.clients", "log", "add", b"reader-a")
+    io.execute(f"{hdr}.clients", "log", "add", b"reader-b")
+    io.execute(f"{hdr}.clients", "log", "add", b"retired/reader-b")
+    io.write_full(f"{hdr}.client.reader-a", (3).to_bytes(8, "little"))
+    io.write_full(f"{hdr}.trimmed", (64).to_bytes(8, "little"))
+    fresh = Journaler(io, "legacyjrn")
+    assert fresh.committed("reader-a") == 3
+    assert fresh.clients() == {"reader-a": 3}
+    assert fresh.trim_floor() == 64
+    # migration is one-shot: legacy objects are gone, state persists
+    from ceph_tpu.client.rados import RadosError
+    with pytest.raises(RadosError):
+        io.read(f"{hdr}.client.reader-a")
+    assert Journaler(io, "legacyjrn").committed("reader-a") == 3
