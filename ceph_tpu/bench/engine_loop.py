@@ -68,8 +68,9 @@ def run(k: int = 8, m: int = 3, nops: int = 16,
     ops = list(range(nops))
 
     # build + compile the engine's fused program at this signature
-    # (the same _fused_cache the daemon uses), and gate correctness:
-    # the first op's device parity must match the host codec
+    # (exposed by ec_util so the bench measures EXACTLY the program
+    # production launches), and gate correctness: the first op's
+    # device parity must match the host codec
     from ceph_tpu.ops import gf256
     fin = ec_util._flush_device_fused_async(sinfo, codec, ops, bufs)
     results = fin()                         # warm + compile
@@ -79,31 +80,13 @@ def run(k: int = 8, m: int = 3, nops: int = 16,
     assert np.array_equal(np.stack([shards0[k + j]
                                     for j in range(m)]), host_par), \
         "device fused parity is not bit-exact vs the host codec"
-    lens = [len(b) // sinfo.stripe_width * chunk_size for b in bufs]
-    batch = np.concatenate(bufs)
-    s = len(batch) // sinfo.stripe_width
-    n_bytes = s * chunk_size
-    data_shards = np.ascontiguousarray(
-        batch.reshape(s, k, chunk_size).transpose(1, 0, 2)
-        .reshape(k, n_bytes))
-    n_b = ec_util._pow2_bucket(n_bytes, 1 << 14)
-    from ceph_tpu.ops import crc32c_device as cd
-    lmax_b = ec_util._pow2_bucket(max(lens),
-                                  max(cd.ROW_BYTES, 1 << 12))
-    nops_b = ec_util._pow2_bucket(nops, 1)
-    key = (backend, codec.coding_matrix.tobytes(), n_b, lmax_b, nops_b)
-    fn = ec_util._fused_cache[key]
-    data_dev = np.zeros((k, n_b), dtype=np.uint8)
-    data_dev[:, :n_bytes] = data_shards
-    offs = np.zeros(nops_b, dtype=np.int32)
-    offs[:nops] = np.cumsum([0] + lens[:-1])
-    lns = np.zeros(nops_b, dtype=np.int32)
-    lns[:nops] = lens
+    fn = fin.fused_fn
+    data_dev, offs, lns = fin.staged
     # PRE-STAGE on device: the closed loop never re-uploads payloads
     ddata = jax.device_put(jnp.asarray(data_dev))
     doffs = jax.device_put(jnp.asarray(offs))
     dlens = jax.device_put(jnp.asarray(lns))
-    batch_bytes = n_bytes * k    # payload bytes per launch
+    batch_bytes = int(data_dev.shape[0]) * int(data_dev.shape[1])
 
     # -- A: pipelined async launches (dispatch included) --------------
     def pipelined_round(n_launches: int) -> float:

@@ -540,6 +540,28 @@ class ErasureCodeClay(ErasureCode):
         if size % ssc:
             raise ErasureCodeError(
                 f"clay: chunk size {size} not a multiple of {ssc} sub-chunks")
+        try:
+            resolved, _ = backend_mod.resolve(self.backend)
+        except KeyError:
+            resolved = None
+        if resolved == "pallas":
+            # round-4 production path: the whole structured chain
+            # (pairwise uncouple -> plane-wise MDS -> recouple) in ONE
+            # pallas kernel with a VMEM-resident working set — 525
+            # GB/s measured (RS-kernel class) vs 9 GB/s for the dense
+            # linearized matrix, which is COMPUTE-bound at ~64x the
+            # RS MAC count (models/clay_device.build_encode_kernel)
+            if getattr(self, "_enc_kernel", None) is None:
+                from ceph_tpu.models.clay_device import \
+                    build_encode_kernel
+                self._enc_kernel = build_encode_kernel(self)
+            sc = size // ssc
+            x = self._stack(chunks, range(self.k), ssc, sc)
+            par = np.asarray(self._enc_kernel(
+                x.reshape(self.k, ssc, sc)))
+            return {pos: par[pos - self.k].reshape(-1)
+                    for pos in want_to_encode
+                    if self.k <= pos < self.k + self.m}
         mat = self._lin_cache.get_or_build(("enc",), self._encode_matrix)
         x = self._stack(chunks, range(self.k), ssc, size // ssc)
         parity = backend_mod.matvec(mat, x, self.backend)

@@ -66,6 +66,8 @@ class Journaler:
         self._registered: set[str] = set()
         self._commit_cache: dict[str, int] = {}
         self._seq_seeded = False
+        #: legacy-format probe runs at most once per instance
+        self._legacy_checked = False
         import threading
         self._append_lock = threading.Lock()
 
@@ -101,8 +103,10 @@ class Journaler:
                                   "client_list", b"")
             meta = json.loads(out)
         except RadosError:
-            return {"clients": {}, "minimum": 0}
-        if not meta["clients"] and not meta.get("minimum"):
+            meta = {"clients": {}, "minimum": 0}
+        if not self._legacy_checked and not meta["clients"] and \
+                not meta.get("minimum"):
+            self._legacy_checked = True    # probe once per instance
             legacy = self._migrate_legacy()
             if legacy is not None:
                 return legacy
@@ -110,19 +114,27 @@ class Journaler:
 
     def _migrate_legacy(self) -> dict | None:
         """One-shot import of pre-cls journal control state; returns
-        the migrated view, or None when there is nothing legacy."""
+        the migrated view, or None when there is nothing legacy.
+        ONLY a definitive -ENOENT counts as absent — a transient read
+        error must surface rather than silently commit position 0 and
+        delete the real one (the read_from contract)."""
+        from ceph_tpu.client.rados import RadosError
         legacy_reg = f"{self.header_oid}.clients"
         legacy_trim = f"{self.header_oid}.trimmed"
-        try:
-            out = self.io.execute(legacy_reg, "log", "list", b"")
-            entries = json.loads(out)
-        except Exception:
-            entries = []
-        try:
-            floor = int.from_bytes(self.io.read(legacy_trim),
-                                   "little")
-        except Exception:
-            floor = 0
+
+        def read_or_absent(fn):
+            try:
+                return fn()
+            except RadosError as exc:
+                if exc.code == -2:
+                    return None
+                raise
+
+        raw = read_or_absent(
+            lambda: self.io.execute(legacy_reg, "log", "list", b""))
+        entries = json.loads(raw) if raw else []
+        raw = read_or_absent(lambda: self.io.read(legacy_trim))
+        floor = int.from_bytes(raw, "little") if raw else 0
         if not entries and not floor:
             return None
         seen, retired = [], set()
@@ -137,28 +149,34 @@ class Journaler:
         for cid in seen:
             if cid in retired:
                 continue
+            raw = read_or_absent(lambda c=cid: self.io.read(
+                f"{self.header_oid}.client.{c}"))
+            clients[cid] = int.from_bytes(raw, "little") if raw else 0
+
+        def register(cid):
+            # a concurrent migrator may have won (and possibly
+            # already retired the id): -EEXIST means its view stands
             try:
-                clients[cid] = int.from_bytes(
-                    self.io.read(f"{self.header_oid}.client.{cid}"),
-                    "little")
-            except Exception:
-                clients[cid] = 0
+                self.io.execute(self._meta_oid, "journal",
+                                "client_register",
+                                json.dumps({"id": cid}).encode())
+                return True
+            except RadosError as exc:
+                if exc.code == -17:
+                    return False
+                raise
+
         for cid, pos in clients.items():
-            self.io.execute(self._meta_oid, "journal",
-                            "client_register",
-                            json.dumps({"id": cid}).encode())
-            if pos:
+            if register(cid) and pos:
                 self.io.execute(self._meta_oid, "journal",
                                 "client_commit",
                                 json.dumps({"id": cid,
                                             "pos": pos}).encode())
         for cid in retired:
-            self.io.execute(self._meta_oid, "journal",
-                            "client_register",
-                            json.dumps({"id": cid}).encode())
-            self.io.execute(self._meta_oid, "journal",
-                            "client_unregister",
-                            json.dumps({"id": cid}).encode())
+            if register(cid):
+                self.io.execute(self._meta_oid, "journal",
+                                "client_unregister",
+                                json.dumps({"id": cid}).encode())
         if floor:
             self.io.execute(self._meta_oid, "journal", "set_minimum",
                             json.dumps({"pos": floor}).encode())
@@ -335,6 +353,12 @@ class Journaler:
         too)."""
         from ceph_tpu.client.rados import RadosError
         if client not in self._registered:
+            # a journal whose FIRST control-plane touch is a commit
+            # must still import legacy-format state before the
+            # register seeds the cls meta (or the old positions and
+            # trim floor would be silently abandoned)
+            if not self._legacy_checked:
+                self._cls_meta()
             try:
                 self.io.execute(
                     self._meta_oid, "journal", "client_register",

@@ -127,3 +127,50 @@ def test_structured_encode_bit_exact():
                 assert np.array_equal(dev[p].reshape(-1),
                                       np.asarray(host[k + p])), \
                     (prof, sc, p)
+
+
+def test_encode_kernel_single_pallas_bit_exact():
+    """Round-4 build_encode_kernel: the whole structured chain in ONE
+    pallas kernel (row-space routing matmuls + VPU coefficient chains
+    + per-plane MDS bit-matmuls) — bit-exact vs the host layered
+    oracle across profiles (incl. virtual nodes) and payload sizes."""
+    from ceph_tpu.models.clay_device import build_encode_kernel
+
+    rng = np.random.default_rng(23)
+    for prof, sizes in ((dict(k=8, m=4, d=11), (1, 5, 64, 700)),
+                        (dict(k=4, m=3, d=6), (1, 9, 100))):
+        c = make(**prof)
+        enc = build_encode_kernel(c)
+        ssc, k, m = c.sub_chunk_no, c.k, c.m
+        for sc in sizes:
+            chunks = {i: rng.integers(0, 256, ssc * sc,
+                                      dtype=np.uint8)
+                      for i in range(k)}
+            host = c.encode_chunks(list(range(k, k + m)), chunks)
+            x = np.stack([chunks[i].reshape(ssc, sc)
+                          for i in range(k)])
+            dev = np.asarray(enc(x))
+            for p in range(m):
+                assert np.array_equal(dev[p].reshape(-1),
+                                      np.asarray(host[k + p])), \
+                    (prof, sc, p)
+
+
+def test_encode_fused_xla_bit_exact():
+    """build_encode_fused (the measured single-XLA-program
+    experiment): bit-exact, kept as the documented negative result —
+    gathers break fusion and bit planes materialize in HBM."""
+    from ceph_tpu.models.clay_device import build_encode_fused
+
+    rng = np.random.default_rng(29)
+    c = make(k=8, m=4, d=11)
+    enc = build_encode_fused(c)
+    ssc, k, m = c.sub_chunk_no, c.k, c.m
+    chunks = {i: rng.integers(0, 256, ssc * 40, dtype=np.uint8)
+              for i in range(k)}
+    host = c.encode_chunks(list(range(k, k + m)), chunks)
+    x = np.stack([chunks[i].reshape(ssc, 40) for i in range(k)])
+    dev = np.asarray(enc(x))
+    for p in range(m):
+        assert np.array_equal(dev[p].reshape(-1),
+                              np.asarray(host[k + p]))
