@@ -113,7 +113,7 @@ def run(k: int = 8, m: int = 3, nops: int = 16,
         row0 = dd[0:1] ^ parity[0:1].astype(jnp.uint8) ^ byte
         return dd.at[0:1].set(row0)
 
-    slope, spread_pct, samples = stable_best_slope(
+    slope, spread_pct, samples, _contended = stable_best_slope(
         step, ddata,
         min_traffic_bytes=batch_bytes * (k + m) // k,
         time_budget=180.0, stable_n=5)

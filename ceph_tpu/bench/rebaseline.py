@@ -51,7 +51,7 @@ def _matvec_rows(tag, mat, data, counted_bytes, budget=150.0):
         return x.at[0:1].set(out[0:1])
 
     traffic = data.nbytes + mat.shape[0] * data.shape[1]
-    slope, spread, samples = stable_best_slope(
+    slope, spread, samples, _contended = stable_best_slope(
         step, dd, min_traffic_bytes=traffic, time_budget=budget,
         stable_n=6)
     return {"row": tag, "GBps": round(counted_bytes / slope / 1e9, 2),
@@ -164,7 +164,7 @@ def crc32c():
         lin = cd.crc_linear_device(x)
         return x.at[0, 0].set((lin[0] & 0xFF).astype(jnp.uint8))
 
-    slope, spread, samples = stable_best_slope(
+    slope, spread, samples, _contended = stable_best_slope(
         step, dd, min_traffic_bytes=data.nbytes, time_budget=150.0,
         stable_n=6)
     return {"row": "crc32c_device_24MiB",

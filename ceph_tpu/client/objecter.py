@@ -54,10 +54,25 @@ class _Op:
         self.attempts = 0
 
 
+EBLOCKLISTED = -108
+
+
 class Objecter:
-    def __init__(self, msgr: Messenger, monc: MonClient) -> None:
+    def __init__(self, msgr: Messenger, monc: MonClient,
+                 client_id: str | None = None) -> None:
         self.msgr = msgr
         self.monc = monc
+        #: the identity ops carry (blocklist fencing + dup-op cache
+        #: key); an instance-qualified id when the owning RadosClient
+        #: provides one, else the bare messenger entity name
+        self.client_id = client_id or msgr.entity_name
+        #: sticky client-side fence (librbd's is-blocklisted
+        #: invalidation role): once ANY op is rejected EBLOCKLISTED,
+        #: this instance never submits again — even after the osdmap
+        #: entry expires, a fenced instance must not resume with
+        #: stale state; the process gets a fresh instance by
+        #: reconnecting (new RadosClient)
+        self.fenced = False
         self._lock = threading.Lock()
         self._next_tid = 1
         self._pending: dict[int, _Op] = {}
@@ -75,6 +90,10 @@ class Objecter:
     def handle_message(self, msg: M.Message, conn: Connection) -> bool:
         if not isinstance(msg, M.MOSDOpReply):
             return False
+        if msg.code == EBLOCKLISTED:
+            # sticky even when the op already timed out locally (a
+            # parked op's late rejection must still fence us)
+            self.fenced = True
         with self._lock:
             op = self._pending.get(msg.tid)
         if op is None:
@@ -102,12 +121,17 @@ class Objecter:
         """Synchronous submit (the aio variant is just this on a
         thread); raises ObjecterError on errno replies."""
         from ceph_tpu.utils.tracing import tracer
+        if self.fenced:
+            raise ObjecterError(
+                EBLOCKLISTED,
+                f"client instance {self.client_id!r} is fenced "
+                "(blocklisted); reconnect for a fresh instance")
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
         span = tracer().new_trace(f"osd_op(op={op} oid={oid})",
                                   self.msgr.entity_name)
-        msg = M.MOSDOp(tid=tid, client=self.msgr.entity_name, epoch=0,
+        msg = M.MOSDOp(tid=tid, client=self.client_id, epoch=0,
                        pool=pool, ps=max(ps, 0), oid=oid, op=op,
                        offset=offset, length=length, data=bytes(data),
                        trace=span.wire(), cls=cls, method=method,

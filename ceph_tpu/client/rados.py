@@ -353,14 +353,22 @@ class IoCtx:
 
 class RadosClient:
     def __init__(self, mon_addr: str, name: str | None = None,
-                 auth: tuple[str, bytes] | None = None) -> None:
+                 auth: tuple[str, bytes] | None = None,
+                 instance: str | None = None) -> None:
+        import uuid
         if name is None:
-            import uuid
             _client_seq[0] += 1
             # globally unique across processes: the mon dedups commands
             # on (client name, tid), so two CLI invocations must never
             # share a name (both would start tids at 1)
             name = f"client.{uuid.uuid4().hex[:8]}.{_client_seq[0]}"
+        #: per-INSTANCE identity carried on every osd op — the
+        #: entity_addr:nonce analog the osdmap blocklist fences
+        #: (src/osd/OSDMap.h:561): a restarted daemon reusing the same
+        #: NAME gets a fresh nonce, so fencing a dead instance never
+        #: blocks its successor. ``instance`` is injectable for tests
+        #: that impersonate a fenced instance.
+        self.instance = instance or f"{name}:{uuid.uuid4().hex[:8]}"
         self.msgr = Messenger(name)
         self.monc = MonClient(self.msgr, mon_addr)
         self.objecter: Objecter | None = None
@@ -381,7 +389,8 @@ class RadosClient:
         # clients bind too: OSD replies ride the same connection the op
         # arrived on, but map pushes need our listening addr
         self.msgr.bind()
-        self.objecter = Objecter(self.msgr, self.monc)
+        self.objecter = Objecter(self.msgr, self.monc,
+                                 client_id=self.instance)
         if self._auth is not None:
             # must precede subscribe: an authed cluster drops every
             # unsigned frame except the MAuth exchange itself
@@ -427,6 +436,18 @@ class RadosClient:
             return
 
     # -- watch/notify plumbing ----------------------------------------
+    def _mwatch(self, **kw) -> "M.MWatch":
+        """Build an MWatch with this client's identity and map epoch
+        filled in — every registration must carry both (the osdmap
+        blocklist fence checks the instance id, and the epoch makes a
+        stale-map OSD park the registration instead of missing a
+        fresh fence). One builder so a future call site cannot
+        silently bypass the fence."""
+        return M.MWatch(
+            client=self.instance,
+            epoch=self.monc.osdmap.epoch if self.monc.osdmap else 0,
+            **kw)
+
     def _primary_addr(self, pool: int, oid: str) -> tuple[str, int, int]:
         osdmap = self.monc.osdmap
         ps = osdmap.object_to_pg(pool, oid)
@@ -464,7 +485,7 @@ class RadosClient:
                 "pool": io.pool_id, "oid": oid, "cb": callback,
                 "osd": primary, "addr": addr}
         try:
-            rep = self._wn_call(M.MWatch(
+            rep = self._wn_call(self._mwatch(
                 tid=tid, pool=io.pool_id, ps=ps, oid=oid,
                 cookie=cookie, watch=True), addr)
         except RadosError:
@@ -484,7 +505,7 @@ class RadosClient:
             return
         try:
             addr, ps, _ = self._primary_addr(w["pool"], w["oid"])
-            self._wn_call(M.MWatch(
+            self._wn_call(self._mwatch(
                 tid=2_000_000 + cookie, pool=w["pool"], ps=ps,
                 oid=w["oid"], cookie=cookie, watch=False), addr,
                 timeout=3.0)
@@ -519,7 +540,7 @@ class RadosClient:
                 # must never be told an unseen notify was processed —
                 # and purge the stale registration
                 try:
-                    conn.send_message(M.MWatch(
+                    conn.send_message(self._mwatch(
                         tid=5_000_000 + msg.cookie, pool=msg.pool,
                         ps=0, oid=msg.oid, cookie=msg.cookie,
                         watch=False))
@@ -559,7 +580,7 @@ class RadosClient:
                 # is what makes 're-watches automatically' true
                 continue
             try:
-                rep = self._wn_call(M.MWatch(
+                rep = self._wn_call(self._mwatch(
                     tid=4_000_000 + cookie, pool=w["pool"], ps=ps,
                     oid=w["oid"], cookie=cookie, watch=True), addr,
                     timeout=3.0)

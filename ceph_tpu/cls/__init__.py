@@ -89,7 +89,11 @@ def _lock_state(obj: bytes | None) -> dict:
 @register("lock", "lock")
 def _lock_lock(inp: bytes, obj: bytes | None):
     """input: {"name", "cookie", "type": "exclusive"|"shared",
-    "duration": seconds (0 = forever)}"""
+    "duration": seconds (0 = forever), "owner": opt client instance
+    id}. ``owner`` is what the reference records as the locker's
+    entity_addr_t — a lock breaker reads it back from ``info`` to
+    know which instance to blocklist before break_lock (the
+    ManagedLock break/steal flow, src/librbd/ManagedLock.h:28)."""
     req = json.loads(inp)
     st = _lock_state(obj)
     now = time.time()
@@ -107,6 +111,7 @@ def _lock_lock(inp: bytes, obj: bytes | None):
     lockers[key] = {
         "type": req["type"],
         "expires": (now + req["duration"]) if req.get("duration") else 0,
+        "owner": req.get("owner", ""),
     }
     st["lockers"] = lockers
     return 0, b"", json.dumps(st).encode()

@@ -111,6 +111,11 @@ class ErasureCodeClay(ErasureCode):
         backend = str(profile.get("backend", "auto"))
         self.backend = backend
         self.linearize = self.to_bool("linearize", profile, True)
+        #: opt-in: route decode_chunks through the round-5 structured
+        #: pallas kernel instead of the dense linearized matrix (see
+        #: _decode_chunks_lin for why it is not the default)
+        self.decode_kernel = self.to_bool("decode_kernel", profile,
+                                          False)
         self._lin_cache.clear()
         # The plane machinery issues thousands of tiny per-sub-chunk solves;
         # those must run on the host even when the (linearized) hot path
@@ -599,6 +604,16 @@ class ErasureCodeClay(ErasureCode):
         missing = [c for c in want_to_read if c not in chunks]
         if not missing:
             return out
+        if self.decode_kernel:
+            # round-5 structured decode kernel
+            # (clay_device.build_transform_kernel): bit-exact, but
+            # MEASURED SLOWER than the dense matrix on current Mosaic
+            # (2.6 vs 14.4 GB/s decode-2 — the multi-level unrolled
+            # body hits a compiler scheduling cliff, BASELINE.md r5
+            # negative result), so it is opt-in
+            # (profile decode_kernel=true), not the default
+            return self._decode_chunks_kernel(want_to_read, chunks,
+                                              out, missing, size)
         mat = self._lin_cache.get_or_build(
             ("dec", avail, erased),
             lambda: self._decode_matrix(avail, erased))
@@ -607,6 +622,42 @@ class ErasureCodeClay(ErasureCode):
         for row, c in enumerate(erased):
             if c in missing:
                 out[c] = rec[row * ssc:(row + 1) * ssc].reshape(-1)
+        return out
+
+    def _decode_chunks_kernel(self, want_to_read, chunks, out,
+                              missing, size):
+        """Run the structured decode kernel for this erasure
+        signature (padded to m nodes the way _decode_layered pads),
+        cached per signature like the ISA decode-table LRU
+        (src/erasure-code/isa/ErasureCodeIsa.cc:226-303)."""
+        n = self.k + self.m
+        ssc = self.sub_chunk_no
+        sc = size // ssc
+        qt = self.q * self.t
+        erased_nodes = {self._node_id(c) for c in range(n)
+                        if c not in chunks}
+        for i in range(self.k + self.nu, qt):
+            if len(erased_nodes) >= self.m:
+                break
+            erased_nodes.add(i)
+        key = frozenset(erased_nodes)
+        fn = self._lin_cache.get_or_build(
+            ("ker", key),
+            lambda: __import__(
+                "ceph_tpu.models.clay_device",
+                fromlist=["build_transform_kernel"]
+            ).build_transform_kernel(self, key))
+        c_full = np.zeros((qt, ssc, sc), dtype=np.uint8)
+        for c, buf in chunks.items():
+            node = self._node_id(c)
+            if node not in key and c < n:
+                c_full[node] = np.asarray(
+                    buf, dtype=np.uint8).reshape(ssc, sc)
+        rec = np.asarray(fn(c_full))
+        er_sorted = sorted(key)
+        for c in missing:
+            node = self._node_id(c)
+            out[c] = rec[er_sorted.index(node)].reshape(-1)
         return out
 
     def _repair_matrix(self, want_chunk: int, helpers: tuple) -> np.ndarray:

@@ -812,6 +812,367 @@ def build_encode_kernel(codec, tile: int = 512):
     return encode
 
 
+def build_decode_tables(codec, erased: frozenset[int]) -> dict:
+    """Global (level-independent) slot tables + per-level masks for
+    the layered DECODE chain (decode_layered,
+    src/erasure-code/clay/ErasureCodeClay.cc:644-709).
+
+    Key round-5 observation: the per-slot coefficient and partner
+    assignments of build_transform's per-level tables are GEOMETRIC —
+    fixed by (slot, erased signature), independent of the score level
+    (the pairing (n,z)<->(nsw,zsw) is an involution; each slot is
+    consistently the low or the high member of its pair, and the
+    erased-set membership that picks the coefficient variant is
+    static). Only WHICH slots update varies by level. So one set of
+    global tables + one mask column per level expresses the whole
+    multi-level chain — which is what lets the decode kernel unroll
+    the levels inside a single pallas program with shared routing
+    matrices. Overlap consistency is asserted while merging.
+    """
+    levels = trace_layered(codec, erased)
+    coeffs = pft_coefficients(codec)
+    qt = codec.q * codec.t
+    ssc = codec.sub_chunk_no
+
+    a1 = np.zeros((qt, ssc), dtype=np.uint8)
+    a2 = np.zeros((qt, ssc), dtype=np.uint8)
+    pn = np.tile(np.arange(qt, dtype=np.int32)[:, None], (1, ssc))
+    pz = np.tile(np.arange(ssc, dtype=np.int32)[None, :], (qt, 1))
+    b1 = np.zeros((qt, ssc), dtype=np.uint8)
+    b2 = np.zeros((qt, ssc), dtype=np.uint8)
+    b3 = np.zeros((qt, ssc), dtype=np.uint8)
+    p2n = np.tile(np.arange(qt, dtype=np.int32)[:, None], (1, ssc))
+    p2z = np.tile(np.arange(ssc, dtype=np.int32)[None, :], (qt, 1))
+    seen_u = np.zeros((qt, ssc), dtype=bool)
+    seen_c = np.zeros((qt, ssc), dtype=bool)
+    masks_u, masks_c, level_planes = [], [], []
+
+    def put_u(n, z, v1, v2, tn, tz):
+        if seen_u[n, z]:
+            assert (a1[n, z], a2[n, z], pn[n, z], pz[n, z]) == \
+                (v1, v2, tn, tz), "level-dependent U slot"
+        seen_u[n, z] = True
+        a1[n, z], a2[n, z] = v1, v2
+        pn[n, z], pz[n, z] = tn, tz
+
+    def put_c(n, z, v1, v2, v3, tn, tz):
+        if seen_c[n, z]:
+            assert (b1[n, z], b2[n, z], b3[n, z], p2n[n, z],
+                    p2z[n, z]) == (v1, v2, v3, tn, tz), \
+                "level-dependent C slot"
+        seen_c[n, z] = True
+        b1[n, z], b2[n, z], b3[n, z] = v1, v2, v3
+        p2n[n, z], p2z[n, z] = tn, tz
+
+    for ops in levels:
+        mu = np.zeros((qt, ssc), dtype=bool)
+        mc = np.zeros((qt, ssc), dtype=bool)
+        for n, z in ops.ident:
+            put_u(n, z, 1, 0, n, z)
+            mu[n, z] = True
+        for v, lst in ops.pair_a.items():
+            mm = coeffs[("a", v)]
+            for nxy, z, nsw, zsw in lst:
+                put_u(nxy, z, int(mm[0][0]), int(mm[0][1]), nsw, zsw)
+                mu[nxy, z] = True
+                put_u(nsw, zsw, int(mm[1][1]), int(mm[1][0]), nxy, z)
+                mu[nsw, zsw] = True
+        for n, z in ops.ident2:
+            put_c(n, z, 0, 1, 0, n, z)
+            mc[n, z] = True
+        for v, lst in ops.type_c.items():
+            mm = coeffs[("c", v)]
+            for nxy, z, nsw, zsw in lst:
+                put_c(nxy, z, int(mm[0][0]), int(mm[0][1]), 0,
+                      nsw, zsw)
+                mc[nxy, z] = True
+        mb = coeffs[("b", 0)]
+        for nxy, z, nsw, zsw in ops.pair_b:
+            put_c(nxy, z, 0, int(mb[0][0]), int(mb[0][1]), nsw, zsw)
+            mc[nxy, z] = True
+            put_c(nsw, zsw, 0, int(mb[1][1]), int(mb[1][0]), nxy, z)
+            mc[nsw, zsw] = True
+        masks_u.append(mu)
+        masks_c.append(mc)
+        level_planes.append(list(ops.planes))
+    return {
+        "a1": a1, "a2": a2, "pn": pn, "pz": pz,
+        "b1": b1, "b2": b2, "b3": b3, "p2n": p2n, "p2z": p2z,
+        "masks_u": masks_u, "masks_c": masks_c,
+        "planes": level_planes,
+    }
+
+
+def build_transform_kernel(codec, erased: frozenset[int],
+                           tile: int = 256):
+    """Round-5: the WHOLE multi-level layered decode chain in ONE
+    Pallas kernel — the decode counterpart of ``build_encode_kernel``
+    (matching decode_layered, ErasureCodeClay.cc:644-709). The dense
+    linearized decode matrix is COMPUTE-bound at ~5% density (14.4
+    GB/s for decode-2, BASELINE.md); this runs the sparse structure
+    directly:
+
+    - state lives Z-MAJOR, each plane's node group PADDED to
+      P = ceil(qt/8)*8 rows (row z*P + n): every per-plane MDS slice
+      is then a CONTIGUOUS, sublane-ALIGNED static slice of a VMEM
+      scratch ref — scratch + aligned in-place stores are what let
+      Mosaic REUSE buffers across the ssc-plane unroll (the
+      value-SSA formulation stacked every unrolled plane's temps:
+      20.7 MiB scoped vmem vs the 16 MiB budget, chip-measured);
+    - the node-major -> z-major embedding runs outside as one XLA
+      transpose (its in-kernel [R, R] routing matrix was the largest
+      single constant);
+    - the global pairwise-coupling tables of build_decode_tables make
+      the per-level work a shared routing matmul (S_pair) + per-row
+      VPU coefficient chains + a per-level mask select — levels
+      unroll statically inside the kernel;
+    - each level's plane-wise MDS decode is one [8e, 8P] bit-matmul
+      per plane group (zero columns at erased/pad nodes), recovered
+      rows stored 8-aligned into a rec scratch and scattered back by
+      one small routing matmul;
+    - phase 2 computes candidates only for the e*ssc ERASED rows
+      (C writes always target erased slots) — small matmuls.
+
+    All routing constants are bf16 (0/1 and byte values are exact).
+    Returns ``[qt, ssc, L] uint8 (erased rows zero) ->
+    [e, ssc, L] uint8`` recovered C for sorted(erased).
+    ``erased`` must be the PADDED node-id set (|erased| == m the way
+    _decode_layered pads it).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ceph_tpu.ops.gf_pallas import _permute_bitmatrix
+
+    tb = build_decode_tables(codec, erased)
+    q, t = codec.q, codec.t
+    qt, ssc = q * t, codec.sub_chunk_no
+    P = ((qt + 7) // 8) * 8            # plane group rows, 8-aligned
+    Rp = ssc * P                       # padded z-major state rows
+    er = sorted(erased)
+    e = len(er)
+    E8 = ((e + 7) // 8) * 8            # rec rows per plane, 8-aligned
+    intact = [i for i in range(qt) if i not in erased]
+    n_levels = len(tb["masks_u"])
+
+    # MDS decode matrix widened to P columns (zeros at erased + pad)
+    dmat_small = _mds_decode_matrix(codec, intact, er)   # [e, kk]
+    dmat_full = np.zeros((e, P), dtype=np.uint8)
+    for col, n in enumerate(intact):
+        dmat_full[:, n] = dmat_small[:, col]
+    dbmat = _permute_bitmatrix(dmat_full)                # [8e, 8P]
+
+    def zr(n, z):                      # padded z-major state row
+        return z * P + n
+
+    a1, a2, pn, pz = tb["a1"], tb["a2"], tb["pn"], tb["pz"]
+    s_pair = np.zeros((Rp, Rp), dtype=np.float32)
+    a1z = np.zeros((Rp, 1), dtype=np.uint8)
+    a2z = np.zeros((Rp, 1), dtype=np.uint8)
+    for n in range(qt):
+        for z in range(ssc):
+            r = zr(n, z)
+            a1z[r, 0], a2z[r, 0] = a1[n, z], a2[n, z]
+            if a2[n, z]:
+                s_pair[r, zr(pn[n, z], pz[n, z])] = 1.0
+    # recovered-U scatter: rec row z*E8 + j -> U row zr(er[j], z)
+    s_back = np.zeros((Rp, ssc * E8), dtype=np.float32)
+    for z in range(ssc):
+        for j in range(e):
+            s_back[zr(er[j], z), z * E8 + j] = 1.0
+    # phase-2 tables over the e*ssc erased rows (plane-major rc order,
+    # padded to E8 rows per plane so the scatter matrix is shared)
+    b1, b2, b3 = tb["b1"], tb["b2"], tb["b3"]
+    p2n, p2z = tb["p2n"], tb["p2z"]
+    Rrc = ssc * E8
+    p2c = np.zeros((Rrc, Rp), dtype=np.float32)
+    s2u = np.zeros((Rrc, Rp), dtype=np.float32)
+    p2u = np.zeros((Rrc, Rp), dtype=np.float32)
+    b1c = np.zeros((Rrc, 1), dtype=np.uint8)
+    b2c = np.zeros((Rrc, 1), dtype=np.uint8)
+    b3c = np.zeros((Rrc, 1), dtype=np.uint8)
+    for z in range(ssc):
+        for j, n in enumerate(er):
+            r = z * E8 + j
+            b1c[r, 0], b2c[r, 0], b3c[r, 0] = \
+                b1[n, z], b2[n, z], b3[n, z]
+            if b1[n, z]:
+                p2c[r, zr(p2n[n, z], p2z[n, z])] = 1.0
+            if b2[n, z]:
+                s2u[r, zr(n, z)] = 1.0
+            if b3[n, z]:
+                p2u[r, zr(p2n[n, z], p2z[n, z])] = 1.0
+    # output extraction: out row j*ssc + z (node-major) <- state row
+    R_out = e * ssc
+    s_out = np.zeros((R_out, Rp), dtype=np.float32)
+    for j, n in enumerate(er):
+        for z in range(ssc):
+            s_out[j * ssc + z, zr(n, z)] = 1.0
+    # per-level masks as stacked int32 columns
+    mu_cols = np.zeros((Rp, n_levels), dtype=np.int32)
+    mmds_cols = np.zeros((Rp, n_levels), dtype=np.int32)
+    mc_cols = np.zeros((Rp, n_levels), dtype=np.int32)
+    for li in range(n_levels):
+        mu, mc = tb["masks_u"][li], tb["masks_c"][li]
+        for n in range(qt):
+            for z in range(ssc):
+                if mu[n, z]:
+                    mu_cols[zr(n, z), li] = 1
+                if mc[n, z]:
+                    mc_cols[zr(n, z), li] = 1
+        for z in tb["planes"][li]:
+            for n in er:
+                mmds_cols[zr(n, z), li] = 1
+
+    bits_a1, tab_a1 = _vartabs_of(a1z)
+    bits_a2, tab_a2 = _vartabs_of(a2z)
+    bits_b1, tab_b1 = _vartabs_of(b1c)
+    bits_b2, tab_b2 = _vartabs_of(b2c)
+    bits_b3, tab_b3 = _vartabs_of(b3c)
+
+    def _vm(x, tab_ref, bits):
+        y = None
+        for pi, b in enumerate(bits):
+            tt = tab_ref[:, pi:pi + 1]
+            term = jnp.where((x >> b) & 1 == 1, tt, 0)
+            y = term if y is None else y ^ term
+        return jnp.zeros_like(x) if y is None else y
+
+    def kernel(c_ref, pair_ref, back_ref, p2c_ref, s2u_ref,
+               p2u_ref, sout_ref, bm_ref, mu_ref, mmds_ref, mc_ref,
+               ta1_ref, ta2_ref, tb1_ref, tb2_ref, tb3_ref, out_ref,
+               cz_ref, u_ref, rec_ref):
+        route = lambda p_ref, xf: jax.lax.dot_general(
+            p_ref[:], xf,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+        cz_ref[:] = c_ref[:].astype(jnp.int32)   # z-major state
+        u_ref[:] = jnp.zeros_like(u_ref)
+        w = jnp.left_shift(
+            1, jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0))
+        for li in range(n_levels):
+            cz = cz_ref[:]
+            czf = cz.astype(jnp.bfloat16)
+            cand_u = _vm(cz, ta1_ref, bits_a1) ^ \
+                _vm(route(pair_ref, czf), ta2_ref, bits_a2)
+            u_ref[:] = jnp.where(mu_ref[:, li:li + 1] == 1, cand_u,
+                                 u_ref[:])
+            # plane-wise MDS over aligned scratch slices: every
+            # iteration reads/writes fixed scratch rows, so the
+            # unroll reuses one iteration's buffers
+            for z in range(ssc):
+                grp = u_ref[z * P:(z + 1) * P, :]
+                parts = [(grp >> cbit) & 1 for cbit in range(8)]
+                bits = jnp.concatenate(parts, axis=0)   # [8P, T]
+                acc = jax.lax.dot_general(
+                    bm_ref[:], bits.astype(jnp.bfloat16),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                bbits = acc.astype(jnp.int32) & 1       # [8e, T]
+                rows = [jnp.sum(bbits[8 * j:8 * j + 8] * w, axis=0,
+                                keepdims=True) for j in range(e)]
+                rows.append(jnp.zeros((E8 - e, grp.shape[-1]),
+                                      jnp.int32))
+                rec_ref[z * E8:(z + 1) * E8, :] = \
+                    jnp.concatenate(rows, axis=0)
+            u_ref[:] = jnp.where(
+                mmds_ref[:, li:li + 1] == 1,
+                route(back_ref, rec_ref[:].astype(jnp.bfloat16)),
+                u_ref[:])
+            # phase 2: candidates for the erased rows only
+            uf = u_ref[:].astype(jnp.bfloat16)
+            czf = cz_ref[:].astype(jnp.bfloat16)
+            cand_c = _vm(route(p2c_ref, czf), tb1_ref, bits_b1) ^ \
+                _vm(route(s2u_ref, uf), tb2_ref, bits_b2) ^ \
+                _vm(route(p2u_ref, uf), tb3_ref, bits_b3)
+            cz_ref[:] = jnp.where(
+                mc_ref[:, li:li + 1] == 1,
+                route(back_ref, cand_c.astype(jnp.bfloat16)),
+                cz_ref[:])
+        out = route(sout_ref, cz_ref[:].astype(jnp.bfloat16))
+        out_ref[:] = out.astype(jnp.uint8)
+
+    bf = lambda m2: jnp.asarray(m2, dtype=jnp.bfloat16)
+    consts = [bf(s_pair), bf(s_back), bf(p2c),
+              bf(s2u), bf(p2u), bf(s_out),
+              bf(dbmat), jnp.asarray(mu_cols),
+              jnp.asarray(mmds_cols), jnp.asarray(mc_cols),
+              jnp.asarray(tab_a1), jnp.asarray(tab_a2),
+              jnp.asarray(tab_b1), jnp.asarray(tab_b2),
+              jnp.asarray(tab_b3)]
+    const_shapes = [s_pair.shape, s_back.shape,
+                    p2c.shape, s2u.shape, p2u.shape, s_out.shape,
+                    dbmat.shape, mu_cols.shape, mmds_cols.shape,
+                    mc_cols.shape, tab_a1.shape, tab_a2.shape,
+                    tab_b1.shape, tab_b2.shape, tab_b3.shape]
+
+    @functools.partial(jax.jit, static_argnames=("L",))
+    def run_padded(cflat, L):
+        grid = (L // tile,)
+        whole = lambda shape: pl.BlockSpec(
+            shape, lambda i: tuple(0 for _ in shape),
+            memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec((Rp, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM)] +
+                     [whole(s) for s in const_shapes],
+            out_specs=pl.BlockSpec((R_out, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((R_out, L), jnp.uint8),
+            scratch_shapes=[
+                pltpu.VMEM((Rp, tile), jnp.int32),      # cz
+                pltpu.VMEM((Rp, tile), jnp.int32),      # u
+                pltpu.VMEM((ssc * E8, tile), jnp.int32),  # rec
+            ],
+            compiler_params=pltpu.CompilerParams(
+                # the default scoped-vmem budget (16 MiB) is below
+                # this kernel's resident set (multi-level unroll +
+                # ~8 MiB of routing constants); raise toward the
+                # physical VMEM so Mosaic stops refusing the fit
+                vmem_limit_bytes=100 * 1024 * 1024,
+            ),
+            interpret=jax.default_backend() == "cpu",
+        )(cflat, *consts)
+
+    def transform(c_full):
+        c_full = jnp.asarray(c_full, dtype=jnp.uint8)
+        L = c_full.shape[-1]
+        lb = tile
+        while lb < L:
+            lb <<= 1
+        # z-major embedding + P-row plane-group padding happen HERE
+        # as one XLA transpose+pad (one extra HBM pass) instead of an
+        # in-kernel [R, R] routing matmul
+        flat = jnp.pad(c_full.transpose(1, 0, 2),
+                       ((0, 0), (0, P - qt), (0, 0))).reshape(Rp, L)
+        if lb != L:
+            flat = jnp.pad(flat, ((0, 0), (0, lb - L)))
+        out = run_padded(flat, lb)
+        if lb != L:
+            out = out[:, :L]
+        return out.reshape(e, ssc, L)
+
+    transform.erased = er
+    return transform
+
+
+def _vartabs_of(coef: np.ndarray):
+    """(bits tuple, stacked [rows, P] int32 table) — the shared
+    varying-constant-multiply decomposition (see build_encode_kernel's
+    _vartabs)."""
+    tabs = _varmul_tables(coef.reshape(-1, 1))
+    if not tabs:
+        return (), np.zeros((coef.size, 1), dtype=np.int32)
+    bits = tuple(b for b, _ in tabs)
+    stacked = np.stack([t.reshape(-1) for _, t in tabs],
+                       axis=1).astype(np.int32)
+    return bits, stacked
+
+
 class ClayDeviceCodec:
     """Per-codec cache of compiled layered transforms, keyed by the
     padded erased-node signature (bounded: C(k+m, m) signatures exist

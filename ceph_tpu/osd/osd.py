@@ -92,6 +92,10 @@ EEXIST = -17
 ENODATA = -61
 EOPNOTSUPP = -95
 ECANCELED = -125
+#: the fencing rejection (the reference's EBLACKLISTED, 108): the
+#: sending client instance is blocklisted in the osdmap — its ops
+#: must never land (src/osd/OSDMap.h:561 enforcement at admission)
+EBLOCKLISTED = -108
 
 #: separator for internal snapshot companion objects (clone bodies
 #: and snapset metadata live as ordinary versioned/recoverable
@@ -390,6 +394,14 @@ class OSD:
         self._op_cache: dict[tuple[str, int], M.MOSDOpReply] = {}
         self._op_cache_order: list[tuple[str, int]] = []
         self._op_cache_lock = threading.Lock()
+        # messages carrying a newer map epoch than ours park here
+        # until the mon's push catches us up
+        # (require_same_or_newer_map role, src/osd/OSD.cc): executing
+        # them against the stale map could miss a blocklist fence the
+        # client's epoch already carries. Entries are
+        # (epoch, wq_key, redispatch_fn).
+        self._map_waiters: list[tuple[int, tuple, object]] = []
+        self._map_waiters_lock = threading.Lock()
         self._hb_last_rx: dict[int, float] = {}
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -598,6 +610,9 @@ class OSD:
     def _on_map(self, newmap: OSDMap) -> None:
         with self._map_lock:
             oldmap, self.osdmap = self.osdmap, newmap
+        # messages that were parked waiting for this (or an older)
+        # epoch re-enter admission from the top against the fresh map
+        self._drain_map_waiters(newmap.epoch)
         # a peer that (re)booted gets a fresh heartbeat grace window:
         # without this, a down->up map pair arriving between two ticks
         # leaves the pre-kill silence clock running and we'd report the
@@ -797,6 +812,29 @@ class OSD:
         else:
             log(5, f"unhandled message {msg!r}")
 
+    def _park_for_map(self, epoch: int, key: tuple, fn) -> None:
+        """Park a message needing map ``epoch``; re-dispatched by the
+        map push. Re-checks after the append so a push that drained
+        concurrently cannot strand the entry until the next push or
+        client resend (the park-after-drain race)."""
+        with self._map_waiters_lock:
+            self._map_waiters.append((epoch, key, fn))
+            # backstop: clients resend, so shed oldest on overflow
+            while len(self._map_waiters) > 10000:
+                self._map_waiters.pop(0)
+        cur = self.get_osdmap().epoch
+        if cur >= epoch:
+            self._drain_map_waiters(cur)
+
+    def _drain_map_waiters(self, epoch: int) -> None:
+        with self._map_waiters_lock:
+            ready = [(k, f) for e, k, f in self._map_waiters
+                     if e <= epoch]
+            self._map_waiters = [(e, k, f) for e, k, f
+                                 in self._map_waiters if e > epoch]
+        for k, f in ready:
+            self.op_wq.enqueue(k, f)
+
     # -- watch/notify (Watch.h / rados_watch+notify roles) ------------
     def _handle_watch(self, msg: M.MWatch, conn: Connection) -> None:
         """Register/unregister a watcher on this primary. Watch state
@@ -805,6 +843,19 @@ class OSD:
         OSD restart drops it, and clients re-watch on the epoch bump
         their map subscription delivers."""
         key = (msg.pool, msg.oid)
+        osdmap = self.get_osdmap()
+        if msg.watch and msg.epoch > osdmap.epoch:
+            # same stale-map fence as ops: the client's epoch may
+            # carry a blocklist entry this map misses
+            self._park_for_map(
+                msg.epoch, (msg.pool, msg.ps),
+                lambda m=msg, c=conn: self._handle_watch(m, c))
+            return
+        if msg.watch and osdmap.is_blocklisted(
+                msg.client or conn.peer_name):
+            conn.send_message(M.MWatchAck(tid=msg.tid,
+                                          code=EBLOCKLISTED))
+            return
         with self._watch_lock:
             if msg.watch:
                 self._watchers.setdefault(key, {})[
@@ -1018,6 +1069,33 @@ class OSD:
         span = tracing.tracer().from_wire(
             msg.trace, f"handle_osd_op(oid={msg.oid})",
             f"osd.{self.whoami}")
+        if msg.epoch > osdmap.epoch:
+            # the client targeted a newer map than we hold — park
+            # until the mon push catches us up. Required for the
+            # blocklist fence: the newer epoch may carry an entry this
+            # map misses, and once we HAVE processed any op at epoch E
+            # every later-arriving op from a client fenced at E is
+            # rejected below (the fencing linearization argument)
+            track.mark_event("waiting_for_map")
+            track.finish()
+            span.event("waiting_for_map")
+            span.finish()
+            self._park_for_map(
+                msg.epoch, (msg.pool, msg.ps),
+                lambda m=msg, c=conn: self._handle_osd_op(m, c))
+            return
+        if osdmap.is_blocklisted(msg.client):
+            # the cluster fenced this client instance (a deposed MDS,
+            # a broken rbd lock holder): nothing from it may land,
+            # not even a dup-cache hit
+            track.mark_event("blocklisted")
+            track.finish()
+            span.event("blocklisted")
+            span.finish()
+            conn.send_message(M.MOSDOpReply(
+                tid=msg.tid, code=EBLOCKLISTED, epoch=osdmap.epoch,
+                data=b"", version=0))
+            return
         cache_key = (msg.client, msg.tid)
         if msg.op in self._MUTATING_OPS:
             with self._op_cache_lock:

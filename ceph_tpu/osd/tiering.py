@@ -32,7 +32,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from ceph_tpu.utils.workerpool import DaemonPool
 
 from ceph_tpu.parallel import messages as M
 from ceph_tpu.utils.dout import Dout
@@ -62,7 +62,7 @@ class TierService:
         self.osd = osd
         self._objecter = None
         self._obj_lock = threading.Lock()
-        self._wq = ThreadPoolExecutor(
+        self._wq = DaemonPool(
             max_workers=2, thread_name_prefix=f"osd{osd.whoami}-tier")
         self._agent_running = False
         self._agent_lock = threading.Lock()

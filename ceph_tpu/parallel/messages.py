@@ -476,7 +476,12 @@ class MWatch(Message):
     documented lite of the reference's persisted watch state)."""
     MSG_TYPE = 50
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
-              ("oid", "str"), ("cookie", "u64"), ("watch", "bool")]
+              ("oid", "str"), ("cookie", "u64"), ("watch", "bool"),
+              # client INSTANCE id ("name:nonce") — what the osdmap
+              # blocklist fences; admission checks it (r5) — and the
+              # client's map epoch so a stale-map OSD parks the
+              # registration instead of missing a fresh fence
+              ("client", "str"), ("epoch", "u32")]
 
 
 class MWatchAck(Message):

@@ -68,7 +68,7 @@ log = Dout("mon")
 _READONLY_COMMANDS = frozenset({
     "osd erasure-code-profile ls", "osd erasure-code-profile get",
     "osd pool ls", "osd pool lssnap", "osd tree", "osd dump",
-    "status", "health", "config dump",
+    "status", "health", "config dump", "osd blocklist ls",
 })
 
 
@@ -128,6 +128,8 @@ class Monitor:
         #: proposal stalls, every tick would otherwise queue another
         #: identical osdmap scan
         self._beacon_check_queued = False
+        #: same dedup for the blocklist-expiry prune mutation
+        self._blocklist_prune_queued = False
         # "client|tid" -> [code, outs, data_hex]: REPLICATED command
         # dedup — part of the committed state, so a retry after leader
         # failover attaches to the original execution instead of
@@ -1467,6 +1469,33 @@ class Monitor:
                     self._beacon_check_queued = False
 
                 self._enqueue_mutation(check_beacons, done=rearm)
+            # prune lapsed blocklist entries (the reference's osdmap
+            # blacklist expiry): enforcement is already lazy in
+            # is_blocklisted, but without this the map grows with
+            # every failover/lock-break forever and 'osd blocklist
+            # ls' reports long-dead fences
+            wall = time.time()
+            lapsed = [ent for ent, until in self.osdmap.blocklist.items()
+                      if until and until <= wall]
+            if lapsed and not self._blocklist_prune_queued:
+                self._blocklist_prune_queued = True
+
+                def prune_blocklist():
+                    self._blocklist_prune_queued = False
+                    w = time.time()
+                    dead = [ent for ent, until in
+                            self.osdmap.blocklist.items()
+                            if until and until <= w]
+                    for ent in dead:
+                        del self.osdmap.blocklist[ent]
+                    if dead:
+                        self._commit()
+
+                def rearm_prune(ok: bool) -> None:
+                    self._blocklist_prune_queued = False
+
+                self._enqueue_mutation(prune_blocklist,
+                                       done=rearm_prune)
 
     # -- command handling (OSDMonitor::prepare_command role) ----------
     def _handle_command(self, cmd: dict) -> tuple[int, str, bytes]:
@@ -1651,6 +1680,38 @@ class Monitor:
                 self.osdmap.crush.reweight(osd, 1.0)
                 self._commit()
                 return 0, f"marked in osd.{osd}", b""
+            if prefix == "osd blocklist":
+                # the fencing primitive (OSDMonitor "osd blacklist"
+                # command, src/mon/OSDMonitor.cc; map field
+                # src/osd/OSDMap.h:561). addr is a client instance id
+                # ("mds.a:3fb2c9d1") or a bare entity name fencing
+                # every instance. The reply data carries the new map
+                # epoch so the caller can wait for the fence to be
+                # in force (MDSMonitor::fail_mds waits for the
+                # osdmon the same way, src/mon/MDSMonitor.cc:729-741).
+                op = cmd["blocklistop"]
+                entity = cmd.get("addr", "")
+                if op == "add":
+                    if not entity:
+                        return -22, "missing addr", b""
+                    expire = float(cmd.get("expire", 3600.0))
+                    until = time.time() + expire if expire > 0 else 0.0
+                    self.osdmap.blocklist_add(entity, until)
+                    self._commit()
+                    return (0, f"blocklisting {entity}",
+                            json.dumps(
+                                {"epoch": self.osdmap.epoch}).encode())
+                if op == "rm":
+                    if not self.osdmap.blocklist_rm(entity):
+                        return -2, f"{entity} is not blocklisted", b""
+                    self._commit()
+                    return (0, f"un-blocklisting {entity}",
+                            json.dumps(
+                                {"epoch": self.osdmap.epoch}).encode())
+                return -22, f"unknown blocklistop {op!r}", b""
+            if prefix == "osd blocklist ls":
+                return 0, "", json.dumps(
+                    self.osdmap.blocklist, sort_keys=True).encode()
             if prefix == "osd pg-upmap-items":
                 return self._cmd_pg_upmap_items(cmd)
             if prefix == "osd rm-pg-upmap-items":

@@ -93,6 +93,14 @@ class OSDMap:
         # pg_temp — how the mgr balancer moves individual PGs
         self.pg_upmap_items: dict[tuple[int, int],
                                   list[tuple[int, int]]] = {}
+        #: the cluster's fencing primitive (OSDMap blacklist role,
+        #: src/osd/OSDMap.h:561): client instance id -> expiry unix
+        #: time (0.0 = no expiry). Epoch-carried like every other map
+        #: field; OSDs reject ops from listed clients at admission.
+        #: An entry may also be a BARE entity name ("mds.a"), which
+        #: fences every instance "mds.a:<nonce>" — the reference's
+        #: whole-addr (any nonce) blocklist variant.
+        self.blocklist: dict[str, float] = {}
         self._next_pool_id = 1
 
     # -- mutation (mon side) ------------------------------------------
@@ -126,6 +134,31 @@ class OSDMap:
         self.pools[pid] = pool
         self.pool_by_name[name] = pid
         return pool
+
+    def blocklist_add(self, entity: str, until: float = 0.0) -> None:
+        """Fence ``entity`` (an instance id "name:nonce" or a bare
+        name fencing all its instances) until unix time ``until``
+        (0 = until removed)."""
+        self.blocklist[entity] = until
+
+    def blocklist_rm(self, entity: str) -> bool:
+        return self.blocklist.pop(entity, None) is not None
+
+    def is_blocklisted(self, entity: str,
+                       now: float | None = None) -> bool:
+        """Op-admission fence check (OSDMap::is_blacklisted role).
+        Matches the exact instance id and the bare entity name before
+        the nonce separator."""
+        if not self.blocklist or not entity:
+            return False
+        if now is None:
+            import time
+            now = time.time()
+        for key in (entity, entity.split(":", 1)[0]):
+            until = self.blocklist.get(key)
+            if until is not None and (until == 0.0 or until > now):
+                return True
+        return False
 
     # -- queries ------------------------------------------------------
     def down_set(self) -> set[int]:
@@ -264,7 +297,9 @@ class OSDMap:
                                 en.str(p.cache_mode),
                                 en.u64(p.target_max_objects),
                                 en.u64(p.target_max_bytes)))
-        e.section(4, body)
+        # v5: blocklist (appended)
+        body.map(self.blocklist, Encoder.str, Encoder.f64)
+        e.section(5, body)
         return e.getvalue()
 
     # -- chunked encoding (per-value Paxos log / share_state role) ----
@@ -298,6 +333,7 @@ class OSDMap:
                         for k, v in self.pg_temp.items()},
             "upmap": {f"{k[0]}.{k[1]}": v
                       for k, v in self.pg_upmap_items.items()},
+            "blocklist": self.blocklist,
         }, sort_keys=True).encode()
         return ch
 
@@ -313,6 +349,7 @@ class OSDMap:
             tuple(int(x) for x in k.split(".")):
                 [tuple(p) for p in v]
             for k, v in meta["upmap"].items()}
+        m.blocklist = dict(meta.get("blocklist", {}))
         cr = json.loads(ch["crush"])
         for bid_s, (name, btype, items, weights) in \
                 cr["buckets"].items():
@@ -341,7 +378,7 @@ class OSDMap:
 
     @classmethod
     def decode(cls, buf: bytes) -> "OSDMap":
-        version, d = Decoder(buf).section(4)
+        version, d = Decoder(buf).section(5)
         m = cls()
         m.epoch = d.u32()
 
@@ -403,4 +440,6 @@ class OSDMap:
                     p.cache_mode = mode
                     p.target_max_objects = tmo
                     p.target_max_bytes = tmb
+        if version >= 5:
+            m.blocklist = d.map(Decoder.str, Decoder.f64)
         return m

@@ -40,13 +40,17 @@ This daemon keeps that architecture on the framework's substrate:
   serves. A deposed active notices its renewal failing and fences
   itself (ops get ESTALE; clients re-read the mdsmap and re-target).
 
-Documented reduction: fencing is checked at op START — an op already
-executing on a just-deposed active can still land writes for a brief
-window. The reference closes that window by OSD-blocklisting the dead
-MDS's client (src/mon/MDSMonitor.cc fail_mds -> blocklist); here the
-lease tick is the only fence. Replay tolerates the overlap (steps are
-idempotent-tolerant), but a concurrent-writer overlap of a few
-hundred ms exists where the reference has none.
+Fencing is airtight (round-5): the takeover blocklists the
+predecessor's rados INSTANCE in the osdmap before replaying or
+serving (src/mon/MDSMonitor.cc:729-741 fail_mds -> blacklist), and
+waits for its own client to hold the blocklist epoch. From then on
+every op the new active sends carries epoch >= fence, forcing each
+OSD it touches up to that map first — so any OSD that has executed
+one of our ops rejects everything the deposed instance still has in
+flight (EBLOCKLISTED at admission). A deposed write can only land
+BEFORE our first contact with that OSD, which linearizes it before
+the takeover — the same argument the reference's blocklist fence
+rests on.
 """
 
 from __future__ import annotations
@@ -56,7 +60,7 @@ import json
 import threading
 import time
 from collections import OrderedDict
-from concurrent.futures import ThreadPoolExecutor
+from ceph_tpu.utils.workerpool import DaemonPool
 
 from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Connection, Messenger
@@ -107,13 +111,13 @@ class MDSDaemon:
         # here, OFF the messenger loop; cap_release/session ops are
         # handled inline in dispatch so a pool full of blocked
         # acquirers can never starve the releases that unblock them
-        self._workers = ThreadPoolExecutor(
+        self._workers = DaemonPool(
             max_workers=8, thread_name_prefix=f"mds-{name}")
         # the revoke-flush path (setattr/getattr) gets its OWN small
         # pool: a revoked writer must flush before releasing, and that
         # flush must never queue behind a main pool saturated with
         # blocked cap_acquire workers waiting on that very release
-        self._flush_workers = ThreadPoolExecutor(
+        self._flush_workers = DaemonPool(
             max_workers=2, thread_name_prefix=f"mds-{name}-flush")
         # -- cap state (Locker.cc role) --
         self._cap_lock = threading.Lock()
@@ -221,10 +225,49 @@ class MDSDaemon:
                 mdsmap = json.loads(self.io.read(MDSMAP_OID))
             except Exception:
                 mdsmap = {"epoch": 0}
+            # fence the predecessor BEFORE replay/serving: blocklist
+            # its rados instance in the osdmap so any write it still
+            # has in flight can never land after our takeover (the
+            # reference's fail_mds waits for the osdmon writeable to
+            # blacklist the dead MDS the same way,
+            # src/mon/MDSMonitor.cc:729-741). Our own ops then carry
+            # the blocklist epoch, so every OSD serving us enforces
+            # the fence before anything of ours executes there.
+            # guard on the INSTANCE, not the name: a restarted daemon
+            # reusing its name (same supervisor slot) must still fence
+            # its own dead predecessor instance
+            prev = mdsmap.get("instance", "")
+            if prev and prev != self._rados.instance:
+                # 24h fence (the reference's mds_blocklist_interval
+                # default): long enough that a paused-and-resumed
+                # predecessor re-learns its fate client-side (its
+                # first rejected op sticky-fences its objecter) well
+                # before the entry lapses
+                code, _outs, data = self._rados.mon_command(
+                    {"prefix": "osd blocklist",
+                     "blocklistop": "add", "addr": prev,
+                     "expire": 86400.0})
+                if code == 0:
+                    fence_epoch = json.loads(data)["epoch"]
+                    # retry transient map-push delays (mon election,
+                    # slow push) instead of dying mid-takeover with
+                    # the active lock held
+                    while not self._stop.is_set():
+                        try:
+                            self._rados.monc.wait_for_map(
+                                fence_epoch, timeout=10.0)
+                            break
+                        except TimeoutError:
+                            log(1, f"mds.{self.name}: waiting for "
+                                f"fence epoch {fence_epoch}")
+                else:
+                    log(0, f"mds.{self.name}: predecessor blocklist "
+                        f"failed (code {code}) — serving anyway")
             self.epoch = int(mdsmap.get("epoch", 0)) + 1
             self.io.write_full(MDSMAP_OID, json.dumps(
                 {"epoch": self.epoch, "active": self.name,
-                 "addr": self.addr}).encode())
+                 "addr": self.addr,
+                 "instance": self._rados.instance}).encode())
             # up:replay — CephFS.__init__ replays the journal tail,
             # finishing any predecessor's half-done dirop
             fs = CephFS(self.io, journaling=True, caps=False,

@@ -24,7 +24,7 @@ import json
 import threading
 import time
 import uuid
-from concurrent.futures import ThreadPoolExecutor
+from ceph_tpu.utils.workerpool import DaemonPool
 
 from ceph_tpu.client.striper import FileLayout, StripedObject
 from ceph_tpu.parallel import messages as M
@@ -69,7 +69,7 @@ class CephFSMount:
         self._ino_locks: dict[int, threading.RLock] = {}
         # revoke handling must run OFF the messenger loop: the flush +
         # release RPC waits on replies dispatched by that very loop
-        self._revoker = ThreadPoolExecutor(
+        self._revoker = DaemonPool(
             max_workers=2, thread_name_prefix=f"fs-revoke")
         self._cap_ttl = 2.0
         self._rpc("session_open", {})

@@ -85,7 +85,8 @@ class RBD:
     def create(self, name: str, size: int,
                layout: FileLayout | None = None,
                journaling: bool = False,
-               primary: bool = True) -> "Image":
+               primary: bool = True,
+               exclusive: bool = False) -> "Image":
         # reserve the directory entry FIRST (atomic in-OSD -EEXIST):
         # a racing create of the same name loses cleanly. A failure
         # AFTER the reservation rolls it back, so a half-created
@@ -100,7 +101,7 @@ class RBD:
                       "sc": layout.stripe_count,
                       "os": layout.object_size,
                       "snaps": {}, "journaling": journaling,
-                      "primary": primary}
+                      "primary": primary, "exclusive": exclusive}
             if journaling:
                 Journaler(self.io, f"rbd.{name}").create()
             self.io.write_full(f"rbd_header.{name}",
@@ -143,6 +144,10 @@ class RBD:
         except Exception:
             pass
         try:
+            self.io.remove(f"rbd_header_lock.{name}")
+        except Exception:
+            pass
+        try:
             _dir_call(self.io, "dir_remove_image", name=name)
         except RBDError:
             pass
@@ -182,6 +187,7 @@ class Image:
             cache = bool(g_conf()["rbd_cache"])
         self.cache = None
         self._watch_cookie = None
+        self._lock_held = False
         if cache:
             from ceph_tpu.client.object_cacher import ObjectCacher
             self.cache = ObjectCacher(g_conf()["rbd_cache_size"])
@@ -232,7 +238,12 @@ class Image:
             pass               # no watchers / primary briefly gone
 
     def close(self) -> None:
-        """Drop the header watch (librbd close role)."""
+        """Drop the header watch and release a held exclusive lock
+        (librbd close role) — a cleanly-closed holder must not leave
+        the image locked forever (the only remedy would be a
+        lock_break that blocklists a healthy client)."""
+        if self._lock_held:
+            self.lock_release()
         if self._watch_cookie is not None:
             try:
                 self.io.unwatch(self._watch_cookie)
@@ -341,10 +352,107 @@ class Image:
         d = Decoder(payload)
         return d.str(), d.u64(), d.bytes(), d.str()
 
+    # -- exclusive lock (src/librbd/ManagedLock.h:28 role) -------------
+    # The cooperative half is a cls exclusive lock on the header object
+    # recording the holder's rados INSTANCE id; the fencing half is the
+    # osdmap blocklist: lock_break() blocklists the recorded instance
+    # before removing the lock, so a dead/hung holder's in-flight
+    # writes can never land after the steal (the break/steal flow the
+    # reference drives through its lock + blacklist pair).
+    _LOCK_NAME = "rbd_lock"
+
+    def _lock_oid(self) -> str:
+        # dedicated object: cls lock state IS the object data, so it
+        # must never share an oid with the header payload
+        return f"rbd_header_lock.{self.name}"
+
+    def lock_acquire(self) -> None:
+        """Take (or re-assert) the exclusive lock. No expiry: holder
+        death is handled by lock_break's fence, as in the reference."""
+        from ceph_tpu.client.rados import RadosError
+        inst = self.io.client.instance
+        try:
+            self.io.execute(self._lock_oid(), "lock", "lock",
+                            json.dumps({
+                                "name": self._LOCK_NAME,
+                                "cookie": inst,
+                                "type": "exclusive",
+                                "duration": 0,
+                                "owner": inst}).encode())
+        except RadosError as exc:
+            if exc.code == -16:
+                raise RBDError(
+                    f"image {self.name!r} is exclusively locked by "
+                    "another client") from None
+            raise
+        self._lock_held = True
+
+    def lock_release(self) -> None:
+        from ceph_tpu.client.rados import RadosError
+        self._lock_held = False
+        try:
+            self.io.execute(self._lock_oid(), "lock", "unlock",
+                            json.dumps({
+                                "name": self._LOCK_NAME,
+                                "cookie": self.io.client.instance,
+                            }).encode())
+        except RadosError:
+            pass                      # already broken/expired
+
+    def lock_owner(self) -> str | None:
+        """The current holder's instance id, or None."""
+        try:
+            st = json.loads(self.io.execute(self._lock_oid(), "lock",
+                                            "info"))
+        except Exception:
+            return None
+        for key, ent in st.get("lockers", {}).items():
+            if key.startswith(f"{self._LOCK_NAME}/"):
+                return ent.get("owner") or key.split("/", 1)[1]
+        return None
+
+    def lock_break(self, blocklist: bool = True) -> None:
+        """Steal a (presumed dead) holder's lock. With ``blocklist``
+        (the default, and the only safe mode for a live-but-hung
+        holder) the holder's instance is fenced in the osdmap FIRST
+        and the breaker waits for the fence epoch — after that none
+        of the old holder's in-flight writes can land."""
+        owner = self.lock_owner()
+        if owner is None:
+            return
+        if blocklist:
+            # 24h fence (see mds.py takeover note): the stolen-from
+            # holder's first rejected op sticky-fences its client
+            # instance long before the entry lapses
+            code, _outs, data = self.io.client.mon_command(
+                {"prefix": "osd blocklist", "blocklistop": "add",
+                 "addr": owner, "expire": 86400.0})
+            if code != 0:
+                raise RBDError(
+                    f"cannot fence lock owner {owner!r}: {code}")
+            self.io.client.monc.wait_for_map(
+                json.loads(data)["epoch"])
+        from ceph_tpu.client.rados import RadosError
+        try:
+            # break the EXACT lock we read and fenced — "*" could
+            # wipe a new healthy holder who acquired after a clean
+            # release during our fence round-trip (cookie == owner
+            # instance by lock_acquire's construction)
+            self.io.execute(self._lock_oid(), "lock", "break_lock",
+                            json.dumps({"name": self._LOCK_NAME,
+                                        "cookie": owner}).encode())
+        except RadosError as exc:
+            if exc.code != -2:        # already gone is success
+                raise
+
     def _check_writable(self) -> None:
         if not self._header.get("primary", True):
             raise RBDError(
                 f"image {self.name!r} is non-primary (mirror target)")
+        if self._header.get("exclusive") and not self._lock_held:
+            # exclusive-lock feature: auto-acquire on first write
+            # (librbd acquires the managed lock lazily the same way)
+            self.lock_acquire()
 
     def resize(self, new_size: int) -> None:
         self._check_writable()

@@ -1,0 +1,274 @@
+"""OSD blocklisting — the cluster's fencing primitive (round-5).
+
+The reference fences dead/deposed daemons by blacklisting their
+address in the osdmap (src/osd/OSDMap.h:561), epoch-propagated and
+enforced at op admission; MDS failover drives it
+(src/mon/MDSMonitor.cc:729-741) and rbd lock-steal rides it
+(src/librbd/ManagedLock.h:28). Here the blocklist fences client
+INSTANCE ids (name:nonce — the entity_addr:nonce analog).
+"""
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import RadosClient, RadosError
+from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.qa.cluster import MiniCluster
+
+EBLOCKLISTED = -108
+
+
+# -- unit: map semantics -------------------------------------------------
+
+def test_osdmap_blocklist_semantics():
+    m = OSDMap()
+    m.blocklist_add("client.a:1111")
+    assert m.is_blocklisted("client.a:1111")
+    assert not m.is_blocklisted("client.a:2222")   # other instance
+    assert not m.is_blocklisted("client.b:1111")
+    # bare-name entry fences every instance of the name
+    m.blocklist_add("mds.x")
+    assert m.is_blocklisted("mds.x:deadbeef")
+    assert m.is_blocklisted("mds.x")
+    # expiry honored lazily
+    m.blocklist_add("client.t:9", until=time.time() - 1)
+    assert not m.is_blocklisted("client.t:9")
+    m.blocklist_add("client.t:9", until=time.time() + 60)
+    assert m.is_blocklisted("client.t:9")
+    # removal
+    assert m.blocklist_rm("client.a:1111")
+    assert not m.is_blocklisted("client.a:1111")
+    assert not m.blocklist_rm("client.a:1111")
+
+
+def test_osdmap_blocklist_wire_roundtrip():
+    m = OSDMap()
+    m.epoch = 7
+    m.add_osd(0, "h:1")
+    m.blocklist_add("mds.a:abcd1234")
+    m.blocklist_add("client.x", until=12345.5)
+    got = OSDMap.decode(m.encode())
+    assert got.blocklist == m.blocklist
+    got2 = OSDMap.from_chunks(m.to_chunks())
+    assert got2.blocklist == m.blocklist
+
+
+# -- cluster: enforcement at op admission --------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        c.client()
+        c.create_pool("blk", pg_num=4, size=2)
+        yield c
+
+
+def _blocklist(client, entity, **kw):
+    cmd = {"prefix": "osd blocklist", "blocklistop": "add",
+           "addr": entity}
+    cmd.update(kw)
+    code, outs, data = client.mon_command(cmd)
+    assert code == 0, outs
+    return json.loads(data)["epoch"]
+
+
+def test_blocklist_fences_client(cluster):
+    victim = cluster.client()
+    io = victim.open_ioctx("blk")
+    io.write_full("pre", b"before fence")
+    admin = cluster.client()
+    epoch = _blocklist(admin, victim.instance)
+    victim.wait_for_epoch(epoch)
+    with pytest.raises(RadosError) as ei:
+        io.write_full("post", b"after fence")
+    assert ei.value.code == EBLOCKLISTED
+    # reads are fenced too: admission rejects the instance wholesale
+    with pytest.raises(RadosError) as ei:
+        io.read("pre")
+    assert ei.value.code == EBLOCKLISTED
+    # another instance of the same client family is unaffected
+    other = cluster.client()
+    assert other.open_ioctx("blk").read("pre") == b"before fence"
+    # the fenced CLIENT stays sticky-fenced even after rm (librbd's
+    # invalidation role: a once-fenced instance must never resume) —
+    # but the map-level entry is gone, so the same instance id via a
+    # FRESH connection works again
+    code, outs, data = admin.mon_command(
+        {"prefix": "osd blocklist", "blocklistop": "rm",
+         "addr": victim.instance})
+    assert code == 0, outs
+    with pytest.raises(RadosError) as ei:
+        io.write_full("post", b"sticky")
+    assert ei.value.code == EBLOCKLISTED
+    fresh = RadosClient(cluster.mon_addr,
+                        instance=victim.instance).connect()
+    fresh.wait_for_epoch(json.loads(data)["epoch"])
+    # prove the map-level unfence with a READ: writes would hit the
+    # old instance's dup-op cache (same id, same tid space — an
+    # impersonation-test artifact, not a product path: real clients
+    # never reuse an instance id)
+    assert fresh.open_ioctx("blk").read("pre") == b"before fence"
+    fresh.shutdown()
+
+
+def test_blocklist_expiry(cluster):
+    victim = cluster.client()
+    io = victim.open_ioctx("blk")
+    epoch = _blocklist(cluster._clients[0], victim.instance,
+                       expire=1.0)
+    victim.wait_for_epoch(epoch)
+    with pytest.raises(RadosError):
+        io.write_full("exp", b"x")
+    time.sleep(1.1)
+    # entry expired (lazy, no new map needed): a client that was
+    # NEVER rejected writes again — but the rejected-one stays
+    # sticky-fenced (it must not resume with stale state)
+    with pytest.raises(RadosError):
+        io.write_full("exp", b"sticky")
+    fresh = RadosClient(cluster.mon_addr,
+                        instance=victim.instance).connect()
+    fio = fresh.open_ioctx("blk")
+    fio.write_full("exp", b"y")
+    assert fio.read("exp") == b"y"
+    fresh.shutdown()
+
+
+def test_blocklist_ls(cluster):
+    admin = cluster._clients[0]
+    epoch = _blocklist(admin, "client.ghost:1234")
+    admin.wait_for_epoch(epoch)
+    code, _outs, data = admin.mon_command(
+        {"prefix": "osd blocklist ls"})
+    assert code == 0
+    assert "client.ghost:1234" in json.loads(data)
+    admin.mon_command({"prefix": "osd blocklist", "blocklistop": "rm",
+                       "addr": "client.ghost:1234"})
+
+
+def test_watch_registration_fenced(cluster):
+    """A fenced instance must not be able to (re)register watches —
+    the MWatch carries the client instance id for admission (r5)."""
+    victim = cluster.client()
+    io = victim.open_ioctx("blk")
+    io.write_full("wobj", b"x")
+    admin = cluster._clients[0]
+    epoch = _blocklist(admin, victim.instance)
+    victim.wait_for_epoch(epoch)
+    with pytest.raises(RadosError):
+        io.watch("wobj", lambda p: None)
+    admin.mon_command({"prefix": "osd blocklist", "blocklistop": "rm",
+                       "addr": victim.instance})
+
+
+def test_mon_prunes_expired_blocklist(cluster):
+    """Lapsed entries leave the map via the mon tick (the reference
+    expires its osdmap blacklist the same way) — without this every
+    failover/lock-break grows the map forever."""
+    admin = cluster._clients[0]
+    _blocklist(admin, "client.prune:1", expire=0.5)
+    deadline = time.time() + 20
+    listing = {}
+    while time.time() < deadline:
+        code, _o, data = admin.mon_command(
+            {"prefix": "osd blocklist ls"})
+        assert code == 0
+        listing = json.loads(data)
+        if "client.prune:1" not in listing:
+            break
+        time.sleep(0.5)
+    assert "client.prune:1" not in listing, \
+        "expired blocklist entry never pruned"
+
+
+def test_mds_takeover_blocklists_predecessor(cluster):
+    """Closes the deposed-active write window: the standby taking over
+    blocklists the dead active's rados instance BEFORE serving
+    (src/mon/MDSMonitor.cc:729-741 fail_mds -> blacklist), so a write
+    the deposed daemon still has in flight cannot land afterward."""
+    from ceph_tpu.services.mds import MDSDaemon
+    from ceph_tpu.services.mds_client import CephFSMount
+
+    cluster.create_pool("mdsblk", pg_num=4, size=2)
+    a = MDSDaemon("ba", cluster.mon_addr, "mdsblk",
+                  active_ttl=1.0).start(wait_active=True)
+    a_inst = a._rados.instance
+    a.kill()                           # crash with the lock held
+    b = MDSDaemon("bb", cluster.mon_addr, "mdsblk",
+                  active_ttl=1.0).start(wait_active=True, timeout=30.0)
+    try:
+        admin = cluster._clients[0]
+        code, _outs, data = admin.mon_command(
+            {"prefix": "osd blocklist ls"})
+        assert code == 0
+        assert a_inst in json.loads(data), \
+            "takeover must fence the predecessor instance"
+        # an op from the fenced instance — the 'already executing on
+        # the deposed active' case, impersonated by a fresh client
+        # with the same wire identity — cannot land
+        imp = RadosClient(cluster.mon_addr, instance=a_inst).connect()
+        with pytest.raises(RadosError) as ei:
+            imp.open_ioctx("mdsblk").write_full("late", b"stale")
+        assert ei.value.code == EBLOCKLISTED
+        imp.shutdown()
+        # the new active serves normally
+        io = admin.open_ioctx("mdsblk")
+        with CephFSMount(io) as m:
+            m.mkdir("/post-takeover")
+            assert "post-takeover" in m.readdir("/")
+    finally:
+        b.stop()
+
+
+def test_rbd_lock_steal_fences_old_holder(cluster):
+    """rbd exclusive-lock break via the blocklist
+    (src/librbd/ManagedLock.h:28): the stealer fences the recorded
+    holder instance, so the old holder's writes — cooperative checks
+    bypassed or not — can never land after the steal."""
+    from ceph_tpu.services.rbd import RBD, RBDError
+
+    cluster.create_pool("rbdblk", pg_num=4, size=2)
+    c1 = cluster.client()
+    c2 = cluster.client()
+    io1 = c1.open_ioctx("rbdblk")
+    io2 = c2.open_ioctx("rbdblk")
+    RBD(io1).create("img", 4 << 20, exclusive=True)
+    img1 = RBD(io1).open("img")
+    img1.write(0, b"owner1")           # auto-acquires the lock
+    assert img1.lock_owner() == c1.instance
+    img2 = RBD(io2).open("img")
+    with pytest.raises(RBDError):      # cooperative half holds
+        img2.write(0, b"intruder")
+    img2.lock_break()                  # fence + break
+    img2.write(0, b"owner2")
+    assert img2.lock_owner() == c2.instance
+    assert img2.read(0, 6) == b"owner2"
+    # the fenced ex-holder (which still believes it holds the lock)
+    # is rejected at RADOS admission, not by courtesy
+    c1.wait_for_epoch(cluster.mon.osdmap.epoch)
+    with pytest.raises(RadosError) as ei:
+        img1.write(0, b"zombie")
+    assert ei.value.code == EBLOCKLISTED
+
+
+def test_impersonated_instance_is_fenced(cluster):
+    """The deposed-daemon scenario reduced to its essence: an op from
+    the fenced INSTANCE — even one 'already past the start fence'
+    (carried by a live connection that acquired the instance id
+    before the fence) — cannot land."""
+    ghost = RadosClient(cluster.mon_addr).connect()
+    inst = ghost.instance
+    io = ghost.open_ioctx("blk")
+    io.write_full("g1", b"pre")
+    epoch = _blocklist(cluster._clients[0], inst)
+    # a FRESH client impersonating the fenced instance (same wire
+    # identity, new connection — strictly more capable than the dying
+    # daemon's in-flight op) still cannot write
+    imp = RadosClient(cluster.mon_addr, instance=inst).connect()
+    imp.wait_for_epoch(epoch)
+    with pytest.raises(RadosError) as ei:
+        imp.open_ioctx("blk").write_full("g2", b"post-fence")
+    assert ei.value.code == EBLOCKLISTED
+    ghost.shutdown()
+    imp.shutdown()

@@ -174,3 +174,93 @@ def test_encode_fused_xla_bit_exact():
     for p in range(m):
         assert np.array_equal(dev[p].reshape(-1),
                               np.asarray(host[k + p]))
+
+
+def test_decode_tables_globally_consistent():
+    """The round-5 observation the decode kernel rests on: per-slot
+    coefficient/partner assignments are geometric (level-independent)
+    — build_decode_tables asserts consistency while merging the
+    per-level tables, across signatures."""
+    from ceph_tpu.models.clay_device import build_decode_tables
+
+    c = make(k=4, m=3, d=6)               # virtual-node profile
+    qt = c.q * c.t
+    for er in itertools.combinations(range(qt), c.m):
+        build_decode_tables(c, frozenset(er))   # asserts internally
+
+
+def test_decode_kernel_single_pallas_bit_exact():
+    """Round-5 structured DECODE kernel (build_transform_kernel, the
+    decode counterpart of the r4 encode kernel): bit-exact vs the
+    host layered oracle across erasure signatures, profiles (incl.
+    virtual nodes), and payload sizes. Runs the real pallas path on
+    TPU and interpret mode on CPU."""
+    from ceph_tpu.models.clay_device import build_transform_kernel
+
+    rng = np.random.default_rng(31)
+    cases = [
+        (dict(k=8, m=4, d=11), [[0, 1], [0, 9], [3], [0, 5, 8, 11]]),
+        (dict(k=4, m=2), [[0, 1], [1, 4], [5]]),
+        (dict(k=4, m=3, d=6), [[0, 1, 2], [2], [4, 6]]),
+    ]
+    for prof, signatures in cases:
+        c = make(**prof)
+        k, m = c.k, c.m
+        ssc, qt = c.sub_chunk_no, c.q * c.t
+        for erase in signatures:
+            for L in (16, 100):
+                data = {i: rng.integers(0, 256, ssc * L,
+                                        dtype=np.uint8)
+                        for i in range(k)}
+                enc = c.encode_chunks(list(range(k, k + m)), data)
+                full = dict(data)
+                full.update(enc)
+                chunks = {i: b for i, b in full.items()
+                          if i not in erase}
+                oracle = c._decode_chunks_host(erase, chunks)
+                erased = {c._node_id(i) for i in erase}
+                for i in range(k + c.nu, qt):
+                    if len(erased) >= m:
+                        break
+                    erased.add(i)
+                fn = build_transform_kernel(c, frozenset(erased))
+                cin = np.zeros((qt, ssc, L), dtype=np.uint8)
+                for i, b in chunks.items():
+                    node = c._node_id(i)
+                    if node not in erased:
+                        cin[node] = np.asarray(b).reshape(ssc, L)
+                rec = np.asarray(fn(cin))
+                er_sorted = sorted(erased)
+                for ch in erase:
+                    got = rec[er_sorted.index(
+                        c._node_id(ch))].reshape(-1)
+                    assert np.array_equal(got, oracle[ch]), \
+                        (prof, erase, ch, L)
+
+
+def test_decode_kernel_optin_routing():
+    """decode_chunks with profile decode_kernel=true routes through
+    the structured kernel and agrees with the numpy-backend codec.
+    (Opt-in, not the production default: the multi-level kernel is
+    bit-exact but measured SLOWER than the dense matrix on current
+    Mosaic — the r5 negative result documented in BASELINE.md.)"""
+    prof = {"k": "4", "m": "2", "backend": "numpy",
+            "decode_kernel": "true"}
+    c = instance().factory("clay", prof)
+    oracle_codec = make(k=4, m=2)
+    rng = np.random.default_rng(37)
+    ssc = c.sub_chunk_no
+    data = {i: rng.integers(0, 256, ssc * 32, dtype=np.uint8)
+            for i in range(4)}
+    enc = c.encode_chunks([4, 5], data)
+    full = dict(data)
+    full.update(enc)
+    chunks = {i: b for i, b in full.items() if i not in (0, 1)}
+    got = c.decode_chunks([0, 1], chunks)
+    want = oracle_codec.decode_chunks([0, 1], chunks)
+    for i in (0, 1):
+        assert np.array_equal(np.asarray(got[i]),
+                              np.asarray(want[i]))
+    assert any(isinstance(kk, tuple) and kk and kk[0] == "ker"
+               for kk in c._lin_cache), \
+        "pallas decode did not use the structured kernel cache"
