@@ -75,18 +75,25 @@ class IoCtx:
         return {"snap_seq": seq, "snaps": snaps}
 
     # -- data ops -----------------------------------------------------
-    def write_full(self, oid: str, data: bytes) -> int:
-        """Replace the object; returns the new object version."""
+    def write_full(self, oid: str, data: bytes,
+                   snapc: dict | None = None) -> int:
+        """Replace the object; returns the new object version.
+        ``snapc``: an explicit self-managed SnapContext
+        ({"snap_seq": s, "snaps": [...]}) overriding the pool's
+        (rados_ioctx_selfmanaged_snap_set_write_ctx role)."""
         return self._submit(oid, M.OSD_OP_WRITE_FULL, data=data,
-                            **self._snapc()).version
+                            **(snapc or self._snapc())).version
 
-    def write(self, oid: str, data: bytes, offset: int = 0) -> int:
+    def write(self, oid: str, data: bytes, offset: int = 0,
+              snapc: dict | None = None) -> int:
         return self._submit(oid, M.OSD_OP_WRITE, data=data,
-                            offset=offset, **self._snapc()).version
+                            offset=offset,
+                            **(snapc or self._snapc())).version
 
-    def append(self, oid: str, data: bytes) -> int:
+    def append(self, oid: str, data: bytes,
+               snapc: dict | None = None) -> int:
         return self._submit(oid, M.OSD_OP_APPEND, data=data,
-                            **self._snapc()).version
+                            **(snapc or self._snapc())).version
 
     def read(self, oid: str, length: int = 0, offset: int = 0,
              snap: int = 0) -> bytes:
@@ -100,15 +107,16 @@ class IoCtx:
         rep = self._submit(oid, M.OSD_OP_STAT, snapid=snap)
         return json.loads(rep.data)["size"]
 
-    def remove(self, oid: str) -> None:
-        self._submit(oid, M.OSD_OP_REMOVE, **self._snapc())
+    def remove(self, oid: str, snapc: dict | None = None) -> None:
+        self._submit(oid, M.OSD_OP_REMOVE, **(snapc or self._snapc()))
 
-    def truncate(self, oid: str, size: int) -> int:
+    def truncate(self, oid: str, size: int,
+                 snapc: dict | None = None) -> int:
         """rados_trunc: shrink or zero-extend to ``size`` (creates a
         zero-filled object when absent, like the reference's
         write-class truncate)."""
         return self._submit(oid, M.OSD_OP_TRUNCATE, offset=size,
-                            **self._snapc()).version
+                            **(snapc or self._snapc())).version
 
     def zero(self, oid: str, offset: int, length: int) -> int:
         """rados write-op zero: clear [offset, offset+length)."""
@@ -168,10 +176,36 @@ class IoCtx:
         raise RadosError(-110, "osdmap never reflected snap change")
 
     def execute(self, oid: str, cls: str, method: str,
-                inp: bytes = b"") -> bytes:
-        """Run an in-OSD object-class method (librados exec role)."""
+                inp: bytes = b"", snapc: dict | None = None) -> bytes:
+        """Run an in-OSD object-class method (librados exec role).
+        ``snapc``: self-managed SnapContext so a mutating class method
+        COW-preserves the pre-call object (CephFS dir entries)."""
         return self._submit(oid, M.OSD_OP_CALL, data=inp, cls=cls,
-                            method=method).data
+                            method=method, **(snapc or {})).data
+
+    # -- self-managed snapshots (librados selfmanaged_snap API) -------
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a snapid from the pool sequence
+        (rados_ioctx_selfmanaged_snap_create): the caller builds its
+        own SnapContext for subsequent writes."""
+        code, outs, data = self.client.mon_command(
+            {"prefix": "osd pool selfmanaged-snap create",
+             "pool": self.pool_name})
+        if code != 0:
+            raise RadosError(code, outs)
+        out = json.loads(data)
+        self.client.monc.wait_for_map(out["epoch"])
+        return out["snapid"]
+
+    def selfmanaged_snap_remove(self, snapid: int) -> None:
+        """Retire a snapid (rados_ioctx_selfmanaged_snap_remove): OSD
+        trimmers reclaim clones it covered, map-driven."""
+        code, outs, data = self.client.mon_command(
+            {"prefix": "osd pool selfmanaged-snap rm",
+             "pool": self.pool_name, "snapid": snapid})
+        if code != 0:
+            raise RadosError(code, outs)
+        self.client.monc.wait_for_map(json.loads(data)["epoch"])
 
     # -- xattrs (rados_{get,set,rm}xattr / getxattrs roles) -----------
     @staticmethod

@@ -72,12 +72,19 @@ class StripedObject:
 
     def __init__(self, ioctx, soid: str,
                  layout: FileLayout | None = None,
-                 cache=None) -> None:
+                 cache=None, snapc: dict | None = None,
+                 snapid: int = 0) -> None:
         self.io = ioctx
         self.soid = soid
         #: optional ObjectCacher (osdc/ObjectCacher role): piece
         #: reads fill it, piece writes invalidate write-through
         self.cache = cache
+        #: self-managed SnapContext carried on every piece/meta write
+        #: (the CephFS realm of the file — SnapContext role), and a
+        #: snapid pinning reads to a snapshot (snap handles are
+        #: read-only)
+        self.snapc = snapc
+        self.snapid = snapid
         existing = self._read_meta()
         if existing is not None:
             self.layout, self.size = existing
@@ -95,7 +102,8 @@ class StripedObject:
 
     def _read_meta(self):
         try:
-            raw = self.io.read(self._meta_oid())
+            raw = self.io.read(self._meta_oid(),
+                               snap=getattr(self, "snapid", 0))
         except Exception:
             return None
         d = json.loads(raw)
@@ -106,7 +114,7 @@ class StripedObject:
             "su": self.layout.stripe_unit,
             "sc": self.layout.stripe_count,
             "os": self.layout.object_size,
-            "size": self.size}).encode())
+            "size": self.size}).encode(), snapc=self.snapc)
 
     def _piece(self, objectno: int) -> str:
         return f"{self.soid}.{objectno:016x}"
@@ -124,7 +132,8 @@ class StripedObject:
         for objectno, obj_off, n in file_to_extents(
                 self.layout, offset, len(data)):
             oid = self._piece(objectno)
-            self.io.write(oid, data[pos:pos + n], offset=obj_off)
+            self.io.write(oid, data[pos:pos + n], offset=obj_off,
+                          snapc=self.snapc)
             if self.cache is not None:
                 # write-through: invalidate AFTER the write lands —
                 # invalidating before would let a concurrent reader
@@ -151,7 +160,8 @@ class StripedObject:
                 gen = self.cache.generation() \
                     if self.cache is not None else 0
                 try:
-                    piece = self.io.read(oid, n, obj_off)
+                    piece = self.io.read(oid, n, obj_off,
+                                         snap=self.snapid)
                 except Exception:
                     piece = b""      # sparse hole reads as zeros
                 if self.cache is not None:
@@ -172,11 +182,12 @@ class StripedObject:
             self.layout, 0, self.size)}) if self.size else []
         for objectno in objectnos:
             try:
-                self.io.remove(self._piece(objectno))
+                self.io.remove(self._piece(objectno),
+                               snapc=self.snapc)
             except Exception:
                 pass
         try:
-            self.io.remove(self._meta_oid())
+            self.io.remove(self._meta_oid(), snapc=self.snapc)
         except Exception:
             pass
         self.size = 0

@@ -118,6 +118,22 @@ def _numops_add(inp: bytes, obj: bytes | None):
         json.dumps(st).encode()
 
 
+@register("numops", "max")
+def _numops_max(inp: bytes, obj: bytes | None):
+    """Raise the counter to at least ``value`` (Lamport receive rule:
+    a replicated event's origin sequence must never be re-minted
+    locally). Returns the resulting value."""
+    req = json.loads(inp)
+    st = _state(obj, {})
+    key, floor = str(req["key"]), float(req["value"])
+    cur = float(st.get(key, 0))
+    if floor <= cur:
+        return 0, json.dumps({key: cur}).encode(), None
+    st[key] = floor
+    return 0, json.dumps({key: floor}).encode(), \
+        json.dumps(st).encode()
+
+
 @register("numops", "mul")
 def _numops_mul(inp: bytes, obj: bytes | None):
     req = json.loads(inp)

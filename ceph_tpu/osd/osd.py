@@ -439,6 +439,9 @@ class OSD:
                              "cache-tier objects flushed to base")
         perf.add_u64_counter("tier_evict",
                              "cache-tier clean objects evicted")
+        perf.add_u64_counter("tier_proxy_read",
+                             "cache-tier reads proxied to base "
+                             "without promotion")
         perf.add_u64_counter("device_batches",
                              "stripe-batch device kernel launches")
         perf.add_u64_counter("device_batch_ops",
@@ -645,7 +648,13 @@ class OSD:
         if oldmap is not None:
             for pid, pool in newmap.pools.items():
                 old = oldmap.pools.get(pid)
-                if old is not None and set(old.snaps) - set(pool.snaps):
+                if old is None:
+                    continue
+                if set(old.snaps) - set(pool.snaps):
+                    shrunk.add(pid)
+                # self-managed mode: trimming is triggered by snapids
+                # ENTERING removed_snaps (pg_pool_t removed_snaps)
+                if set(pool.removed_snaps) - set(old.removed_snaps):
                     shrunk.add(pid)
         if shrunk:
             with self._pgs_lock:
@@ -1252,7 +1261,11 @@ class OSD:
                                        M.OSD_OP_TRUNCATE,
                                        M.OSD_OP_ZERO,
                                        M.OSD_OP_ROLLBACK,
-                                       M.OSD_OP_WRITESAME):
+                                       M.OSD_OP_WRITESAME,
+                                       # cls methods mutate object
+                                       # data too (CephFS dir entries
+                                       # live behind fs.dir_link)
+                                       M.OSD_OP_CALL):
                 # snapshot COW (PrimaryLogPG::make_writeable role):
                 # first mutation under a newer snap context clones the
                 # head before the write lands
@@ -1990,7 +2003,6 @@ class OSD:
         pool = osdmap.pools.get(pg.pool)
         if pool is None:
             return 0
-        existing = set(pool.snaps)
         with pg.lock:
             if pg.state != PG.ACTIVE:
                 return 0
@@ -2012,7 +2024,8 @@ class OSD:
                     continue
                 keep, changed = [], False
                 for c in ss.get("clones", []):
-                    live = [s for s in c["snaps"] if s in existing]
+                    live = [s for s in c["snaps"]
+                            if pool.snap_is_live(s)]
                     if not live:
                         version = pg.alloc_version()
                         be.submit_remove(

@@ -182,6 +182,13 @@ class PG:
         # promote, and recent promote outcomes (suppress re-promote)
         self.tier_parked: dict[str, list] = {}
         self.tier_recent: dict[str, float] = {}
+        # hit-set windows (src/osd/HitSet.h:33 role, in-memory
+        # reduction): the CURRENT window's touched oids, its start
+        # stamp, and up to pool.hit_set_count archived windows —
+        # promotion recency is judged against these
+        self.hit_set_live: set[str] = set()
+        self.hit_set_start: float = 0.0
+        self.hit_set_archive: list[set[str]] = []
         self.backend = None       # set by the OSD when instantiated
         # version allocation cursor: versions are handed out when an op
         # is ACCEPTED (under pg.lock), not when its log entry stages.

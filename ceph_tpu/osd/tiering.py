@@ -54,6 +54,12 @@ PROMOTE_RECENT = 5.0
 #: object to answer EEXIST correctly, so it promotes first)
 _FULL_WRITE_OPS = (M.OSD_OP_WRITE_FULL,)
 
+#: read-class ops a cold miss may PROXY to the base pool instead of
+#: promoting (do_proxy_read, src/osd/PrimaryLogPG.cc:2445): pure
+#: reads whose request shape the base pool answers directly
+_PROXYABLE_OPS = (M.OSD_OP_READ, M.OSD_OP_STAT, M.OSD_OP_SPARSE_READ,
+                  M.OSD_OP_GETXATTR, M.OSD_OP_GETXATTRS)
+
 
 class TierService:
     """Per-OSD cache-tiering engine (promote + agent)."""
@@ -114,10 +120,16 @@ class TierService:
         if op == M.OSD_OP_LIST:
             return False
         mutating = op in self.osd._MUTATING_OPS
+        # hit-set accounting (HitSet.h role): recency is judged
+        # BEFORE this access is recorded, so a first touch never
+        # counts itself (min_read_recency_for_promote=1 means
+        # "promote on the second access within the window")
+        recency = self._hit_recency(pg, pool, msg.oid)
+        self._record_hit(pg, pool, msg.oid)
         try:
             attrs = be.get_xattrs(pg, msg.oid)
         except (NoSuchObject, NoSuchCollection):
-            return self._on_miss(pg, pool, msg, conn, reply)
+            return self._on_miss(pg, pool, msg, conn, reply, recency)
         if WHITEOUT_ATTR in attrs:
             if op == M.OSD_OP_REMOVE or not mutating:
                 reply(ENOENT)     # deleted; never promote through it
@@ -159,12 +171,45 @@ class TierService:
                                version, lambda code: None)
         return False
 
-    def _on_miss(self, pg, pool, msg, conn, reply) -> bool:
+    def _roll_hit_sets(self, pg, pool) -> None:
+        """Advance the hit-set window (caller holds pg.lock)."""
+        now = time.monotonic()
+        if pg.hit_set_start == 0.0:
+            pg.hit_set_start = now
+            return
+        if now - pg.hit_set_start >= pool.hit_set_period:
+            pg.hit_set_archive.insert(0, pg.hit_set_live)
+            del pg.hit_set_archive[max(pool.hit_set_count - 1, 0):]
+            pg.hit_set_live = set()
+            pg.hit_set_start = now
+
+    def _hit_recency(self, pg, pool, oid: str) -> int:
+        """How many tracked hit-set windows contain ``oid`` (caller
+        holds pg.lock); -1 = hit sets disabled (always promote)."""
+        if not pool.hit_set_period:
+            return -1
+        self._roll_hit_sets(pg, pool)
+        n = 1 if oid in pg.hit_set_live else 0
+        return n + sum(1 for hs in pg.hit_set_archive if oid in hs)
+
+    def _record_hit(self, pg, pool, oid: str) -> None:
+        if pool.hit_set_period:
+            pg.hit_set_live.add(oid)
+
+    def _on_miss(self, pg, pool, msg, conn, reply,
+                 recency: int = -1) -> bool:
         """Cache miss: full overwrites proceed (they need no base
-        content and are dirty-by-absence-of-stamps); everything else
-        parks behind a promote."""
+        content and are dirty-by-absence-of-stamps); COLD reads are
+        proxied to the base pool without promotion (hit sets gate
+        promotion — promote-on-every-miss thrashes the tier under
+        scan workloads, the pathology hit sets exist to prevent);
+        everything else parks behind a promote."""
         if msg.op in _FULL_WRITE_OPS:
             return False
+        if recency >= 0 and msg.op in _PROXYABLE_OPS and \
+                recency < pool.min_read_recency_for_promote:
+            self._wq.submit(self._proxy_read, pool, msg, reply)
+            return True
         now = time.monotonic()
         recent = pg.tier_recent.get(msg.oid, 0.0)
         if now - recent < PROMOTE_RECENT:
@@ -181,6 +226,23 @@ class TierService:
         if len(parked) == 1:
             self._wq.submit(self._promote, pg, pool, msg.oid)
         return "parked"
+
+    def _proxy_read(self, pool, msg, reply) -> None:
+        """Serve a cold read from the BASE pool without promoting
+        (do_proxy_read, src/osd/PrimaryLogPG.cc:2445). Tier-worker
+        context, no pg.lock."""
+        from ceph_tpu.client.objecter import ObjecterError
+        try:
+            rep = self.objecter.op_submit(
+                pool.tier_of, msg.oid, msg.op, offset=msg.offset,
+                length=msg.length, xname=msg.xname)
+            self.osd.logger.inc("tier_proxy_read")
+            reply(rep.code, bytes(rep.data), rep.version)
+        except ObjecterError as exc:
+            reply(exc.code)
+        except Exception:
+            from ceph_tpu.osd.osd import EIO
+            reply(EIO)
 
     def _promote(self, pg, pool, oid: str) -> None:
         """Tier-worker context, NO pg.lock held: pull the object from

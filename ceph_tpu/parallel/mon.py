@@ -1520,6 +1520,11 @@ class Monitor:
                 pid = self._resolve_pool(cmd["pool"])
                 pool = self.osdmap.pools[pid]
                 name = cmd["snap"]
+                if pool.selfmanaged:
+                    # the two snapshot modes never mix in one pool
+                    # (pg_pool_t is_unmanaged_snaps_mode refusal)
+                    return -22, "pool is in self-managed snap " \
+                        "mode", b""
                 if name in pool.snaps.values():
                     return -17, f"snap {name!r} exists", b""
                 pool.snap_seq += 1
@@ -1527,6 +1532,33 @@ class Monitor:
                 self._commit()
                 return (0, f"created pool snap {name!r}",
                         json.dumps({"snapid": pool.snap_seq}).encode())
+            if prefix == "osd pool selfmanaged-snap create":
+                # rados_ioctx_selfmanaged_snap_create role: allocate
+                # a snapid from the pool's sequence; the APP supplies
+                # SnapContexts per write (CephFS realms, rbd)
+                pid = self._resolve_pool(cmd["pool"])
+                pool = self.osdmap.pools[pid]
+                if pool.snaps:
+                    return -22, "pool has pool snapshots", b""
+                pool.selfmanaged = True
+                pool.snap_seq += 1
+                self._commit()
+                return (0, "allocated selfmanaged snap",
+                        json.dumps({"snapid": pool.snap_seq,
+                                    "epoch": self.osdmap.epoch
+                                    }).encode())
+            if prefix == "osd pool selfmanaged-snap rm":
+                pid = self._resolve_pool(cmd["pool"])
+                pool = self.osdmap.pools[pid]
+                snapid = int(cmd["snapid"])
+                if not pool.selfmanaged or snapid > pool.snap_seq:
+                    return -2, f"no selfmanaged snap {snapid}", b""
+                if snapid not in pool.removed_snaps:
+                    pool.removed_snaps.append(snapid)
+                    self._commit()   # OSD trimmers react to the map
+                return (0, f"removed selfmanaged snap {snapid}",
+                        json.dumps({"epoch": self.osdmap.epoch
+                                    }).encode())
             if prefix == "osd pool rmsnap":
                 pid = self._resolve_pool(cmd["pool"])
                 pool = self.osdmap.pools[pid]
@@ -1635,6 +1667,12 @@ class Monitor:
                     pool.target_max_objects = int(val)
                 elif var == "target_max_bytes":
                     pool.target_max_bytes = int(val)
+                elif var == "hit_set_period":
+                    pool.hit_set_period = float(val)
+                elif var == "hit_set_count":
+                    pool.hit_set_count = max(1, int(val))
+                elif var == "min_read_recency_for_promote":
+                    pool.min_read_recency_for_promote = int(val)
                 else:
                     return -22, f"unsettable pool var {var!r}", b""
                 self._commit()

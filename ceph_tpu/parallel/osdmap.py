@@ -44,6 +44,14 @@ class PoolInfo:
     #: snap trimmers reclaim clones whose snaps no longer exist
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)       # snapid -> name
+    #: SELF-MANAGED snapshot mode (pg_pool_t is_unmanaged_snaps_mode
+    #: + removed_snaps roles): the application allocates snapids from
+    #: snap_seq and supplies its own SnapContext per write (what
+    #: CephFS realms and librbd do in the reference); a snapid is
+    #: live while <= snap_seq and not in removed_snaps. The two modes
+    #: never mix in one pool (the reference refuses likewise).
+    selfmanaged: bool = False
+    removed_snaps: list = field(default_factory=list)
     #: cache tiering (pg_pool_t tier_of/read_tier/write_tier/
     #: cache_mode roles, src/osd/osd_types.h): a CACHE pool records
     #: its base pool in ``tier_of``; the BASE pool records the
@@ -54,6 +62,17 @@ class PoolInfo:
     cache_mode: str = "none"
     target_max_objects: int = 0
     target_max_bytes: int = 0
+    #: hit-set / promotion-recency knobs (pg_pool_t hit_set_period /
+    #: hit_set_count / min_read_recency_for_promote roles,
+    #: src/osd/HitSet.h:33): period 0 disables hit sets — every miss
+    #: promotes (the pre-r5 behavior). With hit sets on, a READ miss
+    #: promotes only when the object appears in >= min_read_recency
+    #: of the tracked windows; colder reads are PROXIED to the base
+    #: pool without promotion (do_proxy_read,
+    #: src/osd/PrimaryLogPG.cc:2445) so scans cannot thrash the tier.
+    hit_set_period: float = 0.0
+    hit_set_count: int = 4
+    min_read_recency_for_promote: int = 1
 
     @property
     def is_cache_tier(self) -> bool:
@@ -67,6 +86,15 @@ class PoolInfo:
         """(seq, existing snap ids newest-first) — what write ops
         carry (the SnapContext of librados)."""
         return self.snap_seq, sorted(self.snaps, reverse=True)
+
+    def snap_is_live(self, snapid: int) -> bool:
+        """Whether clones covering ``snapid`` may still be trimmed
+        away — the single liveness rule the OSD snap trimmer uses
+        for both snapshot modes."""
+        if self.selfmanaged:
+            return snapid <= self.snap_seq and \
+                snapid not in self.removed_snaps
+        return snapid in self.snaps
 
 
 @dataclass
@@ -299,7 +327,15 @@ class OSDMap:
                                 en.u64(p.target_max_bytes)))
         # v5: blocklist (appended)
         body.map(self.blocklist, Encoder.str, Encoder.f64)
-        e.section(5, body)
+        # v6: self-managed snapshot mode + hit-set knobs (appended)
+        body.map({pid: p for pid, p in self.pools.items()},
+                 Encoder.i32,
+                 lambda en, p: (en.bool(p.selfmanaged),
+                                en.list(p.removed_snaps, Encoder.u64),
+                                en.f64(p.hit_set_period),
+                                en.u32(p.hit_set_count),
+                                en.u32(p.min_read_recency_for_promote)))
+        e.section(6, body)
         return e.getvalue()
 
     # -- chunked encoding (per-value Paxos log / share_state role) ----
@@ -378,7 +414,7 @@ class OSDMap:
 
     @classmethod
     def decode(cls, buf: bytes) -> "OSDMap":
-        version, d = Decoder(buf).section(5)
+        version, d = Decoder(buf).section(6)
         m = cls()
         m.epoch = d.u32()
 
@@ -442,4 +478,18 @@ class OSDMap:
                     p.target_max_bytes = tmb
         if version >= 5:
             m.blocklist = d.map(Decoder.str, Decoder.f64)
+        if version >= 6:
+            sminfo = d.map(
+                Decoder.i32,
+                lambda dd: (dd.bool(), dd.list(Decoder.u64),
+                            dd.f64(), dd.u32(), dd.u32()))
+            for pid, (sm, removed, hsp, hsc, recency) in \
+                    sminfo.items():
+                if pid in m.pools:
+                    p = m.pools[pid]
+                    p.selfmanaged = sm
+                    p.removed_snaps = list(removed)
+                    p.hit_set_period = hsp
+                    p.hit_set_count = hsc
+                    p.min_read_recency_for_promote = recency
         return m

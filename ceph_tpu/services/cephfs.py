@@ -264,6 +264,26 @@ class CephFS:
             self._step(lambda: self._dir_unlink(rec["parent"],
                                                 rec["name"]))
             self._step(lambda: self.io.remove(f"inode.{rec['ino']}"))
+        elif op == "mksnap":
+            def addsnap():
+                inode = dict(self._read_inode(rec["parent"]))
+                snaps = dict(inode.get("snaps", {}))
+                if snaps.get(rec["name"]) != rec["ino"]:
+                    snaps[rec["name"]] = rec["ino"]
+                    inode["snaps"] = snaps
+                    self._write_inode(rec["parent"], inode)
+            self._step(addsnap)
+        elif op == "rmsnap":
+            def dropsnap():
+                inode = dict(self._read_inode(rec["parent"]))
+                snaps = dict(inode.get("snaps", {}))
+                if rec["name"] in snaps:
+                    del snaps[rec["name"]]
+                    inode["snaps"] = snaps
+                    self._write_inode(rec["parent"], inode)
+            self._step(dropsnap)
+            self._step(lambda: self.io.selfmanaged_snap_remove(
+                rec["ino"]))
         elif op == "rename":
             self._step(lambda: self._dir_link(rec["new_parent"],
                                               rec["new_name"],
@@ -272,14 +292,16 @@ class CephFS:
                                                 rec["old_name"]))
 
     # -- inode plumbing ------------------------------------------------
-    def _read_inode(self, ino: int) -> dict:
+    def _read_inode(self, ino: int, snap: int = 0) -> dict:
         try:
-            return json.loads(self.io.read(f"inode.{ino}"))
+            return json.loads(self.io.read(f"inode.{ino}", snap=snap))
         except Exception:
             raise FSError(errno.ENOENT, f"no inode {ino}")
 
-    def _write_inode(self, ino: int, inode: dict) -> None:
-        self.io.write_full(f"inode.{ino}", json.dumps(inode).encode())
+    def _write_inode(self, ino: int, inode: dict,
+                     snapc: dict | None = None) -> None:
+        self.io.write_full(f"inode.{ino}", json.dumps(inode).encode(),
+                           snapc=snapc)
 
     def _alloc_ino(self) -> int:
         out = self.io.execute(SUPER_OID, "fs", "alloc_ino")
@@ -287,7 +309,17 @@ class CephFS:
 
     def _resolve(self, path: str) -> tuple[int, dict]:
         """path -> (ino, inode); raises ENOENT/ENOTDIR."""
+        ino, inode, _realm = self._resolve2(path)
+        return ino, inode
+
+    def _resolve2(self, path: str) -> tuple[int, dict, list[int]]:
+        """path -> (ino, inode, realm snapids). The realm is the
+        union of every traversed directory's snapshots — SnapRealm
+        resolution (src/mds/SnapRealm.h:27 get_snaps walks ancestors
+        the same way); collected during the descent the resolver
+        already performs, so realms cost no extra reads."""
         ino, inode = ROOT_INO, self._read_inode(ROOT_INO)
+        realm: set[int] = set(inode.get("snaps", {}).values())
         for part in [p for p in path.split("/") if p]:
             if inode["type"] != "dir":
                 raise FSError(errno.ENOTDIR, path)
@@ -295,40 +327,60 @@ class CephFS:
             if child is None:
                 raise FSError(errno.ENOENT, path)
             ino, inode = child, self._read_inode(child)
-        return ino, inode
+            realm.update(inode.get("snaps", {}).values())
+        return ino, inode, sorted(realm)
+
+    @staticmethod
+    def _realm_snapc(realm: list[int]) -> dict | None:
+        """SnapContext for a write governed by ``realm`` (librados
+        SnapContext: seq + snapids newest-first), or None when no
+        snapshot governs the path."""
+        if not realm:
+            return None
+        return {"snap_seq": max(realm),
+                "snaps": sorted(realm, reverse=True)}
 
     def _resolve_parent(self, path: str) -> tuple[int, str]:
         ino, name, _ = self._resolve_parent3(path)
         return ino, name
 
     def _resolve_parent3(self, path: str) -> tuple[int, str, dict]:
+        ino, name, inode, _realm = self._resolve_parent4(path)
+        return ino, name, inode
+
+    def _resolve_parent4(self, path: str
+                         ) -> tuple[int, str, dict, list[int]]:
         """Like _resolve_parent but also hands back the parent inode
-        already read during resolution (saves callers that need its
-        entries a second round trip)."""
+        and the governing realm snapids already collected during
+        resolution (saves callers a second walk)."""
         parts = [p for p in path.split("/") if p]
         if not parts:
             raise FSError(errno.EINVAL, "root has no parent")
         parent = "/".join(parts[:-1])
-        ino, inode = self._resolve(parent)
+        ino, inode, realm = self._resolve2(parent)
         if inode["type"] != "dir":
             raise FSError(errno.ENOTDIR, parent)
-        return ino, parts[-1], inode
+        return ino, parts[-1], inode, realm
 
-    def _dir_link(self, dir_ino: int, name: str, ino: int) -> None:
+    def _dir_link(self, dir_ino: int, name: str, ino: int,
+                  snapc: dict | None = None) -> None:
         from ceph_tpu.client.rados import RadosError
         try:
             self.io.execute(f"inode.{dir_ino}", "fs", "dir_link",
                             json.dumps({"name": name,
-                                        "ino": ino}).encode())
+                                        "ino": ino}).encode(),
+                            snapc=snapc)
         except RadosError as exc:
             raise FSError(-exc.code) from None
 
-    def _dir_unlink(self, dir_ino: int, name: str) -> int:
+    def _dir_unlink(self, dir_ino: int, name: str,
+                    snapc: dict | None = None) -> int:
         from ceph_tpu.client.rados import RadosError
         try:
             out = self.io.execute(f"inode.{dir_ino}", "fs",
                                   "dir_unlink",
-                                  json.dumps({"name": name}).encode())
+                                  json.dumps({"name": name}).encode(),
+                                  snapc=snapc)
         except RadosError as exc:
             raise FSError(-exc.code) from None
         return json.loads(out)["ino"]
@@ -336,26 +388,51 @@ class CephFS:
     # -- namespace ops (libcephfs surface) ----------------------------
     def mkdir(self, path: str,
               req: tuple[str, int] | None = None) -> None:
-        parent, name, pinode = self._resolve_parent3(path)
+        parent, name, pinode, realm = self._resolve_parent4(path)
         if name in pinode.get("entries", {}):
             raise FSError(errno.EEXIST, path)
+        snapc = self._realm_snapc(realm)
         ino = self._alloc_ino()
         pos = self._mds_event("mkdir", parent=parent, name=name,
                               ino=ino, req=req)
         try:
             self._write_inode(ino, {"type": "dir", "entries": {},
-                                    "mtime": time.time()})
-            self._dir_link(parent, name, ino)
+                                    "mtime": time.time()},
+                              snapc=snapc)
+            self._dir_link(parent, name, ino, snapc=snapc)
         finally:
             self._mds_committed(pos)
 
     def readdir(self, path: str) -> list[str]:
+        snap = self._snap_split(path)
+        if snap is not None:
+            dirpath, snapname, rest = snap
+            if snapname is None:      # ".../<dir>/.snap" itself
+                _, dinode = self._resolve(dirpath)
+                if dinode["type"] != "dir":
+                    raise FSError(errno.ENOTDIR, path)
+                return sorted(dinode.get("snaps", {}))
+            _, inode, _sid = self._resolve_snap(dirpath, snapname,
+                                                rest)
+            if inode["type"] != "dir":
+                raise FSError(errno.ENOTDIR, path)
+            return sorted(inode["entries"])
         _, inode = self._resolve(path)
         if inode["type"] != "dir":
             raise FSError(errno.ENOTDIR, path)
         return sorted(inode["entries"])
 
     def stat(self, path: str) -> dict:
+        snap = self._snap_split(path)
+        if snap is not None and snap[1] is not None:
+            ino, inode, snapid = self._resolve_snap(*snap)
+            out = {"ino": ino, "type": inode["type"],
+                   "mtime": inode["mtime"], "snapid": snapid}
+            if inode["type"] == "file":
+                out["size"] = inode.get("size", 0)
+            else:
+                out["nentries"] = len(inode["entries"])
+            return out
         ino, inode = self._resolve(path)
         out = {"ino": ino, "type": inode["type"],
                "mtime": inode["mtime"]}
@@ -372,41 +449,50 @@ class CephFS:
             raise FSError(errno.ENOTDIR, path)
         if inode["entries"]:
             raise FSError(errno.ENOTEMPTY, path)
-        parent, name = self._resolve_parent(path)
+        parent, name, _pinode, realm = self._resolve_parent4(path)
+        snapc = self._realm_snapc(realm)
         pos = self._mds_event("rmdir", parent=parent, name=name,
                               ino=ino, req=req)
         try:
-            self._dir_unlink(parent, name)
-            self.io.remove(f"inode.{ino}")
+            self._dir_unlink(parent, name, snapc=snapc)
+            self.io.remove(f"inode.{ino}", snapc=snapc)
         finally:
             self._mds_committed(pos)
 
     def create(self, path: str,
                req: tuple[str, int] | None = None) -> "File":
-        parent, name, pinode = self._resolve_parent3(path)
+        parent, name, pinode, realm = self._resolve_parent4(path)
         if name in pinode.get("entries", {}):
             raise FSError(errno.EEXIST, path)
+        snapc = self._realm_snapc(realm)
         ino = self._alloc_ino()
         pos = self._mds_event("create", parent=parent, name=name,
                               ino=ino, req=req)
         try:
             self._write_inode(ino, {"type": "file", "size": 0,
-                                    "mtime": time.time()})
-            self._dir_link(parent, name, ino)
+                                    "mtime": time.time()},
+                              snapc=snapc)
+            self._dir_link(parent, name, ino, snapc=snapc)
         finally:
             self._mds_committed(pos)
-        return File(self, ino)
+        return File(self, ino, snapc=snapc)
 
     def open(self, path: str, create: bool = False) -> "File":
+        snap = self._snap_split(path)
+        if snap is not None:
+            ino, inode, snapid = self._resolve_snap(*snap)
+            if inode["type"] != "file":
+                raise FSError(errno.EISDIR, path)
+            return File(self, ino, snapid=snapid)
         try:
-            ino, inode = self._resolve(path)
+            ino, inode, realm = self._resolve2(path)
         except FSError as exc:
             if create and exc.errno == errno.ENOENT:
                 return self.create(path)
             raise
         if inode["type"] != "file":
             raise FSError(errno.EISDIR, path)
-        return File(self, ino)
+        return File(self, ino, snapc=self._realm_snapc(realm))
 
     # -- capabilities (Capability.h role, per-mount session) ----------
     def cap_holders(self, path: str) -> dict:
@@ -510,13 +596,18 @@ class CephFS:
         ino, inode = self._resolve(path)
         if inode["type"] == "dir":
             raise FSError(errno.EISDIR, path)
-        parent, name = self._resolve_parent(path)
+        parent, name, _pinode, realm = self._resolve_parent4(path)
+        snapc = self._realm_snapc(realm)
         pos = self._mds_event("unlink", parent=parent, name=name,
                               ino=ino, req=req)
         try:
-            self._dir_unlink(parent, name)
-            StripedObject(self.io, f"fsdata.{ino}").remove()
-            self.io.remove(f"inode.{ino}")
+            self._dir_unlink(parent, name, snapc=snapc)
+            # carried snapc: removal COW-preserves the file's data
+            # and inode for governing snapshots (snapshotted files
+            # survive their deletion — the point of the snapshot)
+            StripedObject(self.io, f"fsdata.{ino}",
+                          snapc=snapc).remove()
+            self.io.remove(f"inode.{ino}", snapc=snapc)
         finally:
             self._mds_committed(pos)
 
@@ -527,17 +618,134 @@ class CephFS:
         between the steps replays the intent and finishes the unlink
         (the MDS journal's dirop atomicity, MDLog/EUpdate role)."""
         ino, _ = self._resolve(old)
-        new_parent, new_name = self._resolve_parent(new)
-        old_parent, old_name = self._resolve_parent(old)
+        new_parent, new_name, _pi, new_realm = \
+            self._resolve_parent4(new)
+        old_parent, old_name, _pi2, old_realm = \
+            self._resolve_parent4(old)
         pos = self._mds_event(
             "rename", ino=ino, new_parent=new_parent,
             new_name=new_name, old_parent=old_parent,
             old_name=old_name, req=req)
         try:
-            self._dir_link(new_parent, new_name, ino)
-            self._dir_unlink(old_parent, old_name)
+            self._dir_link(new_parent, new_name, ino,
+                           snapc=self._realm_snapc(new_realm))
+            self._dir_unlink(old_parent, old_name,
+                             snapc=self._realm_snapc(old_realm))
         finally:
             self._mds_committed(pos)
+
+
+    # -- snapshots (SnapRealm-lite: src/mds/SnapRealm.h:27,
+    # SnapServer.h roles) ---------------------------------------------
+    # A snapshot lives on a DIRECTORY: its snapid is allocated from
+    # the pool's self-managed snap sequence (the SnapServer table
+    # role, delegated to the pool like librados selfmanaged snaps),
+    # recorded in the directory inode, and every write under the
+    # directory carries a SnapContext including it — the OSD's
+    # make_writeable COW preserves both metadata objects (inodes,
+    # journaled dir entries) and striped data, so reading any inode
+    # or data object at the snapid reconstructs the subtree as of the
+    # snapshot. Surfaced through the ".snap" pseudo-directory
+    # convention: readdir("/d/.snap") lists snapshots and
+    # "/d/.snap/<name>/..." resolves inside one, as in the reference.
+
+    def mksnap(self, path: str, name: str,
+               req: tuple[str, int] | None = None) -> int:
+        """Snapshot directory ``path`` as ``name``; returns the
+        snapid. Journaled (mksnap intent carries the allocated
+        snapid, so a crash mid-op replays to completion)."""
+        ino, inode, realm = self._resolve2(path)
+        if inode["type"] != "dir":
+            raise FSError(errno.ENOTDIR, path)
+        if name in inode.get("snaps", {}):
+            raise FSError(errno.EEXIST, f"{path}@{name}")
+        snapid = self.io.selfmanaged_snap_create()
+        pos = self._mds_event("mksnap", parent=ino, name=name,
+                              ino=snapid, req=req)
+        try:
+            inode = dict(self._read_inode(ino))
+            snaps = dict(inode.get("snaps", {}))
+            snaps[name] = snapid
+            inode["snaps"] = snaps
+            # the inode write carries the NEW snap too: COW preserves
+            # the pre-snapshot dir state under the new snapid
+            self._write_inode(
+                ino, inode,
+                snapc=self._realm_snapc(sorted(set(realm)
+                                               | {snapid})))
+        finally:
+            self._mds_committed(pos)
+        return snapid
+
+    def rmsnap(self, path: str, name: str,
+               req: tuple[str, int] | None = None) -> None:
+        ino, inode, realm = self._resolve2(path)
+        if inode["type"] != "dir":
+            raise FSError(errno.ENOTDIR, path)
+        snapid = inode.get("snaps", {}).get(name)
+        if snapid is None:
+            raise FSError(errno.ENOENT, f"{path}@{name}")
+        pos = self._mds_event("rmsnap", parent=ino, name=name,
+                              ino=snapid, req=req)
+        try:
+            inode = dict(self._read_inode(ino))
+            snaps = dict(inode.get("snaps", {}))
+            snaps.pop(name, None)
+            inode["snaps"] = snaps
+            self._write_inode(
+                ino, inode,
+                snapc=self._realm_snapc(
+                    sorted(set(realm) - {snapid})))
+            # retire the snapid: OSD trimmers reclaim its clones
+            self.io.selfmanaged_snap_remove(snapid)
+        finally:
+            self._mds_committed(pos)
+
+    def lssnap(self, path: str) -> dict:
+        """{name: snapid} of the directory's snapshots."""
+        _, inode = self._resolve(path)
+        if inode["type"] != "dir":
+            raise FSError(errno.ENOTDIR, path)
+        return dict(inode.get("snaps", {}))
+
+    @staticmethod
+    def _snap_split(path: str):
+        """Detect the ".snap" pseudo-directory: returns
+        (dirpath, snapname | None, rest) or None for ordinary
+        paths."""
+        parts = [p for p in path.split("/") if p]
+        if ".snap" not in parts:
+            return None
+        i = parts.index(".snap")
+        dirpath = "/".join(parts[:i])
+        tail = parts[i + 1:]
+        if not tail:
+            return dirpath, None, []
+        return dirpath, tail[0], tail[1:]
+
+    def _resolve_snap(self, dirpath: str, snapname: str,
+                      rest: list[str]) -> tuple[int, dict, int]:
+        """Resolve a path inside a snapshot: the snapshotted dir is
+        read at HEAD to find the snapid, then every descent below it
+        reads inodes AT the snapid (the realm's frozen namespace)."""
+        dino, dinode = self._resolve(dirpath)
+        if dinode["type"] != "dir":
+            raise FSError(errno.ENOTDIR, dirpath)
+        snapid = dinode.get("snaps", {}).get(snapname)
+        if snapid is None:
+            raise FSError(errno.ENOENT, f"{dirpath}@{snapname}")
+        # the dir itself as of the snapshot
+        ino = dino
+        inode = self._read_inode(dino, snap=snapid)
+        for part in rest:
+            if inode["type"] != "dir":
+                raise FSError(errno.ENOTDIR, part)
+            child = inode["entries"].get(part)
+            if child is None:
+                raise FSError(errno.ENOENT, part)
+            ino = child
+            inode = self._read_inode(child, snap=snapid)
+        return ino, inode, snapid
 
 
 class File:
@@ -555,10 +763,19 @@ class File:
       raises EAGAIN past ``cap_timeout``.
     """
 
-    def __init__(self, fs: CephFS, ino: int) -> None:
+    def __init__(self, fs: CephFS, ino: int,
+                 snapc: dict | None = None, snapid: int = 0) -> None:
         self.fs = fs
         self.ino = ino
-        self._data = StripedObject(fs.io, f"fsdata.{ino}", fs.layout)
+        #: realm SnapContext (writes) / pinned snapid (snapshot
+        #: handles are read-only). The realm is captured at open; a
+        #: snapshot created while a writer holds the handle applies
+        #: from its next open (documented reduction of the
+        #: reference's cap-recall realm push).
+        self.snapc = snapc
+        self.snapid = snapid
+        self._data = StripedObject(fs.io, f"fsdata.{ino}", fs.layout,
+                                   snapc=snapc, snapid=snapid)
         self.cap_timeout = 10.0
 
     # -- caps (delegated to the MOUNT's session table) ----------------
@@ -585,6 +802,8 @@ class File:
         cap on this ino is unexpired (sibling handles of one mount
         share the cache, so one handle's write is visible to the
         others immediately); re-read otherwise."""
+        if self.snapid:
+            return self.fs._read_inode(self.ino, snap=self.snapid)
         if self.fs.caps_enabled:
             cached = self.fs._cached_inode(self.ino)
             if cached is not None:
@@ -595,12 +814,15 @@ class File:
         return inode
 
     def _put_inode(self, inode: dict) -> None:
-        self.fs._write_inode(self.ino, inode)
+        self.fs._write_inode(self.ino, inode, snapc=self.snapc)
         if self.fs.caps_enabled:
             self.fs._cache_inode(self.ino, inode)
 
     # -- I/O ----------------------------------------------------------
     def write(self, data: bytes, offset: int = 0) -> int:
+        if self.snapid:
+            raise FSError(errno.EROFS, "snapshot handles are "
+                          "read-only")
         self._acquire_cap("exclusive")
         self._data.write(data, offset=offset)
         inode = self._inode()
@@ -611,7 +833,8 @@ class File:
         return len(data)
 
     def read(self, length: int | None = None, offset: int = 0) -> bytes:
-        self._acquire_cap("shared")
+        if not self.snapid:
+            self._acquire_cap("shared")
         inode = self._inode()
         size = inode.get("size", 0)
         # inode size is authoritative: sync the striper handle's
@@ -627,6 +850,9 @@ class File:
         return out + b"\x00" * (length - len(out))
 
     def truncate(self, size: int) -> None:
+        if self.snapid:
+            raise FSError(errno.EROFS, "snapshot handles are "
+                          "read-only")
         self._acquire_cap("exclusive")
         inode = dict(self._inode())
         inode["size"] = size

@@ -344,6 +344,10 @@ class MDSDaemon:
                 return (-errno.EEXIST, b"")
             return (0, json.dumps({"ino": rec["ino"],
                                    "size": 0}).encode())
+        if rec.get("op") == "mksnap":
+            # the journaled intent carries the allocated snapid in
+            # "ino" — the retried request needs it back
+            return (0, json.dumps({"snapid": rec["ino"]}).encode())
         return (0, b"{}")
 
     def _prune_sessions(self) -> None:
@@ -448,18 +452,39 @@ class MDSDaemon:
             return {}
         if op == "create":
             f = fs.create(args["path"], req=req)
-            return {"ino": f.ino, "size": 0}
+            return {"ino": f.ino, "size": 0,
+                    "snaps": (f.snapc or {}).get("snaps", [])}
         if op == "open":
+            snap = fs._snap_split(args["path"])
+            if snap is not None:
+                ino, inode, snapid = fs._resolve_snap(*snap)
+                if inode["type"] != "file":
+                    raise FSError(errno.EISDIR, args["path"])
+                return {"ino": ino, "size": inode.get("size", 0),
+                        "snapid": snapid}
             try:
-                ino, inode = fs._resolve(args["path"])
+                ino, inode, realm = fs._resolve2(args["path"])
             except FSError as exc:
                 if args.get("create") and exc.errno == errno.ENOENT:
                     f = fs.create(args["path"], req=req)
-                    return {"ino": f.ino, "size": 0}
+                    return {"ino": f.ino, "size": 0,
+                            "snaps": (f.snapc or {}).get("snaps", [])}
                 raise
             if inode["type"] != "file":
                 raise FSError(errno.EISDIR, args["path"])
-            return {"ino": ino, "size": inode.get("size", 0)}
+            # the realm snapids ride the reply: the client writes
+            # data DIRECTLY to the OSDs (the MDS is not on the data
+            # path), so it must carry the realm SnapContext itself
+            return {"ino": ino, "size": inode.get("size", 0),
+                    "snaps": sorted(realm, reverse=True)}
+        if op == "mksnap":
+            snapid = fs.mksnap(args["path"], args["name"], req=req)
+            return {"snapid": snapid}
+        if op == "rmsnap":
+            fs.rmsnap(args["path"], args["name"], req=req)
+            return {}
+        if op == "lssnap":
+            return {"snaps": fs.lssnap(args["path"])}
         if op == "unlink":
             fs.unlink(args["path"], req=req)
             return {}
@@ -499,6 +524,10 @@ class MDSDaemon:
         # lease expired mid-flight clobber the new holder's inode
         # (grants and expiry pruning take this same lock; waiters in
         # _cap_acquire release it while waiting, so no deadlock)
+        snaps = [int(x) for x in args.get("snaps", [])]
+        snapc = {"snap_seq": max(snaps),
+                 "snaps": sorted(snaps, reverse=True)} \
+            if snaps else None
         with self._cap_lock:
             held = self._captab.get(ino, {}).get(client)
             if held is None or held[0] != "exclusive" or \
@@ -511,7 +540,9 @@ class MDSDaemon:
                 inode["size"] = size if args.get("force") \
                     else max(inode.get("size", 0), size)
             inode["mtime"] = float(args.get("mtime", time.time()))
-            self.fs._write_inode(ino, inode)
+            # the writer's realm SnapContext rides the flush so the
+            # pre-write inode is COW-preserved for its snapshots
+            self.fs._write_inode(ino, inode, snapc=snapc)
         return {"size": inode.get("size", 0)}
 
     # -- caps (Locker.cc issue/revoke role) ----------------------------

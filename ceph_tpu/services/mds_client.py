@@ -199,11 +199,25 @@ class CephFSMount:
 
     def create(self, path: str) -> "MDSFile":
         out = self._rpc("create", {"path": path})
-        return MDSFile(self, out["ino"])
+        return MDSFile(self, out["ino"],
+                       snaps=out.get("snaps", []))
 
     def open(self, path: str, create: bool = False) -> "MDSFile":
         out = self._rpc("open", {"path": path, "create": create})
-        return MDSFile(self, out["ino"])
+        return MDSFile(self, out["ino"],
+                       snaps=out.get("snaps", []),
+                       snapid=out.get("snapid", 0))
+
+    # -- snapshots (SnapRealm-lite; ".snap" pseudo-dir surface) -------
+    def mksnap(self, path: str, name: str) -> int:
+        return self._rpc("mksnap", {"path": path,
+                                    "name": name})["snapid"]
+
+    def rmsnap(self, path: str, name: str) -> None:
+        self._rpc("rmsnap", {"path": path, "name": name})
+
+    def lssnap(self, path: str) -> dict:
+        return self._rpc("lssnap", {"path": path})["snaps"]
 
     def umount(self) -> None:
         for ino in list(self._caps):
@@ -304,11 +318,22 @@ class MDSFile:
     """Open file handle (Fh role): data via the striper, attributes
     via the MDS, coherence via server-granted caps."""
 
-    def __init__(self, mount: CephFSMount, ino: int) -> None:
+    def __init__(self, mount: CephFSMount, ino: int,
+                 snaps: list | None = None, snapid: int = 0) -> None:
         self.m = mount
         self.ino = ino
+        #: governing realm snapids (newest-first) from the MDS open
+        #: reply — data writes go DIRECTLY to the OSDs, so this
+        #: handle carries the SnapContext itself; ``snapid`` pins a
+        #: read-only snapshot handle
+        self.snaps = [int(x) for x in (snaps or [])]
+        self.snapid = int(snapid)
+        snapc = {"snap_seq": max(self.snaps),
+                 "snaps": self.snaps} if self.snaps else None
+        self._snapc = snapc
         self._data = StripedObject(mount.io, f"fsdata.{ino}",
-                                   mount.layout)
+                                   mount.layout, snapc=snapc,
+                                   snapid=self.snapid)
         self.cap_timeout = 10.0
 
     def release(self) -> None:
@@ -323,12 +348,16 @@ class MDSFile:
         self.release()
 
     def write(self, data: bytes, offset: int = 0) -> int:
+        if self.snapid:
+            raise FSError(errno.EROFS,
+                          "snapshot handles are read-only")
         with self.m._ino_lock(self.ino):
             self.m._cap_get(self.ino, "exclusive", self.cap_timeout)
             self._data.write(data, offset=offset)
             out = self.m._rpc("setattr",
                               {"ino": self.ino,
                                "size": offset + len(data),
+                               "snaps": self.snaps,
                                "mtime": time.time()})
             with self.m._caps_lock:
                 if self.ino in self.m._attr:
@@ -337,6 +366,17 @@ class MDSFile:
 
     def read(self, length: int | None = None,
              offset: int = 0) -> bytes:
+        if self.snapid:
+            # snapshot data is immutable: no caps, size from the
+            # snapshotted meta the striper handle already read
+            size = self._data.size
+            if length is None:
+                length = max(size - offset, 0)
+            length = min(length, max(size - offset, 0))
+            if length <= 0:
+                return b""
+            out = self._data.read(length, offset)
+            return out + b"\x00" * (length - len(out))
         self.m._cap_get(self.ino, "shared", self.cap_timeout)
         size = self.m._getattr(self.ino).get("size", 0)
         # the MDS inode size is authoritative: sync the striper
@@ -352,10 +392,14 @@ class MDSFile:
         return out + b"\x00" * (length - len(out))
 
     def truncate(self, size: int) -> None:
+        if self.snapid:
+            raise FSError(errno.EROFS,
+                          "snapshot handles are read-only")
         with self.m._ino_lock(self.ino):
             self.m._cap_get(self.ino, "exclusive", self.cap_timeout)
             self.m._rpc("setattr", {"ino": self.ino, "size": size,
                                     "force": True,
+                                    "snaps": self.snaps,
                                     "mtime": time.time()})
             self._data.size = min(self._data.size, size)
             self._data._write_meta()

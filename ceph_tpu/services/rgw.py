@@ -143,7 +143,8 @@ class RGWGateway:
     def _log_mutation(self, bucket: str, op: str, key: str,
                       etag: str = "", vid: str | None = None,
                       pair: list | None = None,
-                      origin: str | None = None) -> None:
+                      origin: str | None = None,
+                      oseq: list | None = None) -> None:
         """Append one SEQUENCED replication-log entry: an atomic cls
         numops counter assigns the seq, the entry rides an omap key
         (zero-padded seq) — O(1) appends, PAGED tailing, and markers
@@ -159,6 +160,8 @@ class RGWGateway:
         seq = int(json.loads(out)["seq"])
         ent = {"op": op, "key": key, "etag": etag,
                "zone": origin or self.zone}
+        if oseq is not None:
+            ent["oseq"] = [int(oseq[0]), str(oseq[1])]
         if vid is not None:
             ent["vid"] = vid
         if pair is not None:
@@ -417,11 +420,110 @@ class RGWGateway:
         return f"{bucket}/{key}" if vid == "null" \
             else f"{bucket}/{key}\x00{vid}"
 
+    # -- deferred GC (src/rgw/rgw_gc.cc:257 RGWGC::process role) ------
+    #: omap object holding {soid: enroll_stamp} for striped objects
+    #: being deleted — enrolled BEFORE the inline tail removal,
+    #: cleared after it completes. A gateway crash mid-delete leaves
+    #: the enrollment; the lifecycle worker's gc pass reaps the
+    #: orphaned tails later (the reference defers tails to cls_gc
+    #: the same way instead of trusting the inline delete).
+    GC_OID = ".rgwgc"
+    #: seconds an enrollment must age before the reaper touches it
+    #: (grace for the inline delete still running)
+    GC_DEFER = 2.0
+
+    def _gc_enroll(self, soid: str) -> None:
+        import time as _t
+        try:
+            self.io.omap_set(self.GC_OID,
+                             {soid: str(_t.time()).encode()})
+        except Exception:
+            pass                  # GC is belt-and-braces; the inline
+            # delete still runs
+
+    def _gc_done(self, soid: str) -> None:
+        try:
+            self.io.omap_rm_keys(self.GC_OID, [soid])
+        except Exception:
+            pass
+
+    def _remove_striped(self, soid: str) -> None:
+        """Crash-safe striped-object removal: enroll -> inline remove
+        -> de-enroll. Tails orphaned by a crash between the steps are
+        reaped by the gc pass."""
+        self._gc_enroll(soid)
+        StripedObject(self.io, soid).remove()
+        self._gc_done(soid)
+
+    def gc_list(self) -> dict[str, float]:
+        """Pending gc enrollments {soid: stamp} (radosgw-admin gc
+        list role)."""
+        from ceph_tpu.client.rados import RadosError
+        try:
+            return {k: float(v) for k, v in
+                    self.io.omap_get(self.GC_OID).items()}
+        except RadosError:
+            return {}
+
+    def gc_process(self, grace: float | None = None) -> dict:
+        """Reap aged enrollments: remove every surviving piece of the
+        enrolled stripe (meta + data pieces found by prefix listing),
+        then drop the entry. Returns {"entries": n, "objects": n}
+        (RGWGC::process, src/rgw/rgw_gc.cc:257)."""
+        import time as _t
+        grace = self.GC_DEFER if grace is None else grace
+        now = _t.time()
+        stats = {"entries": 0, "objects": 0}
+        pending = self.gc_list()
+        if not pending:
+            return stats
+        names = None
+        for soid, stamp in pending.items():
+            if now - stamp < grace:
+                continue
+            if names is None:       # one listing serves the pass
+                names = self.io.list_objects()
+            doomed = [n for n in names
+                      if n == soid + StripedObject.META_SUFFIX
+                      or (n.startswith(soid + ".")
+                          and n[len(soid) + 1:].isalnum())]
+            for n in doomed:
+                try:
+                    self.io.remove(n)
+                    stats["objects"] += 1
+                except Exception:
+                    pass
+            self._gc_done(soid)
+            stats["entries"] += 1
+        return stats
+
     def _alloc_vseq(self, bucket: str) -> int:
         out = self.io.execute(self._ver_oid(bucket), "numops", "add",
                               json.dumps({"key": "seq",
                                           "value": 1}).encode())
         return int(json.loads(out)["seq"])
+
+    def _bump_vseq(self, bucket: str, floor: int) -> None:
+        """Lamport receive: applying a remote generation with origin
+        seq ``floor`` raises the local allocator past it, so the next
+        LOCAL mutation deterministically orders after everything this
+        zone has seen (the OLH epoch monotonicity of set_olh,
+        src/rgw/rgw_rados.h:3287)."""
+        self.io.execute(self._ver_oid(bucket), "numops", "max",
+                        json.dumps({"key": "seq",
+                                    "value": floor}).encode())
+
+    @staticmethod
+    def _gen_order(ent: dict) -> tuple:
+        """Deterministic cross-zone total order on generations — the
+        OLH 'which generation is current' resolution
+        (src/rgw/rgw_rados.h:3287 set_olh): (origin seq, origin zone)
+        pairs compare identically at every zone, unlike the local
+        apply-order seq. Legacy entries fall back to (seq, "")."""
+        o = ent.get("oseq")
+        if o:
+            return (int(o[0]), str(o[1]))
+        return (int(ent.get("seq", 0)), "")
 
     def _ver_omap(self, bucket: str, prefix: str) -> dict:
         from ceph_tpu.client.rados import RadosError
@@ -473,7 +575,8 @@ class RGWGateway:
                    acl: str | None = None, owner: str | None = None,
                    version_id: str | None = None,
                    pair: list | None = None,
-                   origin: str | None = None) -> str | None:
+                   origin: str | None = None,
+                   oseq: list | None = None) -> str | None:
         """``etag`` overrides the computed md5 (replication must
         carry the SOURCE etag — multipart objects have 'md5-N' etags
         a re-hash cannot reproduce); ``_log=False`` suppresses the
@@ -501,7 +604,14 @@ class RGWGateway:
                 return None        # remote mutation lost the conflict
         if status is not None:
             self._preserve_null_version(bucket, key)
-            seq = self._alloc_vseq(bucket)
+            if oseq is not None:
+                # replicated generation: adopt the ORIGIN's order pair
+                # and raise the local allocator past it (Lamport)
+                self._bump_vseq(bucket, int(oseq[0]))
+                seq = self._alloc_vseq(bucket)
+            else:
+                seq = self._alloc_vseq(bucket)
+                oseq = [seq, self.zone if self.zone_log else ""]
             # multisite zones qualify minted ids with the zone name:
             # two zones' per-bucket seq counters would otherwise mint
             # COLLIDING ids for concurrently-created generations
@@ -509,14 +619,15 @@ class RGWGateway:
             vid = version_id or (f"v{seq:012d}{suffix}"
                                  if status == "Enabled" else "null")
             doid = self._ver_data_oid(bucket, key, vid)
-            StripedObject(self.io, doid).remove()
+            self._remove_striped(doid)
             so = StripedObject(self.io, doid, self._layout)
             if data:
                 so.write(data)
             import time as _t
             mtime = _t.time()
             ent = {"vid": vid, "seq": seq, "size": len(data),
-                   "etag": etag, "mtime": mtime, "dm": False}
+                   "etag": etag, "mtime": mtime, "dm": False,
+                   "oseq": [int(oseq[0]), str(oseq[1])]}
             # acl/owner ride the generation record so a resurfaced
             # older generation keeps its object ACL (reindex restores
             # from here)
@@ -525,16 +636,20 @@ class RGWGateway:
             if owner is not None:
                 ent["owner"] = owner
             self._ver_put_entry(bucket, key, ent)
-            self._index_add(bucket, key, len(data), etag,
-                            mtime=mtime, acl=acl, owner=owner,
-                            vid=vid)
+            # repoint the main index ONLY when this generation wins
+            # the deterministic order — a replicated older generation
+            # must not displace a newer current (the OLH update rule)
+            ents = self._ver_entries(bucket, key)
+            if max(ents.values(), key=self._gen_order) is                     ents.get(vid):
+                self._index_add(bucket, key, len(data), etag,
+                                mtime=mtime, acl=acl, owner=owner,
+                                vid=vid)
             self.last_version_id = vid
             if _log:
                 self._log_mutation(bucket, "put", key, etag, vid=vid,
-                                   origin=origin)
+                                   origin=origin, oseq=oseq)
             return etag
-        so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
-        so.remove()                    # replace semantics
+        self._remove_striped(f"{bucket}/{key}")  # replace semantics
         so = StripedObject(self.io, f"{bucket}/{key}", self._layout)
         if data:
             so.write(data)
@@ -572,7 +687,8 @@ class RGWGateway:
                       _log: bool = True,
                       _marker_vid: str | None = None,
                       pair: list | None = None,
-                      origin: str | None = None) -> str | None:
+                      origin: str | None = None,
+                      oseq: list | None = None) -> str | None:
         """Unversioned: remove for good. Versioning enabled, no
         version_id: lay a DELETE MARKER (the data stays; GETs 404
         until the marker is deleted). With version_id: permanently
@@ -599,7 +715,7 @@ class RGWGateway:
                     # truthful (only the agent ever passes a pair)
                     raise RGWError(409, "RemoteStale")
             self._index_rm(bucket, key)
-            StripedObject(self.io, f"{bucket}/{key}").remove()
+            self._remove_striped(f"{bucket}/{key}")
             if _log:
                 self._log_mutation(bucket, "del", key,
                                    pair=applied_pair, origin=origin)
@@ -613,7 +729,12 @@ class RGWGateway:
             # any null generation — repeated deletes must not
             # accumulate marker entries
             self._preserve_null_version(bucket, key)
-            seq = self._alloc_vseq(bucket)
+            if oseq is not None:
+                self._bump_vseq(bucket, int(oseq[0]))
+                seq = self._alloc_vseq(bucket)
+            else:
+                seq = self._alloc_vseq(bucket)
+                oseq = [seq, self.zone if self.zone_log else ""]
             suffix = f"-{self.zone}" if self.zone_log else ""
             vid = _marker_vid or (
                 "null" if status == "Suspended"
@@ -621,18 +742,25 @@ class RGWGateway:
             if vid == "null":
                 old = self._ver_entries(bucket, key).get("null")
                 if old is not None and not old.get("dm"):
-                    StripedObject(self.io, self._ver_data_oid(
-                        bucket, key, "null")).remove()
+                    self._remove_striped(self._ver_data_oid(
+                        bucket, key, "null"))
             self._ver_put_entry(bucket, key, {
                 "vid": vid, "seq": seq, "size": 0, "etag": "",
-                "mtime": __import__("time").time(), "dm": True})
-            try:
-                self._index_rm(bucket, key)
-            except RGWError:
-                pass
+                "mtime": __import__("time").time(), "dm": True,
+                "oseq": [int(oseq[0]), str(oseq[1])]})
+            # the marker hides the key ONLY when it wins the
+            # deterministic order (a replicated marker concurrent
+            # with a newer put must not shadow it — the OLH rule)
+            ents = self._ver_entries(bucket, key)
+            newest = max(ents.values(), key=self._gen_order)
+            if newest.get("vid") == vid:
+                try:
+                    self._index_rm(bucket, key)
+                except RGWError:
+                    pass
             if _log:
                 self._log_mutation(bucket, "dm", key, vid=vid,
-                                   origin=origin)
+                                   origin=origin, oseq=oseq)
             return vid
         # permanent delete of one generation
         ents = self._ver_entries(bucket, key)
@@ -640,8 +768,8 @@ class RGWGateway:
         if ent is None:
             raise RGWError(404, "NoSuchVersion")
         if not ent.get("dm"):
-            StripedObject(self.io, self._ver_data_oid(
-                bucket, key, version_id)).remove()
+            self._remove_striped(self._ver_data_oid(
+                bucket, key, version_id))
         self._ver_rm_entry(bucket, key, version_id)
         del ents[version_id]
         cur = self.list_objects(bucket, prefix=key).get(key)
@@ -671,7 +799,7 @@ class RGWGateway:
             pass
         if not ents:
             return
-        newest = max(ents.values(), key=lambda e: e["seq"])
+        newest = max(ents.values(), key=self._gen_order)
         if newest.get("dm"):
             return
         self._index_add(bucket, key, newest["size"], newest["etag"],
@@ -696,10 +824,11 @@ class RGWGateway:
             # IsLatest = the newest generation by seq — a delete
             # marker that is newest IS the latest (it just hides the
             # key from plain listings)
-            latest = max(e["seq"] for e in by_key[key])
-            for ent in sorted(by_key[key], key=lambda e: -e["seq"]):
+            latest = max(by_key[key], key=self._gen_order)
+            for ent in sorted(by_key[key], key=self._gen_order,
+                              reverse=True):
                 out.append({"key": key, **ent,
-                            "is_current": ent["seq"] == latest})
+                            "is_current": ent is latest})
         return out
 
     def list_objects(self, bucket: str, prefix: str = "",

@@ -33,8 +33,11 @@ class LifecycleProcessor:
 
     # -- one pass (RGWLC::process role) -------------------------------
     def process(self, now: float | None = None) -> dict:
-        """Apply every bucket's enabled rules once; returns
-        {"expired": n, "noncurrent_reaped": n, "markers_cleaned": n}."""
+        """Apply every bucket's enabled rules once, then run the
+        deferred-GC reaper (orphaned striped tails from a gateway
+        crash mid-delete — RGWGC::process, src/rgw/rgw_gc.cc:257);
+        returns {"expired": n, "noncurrent_reaped": n,
+        "markers_cleaned": n, "gc_entries": n, "gc_objects": n}."""
         now = time.time() if now is None else now
         stats = {"expired": 0, "noncurrent_reaped": 0,
                  "markers_cleaned": 0}
@@ -47,6 +50,9 @@ class LifecycleProcessor:
                 if rule.get("status", "Enabled") != "Enabled":
                     continue
                 self._apply_rule(bucket, rule, now, stats)
+        gc = self.gw.gc_process()
+        stats["gc_entries"] = gc["entries"]
+        stats["gc_objects"] = gc["objects"]
         return stats
 
     def _apply_rule(self, bucket: str, rule: dict, now: float,

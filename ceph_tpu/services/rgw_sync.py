@@ -128,7 +128,8 @@ class RGWSyncAgent:
                 bucket, ent["key"], data,
                 etag=meta.get("etag") or None,
                 version_id=vid, pair=pair,
-                origin=origin) is not None
+                origin=origin,
+                oseq=ent.get("oseq") or meta.get("oseq")) is not None
         elif ent["op"] == "del":
             try:
                 self.dst.delete_object(bucket, ent["key"],
@@ -140,7 +141,8 @@ class RGWSyncAgent:
             try:
                 self.dst.delete_object(bucket, ent["key"],
                                        _marker_vid=vid,
-                                       origin=origin)
+                                       origin=origin,
+                                       oseq=ent.get("oseq"))
             except RGWError:
                 return False
         elif ent["op"] == "delver":
@@ -165,7 +167,8 @@ class RGWSyncAgent:
                 if ent.get("dm"):
                     self.dst.delete_object(bucket, ent["key"],
                                            _marker_vid=ent["vid"],
-                                           _log=False)
+                                           _log=False,
+                                           oseq=ent.get("oseq"))
                     continue
                 try:
                     data, meta = self.src.get_object(
@@ -174,7 +177,8 @@ class RGWSyncAgent:
                     continue    # reaped mid-enumeration
                 self.dst.put_object(bucket, ent["key"], data,
                                     etag=meta.get("etag") or None,
-                                    version_id=ent["vid"])
+                                    version_id=ent["vid"],
+                                    oseq=ent.get("oseq"))
             return
         marker = ""
         while True:

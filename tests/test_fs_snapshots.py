@@ -1,0 +1,226 @@
+"""CephFS snapshots — SnapRealm-lite (round 5).
+
+Reference: per-directory snapshots (src/mds/SnapRealm.h:27,
+SnapServer.{h,cc}, src/mds/snap.cc) layered on RADOS self-managed
+snaps: snapids come from the pool sequence, every write under a
+snapshotted directory carries the realm's SnapContext, and the OSD's
+make_writeable COW preserves both metadata and striped data. The
+".snap" pseudo-directory surfaces them, as in the reference.
+"""
+
+import errno
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.services.cephfs import CephFS, FSError
+from ceph_tpu.services.mds import MDSDaemon
+from ceph_tpu.services.mds_client import CephFSMount
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_osds=3) as c:
+        c.client()
+        c.create_pool("snapfs", pg_num=4, size=2)
+        c.create_pool("snapmds", pg_num=4, size=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    io = cluster._clients[0].open_ioctx("snapfs")
+    return CephFS(io, caps=False)
+
+
+# -- engine level -------------------------------------------------------
+
+def test_snapshot_preserves_file_content(fs):
+    fs.mkdir("/d")
+    f = fs.create("/d/a")
+    f.write(b"version-1")
+    sid = fs.mksnap("/d", "s1")
+    assert sid > 0
+    assert fs.lssnap("/d") == {"s1": sid}
+    # overwrite AFTER the snapshot
+    f2 = fs.open("/d/a")
+    f2.write(b"version-2!")
+    assert fs.open("/d/a").read() == b"version-2!"
+    # the snapshot still reads the old content
+    snap = fs.open("/d/.snap/s1/a")
+    assert snap.read() == b"version-1"
+    assert fs.stat("/d/.snap/s1/a")["size"] == 9
+    with pytest.raises(FSError) as ei:
+        snap.write(b"nope")
+    assert ei.value.errno == errno.EROFS
+
+
+def test_snapshot_freezes_namespace(fs):
+    fs.mkdir("/ns")
+    fs.create("/ns/old").write(b"x")
+    fs.mksnap("/ns", "before")
+    fs.create("/ns/new").write(b"y")
+    fs.unlink("/ns/old")
+    assert fs.readdir("/ns") == ["new"]
+    # the snapshot namespace is frozen: old exists, new does not
+    assert fs.readdir("/ns/.snap/before") == ["old"]
+    assert fs.open("/ns/.snap/before/old").read() == b"x"
+    with pytest.raises(FSError):
+        fs.open("/ns/.snap/before/new")
+    assert fs.readdir("/ns/.snap") == ["before"]
+
+
+def test_snapshot_nested_dirs(fs):
+    fs.mkdir("/deep")
+    fs.mkdir("/deep/sub")
+    fs.create("/deep/sub/f").write(b"nested-v1")
+    fs.mksnap("/deep", "d1")
+    fs.open("/deep/sub/f").write(b"nested-v2")
+    fs.rmdir  # namespace churn below the realm
+    fs.create("/deep/sub/g").write(b"post")
+    assert fs.open("/deep/.snap/d1/sub/f").read() == b"nested-v1"
+    assert fs.readdir("/deep/.snap/d1/sub") == ["f"]
+
+
+def test_two_snapshots_layer(fs):
+    fs.mkdir("/layers")
+    f = fs.create("/layers/f")
+    f.write(b"AAAA")
+    fs.mksnap("/layers", "t1")
+    fs.open("/layers/f").write(b"BBBB")
+    fs.mksnap("/layers", "t2")
+    fs.open("/layers/f").write(b"CCCC")
+    assert fs.open("/layers/.snap/t1/f").read() == b"AAAA"
+    assert fs.open("/layers/.snap/t2/f").read() == b"BBBB"
+    assert fs.open("/layers/f").read() == b"CCCC"
+
+
+def test_rmsnap_retires_snapid(fs, cluster):
+    fs.mkdir("/gone")
+    fs.create("/gone/f").write(b"keepme")
+    sid = fs.mksnap("/gone", "tmp")
+    fs.open("/gone/f").write(b"newer!")
+    assert fs.open("/gone/.snap/tmp/f").read() == b"keepme"
+    fs.rmsnap("/gone", "tmp")
+    with pytest.raises(FSError):
+        fs.open("/gone/.snap/tmp/f")
+    assert fs.lssnap("/gone") == {}
+    # the snapid is in the pool's removed set (trimmers reclaim)
+    pool_id = fs.io.pool_id
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        pool = cluster._clients[0].monc.osdmap.pools[pool_id]
+        if sid in pool.removed_snaps:
+            break
+        time.sleep(0.2)
+    assert sid in pool.removed_snaps
+
+
+def test_snapshot_of_deleted_file_survives(fs):
+    fs.mkdir("/keep")
+    fs.create("/keep/f").write(b"precious")
+    fs.mksnap("/keep", "hold")
+    fs.unlink("/keep/f")
+    with pytest.raises(FSError):
+        fs.open("/keep/f")
+    assert fs.open("/keep/.snap/hold/f").read() == b"precious"
+
+
+# -- MDS daemon + mounts ------------------------------------------------
+
+def test_mds_snapshot_under_concurrent_writes(cluster):
+    mds = MDSDaemon("sa", cluster.mon_addr, "snapmds",
+                    active_ttl=1.5).start(wait_active=True)
+    io = cluster._clients[0].open_ioctx("snapmds")
+    try:
+        with CephFSMount(io) as m1, CephFSMount(io) as m2:
+            m1.mkdir("/live")
+            f = m1.open("/live/data", create=True)
+            f.write(b"epoch-0")
+            f.release()
+            stop = threading.Event()
+            wrote = []
+
+            def writer():
+                n = 0
+                while not stop.is_set():
+                    h = m1.open("/live/data")
+                    h.write(f"epoch-{n}".encode())
+                    h.release()
+                    wrote.append(n)
+                    n += 1
+
+            t = threading.Thread(target=writer, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            m2.mksnap("/live", "mid")          # under live writes
+            time.sleep(0.3)
+            stop.set()
+            t.join(timeout=10)
+            assert wrote, "writer never ran"
+            # the snapshot holds ONE consistent pre/mid-churn value
+            snap = m2.open("/live/.snap/mid/data")
+            got = snap.read()
+            assert got.startswith(b"epoch-"), got
+            # and the head kept moving past it
+            assert "mid" in m2.lssnap("/live")
+            head = m2.open("/live/data").read()
+            assert head == f"epoch-{wrote[-1]}".encode()
+    finally:
+        mds.stop()
+
+
+def test_mds_failover_mid_snap(cluster):
+    """Kill the active MDS after the mksnap intent journals but
+    before the dir inode update: the standby's replay finishes the
+    snapshot (or the retried request completes it) — the snapshot
+    either exists fully or not at all, never half."""
+    a = MDSDaemon("fa2", cluster.mon_addr, "snapmds",
+                  active_ttl=1.0).start(wait_active=True)
+    io = cluster._clients[0].open_ioctx("snapmds")
+    m = CephFSMount(io, op_timeout=30.0)
+    try:
+        m.mkdir("/fo")
+        f = m.open("/fo/file", create=True)
+        f.write(b"pre-snap")
+        f.release()
+        wedged = threading.Event()
+        orig = a.fs._write_inode
+
+        def stuck_write(ino, inode, snapc=None):
+            if "snaps" in inode and inode["snaps"]:
+                wedged.set()
+                threading.Event().wait()   # never returns
+            return orig(ino, inode, snapc=snapc)
+
+        a.fs._write_inode = stuck_write
+        result = []
+
+        def do_snap():
+            result.append(m.mksnap("/fo", "cut"))
+
+        t = threading.Thread(target=do_snap, daemon=True)
+        t.start()
+        assert wedged.wait(timeout=10), "mksnap never reached the " \
+            "inode write"
+        a.kill()
+        b = MDSDaemon("fb2", cluster.mon_addr, "snapmds",
+                      active_ttl=1.0).start(wait_active=True,
+                                            timeout=30.0)
+        try:
+            t.join(timeout=30)
+            assert result, "retried mksnap did not complete"
+            assert "cut" in m.lssnap("/fo")
+            # post-failover the snapshot serves reads, and new writes
+            # stay out of it
+            h = m.open("/fo/file")
+            h.write(b"post-snap")
+            h.release()
+            assert m.open("/fo/.snap/cut/file").read() == b"pre-snap"
+        finally:
+            b.stop()
+    finally:
+        m.umount()
+        a.kill()

@@ -175,7 +175,7 @@ def test_failover_replays_half_done_rename(cluster):
         wedged = threading.Event()
         orig_unlink = a.fs._dir_unlink
 
-        def stuck_unlink(dir_ino, name):
+        def stuck_unlink(dir_ino, name, snapc=None):
             wedged.set()
             threading.Event().wait()      # never returns
 

@@ -161,3 +161,80 @@ def test_versioned_generation_sets_converge(zones):
     for vid in vids_a:
         assert a.get_object("vb", "doc", version_id=vid)[0] == \
             b.get_object("vb", "doc", version_id=vid)[0]
+
+
+def _versions_view(z, bucket):
+    """Comparable ListObjectVersions projection: (key, vid, dm,
+    is_current) rows — what the OLH convergence contract covers."""
+    return sorted((e["key"], e["vid"], bool(e.get("dm")),
+                   e["is_current"])
+                  for e in z.list_versions(bucket))
+
+
+def test_olh_current_converges_concurrent_puts(zones):
+    """r5 (src/rgw/rgw_rados.h:3287 set_olh): concurrent versioned
+    PUTs in both zones — after sync, both zones agree on WHICH
+    generation is current (not just on the generation set). The
+    (origin seq, zone) order pair decides: both minted seq 1, zone
+    "b" > "a" wins."""
+    a, b, ab, ba = zones
+    for z in (a, b):
+        z.create_bucket("olh")
+        z.set_versioning("olh", "Enabled")
+    a.put_object("olh", "k", b"from-a")
+    b.put_object("olh", "k", b"from-b")
+    _quiesce(ab, ba)
+    va, vb = _versions_view(a, "olh"), _versions_view(b, "olh")
+    assert va == vb, f"versions diverged:\n{va}\n{vb}"
+    assert sum(1 for e in a.list_versions("olh")
+               if e["is_current"]) == 1
+    # the current pointer (plain GET) agrees too — zone b's write
+    # wins the (1, "b") > (1, "a") order in BOTH zones
+    assert a.get_object("olh", "k")[0] == b"from-b"
+    assert b.get_object("olh", "k")[0] == b"from-b"
+
+
+def test_olh_current_converges_put_vs_delete_marker(zones):
+    """Concurrent versioned PUT (zone a) vs DELETE-marker (zone b) on
+    a key both zones already hold: both zones must agree whether the
+    key is visible and which generation is current."""
+    a, b, ab, ba = zones
+    for z in (a, b):
+        z.create_bucket("olhdm")
+        z.set_versioning("olhdm", "Enabled")
+    a.put_object("olhdm", "k", b"base")
+    _quiesce(ab, ba)
+    # concurrent: a PUTs a new generation, b lays a delete marker.
+    # Both mint origin seq 2 -> zone "b" breaks the tie: the marker
+    # is current, the key is hidden in BOTH zones.
+    a.put_object("olhdm", "k", b"newer-a")
+    b.delete_object("olhdm", "k")
+    _quiesce(ab, ba)
+    va, vb = _versions_view(a, "olhdm"), _versions_view(b, "olhdm")
+    assert va == vb, f"versions diverged:\n{va}\n{vb}"
+    cur_a = [e for e in a.list_versions("olhdm") if e["is_current"]]
+    assert len(cur_a) == 1 and cur_a[0].get("dm"), cur_a
+    for z in (a, b):
+        with pytest.raises(RGWError):
+            z.get_object("olhdm", "k")
+
+
+def test_olh_marker_loses_to_causally_later_put(zones):
+    """A delete marker replicated AFTER the peer already applied a
+    causally-later put (Lamport-bumped past the marker's origin seq)
+    must not shadow it in either zone."""
+    a, b, ab, ba = zones
+    for z in (a, b):
+        z.create_bucket("olhseq")
+        z.set_versioning("olhseq", "Enabled")
+    a.put_object("olhseq", "k", b"v1")
+    _quiesce(ab, ba)
+    b.delete_object("olhseq", "k")      # marker, origin seq 2 @ b
+    _quiesce(ab, ba)
+    # a saw the marker (Lamport bump), so its next put orders AFTER
+    a.put_object("olhseq", "k", b"v2")
+    _quiesce(ab, ba)
+    va, vb = _versions_view(a, "olhseq"), _versions_view(b, "olhseq")
+    assert va == vb, f"versions diverged:\n{va}\n{vb}"
+    assert a.get_object("olhseq", "k")[0] == b"v2"
+    assert b.get_object("olhseq", "k")[0] == b"v2"

@@ -396,3 +396,53 @@ def test_multisite_replicates_versions(setup):
     src.delete_object("ms", "doc", version_id=marker)
     agent.sync_once()
     assert dst.get_object("ms", "doc")[0] == b"gen-2"
+
+
+def test_gc_reaps_orphaned_tails_after_crash_mid_delete(setup):
+    """r5 deferred GC (RGWGC::process, src/rgw/rgw_gc.cc:257): a
+    gateway that dies mid-delete leaves striped tail pieces; the gc
+    enrollment survives and the lifecycle worker's gc pass reaps
+    them, space accounted."""
+    from ceph_tpu.client.striper import StripedObject
+    gw, _ = setup
+    gw.create_bucket("gcb")
+    payload = os.urandom(3 << 20)     # 3 pieces at 1 MiB layout
+    gw.put_object("gcb", "victim", payload)
+    soid = "gcb/victim"
+    pieces_before = [n for n in gw.io.list_objects()
+                     if n.startswith(soid + ".")]
+    assert len(pieces_before) >= 2, pieces_before
+    # crash mid-delete: the remove dies after the first piece
+    orig_remove = StripedObject.remove
+    calls = {"n": 0}
+
+    def dying_remove(self):
+        # rip out one piece, then "crash" (exception unwinds the
+        # gateway delete before it de-enrolls)
+        self.io.remove(self._piece(0))
+        raise ConnectionError("gateway died mid-delete")
+
+    StripedObject.remove = dying_remove
+    try:
+        with pytest.raises(ConnectionError):
+            gw.delete_object("gcb", "victim")
+    finally:
+        StripedObject.remove = orig_remove
+    # the enrollment survived the crash; tails still on disk
+    assert soid in gw.gc_list()
+    leftovers = [n for n in gw.io.list_objects()
+                 if n.startswith(soid + ".")]
+    assert leftovers, "crash simulation left no tails"
+    # the lifecycle worker's pass reaps them (gc defer elapsed)
+    time.sleep(2.1)
+    proc = LifecycleProcessor(gw)
+    stats = proc.process()
+    assert stats["gc_entries"] == 1
+    assert stats["gc_objects"] >= len(leftovers)
+    assert [n for n in gw.io.list_objects()
+            if n.startswith(soid + ".")] == []
+    assert gw.gc_list() == {}
+    # a healthy delete leaves no enrollment behind
+    gw.put_object("gcb", "fine", b"x" * 100)
+    gw.delete_object("gcb", "fine")
+    assert gw.gc_list() == {}

@@ -218,3 +218,57 @@ def test_tier_commands_validate(cluster, rados):
         {"prefix": "osd tier cache-mode", "pool": "hot",
          "mode": "none"})
     assert code == -16 and "overlay" in outs   # clients still redirect
+
+
+def test_hit_sets_gate_promotion_scan_vs_hot(cluster, rados):
+    """r5 (src/osd/HitSet.h:33 + PrimaryLogPG.cc:2445): with hit sets
+    on, a SCAN (one touch per object) is served by proxy reads —
+    nothing promotes — while a HOT object (touched repeatedly inside
+    the window) does promote. Uses a fresh pool pair seeded BEFORE
+    the overlay lands, so every read is a genuine cache miss."""
+    cluster.create_pool("base2", pg_num=4, size=2)
+    cluster.create_pool("hot2", pg_num=4, size=2)
+    base_io = rados.open_ioctx("base2")
+    scan_oids = [f"scan-{i}" for i in range(6)]
+    for oid in scan_oids + ["hotobj"]:
+        base_io.write_full(oid, f"payload-{oid}".encode())
+    for cmd in (
+        {"prefix": "osd tier add", "pool": "base2",
+         "tierpool": "hot2", "force_nonempty": "1"},
+        {"prefix": "osd tier cache-mode", "pool": "hot2",
+         "mode": "writeback"},
+        {"prefix": "osd tier set-overlay", "pool": "base2",
+         "overlaypool": "hot2"},
+        {"prefix": "osd pool set", "pool": "hot2",
+         "var": "hit_set_period", "val": "60"},
+        {"prefix": "osd pool set", "pool": "hot2",
+         "var": "min_read_recency_for_promote", "val": "1"},
+    ):
+        code, outs, _ = rados.mon_command(cmd)
+        assert code == 0, outs
+    hot_id = rados.monc.osdmap.pool_by_name["hot2"]
+    rados.wait_for_epoch(cluster.mon.osdmap.epoch)
+    _wait(lambda: rados.monc.osdmap.pools[hot_id].hit_set_period
+          == 60.0, msg="hit_set knobs in client map")
+    promotes0 = _tier_counter(cluster, "tier_promote")
+    proxies0 = _tier_counter(cluster, "tier_proxy_read")
+    # SCAN: one touch each -> every read is a miss, all proxied
+    for oid in scan_oids:
+        assert base_io.read(oid) == f"payload-{oid}".encode()
+    assert _tier_counter(cluster, "tier_promote") == promotes0, \
+        "scan reads must not promote"
+    assert _tier_counter(cluster, "tier_proxy_read") >= \
+        proxies0 + len(scan_oids)
+    hot_io = rados.open_ioctx("hot2")
+    assert hot_io.list_objects() == [], "scan polluted the tier"
+    # HOT: first touch proxied, second touch within the window
+    # promotes
+    assert base_io.read("hotobj") == b"payload-hotobj"
+    assert base_io.read("hotobj") == b"payload-hotobj"
+    _wait(lambda:
+          _tier_counter(cluster, "tier_promote") > promotes0,
+          msg="hot object promoted on re-touch")
+    _wait(lambda: "hotobj" in hot_io.list_objects(),
+          msg="hot object resident in the tier")
+    # and the promoted object serves from the cache
+    assert base_io.read("hotobj") == b"payload-hotobj"
