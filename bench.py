@@ -1,40 +1,34 @@
 #!/usr/bin/env python
 """Driver benchmark gate: k=8,m=3 RS encode AND recovery-decode GB/s
-on one TPU chip (both halves of the north-star metric, BASELINE.json).
+on one TPU chip (both halves of the north-star metric, BASELINE.json),
+plus the Clay k=8,m=4,d=11 decode-2 row (dense linearized matrix vs
+the round-6 block-sparse kernel).
 
-Prints ONE JSON line:
+Output contract (round-6, the r5 ``rc=124, parsed: null`` fix): ONE
+JSON line is printed — and flushed — **per metric as it completes**,
+and a final combined line repeats them all in the historical schema:
+
+    {"metric": "ec_encode_rs_k8m3_device_GBps", "value": N, ...}
+    {"metric": "decode_e1_GBps", "value": N, ...}
+    ...
     {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N,
-     "decode_e1_GBps": N, "decode_e1_vs_baseline": N,
-     "decode_e2_GBps": N, "decode_e2_vs_baseline": N, ...}
+     "decode_e1_GBps": N, ..., "clay_decode2_GBps": N, ...}
 
-The primary metric/value stays the canonical encode (so driver history
-is comparable across rounds); the decode fields carry the recovery
-configs (``-w decode -e {1,2}``, src/erasure-code/isa/README:40-45).
+A driver that reads the last JSON line keeps working; a run killed
+after the first metric still leaves every finished metric parseable.
 
-Measures the canonical config of BASELINE.md — Reed-Solomon k=8, m=3
-(ISA profile), 1 MiB objects (reference run:
-``ceph_erasure_code_benchmark -p isa -P k=8 -P m=3 -S 1048576 -i 1000``,
-src/erasure-code/isa/README:36-38) — as a device-resident stripe-batched
-encode, the way the OSD stripe accumulator feeds the chip (SURVEY.md §7.5).
+Wall clock is BOUNDED: per-metric sampling budgets (``BUDGETS``) sum
+under ``TOTAL_BUDGET`` seconds and every ``stable_best_slope`` call
+additionally receives the same global deadline, so compiles or
+contention eating one metric's share shrink later metrics' sampling
+instead of overrunning the driver's timeout (tests/test_measure_guard
+asserts the configured worst case).
 
-Measurement method: the axon tunnel to the chip has ~10^2 ms RTT and
-``block_until_ready`` there does not guarantee device completion, so naive
-host timing is wrong in both directions. We run the encode inside a single
-jitted ``fori_loop`` whose carry feeds one parity row back into the input
-(a true data dependency, so XLA cannot collapse or overlap iterations) and
-take the slope between two iteration counts — dispatch and fetch overhead
-cancel; the chain update itself adds ~12% traffic, so the number is mildly
-conservative.
-
-vs_baseline is the ratio against the ISA-L-class CPU encode measured live
-on this host: our native C++ AVX2 nibble-table kernel
-(ops/native/gf256.cc — the same split-table technique ISA-L uses in asm;
-~8 GB/s single-core here, inside the 5-10 GB/s external ballpark of
-BASELINE.md — the reference repo itself publishes no absolute numbers).
-Target: >= 10x.
+Measurement method unchanged: chained-slope device-resident loops
+(see ceph_tpu/bench/measure.py) against the live-measured native AVX2
+CPU baseline.
 """
 
-import functools
 import json
 import time
 
@@ -46,6 +40,44 @@ K, M = 8, 3
 OBJECT_SIZE = 1 << 20            # 1 MiB, canonical config
 BATCH_OBJECTS = 128              # objects per kernel launch (128 MiB batch)
 LOOP_COUNTS = (5, 25)
+
+#: per-metric (time_budget, extended_budget) seconds for
+#: stable_best_slope; the worst case sums to <= TOTAL_BUDGET
+BUDGETS = {
+    "encode": (120.0, 120.0),
+    "decode_e1": (60.0, 60.0),
+    "decode_e2": (60.0, 60.0),
+    "clay_decode2_sparse": (50.0, 40.0),
+    "clay_decode2_dense": (30.0, 0.0),
+}
+
+#: global sampling deadline (seconds from process start). Sampling
+#: stops everywhere at this mark; the remaining tail (per-metric
+#: warmup compiles, ~35 s each on the tunnel, plus the exactness
+#: gates) keeps the whole run under ~700 s — comfortably inside the
+#: driver's 870 s timeout (worst case asserted by
+#: tests/test_measure_guard.py)
+TOTAL_BUDGET = 570.0
+
+#: lanes per clay survivor sub-chunk row (input batch = 10*64 rows x
+#: this; ~52 MiB survivors per iteration)
+CLAY_LANES = 1 << 17
+
+_T0 = time.perf_counter()
+_RESULTS: dict = {}
+
+
+def _deadline() -> float:
+    return _T0 + TOTAL_BUDGET
+
+
+def emit(metric: str, fields: dict) -> None:
+    """Print one metric's JSON line NOW (progressive emission) and
+    fold it into the final combined record."""
+    line = {"metric": metric}
+    line.update(fields)
+    print(json.dumps(line), flush=True)
+    _RESULTS[metric] = fields
 
 
 def main() -> None:
@@ -81,12 +113,12 @@ def main() -> None:
     data_bytes = K * n
     last_good = load_last_good()
 
-    def expect(metric):
+    def expect(metric, traffic_bytes=data_bytes):
         # last-good GB/s -> expected seconds/iter for THIS batch size,
         # arming the contended-plateau guard (the r4 2.12 GB/s record
         # was a fully-contended window self-confirming as a plateau)
         gbps = last_good.get(metric)
-        return data_bytes / (gbps * 1e9) if gbps else None
+        return traffic_bytes / (gbps * 1e9) if gbps else None
 
     # adaptive sampling: the tunnel chip is contended in bursts, so
     # sample until an uncontended plateau is established (round-1's
@@ -95,22 +127,27 @@ def main() -> None:
         step, ddata, counts=LOOP_COUNTS,
         # per-iteration HBM traffic is at least data-in + parity-out
         min_traffic_bytes=data_bytes * (K + M) // K,
-        time_budget=240.0, stable_n=6,
+        time_budget=BUDGETS["encode"][0], stable_n=6,
+        extended_budget=BUDGETS["encode"][1],
+        deadline=_deadline(),
         expect_slope=expect("ec_encode_rs_k8m3_device_GBps"))
     gbps = data_bytes / slope / 1e9
-    out = {
-        "metric": "ec_encode_rs_k8m3_device_GBps",
+    cpu_gbps = _cpu_baseline_gbps(mat)
+    enc_fields = {
         "value": round(gbps, 2),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / _cpu_baseline_gbps(mat), 2),
+        "vs_baseline": round(gbps / cpu_gbps, 2),
         "spread_pct": spread_pct,
         "samples": samples,
     }
     clean_metrics = {}
     if contended:
-        out["contended"] = True
+        enc_fields["contended"] = True
     else:
         clean_metrics["ec_encode_rs_k8m3_device_GBps"] = round(gbps, 1)
+        save_last_good(dict(clean_metrics))
+    emit("ec_encode_rs_k8m3_device_GBps", enc_fields)
+    any_contended = contended
     # recovery decode (the other half of the metric): reconstruct e
     # erased chunks from the k cheapest survivors, device-resident,
     # same chained-slope method. GB/s counts the object bytes the
@@ -141,32 +178,172 @@ def main() -> None:
         dslope, dspread, dsamples, dcontended = stable_best_slope(
             dstep, dsurv, counts=LOOP_COUNTS,
             min_traffic_bytes=data_bytes * (K + e) // K,
-            time_budget=150.0, stable_n=6,
+            time_budget=BUDGETS[f"decode_e{e}"][0], stable_n=6,
+            extended_budget=BUDGETS[f"decode_e{e}"][1],
+            deadline=_deadline(),
             expect_slope=expect(f"decode_e{e}_GBps"))
         dgbps = data_bytes / dslope / 1e9
-        out[f"decode_e{e}_GBps"] = round(dgbps, 2)
-        out[f"decode_e{e}_vs_baseline"] = round(
-            dgbps / _cpu_baseline_gbps(dmat), 2)
-        out[f"decode_e{e}_spread_pct"] = dspread
-        out[f"decode_e{e}_samples"] = dsamples
+        dec_fields = {
+            "value": round(dgbps, 2),
+            "unit": "GB/s",
+            "vs_baseline": round(dgbps / _cpu_baseline_gbps(dmat), 2),
+            "spread_pct": dspread,
+            "samples": dsamples,
+        }
         if dcontended:
-            out[f"decode_e{e}_contended"] = True
-            out["contended"] = True
+            dec_fields["contended"] = True
+            any_contended = True
         else:
             clean_metrics[f"decode_e{e}_GBps"] = round(dgbps, 1)
-    if out.get("contended"):
+            save_last_good({f"decode_e{e}_GBps": round(dgbps, 1)})
+        emit(f"decode_e{e}_GBps", dec_fields)
+
+    try:
+        clay_contended = _bench_clay_decode2(expect, clean_metrics)
+        any_contended = any_contended or clay_contended
+    except Exception as exc:  # the flagship rows must still land
+        emit("clay_decode2_GBps", {"error": repr(exc)})
+
+    if any_contended:
         # independent chip-health probe (different program, same
         # chip): a low number here confirms the collapse is
         # environmental, not a kernel regression — the r4 judge had
         # to re-run the whole bench by hand to establish that
         try:
-            out["xla_probe_GBps"] = round(hbm_probe_gbps(), 1)
+            _RESULTS["xla_probe_GBps"] = {"value": round(
+                hbm_probe_gbps(budget=min(
+                    25.0, max(_deadline() - time.perf_counter(),
+                              5.0))), 1)}
         except Exception:
             pass
     if clean_metrics:
         # persist clean plateaus as the next round's expectation
         save_last_good(clean_metrics)
-    print(json.dumps(out))
+    print(json.dumps(_combined(any_contended)), flush=True)
+
+
+def _combined(any_contended: bool) -> dict:
+    """The historical single-line schema, rebuilt from the per-metric
+    records (driver history stays comparable across rounds)."""
+    out = {"metric": "ec_encode_rs_k8m3_device_GBps", "unit": "GB/s"}
+    enc = _RESULTS.get("ec_encode_rs_k8m3_device_GBps", {})
+    out["value"] = enc.get("value")
+    out["vs_baseline"] = enc.get("vs_baseline")
+    out["spread_pct"] = enc.get("spread_pct")
+    out["samples"] = enc.get("samples")
+    for e in (1, 2):
+        dec = _RESULTS.get(f"decode_e{e}_GBps")
+        if dec:
+            out[f"decode_e{e}_GBps"] = dec.get("value")
+            out[f"decode_e{e}_vs_baseline"] = dec.get("vs_baseline")
+            out[f"decode_e{e}_spread_pct"] = dec.get("spread_pct")
+            out[f"decode_e{e}_samples"] = dec.get("samples")
+            if dec.get("contended"):
+                out[f"decode_e{e}_contended"] = True
+    clay = _RESULTS.get("clay_decode2_GBps")
+    if clay:
+        out["clay_decode2_GBps"] = clay.get("value")
+        for k2 in ("path", "sparse_GBps", "dense_GBps",
+                   "speedup_vs_dense", "block_occupancy", "mac_cut",
+                   "error"):
+            if k2 in clay:
+                out["clay_decode2_" + k2] = clay[k2]
+    probe = _RESULTS.get("xla_probe_GBps")
+    if probe:
+        out["xla_probe_GBps"] = probe["value"]
+    if any_contended:
+        out["contended"] = True
+    out["elapsed_s"] = round(time.perf_counter() - _T0, 1)
+    return out
+
+
+def _bench_clay_decode2(expect, clean_metrics: dict) -> bool:
+    """Clay k=8,m=4,d=11 decode-2: the dense linearized [128, 640]
+    matrix vs the round-6 block-sparse gather-of-blocks kernel
+    (ops/gf_block_sparse), both device-resident chained loops. GB/s
+    counts object bytes (k chunks) per iteration, the reference
+    accounting every other decode row uses. Emits one metric line
+    with both paths + the occupancy stats BASELINE.md documents.
+    Returns whether the winning row sampled contended."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.bench.measure import stable_best_slope
+    from ceph_tpu.models.registry import instance
+    from ceph_tpu.ops import gf256, gf_block_sparse, gf_jax
+
+    codec = instance().factory("clay", {
+        "k": "8", "m": "4", "d": "11", "backend": "numpy"})
+    ssc = codec.sub_chunk_no
+    kk = codec.k
+    avail = tuple(range(2, codec.k + codec.m))      # decode-2: lose 0,1
+    erased = (0, 1)
+    mat = codec._decode_matrix(avail, erased)       # [e*ssc, a*ssc]
+    occ = gf_block_sparse.occupancy_stats(mat)
+
+    # bit-exactness gates vs the host oracle, both paths
+    rng = np.random.default_rng(3)
+    xs = rng.integers(0, 256, size=(mat.shape[1], 1 << 12),
+                      dtype=np.uint8)
+    want = gf256.gf_matvec_chunks(mat, xs)
+    assert np.array_equal(gf_block_sparse.matvec(mat, xs), want), \
+        "clay decode-2 block-sparse is not bit-exact vs CPU reference"
+    assert np.array_equal(gf_jax.matvec(mat, xs), want), \
+        "clay decode-2 dense is not bit-exact vs CPU reference"
+
+    data = rng.integers(0, 256, size=(mat.shape[1], CLAY_LANES),
+                        dtype=np.uint8)
+    dd = jax.device_put(jnp.asarray(data))
+    object_bytes = kk * ssc * CLAY_LANES            # k chunks served
+    in_bytes = mat.shape[1] * CLAY_LANES
+    out_bytes = mat.shape[0] * CLAY_LANES
+
+    def sparse_step(ss):
+        rec = gf_block_sparse.matvec_device(mat, ss)
+        return ss.at[0:1].set(rec[0:1])
+
+    def dense_step(ss):
+        rec = gf_jax.matvec_device(mat, ss)
+        return ss.at[0:1].set(rec[0:1])
+
+    rows = {}
+    contended_any = False
+    for name, step_fn in (("sparse", sparse_step),
+                          ("dense", dense_step)):
+        budget, ext = BUDGETS[f"clay_decode2_{name}"]
+        slope, spread, samples, contended = stable_best_slope(
+            step_fn, dd, counts=(3, 13),
+            min_traffic_bytes=in_bytes + out_bytes,
+            time_budget=budget, stable_n=4,
+            extended_budget=ext, deadline=_deadline(),
+            expect_slope=expect(f"clay_decode2_{name}_GBps",
+                                object_bytes))
+        gbps = object_bytes / slope / 1e9
+        rows[name] = {"GBps": round(gbps, 2), "spread_pct": spread,
+                      "samples": samples, "contended": contended}
+        if not contended:
+            clean_metrics[f"clay_decode2_{name}_GBps"] = round(gbps, 1)
+        contended_any = contended_any or contended
+    winner = "sparse" if rows["sparse"]["GBps"] >= \
+        rows["dense"]["GBps"] else "dense"
+    fields = {
+        "value": rows[winner]["GBps"],
+        "unit": "GB/s",
+        "path": winner,
+        "sparse_GBps": rows["sparse"]["GBps"],
+        "dense_GBps": rows["dense"]["GBps"],
+        "sparse_spread_pct": rows["sparse"]["spread_pct"],
+        "dense_spread_pct": rows["dense"]["spread_pct"],
+        "speedup_vs_dense": round(
+            rows["sparse"]["GBps"] / max(rows["dense"]["GBps"], 1e-9),
+            2),
+        "block_occupancy": occ["block_occupancy"],
+        "mac_cut": occ["mac_cut"],
+    }
+    if contended_any:
+        fields["contended"] = True
+    emit("clay_decode2_GBps", fields)
+    return rows[winner]["contended"]
 
 
 def _cpu_baseline_gbps(mat) -> float:

@@ -134,6 +134,7 @@ def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
                       expect_slope: float | None = None,
                       contended_factor: float = 3.0,
                       extended_budget: float = 480.0,
+                      deadline: float | None = None,
                       ) -> tuple[float, float, int, bool]:
     """Adaptive best-slope estimator for a SHARED chip.
 
@@ -157,6 +158,14 @@ def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
     (hunting for a contention gap). If the extended budget also runs
     out contended, the plateau is returned with ``contended=True`` so
     the record is self-describing — never a silent collapse.
+
+    ``deadline`` (round-6, the r5 rc=124 fix): an absolute
+    ``time.perf_counter()`` value past which sampling stops no matter
+    what — the bench harness hands every metric the same global
+    deadline so the WHOLE run is wall-clock-bounded even when
+    compiles or contention eat one metric's share (a later metric
+    then samples fewer rounds instead of the process being killed
+    with every result lost).
 
     Returns (best_slope_seconds, spread_pct, n_samples, contended):
     spread_pct is the relative spread of the plateau samples around
@@ -182,6 +191,10 @@ def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
     t_start = time.perf_counter()
     hard_deadline = t_start + time_budget + (
         extended_budget if expect_slope is not None else 0.0)
+    if deadline is not None:
+        hard_deadline = min(hard_deadline, deadline)
+        time_budget = min(time_budget,
+                          max(deadline - t_start, 0.0))
     cur_sleep = sleep
     slopes: list[float] = []
     times: dict[int, float] = {}

@@ -116,6 +116,12 @@ class ErasureCodeClay(ErasureCode):
         #: _decode_chunks_lin for why it is not the default)
         self.decode_kernel = self.to_bool("decode_kernel", profile,
                                           False)
+        #: round-6 default: let the block-sparse gather-of-blocks
+        #: kernel (ops/gf_block_sparse) take a signature's matvec when
+        #: it MEASURES faster than the dense matrix on-device
+        #: (clay_device.build_decode_matvec; dense remains the
+        #: automatic fallback)
+        self.sparse_lin = self.to_bool("sparse_lin", profile, True)
         self._lin_cache.clear()
         # The plane machinery issues thousands of tiny per-sub-chunk solves;
         # those must run on the host even when the (linearized) hot path
@@ -556,26 +562,55 @@ class ErasureCodeClay(ErasureCode):
             # GB/s measured (RS-kernel class) vs 9 GB/s for the dense
             # linearized matrix, which is COMPUTE-bound at ~64x the
             # RS MAC count (models/clay_device.build_encode_kernel)
-            if getattr(self, "_enc_kernel", None) is None:
-                from ceph_tpu.models.clay_device import \
-                    build_encode_kernel
-                self._enc_kernel = build_encode_kernel(self)
-            sc = size // ssc
-            x = self._stack(chunks, range(self.k), ssc, sc)
-            par = np.asarray(self._enc_kernel(
-                x.reshape(self.k, ssc, sc)))
-            return {pos: par[pos - self.k].reshape(-1)
-                    for pos in want_to_encode
-                    if self.k <= pos < self.k + self.m}
+            try:
+                if getattr(self, "_enc_kernel", None) is None and \
+                        not getattr(self, "_enc_kernel_failed", False):
+                    from ceph_tpu.models.clay_device import \
+                        build_encode_kernel
+                    self._enc_kernel = build_encode_kernel(self)
+                if self._enc_kernel is not None:
+                    sc = size // ssc
+                    x = self._stack(chunks, range(self.k), ssc, sc)
+                    par = np.asarray(self._enc_kernel(
+                        x.reshape(self.k, ssc, sc)))
+                    return {pos: par[pos - self.k].reshape(-1)
+                            for pos in want_to_encode
+                            if self.k <= pos < self.k + self.m}
+            except Exception:
+                # structured-kernel fault: fall through to the matrix
+                # path below (block-sparse where it measures faster,
+                # dense otherwise) — encode must never wedge on a
+                # kernel build/compile failure, and a failed build is
+                # remembered (no per-op rebuild storm)
+                self._enc_kernel = None
+                self._enc_kernel_failed = True
         mat = self._lin_cache.get_or_build(("enc",), self._encode_matrix)
         x = self._stack(chunks, range(self.k), ssc, size // ssc)
-        parity = backend_mod.matvec(mat, x, self.backend)
+        parity = self._lin_matvec(("enc",), mat, x, resolved, "encode")
         out = {}
         for pos in want_to_encode:
             if self.k <= pos < self.k + self.m:
                 p = pos - self.k
                 out[pos] = parity[p * ssc:(p + 1) * ssc].reshape(-1)
         return out
+
+    def _lin_matvec(self, sig_key: tuple, mat: np.ndarray,
+                    x: np.ndarray, resolved: str | None,
+                    label: str) -> np.ndarray:
+        """One linearized-signature matvec, routed per round-6 policy:
+        on a pallas backend the per-signature choice between the
+        block-sparse gather-of-blocks kernel and the dense bit-sliced
+        matmul is MEASURED on-device once and LRU-cached next to the
+        matrix itself (clay_device.build_decode_matvec — dense is the
+        automatic fallback); every other backend keeps the plain
+        dispatch."""
+        if resolved == "pallas" and self.sparse_lin:
+            from ceph_tpu.models.clay_device import build_decode_matvec
+            fn = self._lin_cache.get_or_build(
+                ("sparse",) + sig_key,
+                lambda: build_decode_matvec(self, mat, label=label))
+            return fn(x)
+        return backend_mod.matvec(mat, x, self.backend)
 
     def _decode_matrix(self, avail: tuple, erased: tuple) -> np.ndarray:
         ssc = self.sub_chunk_no
@@ -618,7 +653,12 @@ class ErasureCodeClay(ErasureCode):
             ("dec", avail, erased),
             lambda: self._decode_matrix(avail, erased))
         x = self._stack(chunks, avail, ssc, size // ssc)
-        rec = backend_mod.matvec(mat, x, self.backend)
+        try:
+            resolved, _ = backend_mod.resolve(self.backend)
+        except KeyError:
+            resolved = None
+        rec = self._lin_matvec(("dec", avail, erased), mat, x,
+                               resolved, "decode")
         for row, c in enumerate(erased):
             if c in missing:
                 out[c] = rec[row * ssc:(row + 1) * ssc].reshape(-1)
@@ -680,7 +720,12 @@ class ErasureCodeClay(ErasureCode):
             ("rep", want_chunk, helpers),
             lambda: self._repair_matrix(want_chunk, helpers))
         x = self._stack(chunks, helpers, rss, sc)
-        rec = backend_mod.matvec(mat, x, self.backend)
+        try:
+            resolved, _ = backend_mod.resolve(self.backend)
+        except KeyError:
+            resolved = None
+        rec = self._lin_matvec(("rep", want_chunk, helpers), mat, x,
+                               resolved, "repair")
         return {want_chunk: rec.reshape(-1)}
 
 

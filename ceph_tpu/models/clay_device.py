@@ -74,6 +74,14 @@ import numpy as np
 from ceph_tpu.ops import bitmatrix, gf256
 
 
+def _tpu_compiler_params(pltpu, **kw):
+    """pltpu.CompilerParams across the jax version skew (older
+    runtimes spell it TPUCompilerParams)."""
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 # -- static trace ------------------------------------------------------
 
 @dataclass
@@ -1128,7 +1136,8 @@ def build_transform_kernel(codec, erased: frozenset[int],
                 pltpu.VMEM((Rp, tile), jnp.int32),      # u
                 pltpu.VMEM((ssc * E8, tile), jnp.int32),  # rec
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_compiler_params(
+                pltpu,
                 # the default scoped-vmem budget (16 MiB) is below
                 # this kernel's resident set (multi-level unroll +
                 # ~8 MiB of routing constants); raise toward the
@@ -1171,6 +1180,89 @@ def _vartabs_of(coef: np.ndarray):
     stacked = np.stack([t.reshape(-1) for _, t in tabs],
                        axis=1).astype(np.int32)
     return bits, stacked
+
+
+def build_decode_matvec(codec, mat: np.ndarray, label: str = "decode"):
+    """Round-6: pick block-sparse vs dense for a linearized signature
+    matrix, BY MEASUREMENT on the device (the r5 verdict's
+    prescription: a structured path becomes the default only when it
+    measurably beats the dense path on-device; dense stays the
+    automatic fallback).
+
+    The sparse candidate is the gather-of-blocks kernel
+    (ops/gf_block_sparse): the decode-2 matrix is ~31% occupied at
+    [16, 8] plane-block granularity after greedy row clustering — a
+    3.3x MXU cost cut over the dense [128, 640] sweep (encode matrix
+    5.3x). The plan's static cost model gates obviously-dense
+    matrices; when it predicts a win, both paths run a short
+    best-of-N sample on the chip and the faster one is kept.
+
+    ``CEPH_TPU_CLAY_SPARSE``: ``never``/``0`` forces dense,
+    ``always``/``1`` forces sparse (tests exercise the kernel in
+    interpret mode this way), default measures (TPU only — interpret
+    mode has no meaningful timing, so CPU stays dense).
+
+    Returns ``fn(x [k, N] uint8) -> np [m, N] uint8`` with
+    ``fn.path`` in {"sparse", "dense"} and ``fn.measured`` carrying
+    the calibration numbers for bench/BASELINE reporting.
+    """
+    import os
+    import time
+
+    import jax
+
+    from ceph_tpu.ops import gf_block_sparse, gf_jax
+
+    mat = np.asarray(mat, dtype=np.uint8)
+
+    def dense_fn(x):
+        return np.asarray(jax.device_get(gf_jax.matvec_device(mat, x)))
+
+    def sparse_fn(x):
+        return np.asarray(jax.device_get(
+            gf_block_sparse.matvec_device(mat, x)))
+
+    def done(fn, path, measured=None):
+        fn.path = path
+        fn.measured = measured or {}
+        return fn
+
+    mode = os.environ.get("CEPH_TPU_CLAY_SPARSE", "auto").lower()
+    if mode in ("0", "never", "off"):
+        return done(dense_fn, "dense")
+    if mode in ("1", "always", "force"):
+        return done(sparse_fn, "sparse")
+    plan = gf_block_sparse.plan_blocks(mat)
+    if not plan.worthwhile or jax.default_backend() != "tpu":
+        return done(dense_fn, "dense",
+                    {"cost_frac": plan.cost_frac, "skipped": True})
+
+    import jax.numpy as jnp
+    sample = jnp.zeros((mat.shape[1], 1 << 15), jnp.uint8)
+
+    def best_of(fn, reps: int = 3) -> float:
+        fn(sample)                       # warm / compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(sample)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    try:
+        t_dense = best_of(dense_fn)
+        t_sparse = best_of(sparse_fn)
+    except Exception:
+        # a sparse-path fault must never take decode down: dense is
+        # the always-working fallback
+        return done(dense_fn, "dense", {"calibration_failed": True})
+    measured = {"cost_frac": round(plan.cost_frac, 4),
+                "dense_s": round(t_dense, 6),
+                "sparse_s": round(t_sparse, 6),
+                "label": label}
+    if t_sparse < t_dense:
+        return done(sparse_fn, "sparse", measured)
+    return done(dense_fn, "dense", measured)
 
 
 class ClayDeviceCodec:

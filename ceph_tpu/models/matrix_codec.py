@@ -100,8 +100,25 @@ class MatrixErasureCode(ErasureCode):
                 errno_=5)
         present = have[:k]
         dmat = self._decode_matrix(tuple(present), tuple(missing))
-        data = np.stack([np.asarray(chunks[c], dtype=np.uint8) for c in present])
-        rec = self._matvec(dmat, data)
+        # block-occupancy skip at column granularity (the
+        # ops/gf_block_sparse idea applied to the small signature
+        # matrices the OSD's stage_decode flushes batch): a survivor
+        # whose decode column is all zero contributes nothing over GF
+        # — don't stack (or ship to the device) its bytes at all.
+        # RS decode matrices are dense so this is a no-op there;
+        # locality-structured codes (SHEC-style layouts) drop whole
+        # chunks from the matmul.
+        keep = [i for i in range(len(present)) if dmat[:, i].any()]
+        if len(keep) < len(present):
+            dmat = np.ascontiguousarray(dmat[:, keep])
+            present = [present[i] for i in keep]
+        if not present:
+            some = np.asarray(chunks[have[0]], dtype=np.uint8)
+            rec = np.zeros((len(missing), len(some)), dtype=np.uint8)
+        else:
+            data = np.stack([np.asarray(chunks[c], dtype=np.uint8)
+                             for c in present])
+            rec = self._matvec(dmat, data)
         out = {c: np.asarray(chunks[c], dtype=np.uint8)
                for c in want if c in chunks}
         for row, c in enumerate(missing):

@@ -32,6 +32,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ceph_tpu.ops import bitmatrix
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across the jax version skew: the public
+    ``jax.shard_map`` (with ``check_vma``) landed after 0.4.3x; older
+    runtimes carry it as ``jax.experimental.shard_map`` with the
+    replication check spelled ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def _bitsliced_encode_local(bmat: jax.Array, data: jax.Array) -> jax.Array:
     """[8m,8k] x [k, N] -> [m, N] local bit-sliced GF matmul (ops/gf_jax.py)."""
     k, n = data.shape
@@ -81,11 +94,10 @@ def make_encode_step(mesh: Mesh, coding_matrix: np.ndarray,
         csum = jax.lax.psum(csum, ("stripe", "shard"))
         return chunks, csum
 
-    sharded = jax.shard_map(
-        step, mesh=mesh,
+    sharded = _shard_map(
+        step, mesh,
         in_specs=P("stripe", None, "shard"),
         out_specs=(P("stripe", None, "shard"), P()),
-        check_vma=False,
     )
     return jax.jit(sharded)
 
@@ -108,11 +120,10 @@ def make_matrix_step(mesh: Mesh, flat_matrix: np.ndarray):
         full = jax.lax.all_gather(rec, "shard", axis=2, tiled=True)
         return rec, full
 
-    sharded = jax.shard_map(
-        step, mesh=mesh,
+    sharded = _shard_map(
+        step, mesh,
         in_specs=P("stripe", None, "shard"),
         out_specs=(P("stripe", None, "shard"), P("stripe", None, None)),
-        check_vma=False,
     )
     return jax.jit(sharded)
 
@@ -142,11 +153,10 @@ def make_degraded_read_step(mesh: Mesh, generator: np.ndarray,
         full = jax.lax.all_gather(rec, "shard", axis=2, tiled=True)
         return rec, full
 
-    sharded = jax.shard_map(
-        step, mesh=mesh,
+    sharded = _shard_map(
+        step, mesh,
         in_specs=P("stripe", None, "shard"),
         out_specs=(P("stripe", None, "shard"), P("stripe", None, None)),
-        check_vma=False,
     )
     return jax.jit(sharded)
 

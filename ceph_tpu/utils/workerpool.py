@@ -23,6 +23,10 @@ from __future__ import annotations
 import queue
 import threading
 
+from ceph_tpu.utils.dout import Dout
+
+log = Dout("pool")
+
 
 class DaemonPool:
     def __init__(self, max_workers: int,
@@ -68,8 +72,13 @@ class DaemonPool:
             fn, args, kwargs = item
             try:
                 fn(*args, **kwargs)
-            except Exception:  # noqa: BLE001 — worker must survive
-                pass
+            except Exception as exc:  # noqa: BLE001 — worker must survive
+                # the worker survives, but never silently: a failing
+                # tier/MDS handler otherwise dies without a trace
+                # (ADVICE r5)
+                log(1, f"{threading.current_thread().name}: task "
+                    f"{getattr(fn, '__qualname__', fn)!r} raised "
+                    f"{exc!r}")
 
     def shutdown(self, wait: bool = False) -> None:
         with self._lock:

@@ -189,6 +189,7 @@ def test_decode_tables_globally_consistent():
         build_decode_tables(c, frozenset(er))   # asserts internally
 
 
+@pytest.mark.slow  # ~5 min under pallas interpret mode on CPU CI
 def test_decode_kernel_single_pallas_bit_exact():
     """Round-5 structured DECODE kernel (build_transform_kernel, the
     decode counterpart of the r4 encode kernel): bit-exact vs the

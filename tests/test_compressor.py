@@ -12,7 +12,16 @@ from ceph_tpu.utils.config import g_conf
 
 def test_registry_round_trips():
     plugins = registry().plugins()
-    assert "zlib" in plugins and "zstd" in plugins
+    # stdlib-backed codecs are unconditional; zstd rides the optional
+    # ``zstandard`` module (the registry registers it best-effort,
+    # like the reference's dlopen'd plugins) — require it only where
+    # the module exists
+    assert "zlib" in plugins
+    try:
+        import zstandard  # noqa: F401
+        assert "zstd" in plugins
+    except ImportError:
+        pass
     payload = b"compress me " * 1000 + os.urandom(100)
     for name in plugins:
         c = Compressor.create(name)

@@ -86,7 +86,10 @@ def test_isa_rs_matrix_mds_within_envelope(k, m):
         gf256.invert_matrix(gen[list(rows)])
 
 
-@pytest.mark.parametrize("k,m", [(4, 2), (8, 3), (20, 10)])
+@pytest.mark.parametrize(
+    "k,m",
+    [(4, 2), (8, 3),
+     pytest.param(20, 10, marks=pytest.mark.slow)])  # ~50 s sweep
 def test_cauchy_is_mds(k, m):
     gen = gf256.systematic_generator(gf256.cauchy_matrix_isa(k, m))
     rng = np.random.default_rng(2)

@@ -1,0 +1,215 @@
+"""Block-sparse GF matvec kernel (ops/gf_block_sparse): plan sanity,
+bit-exactness vs the numpy oracle (pallas interpret mode on CPU), and
+the round-6 calibrated routing in models/clay.py."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models.registry import instance
+from ceph_tpu.ops import gf256, gf_block_sparse as bs
+
+
+def _clay(k=8, m=4, d=11):
+    return instance().factory("clay", {
+        "k": str(k), "m": str(m), "d": str(d), "backend": "numpy"})
+
+
+def test_plan_covers_every_nonzero():
+    """Every nonzero entry of the matrix must land in exactly one
+    occupied block of exactly one row group."""
+    c = _clay()
+    mat = c._decode_matrix(tuple(range(2, 12)), (0, 1))
+    plan = bs.plan_blocks(mat)
+    seen = np.zeros_like(mat, dtype=bool)
+    tm, tk = plan.tile_m, plan.tile_k
+    for gi, (occ, _bm) in enumerate(plan.groups):
+        rows = plan.row_order[gi * tm:(gi + 1) * tm]
+        for b in occ:
+            for r in rows:
+                if r < plan.m:
+                    seen[r, b * tk:min((b + 1) * tk, plan.k)] = True
+    assert (seen | (mat == 0)).all(), "nonzero entry outside the plan"
+    # the round-order bookkeeping must be a permutation
+    assert sorted(plan.inv_order.tolist()) == list(range(plan.m))
+
+
+def test_clay_decode2_mac_cut_target():
+    """The tentpole's premise: the k=8,m=4,d=11 decode-2 matrix must
+    plan to >= 3x fewer MXU cycles than the dense sweep (the bisect's
+    3-12x block-sparsity window), and encode >= 4x."""
+    c = _clay()
+    dec = bs.occupancy_stats(c._decode_matrix(tuple(range(2, 12)),
+                                              (0, 1)))
+    enc = bs.occupancy_stats(c._encode_matrix())
+    assert dec["mac_cut"] >= 3.0, dec
+    assert enc["mac_cut"] >= 4.0, enc
+    assert bs.plan_blocks(
+        c._decode_matrix(tuple(range(2, 12)), (0, 1))).worthwhile
+
+
+@pytest.mark.parametrize("shape,density", [
+    ((16, 40), 0.10),
+    ((24, 33), 0.30),   # non-multiple-of-tile shapes (padding path)
+    ((7, 10), 1.00),    # fully dense: must still be exact
+    ((128, 640), 0.05),
+])
+def test_bit_exact_random(shape, density):
+    rng = np.random.default_rng(hash(shape) % (2 ** 31))
+    m, k = shape
+    mat = (rng.integers(0, 256, size=shape) *
+           (rng.random(shape) < density)).astype(np.uint8)
+    data = rng.integers(0, 256, size=(k, 3000), dtype=np.uint8)
+    assert np.array_equal(bs.matvec(mat, data),
+                          gf256.gf_matvec_chunks(mat, data))
+
+
+def test_zero_matrix():
+    mat = np.zeros((8, 16), dtype=np.uint8)
+    data = np.arange(16 * 256, dtype=np.uint8).reshape(16, 256) % 251
+    assert not bs.matvec(mat, data).any()
+
+
+def _assert_sparse_decode_exact(c, full, size, lost):
+    have = {i: v for i, v in full.items() if i not in lost}
+    avail = tuple(sorted(have))
+    mat = c._decode_matrix(avail, lost)
+    x = c._stack(have, avail, c.sub_chunk_no, size // c.sub_chunk_no)
+    rec = bs.matvec(mat, x)
+    want = c._decode_chunks_host(list(lost), have)
+    ssc = c.sub_chunk_no
+    for row, ch in enumerate(lost):
+        assert np.array_equal(
+            rec[row * ssc:(row + 1) * ssc].reshape(-1), want[ch]), \
+            (lost, ch)
+
+
+def _encode_full(c, rng, size):
+    n = c.k + c.m
+    chunks = {i: rng.integers(0, 256, size=size, dtype=np.uint8)
+              for i in range(c.k)}
+    enc = c.encode_chunks(list(range(c.k, n)), chunks)
+    full = dict(chunks)
+    full.update(enc)
+    return full
+
+
+def test_clay_decode2_bit_exact_flagship_signatures():
+    """Representative 2-erasure signatures of the flagship profile
+    (data-data, data-parity, parity-parity) decode bit-identically to
+    the host oracle through the sparse kernel; the exhaustive sweep
+    rides the small profile below (interpret mode makes a 66-signature
+    [128, 640] sweep a tier-2 cost)."""
+    c = _clay()
+    rng = np.random.default_rng(7)
+    size = c.sub_chunk_no * 4
+    full = _encode_full(c, rng, size)
+    for lost in ((0, 1), (2, 10), (10, 11)):
+        _assert_sparse_decode_exact(c, full, size, lost)
+
+
+def test_clay_decode_bit_exact_all_signatures_small_profile():
+    """Exhaustive 1- and 2-erasure sweep on clay k=4,m=2,d=5 (ssc=8,
+    incl. the nu>0 virtual-node geometry of d<k+m-1 variants)."""
+    import itertools
+    for d in (5, 4):                    # d=4 exercises nu>0
+        c = _clay(k=4, m=2, d=d)
+        rng = np.random.default_rng(70 + d)
+        size = c.sub_chunk_no * 4
+        full = _encode_full(c, rng, size)
+        n = c.k + c.m
+        for e in (1, 2):
+            for lost in itertools.combinations(range(n), e):
+                _assert_sparse_decode_exact(c, full, size, lost)
+
+
+def test_calibrated_routing_forced_sparse(monkeypatch):
+    """CEPH_TPU_CLAY_SPARSE=always must route the linearized decode
+    through the sparse kernel (fn.path records the choice) and stay
+    bit-exact end-to-end through decode_chunks' matrix path."""
+    monkeypatch.setenv("CEPH_TPU_CLAY_SPARSE", "always")
+    c = _clay(k=4, m=2, d=5)
+    rng = np.random.default_rng(9)
+    size = c.sub_chunk_no * 8
+    chunks = {i: rng.integers(0, 256, size=size, dtype=np.uint8)
+              for i in range(4)}
+    enc = c.encode_chunks([4, 5], chunks)
+    full = dict(chunks)
+    full.update(enc)
+    have = {i: v for i, v in full.items() if i not in (1, 3)}
+    avail = tuple(sorted(have))
+    mat = c._decode_matrix(avail, (1, 3))
+    x = c._stack(have, avail, c.sub_chunk_no, size // c.sub_chunk_no)
+    rec = c._lin_matvec(("dec", avail, (1, 3)), mat, x, "pallas",
+                        "decode")
+    fn = c._lin_cache[("sparse", "dec", avail, (1, 3))]
+    assert fn.path == "sparse"
+    ssc = c.sub_chunk_no
+    assert np.array_equal(rec[:ssc].reshape(-1), chunks[1])
+    assert np.array_equal(rec[ssc:].reshape(-1), chunks[3])
+
+
+def test_calibrated_routing_defaults_dense_on_cpu(monkeypatch):
+    """Without a real TPU the auto mode must keep the dense fallback
+    (interpret-mode timing is meaningless)."""
+    monkeypatch.delenv("CEPH_TPU_CLAY_SPARSE", raising=False)
+    from ceph_tpu.models.clay_device import build_decode_matvec
+    c = _clay(k=4, m=2, d=5)
+    mat = c._decode_matrix((0, 2, 4, 5), (1, 3))
+    fn = build_decode_matvec(c, mat)
+    import jax
+    if jax.default_backend() != "tpu":
+        assert fn.path == "dense"
+    rng = np.random.default_rng(11)
+    x = rng.integers(0, 256, size=(mat.shape[1], 512), dtype=np.uint8)
+    assert np.array_equal(fn(x), gf256.gf_matvec_chunks(mat, x))
+
+
+def test_matrix_codec_zero_column_pruning():
+    """The column-granularity occupancy skip in
+    MatrixErasureCode.decode_chunks: a locality-structured coding
+    matrix (two disjoint local parities) must decode through a PRUNED
+    matmul — the out-of-group survivors' all-zero columns are dropped
+    before stacking — and stay byte-identical. A dense RS decode must
+    remain un-pruned."""
+    from ceph_tpu.models.jerasure import ErasureCodeJerasure
+    from ceph_tpu.models.matrix_codec import MatrixErasureCode
+
+    class _LocalParity(MatrixErasureCode):
+        def init(self, profile):
+            self._setup(4, 2, np.array([[1, 1, 0, 0], [0, 0, 1, 1]],
+                                       dtype=np.uint8), profile)
+
+    codec = _LocalParity()
+    codec.init({"backend": "numpy"})
+    shapes = []
+    orig = MatrixErasureCode._matvec
+
+    def spy(self, mat, data):
+        shapes.append((mat.shape, data.shape))
+        return orig(self, mat, data)
+
+    rng = np.random.default_rng(13)
+    data = {i: rng.integers(0, 256, size=1024, dtype=np.uint8)
+            for i in range(4)}
+    enc = codec.encode_chunks([4, 5], data)
+    have = {1: data[1], 2: data[2], 3: data[3], 4: enc[4], 5: enc[5]}
+    import unittest.mock as mock
+    with mock.patch.object(MatrixErasureCode, "_matvec", spy):
+        out = codec.decode_chunks([0], have)
+    assert np.array_equal(out[0], data[0])
+    # chunk 0 depends only on its local group {1, parity 4}: the
+    # decode matmul must have shrunk from 4 survivor rows to 2
+    assert shapes and shapes[-1][0][1] == 2, shapes
+
+    # dense RS: pruning must not engage (every column nonzero)
+    rs = ErasureCodeJerasure()
+    rs.init({"k": "4", "m": "2", "backend": "numpy"})
+    enc = rs.encode_chunks([4, 5], data)
+    have = {0: data[0], 2: data[2], 3: data[3], 4: enc[4], 5: enc[5]}
+    shapes.clear()
+    with mock.patch.object(MatrixErasureCode, "_matvec", spy):
+        out = rs.decode_chunks([1], have)
+    assert np.array_equal(out[1], data[1])
+    assert shapes and shapes[-1][0][1] == 4, shapes

@@ -155,6 +155,7 @@ def test_dead_client_lease_expiry(cluster, mds):
         assert f2.read(5) == b"after"
 
 
+@pytest.mark.slow
 def test_failover_replays_half_done_rename(cluster):
     """Kill the active MDS between rename's link and unlink steps; the
     standby replays the journal intent and finishes the op, and the

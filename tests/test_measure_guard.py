@@ -95,6 +95,38 @@ def test_last_good_roundtrip(tmp_path, monkeypatch):
     assert measure.load_last_good()["m1"] == 140.0
 
 
+def test_bench_budget_sum_bounded():
+    """The r5 failure mode was rc=124: per-metric budgets worst-cased
+    to ~1950 s against the driver's 870 s timeout, and the process
+    was killed with every result unprinted. The configured worst case
+    must stay under 700 s (sampling budgets + global deadline; the
+    post-deadline tail is per-metric warmup compiles)."""
+    import bench
+
+    budget_sum = sum(tb + eb for tb, eb in bench.BUDGETS.values())
+    assert budget_sum <= 700, budget_sum
+    assert bench.TOTAL_BUDGET <= 600
+    # the global deadline must not be looser than the per-metric sum
+    assert bench.TOTAL_BUDGET <= budget_sum
+
+
+def test_deadline_caps_sampling(monkeypatch):
+    """A stable_best_slope call handed an already-passed deadline must
+    still return (one honest round), and an extension must never
+    sample past the deadline."""
+    monkeypatch.setattr(measure.time, "sleep", lambda s: None)
+    t0 = measure.time.perf_counter()
+    slope, _spread, _n, _c = measure.stable_best_slope(
+        _step, _x0(), min_traffic_bytes=1, counts=(2, 6),
+        time_budget=30.0, stable_n=1, sleep=0.0,
+        expect_slope=1e-12, extended_budget=30.0,
+        deadline=measure.time.perf_counter() + 0.3)
+    elapsed = measure.time.perf_counter() - t0
+    assert slope > 0
+    assert elapsed < 10.0, \
+        f"deadline must dominate the 60s configured budget ({elapsed=})"
+
+
 def test_repo_last_good_seeded():
     # the committed expectation file holds the r3 driver-captured rows
     lg = measure.load_last_good()

@@ -34,6 +34,7 @@ def _wait_leader(cluster, timeout=10.0):
                        f"{[(m.rank, m.is_leader()) for m in cluster.mons.values()]}")
 
 
+@pytest.mark.slow
 def test_three_mon_replication_and_failover(fast):
     with MiniCluster(n_osds=3, n_mons=3) as cluster:
         leader = _wait_leader(cluster)

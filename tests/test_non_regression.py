@@ -38,6 +38,56 @@ def test_cross_backend_bit_identical(corpus):
             assert nr.check_one(d, backend=b) == [], f"backend {b}"
 
 
+def test_corpus_clay_block_sparse_decode_bit_identical(corpus,
+                                                       monkeypatch):
+    """Round-6 gate: the block-sparse gather-of-blocks kernel
+    (ops/gf_block_sparse, forced via CEPH_TPU_CLAY_SPARSE=always)
+    must reproduce the stored corpus bytes through every small
+    erasure combination, exactly like the dense path — the corpus
+    contract applied to the new decode kernel."""
+    monkeypatch.setenv("CEPH_TPU_CLAY_SPARSE", "always")
+    import itertools
+
+    import numpy as np
+
+    from ceph_tpu.models import registry as ec_registry
+
+    base, created = corpus
+    clay_dirs = [d for d in created if "/clay/" in d.replace("\\", "/")]
+    assert clay_dirs, "corpus has no clay profile"
+    for d in clay_dirs:
+        import json as _json
+        import os as _os
+        meta = _json.load(open(_os.path.join(d, "meta.json")))
+        profile = dict(meta["profile"])
+        profile["backend"] = "numpy"
+        codec = ec_registry.instance().factory(meta["plugin"], profile)
+        n = meta["chunk_count"]
+        chunks = {}
+        for i in range(n):
+            chunks[i] = np.frombuffer(
+                open(_os.path.join(d, f"chunk.{i}"), "rb").read(),
+                dtype=np.uint8)
+        size = len(chunks[0])
+        for e in (1, 2):
+            for lost in itertools.combinations(range(n), e):
+                have = {i: v for i, v in chunks.items()
+                        if i not in lost}
+                avail = tuple(sorted(have))
+                mat = codec._decode_matrix(avail, lost)
+                x = codec._stack(have, avail, codec.sub_chunk_no,
+                                 size // codec.sub_chunk_no)
+                rec = codec._lin_matvec(("dec", avail, lost), mat, x,
+                                        "pallas", "decode")
+                ssc = codec.sub_chunk_no
+                for row, ch in enumerate(lost):
+                    assert np.array_equal(
+                        rec[row * ssc:(row + 1) * ssc].reshape(-1),
+                        chunks[ch]), (d, lost, ch)
+                fn = codec._lin_cache[("sparse", "dec", avail, lost)]
+                assert fn.path == "sparse"
+
+
 def test_cli_create_then_check(tmp_path, capsys):
     base = str(tmp_path / "c")
     assert nr.main(["--base", base, "--create", "--plugin", "jerasure",

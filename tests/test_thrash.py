@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # tier-2: heavy cluster workload (tier-1 runs -m 'not slow')
+
 from ceph_tpu.client.rados import RadosError
 from ceph_tpu.qa.cluster import MiniCluster
 from ceph_tpu.qa.thrasher import Thrasher

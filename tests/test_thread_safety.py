@@ -135,3 +135,32 @@ def test_clay_linearized_cache_concurrent():
             (w, i, lost)
 
     _hammer(6, op, iters=25)
+
+
+def test_daemon_pool_logs_swallowed_exceptions():
+    """DaemonPool workers must survive a failing task AND leave a
+    trace (ADVICE r5: the bare ``pass`` made failing tier/MDS
+    handlers die completely silently)."""
+    import time
+
+    from ceph_tpu.utils import dout
+    from ceph_tpu.utils.workerpool import DaemonPool
+
+    pool = DaemonPool(2, thread_name_prefix="logtest")
+    done = []
+
+    def boom():
+        raise RuntimeError("daemon-pool-test-error")
+
+    pool.submit(boom)
+    pool.submit(lambda: done.append(1))   # pool still alive after it
+    for _ in range(100):
+        if done:
+            break
+        time.sleep(0.02)
+    assert done, "worker died instead of surviving the exception"
+    recent = [r for r in dout.dump_recent()
+              if "daemon-pool-test-error" in r]
+    assert recent, "swallowed exception left no log record"
+    assert "logtest" in recent[-1]        # thread name in the record
+    pool.shutdown()
