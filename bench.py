@@ -71,11 +71,25 @@ def _deadline() -> float:
     return _T0 + TOTAL_BUDGET
 
 
+def _telemetry_snapshot() -> dict:
+    """Device-telemetry brief for metric lines: every BENCH number
+    carries its own explanation (compiles, recompiles, calibration
+    winners). Degrades to {} so a telemetry fault can never cost a
+    metric line."""
+    try:
+        from ceph_tpu.utils.device_telemetry import telemetry
+        return telemetry().snapshot_brief()
+    except Exception:
+        return {}
+
+
 def emit(metric: str, fields: dict) -> None:
     """Print one metric's JSON line NOW (progressive emission) and
-    fold it into the final combined record."""
+    fold it into the final combined record. Every line carries a
+    ``telemetry`` snapshot (see _telemetry_snapshot)."""
     line = {"metric": metric}
     line.update(fields)
+    line["telemetry"] = _telemetry_snapshot()
     print(json.dumps(line), flush=True)
     _RESULTS[metric] = fields
 
@@ -254,6 +268,7 @@ def _combined(any_contended: bool) -> dict:
     if any_contended:
         out["contended"] = True
     out["elapsed_s"] = round(time.perf_counter() - _T0, 1)
+    out["telemetry"] = _telemetry_snapshot()
     return out
 
 

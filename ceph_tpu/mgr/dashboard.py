@@ -9,6 +9,8 @@ from the mgr's cluster view:
     GET /api/status   full mon status JSON
     GET /api/osds     per-OSD up/in table
     GET /api/pools    pool table (type, pg_num, size)
+    GET /api/device   device-path telemetry snapshot (compiles,
+                      flushes, occupancy, calibration outcomes)
 
 Commands: ``dashboard status|on|off`` over the mgr asok; ``on`` binds
 an ephemeral port (reported by status) on 127.0.0.1.
@@ -41,6 +43,9 @@ _PAGE = """<!doctype html>
 {pool_rows}</table>
 <h3>pgs</h3><p>{pgs}</p>
 <h3>balancer</h3><p>{balancer}</p>
+<h3>device</h3><p>{device}</p>
+<table><tr><th>calibration</th><th>winner</th><th>dense_s</th>
+<th>sparse_s</th></tr>{device_rows}</table>
 </body></html>"""
 
 
@@ -76,6 +81,10 @@ class Module(MgrModule):
                           "type": "erasure" if p.is_ec
                           else "replicated"}
                  for pid, p in sorted(osdmap.pools.items())}).encode()
+        if path == "/api/device":
+            from ceph_tpu.utils.device_telemetry import telemetry
+            return 200, "application/json", json.dumps(
+                telemetry().snapshot()).encode()
         if path == "/":
             return 200, "text/html", self._page(status, osdmap)
         return 404, "text/plain", b"not found"
@@ -92,6 +101,15 @@ class Module(MgrModule):
             f"<td>{p.pg_num}</td><td>{p.size}</td></tr>"
             for _, p in sorted(osdmap.pools.items()))
         bal = self.mgr.modules.get("balancer")
+        from ceph_tpu.utils.device_telemetry import telemetry
+        tel = telemetry()
+        device_rows = "".join(
+            f"<tr><td>{html.escape(sig)}</td>"
+            f"<td>{html.escape(str(cal.get('winner')))}</td>"
+            f"<td>{cal.get('dense_s', '')}</td>"
+            f"<td>{cal.get('sparse_s', '')}</td></tr>"
+            for sig, cal in sorted(
+                tel.snapshot()["calibrations"].items()))
         return _PAGE.format(
             health=html.escape(health),
             hclass="ok" if health.startswith("HEALTH_OK") else "warn",
@@ -102,6 +120,8 @@ class Module(MgrModule):
             pgs=json.dumps(status.get("pgmap", {})),
             balancer="active" if bal is not None and bal.active
             else "idle",
+            device=html.escape(json.dumps(tel.snapshot_brief())),
+            device_rows=device_rows,
         ).encode()
 
     # -- server --------------------------------------------------------

@@ -584,7 +584,7 @@ class ErasureCodeClay(ErasureCode):
                 # remembered (no per-op rebuild storm)
                 self._enc_kernel = None
                 self._enc_kernel_failed = True
-        mat = self._lin_cache.get_or_build(("enc",), self._encode_matrix)
+        mat = self._lin_cached(("enc",), self._encode_matrix)
         x = self._stack(chunks, range(self.k), ssc, size // ssc)
         parity = self._lin_matvec(("enc",), mat, x, resolved, "encode")
         out = {}
@@ -592,6 +592,22 @@ class ErasureCodeClay(ErasureCode):
             if self.k <= pos < self.k + self.m:
                 p = pos - self.k
                 out[pos] = parity[p * ssc:(p + 1) * ssc].reshape(-1)
+        return out
+
+    def _lin_cached(self, key, build):
+        """get_or_build on the linearized-transform LRU, counting
+        hits/misses into device telemetry: a miss rate that climbs
+        under a steady signature set means the LRU bound is below the
+        live working set (the ISA decode-table cache-health signal)."""
+        built = []
+
+        def counted():
+            built.append(1)
+            return build()
+
+        out = self._lin_cache.get_or_build(key, counted)
+        from ceph_tpu.utils.device_telemetry import telemetry
+        telemetry().note_lin_matvec(hit=not built)
         return out
 
     def _lin_matvec(self, sig_key: tuple, mat: np.ndarray,
@@ -606,7 +622,7 @@ class ErasureCodeClay(ErasureCode):
         dispatch."""
         if resolved == "pallas" and self.sparse_lin:
             from ceph_tpu.models.clay_device import build_decode_matvec
-            fn = self._lin_cache.get_or_build(
+            fn = self._lin_cached(
                 ("sparse",) + sig_key,
                 lambda: build_decode_matvec(self, mat, label=label))
             return fn(x)
@@ -649,7 +665,7 @@ class ErasureCodeClay(ErasureCode):
             # (profile decode_kernel=true), not the default
             return self._decode_chunks_kernel(want_to_read, chunks,
                                               out, missing, size)
-        mat = self._lin_cache.get_or_build(
+        mat = self._lin_cached(
             ("dec", avail, erased),
             lambda: self._decode_matrix(avail, erased))
         x = self._stack(chunks, avail, ssc, size // ssc)
@@ -681,7 +697,7 @@ class ErasureCodeClay(ErasureCode):
                 break
             erased_nodes.add(i)
         key = frozenset(erased_nodes)
-        fn = self._lin_cache.get_or_build(
+        fn = self._lin_cached(
             ("ker", key),
             lambda: __import__(
                 "ceph_tpu.models.clay_device",
@@ -716,7 +732,7 @@ class ErasureCodeClay(ErasureCode):
         if chunk_size != self.sub_chunk_no * sc:
             raise ErasureCodeError("clay: chunk_size/helper size mismatch")
         helpers = tuple(sorted(chunks))
-        mat = self._lin_cache.get_or_build(
+        mat = self._lin_cached(
             ("rep", want_chunk, helpers),
             lambda: self._repair_matrix(want_chunk, helpers))
         x = self._stack(chunks, helpers, rss, sc)

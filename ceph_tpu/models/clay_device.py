@@ -1208,12 +1208,16 @@ def build_decode_matvec(codec, mat: np.ndarray, label: str = "decode"):
     """
     import os
     import time
+    import zlib
 
     import jax
 
     from ceph_tpu.ops import gf_block_sparse, gf_jax
+    from ceph_tpu.utils.device_telemetry import telemetry
 
     mat = np.asarray(mat, dtype=np.uint8)
+    sig = (f"[{mat.shape[0]}x{mat.shape[1]}]"
+           f"#{zlib.crc32(mat.tobytes()):08x}")
 
     def dense_fn(x):
         return np.asarray(jax.device_get(gf_jax.matvec_device(mat, x)))
@@ -1225,6 +1229,10 @@ def build_decode_matvec(codec, mat: np.ndarray, label: str = "decode"):
     def done(fn, path, measured=None):
         fn.path = path
         fn.measured = measured or {}
+        if measured:
+            # every decided outcome lands in telemetry, forced/skipped
+            # ones included — BENCH rounds carry their own explanation
+            telemetry().note_calibration(label, sig, path, measured)
         return fn
 
     mode = os.environ.get("CEPH_TPU_CLAY_SPARSE", "auto").lower()
@@ -1278,7 +1286,22 @@ class ClayDeviceCodec:
     def transform(self, erased: frozenset[int], c_in: np.ndarray):
         """c_in: [q*t, ssc, L] uint8 (numpy or device array); returns
         the completed node array (device)."""
+        import time as _time
+
         import jax.numpy as jnp
-        fn = self._fns.get_or_build(
-            erased, lambda: build_transform(self.codec, erased))
+
+        from ceph_tpu.utils.device_telemetry import telemetry
+
+        def build():
+            # a signature rebuilt after LRU eviction IS a recompile in
+            # the bug-class sense: the cache bound is undersized for
+            # the live signature set
+            t0 = _time.perf_counter()
+            fn = build_transform(self.codec, erased)
+            telemetry().note_compile(
+                f"clay_transform(k={self.codec.k},m={self.codec.m})"
+                f"er={sorted(erased)}", _time.perf_counter() - t0)
+            return fn
+
+        fn = self._fns.get_or_build(erased, build)
         return fn(jnp.asarray(c_in))

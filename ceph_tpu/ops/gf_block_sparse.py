@@ -228,7 +228,7 @@ def _sparse_kernel(data_ref, *refs, plan: BlockPlan):
     out_ref[:] = jnp.concatenate(outs, axis=0)     # group-major rows
 
 
-def _build_runner(plan: BlockPlan, tile: int):
+def _build_runner(plan: BlockPlan, tile: int, sig: str = ""):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -277,7 +277,13 @@ def _build_runner(plan: BlockPlan, tile: int):
         if nb != n:
             data = jnp.pad(data, ((0, 0), (0, nb - n)))
         mat_args = [jnp.asarray(m2) for m2 in mats]
-        out = run_padded(data, *mat_args, n=nb)
+        from ceph_tpu.ops.jax_util import tracing_active
+        if tracing_active():
+            out = run_padded(data, *mat_args, n=nb)
+        else:
+            from ceph_tpu.utils.device_telemetry import telemetry
+            out = telemetry().timed_call(
+                f"{sig}N{nb}", run_padded, data, *mat_args, n=nb)
         # un-permute the group-major rows with one XLA gather (out is
         # the small side: e*ssc rows vs a*ssc input rows)
         out = jnp.take(out, inv, axis=0)
@@ -299,8 +305,14 @@ class _RunnerCache:
         key = (mat.shape, tile_m, tile_k, tile, mat.tobytes())
 
         def build():
+            import zlib
             plan = plan_blocks(mat, tile_m, tile_k)
-            return plan, _build_runner(plan, tile)
+            # matrix-content digest in the signature: two same-shape
+            # matrices compile two DIFFERENT programs, which must not
+            # read as a recompile of one signature
+            sig = (f"gf_block_sparse[{plan.m}x{plan.k}]"
+                   f"#{zlib.crc32(mat.tobytes()):08x}t{tile}")
+            return plan, _build_runner(plan, tile, sig)
 
         return self._lru.get_or_build(key, build)
 

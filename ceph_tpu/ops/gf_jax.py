@@ -99,7 +99,19 @@ _matrix_cache = _MatrixCache()
 def matvec_device(mat: np.ndarray, data) -> "jax.Array":
     """Device-in/device-out encode: data may be a jax array already in HBM."""
     bmat = _matrix_cache.get(np.asarray(mat, dtype=np.uint8))
-    return _bitsliced_matvec_device(bmat, jnp.asarray(data, dtype=jnp.uint8))
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    from ceph_tpu.ops.jax_util import tracing_active
+    if tracing_active():
+        # under an outer jit the call inlines: compile accounting
+        # belongs to the outer program, not this entry
+        return _bitsliced_matvec_device(bmat, data)
+    from ceph_tpu.utils.device_telemetry import telemetry
+    # the jit specializes on shapes only (bmat is a traced operand),
+    # so the signature is exactly (m, k, N)
+    return telemetry().timed_call(
+        f"gf_jax[{bmat.shape[0] // 8}x{bmat.shape[1] // 8}]"
+        f"N{data.shape[1]}",
+        _bitsliced_matvec_device, bmat, data)
 
 
 #: smallest jit-specialization bucket for the host entry (bytes of N)

@@ -182,7 +182,15 @@ def matvec_device(mat: np.ndarray, data, tile: int = DEFAULT_TILE):
     pad = nb - n
     if pad:
         data = jnp.pad(data, ((0, 0), (0, pad)))
-    out = _matvec_padded(bmat, data, k, m_out, g, t)
+    if _tracing():
+        # under an outer jit the call inlines into the caller's trace:
+        # timing/cache introspection would account the OUTER compile
+        out = _matvec_padded(bmat, data, k, m_out, g, t)
+    else:
+        from ceph_tpu.utils.device_telemetry import telemetry
+        out = telemetry().timed_call(
+            f"gf_pallas[{m_out}x{k}]g{g}t{t}N{nb}",
+            _matvec_padded, bmat, data, k, m_out, g, t)
     return out[:, :n] if pad else out
 
 

@@ -51,7 +51,9 @@ from typing import Callable
 import numpy as np
 
 from ceph_tpu.osd import ec_util
+from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils.dout import Dout
+from ceph_tpu.utils.tracing import NOOP
 
 log = Dout("osd")
 
@@ -97,13 +99,18 @@ class DeviceEncodeEngine:
     def stage_encode(self, key, codec, sinfo: ec_util.StripeInfo,
                      data: np.ndarray,
                      cont: Callable[[dict | None, dict | None,
-                                     Exception | None], None]) -> None:
+                                     Exception | None], None],
+                     span=NOOP) -> None:
         """Queue one op's stripe-aligned payload for batched device
         encode; ``cont(shards, crcs, err)`` is dispatched on ``key``
         (crcs = per-shard LINEAR crc parts computed on device from the
         same buffers, or None; err set and shards None on device
-        failure — caller falls back)."""
-        self._q.put(("enc", key, codec, sinfo, data, cont))
+        failure — caller falls back). ``span``: the op's dataflow
+        trace continues through the engine (flush launch, kernel
+        dispatch, crc pass events); default NOOP is free."""
+        import time as _time
+        self._q.put(("enc", key, codec, sinfo, data, cont, span,
+                     _time.monotonic()))
 
     def stage_barrier(self, key, fn: Callable[[], None]) -> None:
         """Queue an ordering barrier: ``fn`` dispatches on ``key``
@@ -113,17 +120,20 @@ class DeviceEncodeEngine:
     def stage_decode(self, key, codec, sinfo: ec_util.StripeInfo,
                      shards: dict[int, np.ndarray], want: list[int],
                      cont: Callable[[dict | None, Exception | None],
-                                    None]) -> None:
+                                    None], span=NOOP) -> None:
         """Queue a reconstruct of ``want`` chunk streams from the
         surviving ``shards``; ``cont(decoded, err)`` runs INLINE on
         the engine thread (must be cheap and lock-free — the typical
         continuation publishes the result and sets an event for a
         blocked decode_sync caller)."""
-        self._q.put(("dec", key, codec, sinfo, shards, want, cont))
+        import time as _time
+        self._q.put(("dec", key, codec, sinfo, shards, want, cont,
+                     span, _time.monotonic()))
 
     def decode_sync(self, key, codec, sinfo: ec_util.StripeInfo,
                     shards: dict[int, np.ndarray], want: list[int],
-                    timeout: float = 60.0) -> dict[int, np.ndarray] | None:
+                    timeout: float = 60.0,
+                    span=NOOP) -> dict[int, np.ndarray] | None:
         """Blocking decode through the batched engine; returns the
         decoded {chunk: bytes} map or None on device fault/timeout
         (the caller falls back to its host twin). Safe to call from
@@ -136,7 +146,8 @@ class DeviceEncodeEngine:
             box[0], box[1] = out, err
             ev.set()
 
-        self.stage_decode(key, codec, sinfo, shards, want, cont)
+        self.stage_decode(key, codec, sinfo, shards, want, cont,
+                          span=span)
         if not ev.wait(timeout):
             log(0, f"device decode timed out after {timeout}s; "
                 "host fallback")
@@ -175,10 +186,10 @@ class DeviceEncodeEngine:
                     self._drain_inflight()
                     return
                 if item[0] == "enc":
-                    _, key, codec, sinfo, data, cont = item
+                    _, key, codec, sinfo, data, cont, span, ts = item
                     _, _, items = pending.setdefault(
                         id(codec), (codec, sinfo, []))
-                    items.append((key, data, cont))
+                    items.append((key, data, cont, span, ts))
                     nbytes += data.nbytes
                     if nbytes >= self._flush_bytes:
                         # flush BOTH kinds: the byte counter is
@@ -189,12 +200,13 @@ class DeviceEncodeEngine:
                         self._flush_decodes(dec_pending)
                         pending, dec_pending, nbytes = {}, {}, 0
                 elif item[0] == "dec":
-                    _, key, codec, sinfo, shards, want, cont = item
+                    (_, key, codec, sinfo, shards, want, cont, span,
+                     ts) = item
                     sig = (id(codec),
                            tuple(sorted(shards)), tuple(sorted(want)))
                     _, _, items = dec_pending.setdefault(
                         sig, (codec, sinfo, []))
-                    items.append((key, shards, want, cont))
+                    items.append((key, shards, want, cont, span, ts))
                     nbytes += sum(np.asarray(v).nbytes
                                   for v in shards.values())
                     if nbytes >= self._flush_bytes:
@@ -235,8 +247,11 @@ class DeviceEncodeEngine:
             batcher = ec_util.StripeBatcher(
                 sinfo, codec, mesh=mesh_mod.get_default_mesh(),
                 on_fallback=self._note_fused_fallback)
-            for i, (_key, data, _cont) in enumerate(items):
+            nbytes = 0
+            for i, (_key, data, _cont, _span, _ts) in \
+                    enumerate(items):
                 batcher.append(i, data)
+                nbytes += data.nbytes
             try:
                 finalize = batcher.flush_async(
                     with_crcs=ec_util.fuse_crc_policy(codec))
@@ -248,16 +263,28 @@ class DeviceEncodeEngine:
                 log(0, f"device encode batch of {len(items)} ops "
                     f"failed: {exc!r}")
                 self.stats["errors"] += 1
-                for key, _data, cont in items:
+                for key, _data, cont, span, _ts in items:
+                    span.event(f"device_error {exc!r}")
+                    span.finish()
                     self._dispatch(key, _bind(cont, None, None, exc))
                 continue
             # batch launched (async): NOW harvest the previous one —
             # its download overlaps this batch's upload/compute
             if _TP_FLUSH.enabled:
-                _TP_FLUSH(len(items),
-                          sum(d.nbytes for _, d, _c in items))
+                _TP_FLUSH(len(items), nbytes)
+            launched = _time.monotonic()
+            tel = _telemetry()
+            kspans = []
+            for _key, _data, _cont, span, ts in items:
+                # queue wait = stage -> launch (the batching latency
+                # an op paid for its amortization win)
+                tel.note_queue_wait("encode", launched - ts)
+                if span is not NOOP:   # no formatting when untraced
+                    span.event(f"batch_flush ops={len(items)} "
+                               f"bytes={nbytes}")
+                kspans.append(span.child("kernel_dispatch"))
             drained += self._drain_inflight()
-            self._inflight = (items, finalize)
+            self._inflight = (items, finalize, kspans)
         if pending:
             # drain time self-accounts inside _drain_inflight; only
             # the launch-side time is added here (no double count)
@@ -272,7 +299,7 @@ class DeviceEncodeEngine:
         if self._inflight is None:
             return 0.0
         t0 = _time.perf_counter()
-        items, finalize = self._inflight
+        items, finalize, kspans = self._inflight
         self._inflight = None
         try:
             results = finalize()
@@ -280,21 +307,32 @@ class DeviceEncodeEngine:
             log(0, f"device encode batch of {len(items)} ops "
                 f"failed: {exc!r}")
             self.stats["errors"] += 1
-            for key, _data, cont in items:
+            for (key, _data, cont, span, _ts), kspan in zip(items,
+                                                            kspans):
+                kspan.event(f"device_error {exc!r}")
+                kspan.finish()
+                span.finish()
                 self._dispatch(key, _bind(cont, None, None, exc))
             results = None
         if results is not None:
+            nbytes = sum(d.nbytes for _, d, _c, _s, _t in items)
             self.stats["flushes"] += 1
             self.stats["ops"] += len(items)
-            self.stats["bytes"] += sum(d.nbytes for _, d, _c in items)
+            self.stats["bytes"] += nbytes
             self.stats["max_batch_ops"] = max(
                 self.stats["max_batch_ops"], len(items))
             if self._counters is not None:
                 self._counters.inc("device_batches")
                 self._counters.inc("device_batch_ops", len(items))
-            for (key, _data, cont), (_i, shards, crcs) in zip(
-                    items, results):
+            for (key, _data, cont, span, _ts), (_i, shards, crcs), \
+                    kspan in zip(items, results, kspans):
+                if crcs is not None:
+                    kspan.event("crc_pass")
+                kspan.finish()
+                span.finish()
                 self._dispatch(key, _bind(cont, shards, crcs, None))
+            _telemetry().note_encode_flush(
+                len(items), nbytes, _time.perf_counter() - t0)
         dt = _time.perf_counter() - t0
         self.stats["busy_s"] += dt
         return dt
@@ -306,6 +344,7 @@ class DeviceEncodeEngine:
         so a persistent regression is visible instead of silently
         degrading every flush to host hashing (r2 verdict weak #3)."""
         self.stats["device_fused_fallbacks"] += 1
+        _telemetry().note_fused_fallback()
         if self._counters is not None:
             self._counters.inc("device_fused_fallbacks")
 
@@ -315,37 +354,53 @@ class DeviceEncodeEngine:
         keyed exactly like the ISA decode-table cache), so their shard
         streams concatenate along the byte axis into a single launch.
         Continuations run inline (see stage_decode)."""
+        import time as _time
         for (_cid, present, want), (codec, sinfo, items) in \
                 dec_pending.items():
+            launched = _time.monotonic()
+            t0 = _time.perf_counter()
+            tel = _telemetry()
+            for _key, _shards, _want, _cont, span, ts in items:
+                tel.note_queue_wait("decode", launched - ts)
+                if span is not NOOP:   # no formatting when untraced
+                    span.event(f"decode_flush ops={len(items)} "
+                               f"sig={list(present)}->{list(want)}")
             try:
                 merged = {
                     c: np.concatenate(
                         [np.asarray(shards[c], dtype=np.uint8)
-                         for _k, shards, _w, _c in items])
+                         for _k, shards, _w, _c, _s, _t in items])
                     for c in present}
                 lens = [len(np.asarray(shards[present[0]]))
-                        for _k, shards, _w, _c in items]
+                        for _k, shards, _w, _c, _s, _t in items]
                 out = ec_util.decode(sinfo, codec, merged, list(want))
             except Exception as exc:
                 log(0, f"device decode batch of {len(items)} ops "
                     f"(sig {present}->{want}) failed: {exc!r}")
                 self.stats["decode_errors"] += 1
-                for _key, _shards, _want, cont in items:
+                for _key, _shards, _want, cont, span, _ts in items:
+                    span.event(f"device_error {exc!r}")
+                    span.finish()
                     cont(None, exc)
                 continue
             if _TP_DECODE_FLUSH.enabled:
                 _TP_DECODE_FLUSH(len(items), str(present))
+            nbytes = sum(ln * len(present) for ln in lens)
             self.stats["decode_flushes"] += 1
             self.stats["decode_ops"] += len(items)
-            self.stats["decode_bytes"] += sum(
-                ln * len(present) for ln in lens)
+            self.stats["decode_bytes"] += nbytes
             self.stats["max_decode_batch_ops"] = max(
                 self.stats["max_decode_batch_ops"], len(items))
             if self._counters is not None:
                 self._counters.inc("device_decode_batches")
                 self._counters.inc("device_decode_ops", len(items))
+            tel.note_decode_flush(len(items), nbytes,
+                                  _time.perf_counter() - t0)
             off = 0
-            for (_key, _shards, _want, cont), ln in zip(items, lens):
+            for (_key, _shards, _want, cont, span, _ts), ln in zip(
+                    items, lens):
+                span.event("decode_done")
+                span.finish()
                 cont({c: v[off:off + ln] for c, v in out.items()},
                      None)
                 off += ln

@@ -146,8 +146,11 @@ class ECBackend(PGBackend):
         if missing and self.device is not None and \
                 self.device_codec is not None and \
                 ec_util.device_decodable(self.device_codec):
+            # the op's dataflow trace continues into the engine's
+            # signature-batched decode flush (NOOP when tracing off)
             out = self.device.decode_sync(
-                pg.pgid, self.device_codec, self.sinfo, shards, want)
+                pg.pgid, self.device_codec, self.sinfo, shards, want,
+                span=tracing.current().child("engine_decode"))
             if out is not None:
                 return out
             log(1, f"{pg}: device decode fell back to host "
@@ -230,8 +233,14 @@ class ECBackend(PGBackend):
             # ECBackend.cc:2107-2112)
             buf = np.frombuffer(self._pad(data), dtype=np.uint8)
 
+            # the continuation runs on an op-wq thread whose current
+            # span is NOOP: carry the op span across the engine
+            # boundary or the sub-write child spans die here
+            op_span = tracing.current()
+
             def cont(shards, crcs, err, pg=pg, oid=oid, data=data,
-                     version=version, on_commit=on_commit):
+                     version=version, on_commit=on_commit,
+                     op_span=op_span):
                 if shards is None:
                     log(0, f"device encode failed for {oid} "
                         f"({err!r}); host fallback")
@@ -239,11 +248,23 @@ class ECBackend(PGBackend):
                                             self._pad(data))
                     crcs = None
                 with pg.lock:
-                    self._finish_write(pg, oid, data, version, shards,
-                                       on_commit, crcs=crcs)
+                    tracing.set_current(op_span)
+                    try:
+                        self._finish_write(pg, oid, data, version,
+                                           shards, on_commit,
+                                           crcs=crcs)
+                    finally:
+                        tracing.set_current(tracing.NOOP)
 
+            # dataflow trace across the engine boundary: one child
+            # span rides the staged op through batch flush + kernel
+            # dispatch + crc pass (tracing off -> NOOP, zero Spans)
+            eng_span = op_span.child("engine_flush")
+            if eng_span is not tracing.NOOP:
+                eng_span.event(f"staged oid={oid}")
             self.device.stage_encode(pg.pgid, self.device_codec,
-                                     self.sinfo, buf, cont)
+                                     self.sinfo, buf, cont,
+                                     span=eng_span)
             return
         shards = ec_util.encode(self.sinfo, self.codec, self._pad(data))
         self._finish_write(pg, oid, data, version, shards, on_commit)
@@ -288,10 +309,18 @@ class ECBackend(PGBackend):
         if self.device is not None:
             # ordering barrier: a staged-but-unflushed write to this
             # object must fan out BEFORE the remove, or the remove
-            # would be resurrected by the older write's txn
-            def barrier(pg=pg) -> None:
+            # would be resurrected by the older write's txn (the op
+            # span rides along — barriers run on the engine's
+            # dispatch, where current() is NOOP)
+            op_span = tracing.current()
+
+            def barrier(pg=pg, op_span=op_span) -> None:
                 with pg.lock:
-                    run()
+                    tracing.set_current(op_span)
+                    try:
+                        run()
+                    finally:
+                        tracing.set_current(tracing.NOOP)
             self.device.stage_barrier(pg.pgid, barrier)
             return
         run()
@@ -319,9 +348,15 @@ class ECBackend(PGBackend):
             self.submit_write(pg, oid, data, version, on_commit)
 
         if self.device is not None:
-            def barrier(pg=pg) -> None:
+            op_span = tracing.current()
+
+            def barrier(pg=pg, op_span=op_span) -> None:
                 with pg.lock:
-                    run()
+                    tracing.set_current(op_span)
+                    try:
+                        run()
+                    finally:
+                        tracing.set_current(tracing.NOOP)
             self.device.stage_barrier(pg.pgid, barrier)
             return
         run()
@@ -369,9 +404,15 @@ class ECBackend(PGBackend):
             # object must fan out first, or its (deferred) txn would
             # land after ours with an OLDER "v" — shard versions would
             # regress against the log
-            def barrier(pg=pg) -> None:
+            op_span = tracing.current()
+
+            def barrier(pg=pg, op_span=op_span) -> None:
                 with pg.lock:
-                    run()
+                    tracing.set_current(op_span)
+                    try:
+                        run()
+                    finally:
+                        tracing.set_current(tracing.NOOP)
             self.device.stage_barrier(pg.pgid, barrier)
             return
         run()
@@ -434,10 +475,13 @@ class ECBackend(PGBackend):
             pg.extent_cache.pin(oid, version, offset, data,
                                 max(base, end), full=False)
 
+            op_span = tracing.current()
+
             def barrier(pg=pg, oid=oid, offset=offset, data=data,
                         version=version, on_commit=on_commit,
-                        old_size=old_size) -> None:
+                        old_size=old_size, op_span=op_span) -> None:
                 with pg.lock:
+                    tracing.set_current(op_span)
                     try:
                         self._submit_partial_write_sync(
                             pg, oid, offset, data, version, on_commit,
@@ -447,6 +491,8 @@ class ECBackend(PGBackend):
                             f"v{version} failed: {exc}")
                         pg.extent_cache.unpin(oid, version)
                         on_commit(-5)
+                    finally:
+                        tracing.set_current(tracing.NOOP)
 
             self.device.stage_barrier(pg.pgid, barrier)
             return

@@ -505,7 +505,12 @@ def _flush_device_fused_async(sinfo: StripeInfo, codec, ops, bufs):
     offs_arr[:len(ops)] = np.cumsum([0] + lens[:-1])
     lens_arr = np.zeros(nops_b, dtype=np.int32)
     lens_arr[:len(ops)] = lens
-    parity_dev, lin_dev = fn(data_dev, offs_arr, lens_arr)
+    from ceph_tpu.utils.device_telemetry import telemetry
+    parity_dev, lin_dev = telemetry().timed_call(
+        f"fused_crc[{codec.backend}"
+        f"{list(codec.coding_matrix.shape)}]"
+        f"N{n_b}L{lmax_b}ops{nops_b}",
+        fn, data_dev, offs_arr, lens_arr)
 
     def finalize():
         parity = np.asarray(parity_dev)

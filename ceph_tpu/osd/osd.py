@@ -478,6 +478,8 @@ class OSD:
             "dump_traces",
             lambda a: tracing.tracer().dump(a.get("trace_id")),
             "finished dataflow-trace spans (blkin role)")
+        from ceph_tpu.utils import device_telemetry as _dt
+        _dt.register_asok(self.asok)
         from ceph_tpu.utils import tracepoints as _tp
         _tp.register_asok(self.asok)
         self.asok.start()

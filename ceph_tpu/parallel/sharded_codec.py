@@ -32,6 +32,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ceph_tpu.ops import bitmatrix
 
 
+def _instrumented(step, sig: str):
+    """Wrap a jitted mesh step with device telemetry: per-call
+    dispatch count plus compile accounting keyed by ``sig`` (a mesh
+    step recompiling under a steady batch shape is the same bug-class
+    signal as any other device entry point)."""
+    from ceph_tpu.utils.device_telemetry import telemetry
+
+    def run(*args):
+        tel = telemetry()
+        tel.note_mesh_dispatch()
+        return tel.timed_call(sig, step, *args)
+
+    run.__wrapped__ = step
+    return run
+
+
+def _mat_sig(kind: str, mesh: Mesh, mat: np.ndarray) -> str:
+    import zlib
+    shape = "x".join(str(s) for s in mat.shape)
+    return (f"sharded_codec.{kind}[{shape}]"
+            f"#{zlib.crc32(np.ascontiguousarray(mat).tobytes()):08x}"
+            f"@mesh{dict(mesh.shape)}")
+
+
 def _shard_map(f, mesh, in_specs, out_specs):
     """jax.shard_map across the jax version skew: the public
     ``jax.shard_map`` (with ``check_vma``) landed after 0.4.3x; older
@@ -99,7 +123,8 @@ def make_encode_step(mesh: Mesh, coding_matrix: np.ndarray,
         in_specs=P("stripe", None, "shard"),
         out_specs=(P("stripe", None, "shard"), P()),
     )
-    return jax.jit(sharded)
+    return _instrumented(jax.jit(sharded),
+                         _mat_sig("encode", mesh, coding_matrix))
 
 
 def make_matrix_step(mesh: Mesh, flat_matrix: np.ndarray):
@@ -125,7 +150,8 @@ def make_matrix_step(mesh: Mesh, flat_matrix: np.ndarray):
         in_specs=P("stripe", None, "shard"),
         out_specs=(P("stripe", None, "shard"), P("stripe", None, None)),
     )
-    return jax.jit(sharded)
+    return _instrumented(jax.jit(sharded),
+                         _mat_sig("matrix", mesh, flat_matrix))
 
 
 def make_degraded_read_step(mesh: Mesh, generator: np.ndarray,
@@ -158,7 +184,8 @@ def make_degraded_read_step(mesh: Mesh, generator: np.ndarray,
         in_specs=P("stripe", None, "shard"),
         out_specs=(P("stripe", None, "shard"), P("stripe", None, None)),
     )
-    return jax.jit(sharded)
+    return _instrumented(jax.jit(sharded),
+                         _mat_sig("degraded_read", mesh, dmat))
 
 
 def shard_stripe_batch(mesh: Mesh, data: np.ndarray) -> jax.Array:

@@ -1,0 +1,264 @@
+"""Device-path telemetry — PerfCounters for the TPU EC pipeline.
+
+The paper's metric is encode/decode GB/s, but a number that moves
+needs an explanation: batching and data-movement effects dominate the
+online-EC hot path (arXiv:1709.05365) and per-stage timing is what
+makes a pipelined code debuggable (arXiv:1207.6744). Ceph's answer is
+PerfCounters + ``perf dump``; this module is that answer for the
+device path — one process-wide registry fed by:
+
+- the Pallas/XLA compile entry points (``ops/gf_pallas``,
+  ``ops/gf_block_sparse``, ``models/clay_device``,
+  ``parallel/sharded_codec``): per-codec-signature compile counts and
+  compile wall time. A signature that compiles MORE THAN ONCE is a
+  bug-class signal (an unbucketed shape leaking into a jit cache —
+  the recompile storm every device entry point is designed to
+  prevent), surfaced as the ``recompiles`` counter;
+- ``osd/device_engine.py``: batch-occupancy histograms for
+  stage_encode/stage_decode flushes, flush sizes, the queue-wait vs
+  device-time latency split, bytes encoded/decoded, fused-path
+  fallbacks;
+- ``models/clay_device.build_decode_matvec``: sparse-vs-dense
+  calibration outcomes (winner + measured timings, per signature);
+- ``models/clay.py``: linearized-transform LRU hits/misses.
+
+Counters are ALWAYS ON and cheap (one lock, integer adds); the
+per-signature side tables are bounded dicts. ``snapshot()`` is the
+JSON-able view served by the ``device perf dump`` admin command, the
+mgr dashboard's device panel, and the telemetry field bench.py
+attaches to every metric line. The plain counters also live in the
+process PerfCounters collection under the ``device`` logger, so
+``perf dump`` and the prometheus exporter pick them up for free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ceph_tpu.utils.perf_counters import PerfCounters, collection
+
+#: bound on the per-signature side tables (compiles / calibrations):
+#: signatures are O(erasure signatures x shape buckets) in practice,
+#: but a pathological caller must not grow the dump without bound
+_MAX_SIGNATURES = 256
+
+
+class DeviceTelemetry:
+    """Process-wide device-path counters (one per process, like the
+    reference's per-daemon PerfCounters — the device is per-process
+    here, so the registry is too)."""
+
+    def __init__(self, name: str = "device") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        perf = collection().get(name)
+        if perf is None:
+            perf = collection().create(name)
+            self._declare(perf)
+        self.perf = perf
+        #: signature -> {"compiles": n, "seconds": total}
+        self._compiles: dict[str, dict] = {}
+        #: "label|signature" -> calibration outcome dict
+        self._calibrations: dict[str, dict] = {}
+
+    @staticmethod
+    def _declare(perf: PerfCounters) -> None:
+        perf.add_u64_counter("compiles",
+                             "device kernel/program compilations")
+        perf.add_u64_counter("recompiles",
+                             "signatures compiled more than once "
+                             "(shape leaking into a jit cache)")
+        perf.add_time_avg("compile_time",
+                          "wall seconds per compilation")
+        perf.add_histogram("encode_batch_ops",
+                           "ops per stage_encode flush (occupancy)")
+        perf.add_histogram("decode_batch_ops",
+                           "ops per stage_decode flush (occupancy)")
+        perf.add_histogram("flush_bytes",
+                           "payload bytes per encode flush")
+        perf.add_time_avg("encode_queue_wait",
+                          "stage_encode -> flush launch wait")
+        perf.add_time_avg("decode_queue_wait",
+                          "stage_decode -> flush launch wait")
+        perf.add_time_avg("flush_device_time",
+                          "engine-thread seconds per encode-flush "
+                          "harvest (device wait + download + "
+                          "continuation dispatch)")
+        perf.add_time_avg("decode_flush_device_time",
+                          "engine-thread seconds per decode flush")
+        perf.add_u64_counter("bytes_encoded",
+                             "payload bytes through device encode")
+        perf.add_u64_counter("bytes_decoded",
+                             "shard bytes through device decode")
+        perf.add_u64_counter("fused_fallbacks",
+                             "mesh/fused flush paths that fell back")
+        perf.add_u64_counter("calibrations",
+                             "sparse-vs-dense on-device calibrations")
+        perf.add_u64_counter("calibrations_sparse_won",
+                             "calibrations the sparse kernel won")
+        perf.add_u64_counter("lin_matvec_hits",
+                             "clay linearized-transform LRU hits")
+        perf.add_u64_counter("lin_matvec_misses",
+                             "clay linearized-transform LRU builds")
+        perf.add_u64_counter("mesh_dispatches",
+                             "multi-chip sharded-codec step calls")
+
+    # -- compile accounting -------------------------------------------
+    def note_compile(self, signature: str, seconds: float) -> None:
+        """One compilation of ``signature`` took ``seconds`` wall.
+        The second compile of the same signature counts a recompile —
+        the bug-class every pow2-bucketed entry point exists to
+        prevent."""
+        self.perf.inc("compiles")
+        self.perf.tinc("compile_time", seconds)
+        with self._lock:
+            ent = self._compiles.get(signature)
+            if ent is None:
+                if len(self._compiles) >= _MAX_SIGNATURES:
+                    self._compiles.pop(next(iter(self._compiles)))
+                ent = self._compiles[signature] = {"compiles": 0,
+                                                   "seconds": 0.0}
+            ent["compiles"] += 1
+            ent["seconds"] += seconds
+            recompiled = ent["compiles"] > 1
+        if recompiled:
+            self.perf.inc("recompiles")
+
+    def compile_count(self, signature: str) -> int:
+        with self._lock:
+            ent = self._compiles.get(signature)
+            return ent["compiles"] if ent else 0
+
+    def timed_call(self, signature: str, fn, *args, **kwargs):
+        """Call a jitted device entry point, accounting a compile when
+        the jit cache grows underneath it (``_cache_size`` on jitted
+        functions); falls back to first-call-per-signature counting on
+        runtimes without that introspection. The non-compiling path
+        costs two attribute loads and a perf_counter pair."""
+        cache_size = getattr(fn, "_cache_size", None)
+        before = None
+        if cache_size is not None:
+            try:
+                before = cache_size()
+            except Exception:
+                cache_size = None
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if cache_size is not None:
+            try:
+                if cache_size() > before:
+                    self.note_compile(signature, dt)
+            except Exception:
+                pass
+        else:
+            with self._lock:
+                seen = signature in self._compiles
+            if not seen:
+                self.note_compile(signature, dt)
+        return out
+
+    # -- engine flush accounting --------------------------------------
+    def note_encode_flush(self, ops: int, nbytes: int,
+                          device_s: float) -> None:
+        self.perf.hinc("encode_batch_ops", ops)
+        self.perf.hinc("flush_bytes", nbytes)
+        self.perf.tinc("flush_device_time", device_s)
+        self.perf.inc("bytes_encoded", nbytes)
+
+    def note_decode_flush(self, ops: int, nbytes: int,
+                          device_s: float) -> None:
+        self.perf.hinc("decode_batch_ops", ops)
+        self.perf.tinc("decode_flush_device_time", device_s)
+        self.perf.inc("bytes_decoded", nbytes)
+
+    def note_queue_wait(self, kind: str, seconds: float) -> None:
+        self.perf.tinc(f"{kind}_queue_wait", seconds)
+
+    def note_fused_fallback(self) -> None:
+        self.perf.inc("fused_fallbacks")
+
+    # -- codec-layer accounting ---------------------------------------
+    def note_calibration(self, label: str, signature: str,
+                         winner: str, measured: dict) -> None:
+        """One build_decode_matvec outcome: which path won this
+        signature on this chip and what both paths measured."""
+        self.perf.inc("calibrations")
+        if winner == "sparse":
+            self.perf.inc("calibrations_sparse_won")
+        with self._lock:
+            if len(self._calibrations) >= _MAX_SIGNATURES:
+                self._calibrations.pop(next(iter(self._calibrations)))
+            self._calibrations[f"{label}|{signature}"] = {
+                "winner": winner, **measured}
+
+    def note_lin_matvec(self, hit: bool) -> None:
+        self.perf.inc("lin_matvec_hits" if hit else "lin_matvec_misses")
+
+    def note_mesh_dispatch(self) -> None:
+        self.perf.inc("mesh_dispatches")
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The full JSON-able view: counters + per-signature tables
+        (the ``device perf dump`` payload)."""
+        with self._lock:
+            compiles = {s: dict(v) for s, v in self._compiles.items()}
+            calibrations = {s: dict(v)
+                            for s, v in self._calibrations.items()}
+        return {"counters": self.perf.dump(),
+                "compiles_by_signature": compiles,
+                "calibrations": calibrations}
+
+    def snapshot_brief(self) -> dict:
+        """Compact view for bench metric lines: scalar counters plus
+        calibration winners, no histograms (a metric line must stay
+        one readable line)."""
+        counters = self.perf.dump()
+        brief = {}
+        for key in ("compiles", "recompiles", "bytes_encoded",
+                    "bytes_decoded", "fused_fallbacks", "calibrations",
+                    "calibrations_sparse_won", "lin_matvec_hits",
+                    "lin_matvec_misses"):
+            val = counters.get(key)
+            if val:
+                brief[key] = val
+        ct = counters.get("compile_time") or {}
+        if ct.get("avgcount"):
+            brief["compile_time_s"] = round(ct["sum"], 3)
+        with self._lock:
+            if self._calibrations:
+                brief["calibration_winners"] = {
+                    s: v["winner"]
+                    for s, v in self._calibrations.items()}
+        return brief
+
+    def reset(self) -> None:
+        """Test hook: drop the logger and side tables (a fresh
+        telemetry() call re-creates both)."""
+        collection().remove(self.name)
+        global _telemetry
+        with _module_lock:
+            _telemetry = None
+
+
+_module_lock = threading.Lock()
+_telemetry: DeviceTelemetry | None = None
+
+
+def telemetry() -> DeviceTelemetry:
+    global _telemetry
+    with _module_lock:
+        if _telemetry is None:
+            _telemetry = DeviceTelemetry()
+        return _telemetry
+
+
+def register_asok(asok) -> None:
+    """The ``device perf dump`` admin command (the device-path
+    counterpart of ``perf dump``)."""
+    asok.register_command(
+        "device perf dump", lambda a: telemetry().snapshot(),
+        "device-path telemetry: compiles, flushes, occupancy, "
+        "calibration outcomes")

@@ -18,7 +18,9 @@ class CounterType(Enum):
     U64 = "u64"            # monotonically increasing counter
     GAUGE = "gauge"        # settable level
     TIME_AVG = "time_avg"  # (sum, count) pair -> average latency
-    HISTOGRAM = "hist"     # fixed power-of-2 buckets
+    # power-of-2 buckets: bucket 0 = non-positive values, bucket
+    # b >= 1 = [2^(b-1), 2^b) (positive sub-1.0 values join bucket 1)
+    HISTOGRAM = "hist"
 
 
 class PerfCounters:
@@ -68,10 +70,21 @@ class PerfCounters:
             self._values[key] = (s + seconds, c + 1)
 
     def hinc(self, key: str, value: float) -> None:
+        """Record one observation. Bucket edges (pinned by
+        tests/test_device_telemetry.py): bucket 0 holds non-positive
+        values only; bucket b >= 1 holds [2^(b-1), 2^b). Positive
+        sub-1.0 observations count in bucket 1 with the 1s — they are
+        real observations and must not masquerade as zeros (the old
+        ``int(value)`` truncation sent 0.5 to the zero bucket)."""
         with self._lock:
             assert self._types[key] == CounterType.HISTOGRAM
-            bucket = min(self._HIST_BUCKETS - 1,
-                         max(0, int(value).bit_length()))
+            if value <= 0:
+                bucket = 0
+            elif value < 1:
+                bucket = 1
+            else:
+                bucket = min(self._HIST_BUCKETS - 1,
+                             int(value).bit_length())
             self._values[key][bucket] += 1
 
     def time(self, key: str):

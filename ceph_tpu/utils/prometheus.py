@@ -40,6 +40,26 @@ def render_text() -> str:
                         lines.append(
                             f'{m}{{daemon="{daemon}"}} {val[part]}')
                 continue
+            if isinstance(val, list):
+                # power-of-2 histogram (PerfCounters.hinc): cumulative
+                # le-labelled buckets + _count, the prometheus
+                # histogram shape. Bucket b>=1 covers [2^(b-1), 2^b),
+                # so its upper edge is 2^b - 1 inclusive.
+                m = f"{metric}_bucket"
+                if m not in seen_types:
+                    lines.append(f"# TYPE {metric} histogram")
+                    seen_types.add(m)
+                cum = 0
+                for b, count in enumerate(val):
+                    cum += count
+                    le = "0" if b == 0 else str((1 << b) - 1)
+                    lines.append(
+                        f'{m}{{daemon="{daemon}",le="{le}"}} {cum}')
+                lines.append(
+                    f'{m}{{daemon="{daemon}",le="+Inf"}} {cum}')
+                lines.append(
+                    f'{metric}_count{{daemon="{daemon}"}} {cum}')
+                continue
             if metric not in seen_types:
                 lines.append(f"# TYPE {metric} counter")
                 seen_types.add(metric)

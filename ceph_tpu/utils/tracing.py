@@ -102,6 +102,11 @@ class Tracer:
         if not ctx or not self.enabled:
             return NOOP
         trace_id, _, parent = ctx.partition(":")
+        if not trace_id:
+            # malformed ctx like ":7": a span with an empty trace_id
+            # could never be queried by dump(trace_id) and would
+            # orphan the chain — treat it as untraced
+            return NOOP
         try:
             parent_id = int(parent)
         except ValueError:

@@ -1,0 +1,49 @@
+"""Tier-1-safe smoke test for the BENCH pipeline wiring: bench.py must
+import cleanly under JAX_PLATFORMS=cpu (the driver environment minus
+the chip) and every metric line it emits must round-trip json.loads
+INCLUDING the telemetry snapshot field — the schema the driver's
+last-JSON-line reader and the BENCH history depend on."""
+
+import json
+
+
+def test_bench_imports_cleanly():
+    """Importing the module must not touch a device or run main()."""
+    import bench
+    assert callable(bench.main)
+    assert bench.TOTAL_BUDGET < 870      # inside the driver timeout
+
+
+def test_metric_line_roundtrips_with_telemetry(capsys):
+    import bench
+
+    # seed some real telemetry so the snapshot is non-trivial
+    from ceph_tpu.utils.device_telemetry import telemetry
+    telemetry().note_compile("bench_wiring_smoke", 0.01)
+
+    bench.emit("smoke_metric", {"value": 1.23, "unit": "GB/s"})
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip()]
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "smoke_metric"
+    assert rec["value"] == 1.23
+    assert isinstance(rec["telemetry"], dict)
+    assert rec["telemetry"].get("compiles", 0) >= 1
+    # the combined (historical-schema) line carries it too
+    combined = bench._combined(any_contended=False)
+    rec2 = json.loads(json.dumps(combined))
+    assert isinstance(rec2["telemetry"], dict)
+    bench._RESULTS.pop("smoke_metric", None)
+
+
+def test_telemetry_snapshot_degrades_to_empty(monkeypatch):
+    """A telemetry fault must never cost a metric line."""
+    import bench
+
+    import ceph_tpu.utils.device_telemetry as dt
+
+    def boom():
+        raise RuntimeError("telemetry down")
+
+    monkeypatch.setattr(dt, "telemetry", boom)
+    assert bench._telemetry_snapshot() == {}

@@ -58,9 +58,14 @@ def test_write_lands_in_cache_and_agent_flushes(cluster, rados):
     hot_io = rados.open_ioctx("hot")
     base_io.write_full("obj1", b"tiered-payload")   # redirected
     # the object materialized in the CACHE pool, not base (PGLS is
-    # not redirected, so the two listings tell them apart)
-    assert "obj1" in hot_io.list_objects()
+    # not redirected, so the two listings tell them apart). Base is
+    # checked FIRST (before the agent can flush); the hot listing is
+    # polled briefly — PGLS fans per-PG ops that can transiently race
+    # the map churn right after pool/tier creation (pre-existing
+    # ~5% flake on the seed: an acked write's listing came back [])
     assert "obj1" not in base_io.list_objects()
+    _wait(lambda: "obj1" in hot_io.list_objects(), timeout=10,
+          msg="write visible in cache-pool listing")
     # reads through the overlay serve from cache
     assert base_io.read("obj1") == b"tiered-payload"
     # agent flush propagates to base

@@ -26,6 +26,19 @@ def test_noop_when_disabled():
     assert span.wire() == ""
 
 
+def test_from_wire_rejects_malformed_ctx(traced):
+    """A wire ctx with a valid parent but EMPTY trace_id (":7") must
+    continue as NOOP: a span with trace_id == "" could never be
+    queried by dump(trace_id) and would orphan the chain."""
+    assert traced.from_wire(":7", "x", "svc") is tracing.NOOP
+    assert traced.from_wire(":", "x", "svc") is tracing.NOOP
+    assert traced.from_wire("abc:notanint", "x", "svc") is tracing.NOOP
+    ok = traced.from_wire("abc:7", "x", "svc")
+    assert ok is not tracing.NOOP
+    assert ok.trace_id == "abc" and ok.parent_id == 7
+    ok.finish()
+
+
 def test_span_tree(traced):
     root = traced.new_trace("op", "client")
     child = root.child("sub", "osd.0")
