@@ -49,6 +49,7 @@ BUDGETS = {
     "decode_e2": (60.0, 60.0),
     "clay_decode2_sparse": (50.0, 40.0),
     "clay_decode2_dense": (30.0, 0.0),
+    "scrub_verify": (50.0, 30.0),
 }
 
 #: global sampling deadline (seconds from process start). Sampling
@@ -218,6 +219,12 @@ def main() -> None:
     except Exception as exc:  # the flagship rows must still land
         emit("clay_decode2_GBps", {"error": repr(exc)})
 
+    try:
+        scrub_contended = _bench_scrub_verify(expect, clean_metrics)
+        any_contended = any_contended or scrub_contended
+    except Exception as exc:  # a scrub-bench fault must still land
+        emit("scrub_verify_GBps", {"error": repr(exc)})
+
     if any_contended:
         # independent chip-health probe (different program, same
         # chip): a low number here confirms the collapse is
@@ -262,6 +269,12 @@ def _combined(any_contended: bool) -> dict:
                    "error"):
             if k2 in clay:
                 out["clay_decode2_" + k2] = clay[k2]
+    scrub = _RESULTS.get("scrub_verify_GBps")
+    if scrub:
+        for k2 in ("value", "spread_pct", "samples", "contended",
+                   "error"):
+            if k2 in scrub:
+                out["scrub_verify_" + k2] = scrub[k2]
     probe = _RESULTS.get("xla_probe_GBps")
     if probe:
         out["xla_probe_GBps"] = probe["value"]
@@ -359,6 +372,73 @@ def _bench_clay_decode2(expect, clean_metrics: dict) -> bool:
         fields["contended"] = True
     emit("clay_decode2_GBps", fields)
     return rows[winner]["contended"]
+
+
+#: scrub_verify batch geometry: objects per launch x shard bytes —
+#: 32 x 11 x 256 KiB = 88 MiB of shard bytes verified per iteration
+SCRUB_OBJECTS = 32
+SCRUB_SHARD_BYTES = 1 << 18
+
+
+def _bench_scrub_verify(expect, clean_metrics: dict) -> bool:
+    """Deep-scrub verify GB/s: the EXACT fused program the scrub
+    engine launches (osd/scrub_engine.verify_fn — parity re-encode +
+    XOR-compare reduced to the mismatch bitmap, plus every shard's
+    crc32c linear part), chained device-resident. GB/s counts the
+    shard bytes verified per iteration (the 'scrub GB/s' headline:
+    how fast background verification streams a PG through the
+    device). Returns whether the row sampled contended."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.bench.measure import stable_best_slope
+    from ceph_tpu.ops import gf256
+    from ceph_tpu.osd import scrub_engine
+
+    mat = gf256.rs_matrix_isa(K, M)
+    n = K + M
+    nobj, l_b = SCRUB_OBJECTS, SCRUB_SHARD_BYTES
+    fn = scrub_engine.verify_fn(mat, K, l_b, nobj)
+    rng = np.random.default_rng(5)
+    # content does not change the cost; random batch = all-mismatch
+    batch = rng.integers(0, 256, size=(nobj, n, l_b), dtype=np.uint8)
+    # warm through the engine's accounted entry so the metric line's
+    # telemetry snapshot carries this program's compile
+    scrub_engine.verify_batch(mat, K, batch)
+    dd = jax.device_put(jnp.asarray(batch))
+
+    def step(b):
+        mism, lin = fn(b)
+        # fold both outputs back in: a real data dependency between
+        # iterations, nothing dead-code-eliminated
+        fold = (lin[0, 0] & 0xFF).astype(jnp.uint8) ^ \
+            mism[0, 0].astype(jnp.uint8)
+        return b.at[0, 0, 0].set(fold)
+
+    verified = nobj * n * l_b
+    budget, ext = BUDGETS["scrub_verify"]
+    slope, spread, samples, contended = stable_best_slope(
+        step, dd, counts=(3, 13),
+        # traffic: the batch in + bitmap/crc out (out is negligible)
+        min_traffic_bytes=verified,
+        time_budget=budget, stable_n=4, extended_budget=ext,
+        deadline=_deadline(),
+        expect_slope=expect("scrub_verify_GBps", verified))
+    gbps = verified / slope / 1e9
+    fields = {
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "objects_per_batch": nobj,
+        "shard_bytes": l_b,
+        "spread_pct": spread,
+        "samples": samples,
+    }
+    if contended:
+        fields["contended"] = True
+    else:
+        clean_metrics["scrub_verify_GBps"] = round(gbps, 1)
+    emit("scrub_verify_GBps", fields)
+    return contended
 
 
 def _cpu_baseline_gbps(mat) -> float:

@@ -46,6 +46,9 @@ _PAGE = """<!doctype html>
 <h3>device</h3><p>{device}</p>
 <table><tr><th>calibration</th><th>winner</th><th>dense_s</th>
 <th>sparse_s</th></tr>{device_rows}</table>
+<h3>deep scrub</h3>
+<table><tr><th>batches</th><th>bytes verified</th><th>mismatches</th>
+<th>repaired shards</th><th>host fallbacks</th></tr>{scrub_row}</table>
 </body></html>"""
 
 
@@ -85,9 +88,22 @@ class Module(MgrModule):
             from ceph_tpu.utils.device_telemetry import telemetry
             return 200, "application/json", json.dumps(
                 telemetry().snapshot()).encode()
+        if path == "/api/scrub":
+            from ceph_tpu.utils.device_telemetry import telemetry
+            return 200, "application/json", json.dumps(
+                self._scrub_counters(telemetry())).encode()
         if path == "/":
             return 200, "text/html", self._page(status, osdmap)
         return 404, "text/plain", b"not found"
+
+    @staticmethod
+    def _scrub_counters(tel) -> dict:
+        counters = tel.snapshot()["counters"]
+        return {key: counters.get(key, 0)
+                for key in ("scrub_batches", "scrub_bytes_verified",
+                            "scrub_mismatch_stripes",
+                            "scrub_repaired_shards",
+                            "scrub_host_fallbacks")}
 
     def _page(self, status: dict, osdmap) -> bytes:
         health = status.get("health", "unknown")
@@ -110,6 +126,13 @@ class Module(MgrModule):
             f"<td>{cal.get('sparse_s', '')}</td></tr>"
             for sig, cal in sorted(
                 tel.snapshot()["calibrations"].items()))
+        sc = self._scrub_counters(tel)
+        scrub_row = (
+            f"<tr><td>{sc['scrub_batches']}</td>"
+            f"<td>{sc['scrub_bytes_verified']}</td>"
+            f"<td>{sc['scrub_mismatch_stripes']}</td>"
+            f"<td>{sc['scrub_repaired_shards']}</td>"
+            f"<td>{sc['scrub_host_fallbacks']}</td></tr>")
         return _PAGE.format(
             health=html.escape(health),
             hclass="ok" if health.startswith("HEALTH_OK") else "warn",
@@ -122,6 +145,7 @@ class Module(MgrModule):
             else "idle",
             device=html.escape(json.dumps(tel.snapshot_brief())),
             device_rows=device_rows,
+            scrub_row=scrub_row,
         ).encode()
 
     # -- server --------------------------------------------------------

@@ -125,6 +125,32 @@ class MatrixErasureCode(ErasureCode):
             out[c] = rec[row]
         return out
 
+    def verify_chunks(self, chunks: Mapping[int, np.ndarray]
+                      ) -> list[int]:
+        """Host twin of the deep-scrub parity check: re-encode the
+        data chunks and XOR-compare against the stored parity;
+        returns the PARITY indices (k..n-1) that mismatch. This is
+        the oracle the device verify pass (osd/scrub_engine.py) is
+        bit-exact against — position-wise codecs only (callers gate
+        on ``chunk_mapping``)."""
+        k, n = self._k, self.get_chunk_count()
+        if self.chunk_mapping:
+            raise ErasureCodeError(
+                "verify_chunks: layered/mapped codecs have no "
+                "position-wise parity check")
+        missing = [i for i in range(n) if i not in chunks]
+        if missing:
+            raise ErasureCodeError(
+                f"verify_chunks: need all {n} chunks, missing "
+                f"{missing}")
+        data = np.stack([np.asarray(chunks[i], dtype=np.uint8)
+                         for i in range(k)])
+        parity = self._matvec(self.coding_matrix, data)
+        return [k + j for j in range(n - k)
+                if not np.array_equal(
+                    parity[j], np.asarray(chunks[k + j],
+                                          dtype=np.uint8))]
+
     def _decode_matrix(self, present: tuple, missing: tuple) -> np.ndarray:
         """LRU-cached decode matrix, keyed by the erasure signature
         (reference: ErasureCodeIsa.cc:226-303 caches decode tables the same

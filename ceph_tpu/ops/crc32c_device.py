@@ -297,6 +297,15 @@ def crc_linear_device(x, length: int | None = None):
     return _jit_linear_batch()(x, b_mat, p_mat, r, c)
 
 
+def crc32c_from_linear(lin: int, length: int, seed: int = 0) -> int:
+    """Recover a full crc32c from a device-computed LINEAR part (the
+    affine identity): ``crc32c(M, seed) = L(M) ^ crc32c(0^len,
+    seed)``. ``length`` is the TRUE buffer length — front zero-padding
+    applied on device (shape bucketing) does not change L, so callers
+    pass the unpadded length here. O(32^2 log len) host work."""
+    return int(np.uint32(lin)) ^ zeros_crc(length, seed)
+
+
 def crc32c_device(x, seed: int = 0) -> np.ndarray:
     """Batched crc32c of every row of ``x`` [n, L] with ``seed`` —
     bit-equal to utils.checksum.crc32c(row, seed)."""

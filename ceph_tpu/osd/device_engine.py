@@ -85,6 +85,9 @@ class DeviceEncodeEngine:
                       "decode_flushes": 0, "decode_ops": 0,
                       "decode_bytes": 0, "max_decode_batch_ops": 0,
                       "decode_errors": 0, "device_fused_fallbacks": 0,
+                      # auxiliary device work run via run_sync (deep
+                      # scrub verify launches)
+                      "aux_runs": 0,
                       # engine-thread seconds spent launching +
                       # finalizing device batches: busy_s/flushes is
                       # the MEASURED per-launch cost the amortization
@@ -157,6 +160,23 @@ class DeviceEncodeEngine:
             return None
         return box[0]
 
+    def run_sync(self, fn: Callable[[], object],
+                 timeout: float = 120.0):
+        """Run ``fn`` on the engine thread and return its result
+        (deep scrub's verify launches ride here so background
+        verification serializes with client encode/decode flushes on
+        the one device instead of contending mid-download). Raises
+        what ``fn`` raises; raises TimeoutError when the engine is
+        stopped or wedged."""
+        ev = threading.Event()
+        box: list = [None, None]
+        self._q.put(("run", fn, box, ev))
+        if not ev.wait(timeout):
+            raise TimeoutError("device engine run_sync timed out")
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
     def stop(self) -> None:
         self._running = False
         self._q.put(None)
@@ -213,6 +233,24 @@ class DeviceEncodeEngine:
                         self._flush(pending)
                         self._flush_decodes(dec_pending)
                         pending, dec_pending, nbytes = {}, {}, 0
+                elif item[0] == "run":
+                    # auxiliary device work (deep-scrub verify): runs
+                    # after the in-flight batch drains so it never
+                    # contends with an encode download on the device
+                    import time as _time
+                    self._flush(pending)
+                    self._flush_decodes(dec_pending)
+                    self._drain_inflight()
+                    pending, dec_pending, nbytes = {}, {}, 0
+                    _, fn, box, ev = item
+                    t0 = _time.perf_counter()
+                    try:
+                        box[0] = fn()
+                    except Exception as exc:
+                        box[1] = exc
+                    self.stats["aux_runs"] += 1
+                    self.stats["busy_s"] += _time.perf_counter() - t0
+                    ev.set()
                 else:                        # barrier
                     self._flush(pending)
                     self._flush_decodes(dec_pending)

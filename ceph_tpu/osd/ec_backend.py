@@ -1096,7 +1096,7 @@ class ECBackend(PGBackend):
                 return reply
             hraw = attrs.get("hinfo")
             if hraw and msg.offset == 0 and not msg.length \
-                    and not msg.offsets:
+                    and not msg.offsets and not msg.raw:
                 hinfo = HashInfo.from_dict(json.loads(hraw))
                 crc = checksum.crc32c(data, ec_util.HINFO_SEED)
                 if crc != hinfo.get_chunk_hash(msg.shard):

@@ -478,6 +478,12 @@ class OSD:
             "dump_traces",
             lambda a: tracing.tracer().dump(a.get("trace_id")),
             "finished dataflow-trace spans (blkin role)")
+        self.asok.register_command(
+            "deep-scrub",
+            lambda a: self._asok_deep_scrub(a),
+            "device deep scrub of one pg ({pool, ps, [repair]}): "
+            "fused crc + parity-re-encode verify with batched "
+            "sparse repair")
         from ceph_tpu.utils import device_telemetry as _dt
         _dt.register_asok(self.asok)
         from ceph_tpu.utils import tracepoints as _tp
@@ -586,6 +592,22 @@ class OSD:
                 "osdmap_epoch": osdmap.epoch if osdmap else 0,
                 "num_primary_pgs": num_pgs,
                 "slow_ops": len(self.op_tracker.get_slow_ops())}
+
+    def _asok_deep_scrub(self, args: dict) -> dict:
+        try:
+            pool = int(args["pool"])
+            ps = int(args["ps"])
+        except (KeyError, TypeError, ValueError):
+            return {"error": "need integer 'pool' and 'ps' args"}
+        repair = bool(int(args.get("repair", 1)))
+        timeout = float(args.get("timeout", 120.0))
+        try:
+            res = self.scrub_pg((pool, ps), repair=repair,
+                                timeout=timeout, deep=True)
+        except TimeoutError as exc:
+            return {"error": repr(exc)}
+        res["engine_stats"] = dict(self.scrub_engine().stats)
+        return res
 
     def _asok_dump_pgs(self) -> list[dict]:
         with self._pgs_lock:
@@ -1833,22 +1855,34 @@ class OSD:
                                qos=QOS_SCRUB)
 
     # -- scrub (PGBackend::be_compare_scrubmaps role) -----------------
+    def scrub_engine(self):
+        """Lazy per-OSD deep-scrub engine (osd/scrub_engine.py: the
+        batched device verify + sparse-repair subsystem)."""
+        engine = getattr(self, "_scrub_engine", None)
+        if engine is None:
+            from ceph_tpu.osd.scrub_engine import DeepScrubEngine
+            engine = self._scrub_engine = DeepScrubEngine(self)
+        return engine
+
     def scrub_pg(self, pgid: tuple[int, int], repair: bool = True,
-                 timeout: float = 60.0) -> dict:
+                 timeout: float = 60.0, deep: bool = False) -> dict:
         """Primary-side scrub of one PG: fan checksum reads over every
         up shard of every object, compare against the authoritative
         hinfo (EC) or the self-validating replica crcs (replicated),
         and optionally repair divergent shards through the recovery
-        path. Blocking external entry (harness/admin socket); the work
-        runs on its own thread — scrub fan-outs can block for many
-        SUBOP_TIMEOUTs and must not occupy an op_wq worker (client ops
-        for unrelated PGs hash onto the same shards)."""
+        path. ``deep`` runs the device deep-scrub engine instead
+        (fused crc + parity-re-encode verify, batched sparse repair;
+        host shallow stays the fallback for pools the device path
+        cannot take). Blocking external entry (harness/admin socket);
+        the work runs on its own thread — scrub fan-outs can block for
+        many SUBOP_TIMEOUTs and must not occupy an op_wq worker
+        (client ops for unrelated PGs hash onto the same shards)."""
         done = threading.Event()
         result: dict = {}
 
         def run() -> None:
             try:
-                result.update(self._do_scrub(pgid, repair))
+                result.update(self._do_scrub(pgid, repair, deep=deep))
             except Exception as exc:          # surface, don't vanish
                 result["error"] = repr(exc)
             finally:
@@ -1860,12 +1894,14 @@ class OSD:
             raise TimeoutError(f"scrub of pg {pgid} timed out")
         return result
 
-    def _do_scrub(self, pgid: tuple[int, int], repair: bool) -> dict:
+    def _scrub_resolve_pg(self, pgid: tuple[int, int]):
+        """Shared scrub entry: resolve + activate the PG on demand.
+        Returns (pg, None) or (None, error dict)."""
         pool_id, ps = pgid
         osdmap = self.get_osdmap()
         _, acting, primary = osdmap.pg_to_up_acting(pool_id, ps)
         if primary != self.whoami:
-            return {"error": "not primary"}
+            return None, {"error": "not primary"}
         with self._pgs_lock:
             pg = self.pgs.get(pgid)
             if pg is None:
@@ -1880,7 +1916,20 @@ class OSD:
                 pg.epoch = osdmap.epoch
                 self._peer(pg)
             if pg.state != PG.ACTIVE:
-                return {"error": "pg not active here"}
+                return None, {"error": "pg not active here"}
+        return pg, None
+
+    def _do_scrub(self, pgid: tuple[int, int], repair: bool,
+                  deep: bool = False) -> dict:
+        pg, err = self._scrub_resolve_pg(pgid)
+        if err is not None:
+            return err
+        if deep:
+            res = self.scrub_engine().deep_scrub_pg(pg, repair=repair)
+            if res is not None:
+                return res
+            # pool/codec the device path cannot take: the host
+            # shallow scrub below is the documented fallback
         listing = self._scrub_listing(pg)
         with pg.lock:
             latest: dict[str, int] = {}

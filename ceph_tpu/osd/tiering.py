@@ -233,9 +233,13 @@ class TierService:
         context, no pg.lock."""
         from ceph_tpu.client.objecter import ObjecterError
         try:
+            # the op's snap context rides along: a pool-snapshot read
+            # proxied to the base pool must resolve through the base's
+            # snapset to the covering clone, not answer HEAD data
             rep = self.objecter.op_submit(
                 pool.tier_of, msg.oid, msg.op, offset=msg.offset,
-                length=msg.length, xname=msg.xname)
+                length=msg.length, xname=msg.xname,
+                snapid=msg.snapid)
             self.osd.logger.inc("tier_proxy_read")
             reply(rep.code, bytes(rep.data), rep.version)
         except ObjecterError as exc:

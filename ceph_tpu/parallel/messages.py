@@ -393,13 +393,16 @@ class MECSubRead(Message):
     """Primary -> shard: read shard chunk(s) (ECSubRead: offsets +
     subchunk lists; attrs on request). ``offsets``/``lengths`` carry a
     fragmented multi-range read (clay sub-chunk repair,
-    ECBackend.cc:978-1002); the reply concatenates the fragments."""
+    ECBackend.cc:978-1002); the reply concatenates the fragments.
+    ``raw`` skips the serving OSD's hinfo crc gate: deep scrub wants
+    the raw observation (it hashes on the device itself), not a
+    pre-judged -EIO."""
     MSG_TYPE = 32
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("oid", "str"), ("offset", "u64"),
               ("length", "u64"), ("want_attrs", "bool"),
               ("csum_only", "bool"), ("offsets", "u64_list"),
-              ("lengths", "u64_list")]
+              ("lengths", "u64_list"), ("raw", "bool")]
 
 
 class MECSubReadReply(Message):

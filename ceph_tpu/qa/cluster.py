@@ -203,12 +203,18 @@ class MiniCluster:
         log(1, f"revived mon rank {rank} at {addr}")
         return m
 
-    def scrub_pool(self, pool_name: str, repair: bool = True) -> dict:
-        """Scrub every PG of a pool on its primary (the 'ceph pg scrub'
-        role); returns aggregated results."""
+    def scrub_pool(self, pool_name: str, repair: bool = True,
+                   deep: bool = False) -> dict:
+        """Scrub every PG of a pool on its primary (the 'ceph pg
+        scrub' / 'ceph pg deep-scrub' roles); returns aggregated
+        results. ``deep`` routes through the device deep-scrub engine
+        (fused crc + parity verify, batched sparse repair)."""
         osdmap = self.mon.osdmap
         pool_id = osdmap.pool_by_name[pool_name]
         agg = {"objects": 0, "inconsistent": {}, "repaired": []}
+        if deep:
+            agg["batches"] = 0
+            agg["bytes_verified"] = 0
         for ps in osdmap.pgs_of_pool(pool_id):
             _, _, primary = osdmap.pg_to_up_acting(pool_id, ps)
             osd = self.osds.get(primary)
@@ -217,7 +223,8 @@ class MiniCluster:
                 continue
             # the primary instantiates + peers the PG on demand, so a
             # PG that served no op since failover still gets scrubbed
-            res = osd.scrub_pg((pool_id, ps), repair=repair)
+            res = osd.scrub_pg((pool_id, ps), repair=repair,
+                               deep=deep, timeout=120.0)
             if "error" in res:
                 agg.setdefault("skipped", []).append(
                     f"{pool_id}.{ps}: {res['error']}")
@@ -225,6 +232,10 @@ class MiniCluster:
             agg["objects"] += res["objects"]
             agg["inconsistent"].update(res["inconsistent"])
             agg["repaired"].extend(res["repaired"])
+            if deep and res.get("deep"):
+                agg["deep"] = True
+                agg["batches"] += res.get("batches", 0)
+                agg["bytes_verified"] += res.get("bytes_verified", 0)
         return agg
 
     # -- waiting ------------------------------------------------------

@@ -515,15 +515,20 @@ class RGWGateway:
 
     @staticmethod
     def _gen_order(ent: dict) -> tuple:
-        """Deterministic cross-zone total order on generations — the
+        """Deterministic cross-zone TOTAL order on generations — the
         OLH 'which generation is current' resolution
         (src/rgw/rgw_rados.h:3287 set_olh): (origin seq, origin zone)
         pairs compare identically at every zone, unlike the local
-        apply-order seq. Legacy entries fall back to (seq, "")."""
+        apply-order seq. Legacy entries fall back to (seq, ""). The
+        vid is the final tie-breaker: two generations with an equal
+        (seq, zone) pair (legacy no-oseq entries, or zone_log-off
+        zones minting equal seqs) must still order the same way
+        everywhere, or max() picks by iteration order and the OLH
+        repoint becomes load-order-dependent."""
         o = ent.get("oseq")
         if o:
-            return (int(o[0]), str(o[1]))
-        return (int(ent.get("seq", 0)), "")
+            return (int(o[0]), str(o[1]), str(ent.get("vid", "")))
+        return (int(ent.get("seq", 0)), "", str(ent.get("vid", "")))
 
     def _ver_omap(self, bucket: str, prefix: str) -> dict:
         from ceph_tpu.client.rados import RadosError
@@ -640,7 +645,11 @@ class RGWGateway:
             # the deterministic order — a replicated older generation
             # must not displace a newer current (the OLH update rule)
             ents = self._ver_entries(bucket, key)
-            if max(ents.values(), key=self._gen_order) is                     ents.get(vid):
+            # compare by VID, not object identity: on a _gen_order tie
+            # an identity check against whatever max() happened to
+            # return first silently skipped the repoint
+            if max(ents.values(),
+                   key=self._gen_order).get("vid") == vid:
                 self._index_add(bucket, key, len(data), etag,
                                 mtime=mtime, acl=acl, owner=owner,
                                 vid=vid)

@@ -469,3 +469,45 @@ class BlockStore(ObjectStore):
 
     def clear_data_error(self, cid: str, oid: str) -> None:
         self._eio.discard((cid, oid))
+
+    def inject_bit_flip(self, cid: str, oid: str, offset: int = 0,
+                        length: int = 4) -> None:
+        """Silent corruption: flip stored bytes of the blob backing
+        logical ``offset`` and repoint the extent at a blob whose
+        checksum MATCHES the flipped bytes — the store's blob csum
+        cannot see it (the csum-collision / below-the-checksum rot
+        class), so reads return rot with no EIO. That is exactly the
+        corruption only the deep-scrub parity/crc pass catches."""
+        m = self._meta(cid, oid)
+        changed = False
+        for x in m.extents:
+            lo = max(x.logical_off, offset)
+            hi = min(x.end, offset + length)
+            if lo >= hi:
+                continue
+            if x.comp != COMP_NONE:
+                # flipping compressed bytes would fail decompression
+                # loudly, not silently; decompress, flip, restore raw
+                blob = bytearray(self._read_blob(x))
+                comp = COMP_NONE
+            else:
+                raw, _ = self._data.read(x.blob_off, x.disk_len)
+                blob = bytearray(raw)
+                comp = x.comp
+            s = x.slice_off + (lo - x.logical_off)
+            blob[s:s + (hi - lo)] = bytes(b ^ 0xFF
+                                          for b in blob[s:s + (hi - lo)])
+            with self._append_lock:
+                file_off, ncrc = self._data.append(bytes(blob))
+            self._data.sync()
+            x.blob_off = file_off
+            x.blob_len = len(blob)
+            x.disk_len = len(blob)
+            x.comp = comp
+            x.blob_crc = ncrc if (x.csum == 0 and ncrc is not None) \
+                else _CSUM_FNS[x.csum](bytes(blob))
+            changed = True
+        if changed:
+            batch = WriteBatch()
+            batch.put(self._okey(cid, oid), m.encode())
+            self._db.submit(batch, sync=True)

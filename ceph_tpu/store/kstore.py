@@ -355,3 +355,24 @@ class KStore(ObjectStore):
 
     def clear_data_error(self, cid: str, oid: str) -> None:
         self._eio.discard((cid, oid))
+
+    def inject_bit_flip(self, cid: str, oid: str, offset: int = 0,
+                        length: int = 4) -> None:
+        """Silent corruption: flip stored stripe bytes in place (no
+        EIO on read — the deep-scrub detection target)."""
+        with self._lock:
+            self._meta(cid, oid)          # ENOENT check
+            batch = WriteBatch()
+            pos, end = offset, offset + length
+            while pos < end:
+                n = pos // STRIPE
+                s_off = pos - n * STRIPE
+                take = min(STRIPE - s_off, end - pos)
+                stripe = bytearray(
+                    self._db.get(self._data_key(cid, oid, n)) or b"")
+                hi = min(s_off + take, len(stripe))
+                stripe[s_off:hi] = bytes(b ^ 0xFF
+                                         for b in stripe[s_off:hi])
+                batch.put(self._data_key(cid, oid, n), bytes(stripe))
+                pos += take
+            self._db.submit(batch, sync=True)

@@ -166,3 +166,12 @@ class MemStore(ObjectStore):
 
     def clear_data_error(self, cid: str, oid: str) -> None:
         self._eio.discard((cid, oid))
+
+    def inject_bit_flip(self, cid: str, oid: str, offset: int = 0,
+                        length: int = 4) -> None:
+        """Silent corruption: flip the stored bytes in place — reads
+        return the rot with no error (deep scrub's detection target)."""
+        o = self._obj(cid, oid)
+        end = min(offset + length, len(o.data))
+        o.data[offset:end] = bytes(b ^ 0xFF
+                                   for b in o.data[offset:end])

@@ -221,6 +221,16 @@ class ObjectStore:
     def clear_data_error(self, cid: str, oid: str) -> None:
         raise NotImplementedError
 
+    def inject_bit_flip(self, cid: str, oid: str, offset: int = 0,
+                        length: int = 4) -> None:
+        """SILENT corruption injection (the bitrot the deep-scrub
+        parity/crc pass exists to catch): XOR-flip ``length`` stored
+        bytes at ``offset`` such that a subsequent read returns the
+        flipped bytes WITHOUT an EIO — i.e. below-the-checksum rot, or
+        rot the store's csum collides with. A rewrite of the object
+        replaces the flipped bytes like any other data."""
+        raise NotImplementedError
+
 
 def create_store(kind: str, path: str | None = None) -> ObjectStore:
     """Factory (ObjectStore::create role, src/os/ObjectStore.cc:62-95)."""

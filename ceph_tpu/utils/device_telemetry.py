@@ -103,6 +103,27 @@ class DeviceTelemetry:
                              "clay linearized-transform LRU builds")
         perf.add_u64_counter("mesh_dispatches",
                              "multi-chip sharded-codec step calls")
+        # deep-scrub engine (osd/scrub_engine.py): the background-
+        # verification pipeline's own accounting
+        perf.add_u64_counter("scrub_batches",
+                             "deep-scrub device verify launches")
+        perf.add_u64_counter("scrub_bytes_verified",
+                             "shard bytes through the fused crc + "
+                             "parity-re-encode verify pass")
+        perf.add_u64_counter("scrub_mismatch_stripes",
+                             "objects flagged by the device mismatch "
+                             "bitmap / crc vector")
+        perf.add_u64_counter("scrub_repaired_shards",
+                             "shards rebuilt by deep-scrub sparse "
+                             "decode + recovery push")
+        perf.add_u64_counter("scrub_host_fallbacks",
+                             "objects judged by the host shallow "
+                             "oracle (device fault or ambiguous "
+                             "conviction)")
+        perf.add_histogram("scrub_batch_objs",
+                           "objects per deep-scrub verify launch")
+        perf.add_time_avg("scrub_device_time",
+                          "wall seconds per deep-scrub verify launch")
 
     # -- compile accounting -------------------------------------------
     def note_compile(self, signature: str, seconds: float) -> None:
@@ -199,6 +220,25 @@ class DeviceTelemetry:
     def note_mesh_dispatch(self) -> None:
         self.perf.inc("mesh_dispatches")
 
+    # -- deep-scrub accounting ----------------------------------------
+    def note_scrub_flush(self, objs: int, nbytes: int,
+                         device_s: float) -> None:
+        """One deep-scrub verify launch: ``objs`` objects, ``nbytes``
+        shard bytes verified, in ``device_s`` wall seconds."""
+        self.perf.inc("scrub_batches")
+        self.perf.inc("scrub_bytes_verified", nbytes)
+        self.perf.hinc("scrub_batch_objs", objs)
+        self.perf.tinc("scrub_device_time", device_s)
+
+    def note_scrub_mismatch(self) -> None:
+        self.perf.inc("scrub_mismatch_stripes")
+
+    def note_scrub_repair(self) -> None:
+        self.perf.inc("scrub_repaired_shards")
+
+    def note_scrub_host_fallback(self) -> None:
+        self.perf.inc("scrub_host_fallbacks")
+
     # -- export ---------------------------------------------------------
     def snapshot(self) -> dict:
         """The full JSON-able view: counters + per-signature tables
@@ -220,7 +260,9 @@ class DeviceTelemetry:
         for key in ("compiles", "recompiles", "bytes_encoded",
                     "bytes_decoded", "fused_fallbacks", "calibrations",
                     "calibrations_sparse_won", "lin_matvec_hits",
-                    "lin_matvec_misses"):
+                    "lin_matvec_misses", "scrub_batches",
+                    "scrub_bytes_verified", "scrub_mismatch_stripes",
+                    "scrub_repaired_shards", "scrub_host_fallbacks"):
             val = counters.get(key)
             if val:
                 brief[key] = val

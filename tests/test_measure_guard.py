@@ -108,6 +108,12 @@ def test_bench_budget_sum_bounded():
     assert bench.TOTAL_BUDGET <= 600
     # the global deadline must not be looser than the per-metric sum
     assert bench.TOTAL_BUDGET <= budget_sum
+    # the deep-scrub verify metric has its OWN sampling budget (it
+    # must not ride free on another metric's share and push the
+    # worst case past the driver timeout)
+    assert "scrub_verify" in bench.BUDGETS
+    tb, eb = bench.BUDGETS["scrub_verify"]
+    assert 0 < tb and tb + eb <= 100, (tb, eb)
 
 
 def test_deadline_caps_sampling(monkeypatch):

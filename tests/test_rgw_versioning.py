@@ -35,6 +35,39 @@ def _req(url, method="GET", data=None, headers=None):
 
 # -- versioning ---------------------------------------------------------
 
+def test_olh_equal_seq_tie_repoints_deterministically(setup):
+    """Regression (rgw.py OLH winner check): two generations with an
+    EQUAL (origin seq, zone) pair used to compare by object identity
+    against whatever max() returned first — on a tie the index
+    repoint was silently skipped. _gen_order now tie-breaks on vid
+    (a total order), and the winner check compares vids."""
+    _, gw, _ = setup
+    gw.create_bucket("tieb")
+    gw.set_versioning("tieb", "Enabled")
+    gw.put_object("tieb", "k", b"local")
+    v1 = gw.last_version_id
+    s1 = gw._ver_entries("tieb", "k")[v1]["oseq"][0]
+    # an equal-(seq, zone) generation whose vid orders AFTER v1 wins
+    # the tie and must repoint the index (the skipped-repoint bug:
+    # max() returned v1's entry first and the identity check failed)
+    gw.put_object("tieb", "k", b"tie-wins", version_id="vzz-tie",
+                  oseq=[s1, ""])
+    assert gw.get_object("tieb", "k")[0] == b"tie-wins"
+    assert gw.list_objects("tieb", prefix="k")["k"]["vid"] == \
+        "vzz-tie"
+    # an equal pair whose vid orders BEFORE the current must NOT
+    # displace it — the tie resolves the same way on every zone
+    gw.put_object("tieb", "k", b"tie-loses", version_id="v-low",
+                  oseq=[s1, ""])
+    assert gw.get_object("tieb", "k")[0] == b"tie-wins"
+    assert gw.list_objects("tieb", prefix="k")["k"]["vid"] == \
+        "vzz-tie"
+    # by-id access to every generation still works
+    assert gw.get_object("tieb", "k", version_id=v1)[0] == b"local"
+    assert gw.get_object("tieb", "k",
+                         version_id="v-low")[0] == b"tie-loses"
+
+
 def test_versioned_put_get_delete_cycle(setup):
     _, gw, _ = setup
     gw.create_bucket("vb")

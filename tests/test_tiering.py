@@ -225,6 +225,49 @@ def test_tier_commands_validate(cluster, rados):
     assert code == -16 and "overlay" in outs   # clients still redirect
 
 
+def test_proxy_read_preserves_pool_snapshot(cluster, rados):
+    """Regression (_proxy_read dropped the op's snap context): a
+    pool-snapshot read proxied through a hit-set-gated cache tier
+    must return the SNAPSHOT clone's bytes from the base pool, not
+    HEAD data. Seeds + snapshots the base pool BEFORE the overlay
+    lands, so the reads are genuine cold misses served by proxy
+    (min_read_recency_for_promote=2 keeps both touches proxied)."""
+    cluster.create_pool("base3", pg_num=4, size=2)
+    cluster.create_pool("hot3", pg_num=4, size=2)
+    base_io = rados.open_ioctx("base3")
+    base_io.write_full("snapobj", b"version-one")
+    snapid = base_io.snap_create("s1")
+    base_io.write_full("snapobj", b"version-two!")   # COWs v1
+    assert base_io.read("snapobj", snap=snapid) == b"version-one"
+    for cmd in (
+        {"prefix": "osd tier add", "pool": "base3",
+         "tierpool": "hot3", "force_nonempty": "1"},
+        {"prefix": "osd tier cache-mode", "pool": "hot3",
+         "mode": "writeback"},
+        {"prefix": "osd tier set-overlay", "pool": "base3",
+         "overlaypool": "hot3"},
+        {"prefix": "osd pool set", "pool": "hot3",
+         "var": "hit_set_period", "val": "60"},
+        {"prefix": "osd pool set", "pool": "hot3",
+         "var": "min_read_recency_for_promote", "val": "2"},
+    ):
+        code, outs, _ = rados.mon_command(cmd)
+        assert code == 0, outs
+    hot_id = rados.monc.osdmap.pool_by_name["hot3"]
+    rados.wait_for_epoch(cluster.mon.osdmap.epoch)
+    _wait(lambda: rados.monc.osdmap.pools[hot_id].hit_set_period
+          == 60.0, msg="hit_set knobs in client map")
+    proxies0 = _tier_counter(cluster, "tier_proxy_read")
+    # HEAD through the overlay: proxied, current bytes
+    assert base_io.read("snapobj") == b"version-two!"
+    # SNAPSHOT through the overlay: proxied, must serve the clone
+    assert base_io.read("snapobj", snap=snapid) == b"version-one"
+    assert _tier_counter(cluster, "tier_proxy_read") >= proxies0 + 2
+    # nothing promoted: the tier stayed clean (reads were proxied)
+    hot_io = rados.open_ioctx("hot3")
+    assert "snapobj" not in hot_io.list_objects()
+
+
 def test_hit_sets_gate_promotion_scan_vs_hot(cluster, rados):
     """r5 (src/osd/HitSet.h:33 + PrimaryLogPG.cc:2445): with hit sets
     on, a SCAN (one touch per object) is served by proxy reads —
