@@ -17,12 +17,20 @@ and a final combined line repeats them all in the historical schema:
 A driver that reads the last JSON line keeps working; a run killed
 after the first metric still leaves every finished metric parseable.
 
-Wall clock is BOUNDED: per-metric sampling budgets (``BUDGETS``) sum
-under ``TOTAL_BUDGET`` seconds and every ``stable_best_slope`` call
-additionally receives the same global deadline, so compiles or
-contention eating one metric's share shrink later metrics' sampling
-instead of overrunning the driver's timeout (tests/test_measure_guard
-asserts the configured worst case).
+Wall clock is BOUNDED: every ``stable_best_slope`` call receives the
+same global ``TOTAL_BUDGET`` deadline, so compiles or contention
+eating one metric's share shrink later metrics' sampling instead of
+overrunning the driver's timeout. The structural worst case is
+``TOTAL_BUDGET + N_WARMUP_COMPILES * COLD_COMPILE_S`` (every warmup
+compile fully cold) and must clear the driver's 870 s timeout with
+>= 60 s slack (tests/test_measure_guard asserts it); with the
+persistent compilation cache (utils/compile_cache) warm, the compile
+tail collapses to seconds.
+
+New in round 9: a ``multichip_encode_GBps`` row — the sharded encode
+step over ALL local devices (the engine's mesh seam) — so the
+MULTICHIP harness measures the mesh instead of dry-running it. On a
+single chip the line still lands, marked ``skipped``.
 
 Measurement method unchanged: chained-slope device-resident loops
 (see ceph_tpu/bench/measure.py) against the live-measured native AVX2
@@ -42,23 +50,34 @@ BATCH_OBJECTS = 128              # objects per kernel launch (128 MiB batch)
 LOOP_COUNTS = (5, 25)
 
 #: per-metric (time_budget, extended_budget) seconds for
-#: stable_best_slope; the worst case sums to <= TOTAL_BUDGET
+#: stable_best_slope; the global deadline below dominates the sum
 BUDGETS = {
-    "encode": (120.0, 120.0),
+    "encode": (110.0, 110.0),
     "decode_e1": (60.0, 60.0),
     "decode_e2": (60.0, 60.0),
     "clay_decode2_sparse": (50.0, 40.0),
     "clay_decode2_dense": (30.0, 0.0),
     "scrub_verify": (50.0, 30.0),
+    "multichip_encode": (40.0, 20.0),
 }
 
 #: global sampling deadline (seconds from process start). Sampling
-#: stops everywhere at this mark; the remaining tail (per-metric
-#: warmup compiles, ~35 s each on the tunnel, plus the exactness
-#: gates) keeps the whole run under ~700 s — comfortably inside the
-#: driver's 870 s timeout (worst case asserted by
-#: tests/test_measure_guard.py)
-TOTAL_BUDGET = 570.0
+#: stops everywhere at this mark; the remaining tail is per-metric
+#: warmup compiles — ~COLD_COMPILE_S each on the tunnel when the
+#: persistent compilation cache (utils/compile_cache, enabled at the
+#: top of main) is cold, near-zero once it is warm. The structural
+#: worst case TOTAL_BUDGET + N_WARMUP_COMPILES * COLD_COMPILE_S must
+#: stay >= 60 s under the driver's 870 s timeout even fully cold
+#: (asserted by tests/test_measure_guard.py — the r5 rc=124 class)
+TOTAL_BUDGET = 520.0
+
+#: tunnel worst-case seconds for ONE cold per-signature compile
+COLD_COMPILE_S = 35.0
+
+#: warmup compiles a run can pay AFTER the sampling deadline passes:
+#: one per BUDGETS metric (each stable_best_slope call warms its own
+#: program) plus the contended-health probe
+N_WARMUP_COMPILES = len(BUDGETS) + 1
 
 #: lanes per clay survivor sub-chunk row (input batch = 10*64 rows x
 #: this; ~52 MiB survivors per iteration)
@@ -96,6 +115,12 @@ def emit(metric: str, fields: dict) -> None:
 
 
 def main() -> None:
+    # warmup-kill: per-signature device programs persist on disk, so
+    # the ~35 s tunnel compiles are paid once per machine — the rc=124
+    # round was warmups alone eating the driver budget
+    from ceph_tpu.utils import compile_cache
+    compile_cache.enable()
+
     import jax
     import jax.numpy as jnp
     from ceph_tpu.ops import gf256, gf_pallas
@@ -144,7 +169,7 @@ def main() -> None:
         min_traffic_bytes=data_bytes * (K + M) // K,
         time_budget=BUDGETS["encode"][0], stable_n=6,
         extended_budget=BUDGETS["encode"][1],
-        deadline=_deadline(),
+        deadline=_deadline(), label="encode",
         expect_slope=expect("ec_encode_rs_k8m3_device_GBps"))
     gbps = data_bytes / slope / 1e9
     cpu_gbps = _cpu_baseline_gbps(mat)
@@ -195,7 +220,7 @@ def main() -> None:
             min_traffic_bytes=data_bytes * (K + e) // K,
             time_budget=BUDGETS[f"decode_e{e}"][0], stable_n=6,
             extended_budget=BUDGETS[f"decode_e{e}"][1],
-            deadline=_deadline(),
+            deadline=_deadline(), label=f"decode_e{e}",
             expect_slope=expect(f"decode_e{e}_GBps"))
         dgbps = data_bytes / dslope / 1e9
         dec_fields = {
@@ -224,6 +249,12 @@ def main() -> None:
         any_contended = any_contended or scrub_contended
     except Exception as exc:  # a scrub-bench fault must still land
         emit("scrub_verify_GBps", {"error": repr(exc)})
+
+    try:
+        mc_contended = _bench_multichip(expect, clean_metrics)
+        any_contended = any_contended or mc_contended
+    except Exception as exc:  # the mesh row must still land a line
+        emit("multichip_encode_GBps", {"error": repr(exc)})
 
     if any_contended:
         # independent chip-health probe (different program, same
@@ -275,6 +306,12 @@ def _combined(any_contended: bool) -> dict:
                    "error"):
             if k2 in scrub:
                 out["scrub_verify_" + k2] = scrub[k2]
+    mc = _RESULTS.get("multichip_encode_GBps")
+    if mc:
+        for k2 in ("value", "n_devices", "spread_pct", "samples",
+                   "contended", "skipped", "error"):
+            if k2 in mc:
+                out["multichip_encode_" + k2] = mc[k2]
     probe = _RESULTS.get("xla_probe_GBps")
     if probe:
         out["xla_probe_GBps"] = probe["value"]
@@ -344,6 +381,7 @@ def _bench_clay_decode2(expect, clean_metrics: dict) -> bool:
             min_traffic_bytes=in_bytes + out_bytes,
             time_budget=budget, stable_n=4,
             extended_budget=ext, deadline=_deadline(),
+            label=f"clay_decode2_{name}",
             expect_slope=expect(f"clay_decode2_{name}_GBps",
                                 object_bytes))
         gbps = object_bytes / slope / 1e9
@@ -372,6 +410,102 @@ def _bench_clay_decode2(expect, clean_metrics: dict) -> bool:
         fields["contended"] = True
     emit("clay_decode2_GBps", fields)
     return rows[winner]["contended"]
+
+
+#: multichip stripe-batch geometry: chunk bytes per stripe, and the
+#: logical batch bytes per iteration (smaller on CPU hosts — the
+#: virtual 8-device mesh is a wiring check, not a bandwidth probe)
+MULTICHIP_CHUNK = 1 << 18
+
+
+def _multichip_batch_bytes() -> int:
+    import jax
+    return (8 << 20) if jax.default_backend() == "cpu" else (64 << 20)
+
+
+def _bench_multichip(expect, clean_metrics: dict) -> bool:
+    """k=8,m=3 encode sharded over ALL local devices — the exact
+    distributed step the engine's mesh seam runs
+    (parallel/sharded_codec.make_encode_step, place=False, the
+    StripeBatcher._flush_mesh program): the MULTICHIP harness finally
+    measures the mesh instead of dry-running it. GB/s counts logical
+    data bytes consumed per iteration (parity is computed with zero
+    communication; the psum'd integrity stat rides along). On a
+    single-device host the metric line still lands, marked skipped —
+    a driver parsing the stream never sees a hole. Returns whether
+    the row sampled contended."""
+    import jax
+    import jax.numpy as jnp
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        emit("multichip_encode_GBps", {
+            "skipped": f"single device (n_devices={n_dev})",
+            "n_devices": n_dev})
+        return False
+    from ceph_tpu.bench.measure import stable_best_slope
+    from ceph_tpu.ops import gf256
+    from ceph_tpu.parallel import mesh as mesh_mod
+    from ceph_tpu.parallel import sharded_codec
+
+    mesh = mesh_mod.make_mesh(n_dev)
+    n_stripe, n_shard = mesh.shape["stripe"], mesh.shape["shard"]
+    mat = gf256.rs_matrix_isa(K, M)
+    cs = MULTICHIP_CHUNK
+    s = max(_multichip_batch_bytes() // (K * cs), n_stripe)
+    s = -(-s // n_stripe) * n_stripe
+    step = sharded_codec.make_encode_step(mesh, mat, place=False)
+    rng = np.random.default_rng(11)
+    # bit-exactness gate vs the host oracle (through the accounted
+    # entry, so the metric line's telemetry carries a mesh dispatch)
+    small = rng.integers(0, 256, size=(n_stripe, K, n_shard * 128),
+                         dtype=np.uint8)
+    chunks, _csum = step(sharded_codec.shard_stripe_batch(mesh, small))
+    got = np.asarray(chunks)
+    for i in range(n_stripe):
+        assert np.array_equal(
+            got[i, K:], gf256.gf_matvec_chunks(mat, small[i])), \
+            "mesh encode is not bit-exact vs CPU reference"
+        assert np.array_equal(got[i, :K], small[i])
+
+    data = rng.integers(0, 256, size=(s, K, cs), dtype=np.uint8)
+    dd = sharded_codec.shard_stripe_batch(mesh, data)
+    # the loop runs the UNinstrumented jitted step: the telemetry
+    # wrapper's side effects would fire at trace time, not per call
+    inner = getattr(step, "__wrapped__", step)
+
+    def mstep(d):
+        chunks, csum = inner(d)
+        # fold both outputs back in: a real data dependency between
+        # iterations, nothing dead-code-eliminated
+        fold = (csum[0] & jnp.uint32(0xFF)).astype(jnp.uint8) ^ \
+            chunks[0, 0, 0]
+        return d.at[0, 0, 0].set(fold)
+
+    data_bytes = s * K * cs
+    budget, ext = BUDGETS["multichip_encode"]
+    slope, spread, samples, contended = stable_best_slope(
+        mstep, dd, counts=(3, 13),
+        min_traffic_bytes=data_bytes * (K + M) // K // n_dev,
+        time_budget=budget, stable_n=4, extended_budget=ext,
+        deadline=_deadline(), label="multichip_encode",
+        expect_slope=expect("multichip_encode_GBps", data_bytes))
+    gbps = data_bytes / slope / 1e9
+    fields = {
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "n_devices": n_dev,
+        "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+        "batch_bytes": data_bytes,
+        "spread_pct": spread,
+        "samples": samples,
+    }
+    if contended:
+        fields["contended"] = True
+    else:
+        clean_metrics["multichip_encode_GBps"] = round(gbps, 1)
+    emit("multichip_encode_GBps", fields)
+    return contended
 
 
 #: scrub_verify batch geometry: objects per launch x shard bytes —
@@ -422,7 +556,7 @@ def _bench_scrub_verify(expect, clean_metrics: dict) -> bool:
         # traffic: the batch in + bitmap/crc out (out is negligible)
         min_traffic_bytes=verified,
         time_budget=budget, stable_n=4, extended_budget=ext,
-        deadline=_deadline(),
+        deadline=_deadline(), label="scrub_verify",
         expect_slope=expect("scrub_verify_GBps", verified))
     gbps = verified / slope / 1e9
     fields = {

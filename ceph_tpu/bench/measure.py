@@ -135,6 +135,7 @@ def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
                       contended_factor: float = 3.0,
                       extended_budget: float = 480.0,
                       deadline: float | None = None,
+                      label: str | None = None,
                       ) -> tuple[float, float, int, bool]:
     """Adaptive best-slope estimator for a SHARED chip.
 
@@ -168,6 +169,14 @@ def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
     with every result lost).
 
     Returns (best_slope_seconds, spread_pct, n_samples, contended):
+    ``label`` (round-9 warmup-kill accounting): names this metric's
+    warmup compile in device telemetry as ``bench[label]``. With the
+    persistent compilation cache enabled the signature lands in the
+    cross-process ledger, so a LATER bench invocation's warmup counts
+    a compile_cache_hit and records its (much smaller) warm wall time
+    next to the cold one — the proof the ~35 s/metric tunnel compiles
+    are paid once per machine, not once per round.
+
     spread_pct is the relative spread of the plateau samples around
     their median — the run-to-run reproducibility figure BASELINE.md
     documents.
@@ -186,7 +195,15 @@ def stable_best_slope(step_fn, x0, *, min_traffic_bytes: int,
         return int(jnp.sum(leaf.reshape(-1)[::4096]
                            .astype(jnp.uint32)))
 
+    t_warm = time.perf_counter()
     force(loop(x0, 2))                   # warmup / compile
+    if label is not None:
+        try:
+            from ceph_tpu.utils.device_telemetry import telemetry
+            telemetry().note_compile(f"bench[{label}]",
+                                     time.perf_counter() - t_warm)
+        except Exception:
+            pass                         # accounting never costs data
     min_slope = min_traffic_bytes / (HBM_CEILING_GBPS * 1e9)
     t_start = time.perf_counter()
     hard_deadline = t_start + time_budget + (

@@ -16,6 +16,7 @@ stores these in xattrs of the first object).
 from __future__ import annotations
 
 import json
+import uuid
 from dataclasses import dataclass
 
 
@@ -87,7 +88,7 @@ class StripedObject:
         self.snapid = snapid
         existing = self._read_meta()
         if existing is not None:
-            self.layout, self.size = existing
+            self.layout, self.size, self.tag = existing
             if layout is not None and layout != self.layout:
                 raise ValueError(
                     f"{soid}: layout mismatch with stored layout")
@@ -95,6 +96,14 @@ class StripedObject:
             self.layout = layout or FileLayout()
             self.layout.validate()
             self.size = 0
+            #: per-write-generation tag (rgw_gc chain-tag role): a
+            #: fresh stream mints one on first write; it is stamped
+            #: into the meta AND every piece's gc_tag xattr, so the
+            #: deferred-GC reaper can tell THIS generation's pieces
+            #: from a concurrent re-upload's (services/rgw.py
+            #: gc_process). None until the first write; legacy
+            #: streams (written before tagging) stay None.
+            self.tag = None
 
     # -- meta ----------------------------------------------------------
     def _meta_oid(self) -> str:
@@ -107,14 +116,19 @@ class StripedObject:
         except Exception:
             return None
         d = json.loads(raw)
-        return (FileLayout(d["su"], d["sc"], d["os"]), d["size"])
+        return (FileLayout(d["su"], d["sc"], d["os"]), d["size"],
+                d.get("tag"))
 
     def _write_meta(self) -> None:
-        self.io.write_full(self._meta_oid(), json.dumps({
+        meta = {
             "su": self.layout.stripe_unit,
             "sc": self.layout.stripe_count,
             "os": self.layout.object_size,
-            "size": self.size}).encode(), snapc=self.snapc)
+            "size": self.size}
+        if self.tag is not None:
+            meta["tag"] = self.tag
+        self.io.write_full(self._meta_oid(), json.dumps(meta).encode(),
+                           snapc=self.snapc)
 
     def _piece(self, objectno: int) -> str:
         return f"{self.soid}.{objectno:016x}"
@@ -124,16 +138,25 @@ class StripedObject:
         stream since this one opened)."""
         existing = self._read_meta()
         if existing is not None:
-            self.layout, self.size = existing
+            self.layout, self.size, self.tag = existing
 
     # -- I/O -----------------------------------------------------------
     def write(self, data: bytes, offset: int = 0) -> None:
+        if self.tag is None:
+            self.tag = uuid.uuid4().hex[:16]
         pos = 0
         for objectno, obj_off, n in file_to_extents(
                 self.layout, offset, len(data)):
             oid = self._piece(objectno)
             self.io.write(oid, data[pos:pos + n], offset=obj_off,
                           snapc=self.snapc)
+            try:
+                # generation stamp for the gc reaper; best-effort (an
+                # untagged piece is merely unreapable by a TAGGED
+                # enrollment — safe side)
+                self.io.setxattr(oid, "gc_tag", self.tag.encode())
+            except Exception:
+                pass
             if self.cache is not None:
                 # write-through: invalidate AFTER the write lands —
                 # invalidating before would let a concurrent reader

@@ -46,6 +46,10 @@ _PAGE = """<!doctype html>
 <h3>device</h3><p>{device}</p>
 <table><tr><th>calibration</th><th>winner</th><th>dense_s</th>
 <th>sparse_s</th></tr>{device_rows}</table>
+<h3>engine pipeline</h3>
+<table><tr><th>in-flight depth &ge;2 launches</th>
+<th>overlap &ge;50% batches</th><th>mesh dispatches</th>
+<th>compile cache hits</th></tr>{pipeline_row}</table>
 <h3>deep scrub</h3>
 <table><tr><th>batches</th><th>bytes verified</th><th>mismatches</th>
 <th>repaired shards</th><th>host fallbacks</th></tr>{scrub_row}</table>
@@ -133,6 +137,16 @@ class Module(MgrModule):
             f"<td>{sc['scrub_mismatch_stripes']}</td>"
             f"<td>{sc['scrub_repaired_shards']}</td>"
             f"<td>{sc['scrub_host_fallbacks']}</td></tr>")
+        counters = tel.snapshot()["counters"]
+        depth = counters.get("engine_inflight_depth", [])
+        overlap = counters.get("engine_overlap_pct", [])
+        # histogram bucket b holds [2^(b-1), 2^b): depth >= 2 lives in
+        # buckets[2:], overlap >= 50% in buckets[7:] (64..)
+        pipeline_row = (
+            f"<tr><td>{sum(depth[2:])}</td>"
+            f"<td>{sum(overlap[7:])}</td>"
+            f"<td>{counters.get('mesh_dispatches', 0)}</td>"
+            f"<td>{counters.get('compile_cache_hits', 0)}</td></tr>")
         return _PAGE.format(
             health=html.escape(health),
             hclass="ok" if health.startswith("HEALTH_OK") else "warn",
@@ -146,6 +160,7 @@ class Module(MgrModule):
             device=html.escape(json.dumps(tel.snapshot_brief())),
             device_rows=device_rows,
             scrub_row=scrub_row,
+            pipeline_row=pipeline_row,
         ).encode()
 
     # -- server --------------------------------------------------------

@@ -95,23 +95,48 @@ class _MatrixCache:
 
 _matrix_cache = _MatrixCache()
 
+#: donating twin of the bit-sliced entry (same semantics as
+#: gf_pallas._matvec_padded_donated): the input buffer is released to
+#: XLA when matvec_device owns it, so steady-state encode reuses the
+#: block instead of allocating per launch. Parity [m, N] is smaller
+#: than data [k, N], so XLA cannot alias it INTO an output and warns
+#: "not usable" — the win is the freed block covering the 8x
+#: bit-plane intermediates, so the aliasing warning is suppressed.
+import warnings as _warnings  # noqa: E402
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+_bitsliced_matvec_device_donated = (
+    jax.jit(_bitsliced_matvec_device.__wrapped__, donate_argnums=(1,))
+    if HAVE_JAX and hasattr(_bitsliced_matvec_device, "__wrapped__")
+    else _bitsliced_matvec_device)
+
 
 def matvec_device(mat: np.ndarray, data) -> "jax.Array":
-    """Device-in/device-out encode: data may be a jax array already in HBM."""
+    """Device-in/device-out encode: data may be a jax array already in HBM.
+
+    A HOST input (numpy/bytes) is uploaded by this call, which then
+    owns the device buffer and donates it to the kernel; a live jax
+    array stays the caller's — it is never donated."""
     bmat = _matrix_cache.get(np.asarray(mat, dtype=np.uint8))
+    owned = not isinstance(data, jax.Array)
     data = jnp.asarray(data, dtype=jnp.uint8)
     from ceph_tpu.ops.jax_util import tracing_active
     if tracing_active():
         # under an outer jit the call inlines: compile accounting
-        # belongs to the outer program, not this entry
+        # belongs to the outer program, not this entry (and donation
+        # is meaningless on a traced value)
         return _bitsliced_matvec_device(bmat, data)
     from ceph_tpu.utils.device_telemetry import telemetry
+    fn = _bitsliced_matvec_device_donated if owned \
+        else _bitsliced_matvec_device
     # the jit specializes on shapes only (bmat is a traced operand),
     # so the signature is exactly (m, k, N)
     return telemetry().timed_call(
         f"gf_jax[{bmat.shape[0] // 8}x{bmat.shape[1] // 8}]"
-        f"N{data.shape[1]}",
-        _bitsliced_matvec_device, bmat, data)
+        f"N{data.shape[1]}" + ("d" if owned else ""),
+        fn, bmat, data)
 
 
 #: smallest jit-specialization bucket for the host entry (bytes of N)

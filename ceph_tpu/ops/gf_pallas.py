@@ -99,9 +99,9 @@ def _gf_matvec_kernel(bmat_ref, data_ref, out_ref, *,
         out_ref[:, q * t:(q + 1) * t] = pb[q * m_out:(q + 1) * m_out, :]
 
 
-@functools.partial(jax.jit, static_argnames=("k", "m_out", "g", "tile"))
-def _matvec_padded(bmat: jax.Array, data: jax.Array,
-                   k: int, m_out: int, g: int, tile: int) -> jax.Array:
+def _matvec_padded_impl(bmat: jax.Array, data: jax.Array,
+                        k: int, m_out: int, g: int,
+                        tile: int) -> jax.Array:
     n = data.shape[1]
     block = g * tile
     grid = (n // block,)
@@ -119,6 +119,27 @@ def _matvec_padded(bmat: jax.Array, data: jax.Array,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m_out, n), jnp.uint8),
     )(bmat, data)
+
+
+_matvec_padded = jax.jit(
+    _matvec_padded_impl, static_argnames=("k", "m_out", "g", "tile"))
+
+#: donating variant: the data buffer's HBM is handed to XLA for reuse,
+#: so steady-state encode stops allocating a fresh input block per
+#: launch. Used ONLY when matvec_device owns the buffer (host input,
+#: or a fresh pad copy) — a caller-retained jax array must never be
+#: invalidated under its owner. Parity [m, N] cannot alias the larger
+#: [k, N] input as an output, so XLA's "not usable" aliasing warning
+#: is suppressed (the win is the freed block covering the in-VMEM/HBM
+#: intermediates, not output aliasing).
+import warnings as _warnings  # noqa: E402
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+_matvec_padded_donated = jax.jit(
+    _matvec_padded_impl, static_argnames=("k", "m_out", "g", "tile"),
+    donate_argnums=(1,))
 
 
 def _tracing() -> bool:
@@ -172,6 +193,10 @@ def matvec_device(mat: np.ndarray, data, tile: int = DEFAULT_TILE):
     m_out, k = mat.shape
     g = _fold(k)
     bmat = _perm_cache.get(mat, g)
+    # we own (and may donate) the device buffer unless the CALLER
+    # handed us a live jax array — jnp.asarray is a no-op then, and
+    # donating it would invalidate the caller's copy
+    owned = not isinstance(data, jax.Array)
     data = jnp.asarray(data, dtype=jnp.uint8)
     n = data.shape[1]
     t = min(tile // g, max(128, _round_up(-(-n // g), 128)))
@@ -182,15 +207,19 @@ def matvec_device(mat: np.ndarray, data, tile: int = DEFAULT_TILE):
     pad = nb - n
     if pad:
         data = jnp.pad(data, ((0, 0), (0, pad)))
+        owned = True               # the pad copy is ours to donate
     if _tracing():
         # under an outer jit the call inlines into the caller's trace:
         # timing/cache introspection would account the OUTER compile
+        # (and donation is meaningless on a traced value)
         out = _matvec_padded(bmat, data, k, m_out, g, t)
     else:
         from ceph_tpu.utils.device_telemetry import telemetry
+        fn = _matvec_padded_donated if owned else _matvec_padded
         out = telemetry().timed_call(
-            f"gf_pallas[{m_out}x{k}]g{g}t{t}N{nb}",
-            _matvec_padded, bmat, data, k, m_out, g, t)
+            f"gf_pallas[{m_out}x{k}]g{g}t{t}N{nb}"
+            + ("d" if owned else ""),
+            fn, bmat, data, k, m_out, g, t)
     return out[:, :n] if pad else out
 
 

@@ -37,6 +37,29 @@ therefore adds no latency (a lone op flushes immediately) and a busy
 one amortizes dispatch over the whole backlog. A size cap
 (``flush_bytes``) bounds the device working set.
 
+Launch pipeline (the round-9 tentpole): encode flushes exploit JAX
+async dispatch — a flush LAUNCHES its device program and parks the
+``finalize`` (download) on a bounded in-flight deque instead of
+blocking. Up to ``window`` (default 3, ``CEPH_TPU_ENGINE_WINDOW``)
+batches stay in flight: while batch N computes on device, batch N+1
+stages/uploads and batch N-1's parity downloads. Retirement is
+strictly in deque order, so continuations still dispatch in
+submission order and every ordering point — ``stage_barrier``,
+``run_sync``, ``stop``, a launch failure — drains the whole window
+first; the pre-pipeline per-PG commit-order invariant is preserved
+exactly. ``window=1`` degenerates to the old serial engine (launch,
+then immediately download), which is what the overlap tests compare
+against.
+
+Multi-chip routing: when a process default mesh is configured
+(parallel/mesh.py), flushes whose batch size reaches
+``mesh_flush_bytes`` (default 1 MiB, ``CEPH_TPU_MESH_FLUSH_BYTES``)
+run the sharded encode step across all mesh devices
+(parallel/sharded_codec.make_encode_step); smaller flushes stay on
+the single-chip path, where one kernel launch beats paying the
+collective/placement overhead (the dense-vs-sharded crossover,
+BASELINE.md "Pipelined engine").
+
 Failure containment: a device encode error fails over to the op
 continuations with the error; ECBackend re-encodes those ops on its
 host codec (the daemon must never wedge on an accelerator fault).
@@ -70,12 +93,31 @@ class DeviceEncodeEngine:
 
     def __init__(self, dispatch: Callable[[object, Callable], None],
                  flush_bytes: int = 64 << 20,
-                 counters=None) -> None:
+                 counters=None, window: int | None = None,
+                 mesh_flush_bytes: int | None = None) -> None:
+        import os
         #: dispatch(key, fn): run fn on the per-key FIFO executor (the
         #: OSD passes op_wq.enqueue, keyed by pgid)
         self._dispatch = dispatch
         self._flush_bytes = flush_bytes
         self._counters = counters
+        #: max launched-not-retired encode batches (the pipeline
+        #: depth); 1 = the old serial engine
+        if window is None:
+            window = int(os.environ.get("CEPH_TPU_ENGINE_WINDOW", 3))
+        self._window = max(1, window)
+        #: batches at least this big route through the default mesh's
+        #: sharded encode step (when one is configured); smaller ones
+        #: stay single-chip
+        if mesh_flush_bytes is None:
+            mesh_flush_bytes = int(os.environ.get(
+                "CEPH_TPU_MESH_FLUSH_BYTES", 1 << 20))
+        self._mesh_flush_bytes = mesh_flush_bytes
+        # warmup-kill: per-signature device programs persist across
+        # processes (best-effort; a disabled/failed cache only costs
+        # recompiles, never correctness)
+        from ceph_tpu.utils import compile_cache
+        compile_cache.enable()
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._running = True
         #: introspection (asok / tests): launches, ops, bytes, and the
@@ -85,6 +127,11 @@ class DeviceEncodeEngine:
                       "decode_flushes": 0, "decode_ops": 0,
                       "decode_bytes": 0, "max_decode_batch_ops": 0,
                       "decode_errors": 0, "device_fused_fallbacks": 0,
+                      # launch-pipeline occupancy: the deepest the
+                      # in-flight window ever got (>= 2 proves
+                      # upload/compute/download overlapped) and how
+                      # many flushes routed through the mesh
+                      "max_inflight_depth": 0, "mesh_flushes": 0,
                       # auxiliary device work run via run_sync (deep
                       # scrub verify launches)
                       "aux_runs": 0,
@@ -184,12 +231,14 @@ class DeviceEncodeEngine:
 
     # -- engine thread ------------------------------------------------
     def _run(self) -> None:
-        #: one-deep launch pipeline: (items, finalize) of the batch
-        #: whose device program is queued but not yet downloaded —
-        #: batch N+1 stages and LAUNCHES while N's results stream
-        #: back (double-buffering; on a high-RTT link this overlaps
-        #: upload(N+1) with compute+download(N))
-        self._inflight = None
+        import collections
+        #: launch pipeline: deque of (items, finalize, kspans,
+        #: launch_t) for batches whose device programs are queued but
+        #: not yet downloaded — up to ``window`` deep. While batch N
+        #: computes, batch N+1 concatenates/uploads and batch N-1
+        #: downloads; retirement is strictly FIFO so continuation
+        #: order equals submission order.
+        self._inflight = collections.deque()
         while True:
             item = self._q.get()
             if item is None:
@@ -271,25 +320,34 @@ class DeviceEncodeEngine:
                     self._drain_inflight()
                     pending, dec_pending, nbytes = {}, {}, 0
                     break
-            if not self._running:
-                return
+            # shutdown is the None sentinel, NOT self._running: ops
+            # staged before stop() must still flush (checking the
+            # flag here raced the idle drain and dropped them)
 
     def _flush(self, pending: dict) -> None:
         import time as _time
         from ceph_tpu.parallel import mesh as mesh_mod
         t0 = _time.perf_counter()
-        drained = 0.0                 # _drain_inflight self-accounts
+        drained = 0.0                 # retirement self-accounts
         for codec, sinfo, items in pending.values():
-            # a configured default mesh routes the flush through the
+            nbytes = sum(d.nbytes for _k, d, _c, _s, _t in items)
+            # a configured default mesh takes the flush through the
             # multi-chip encode step (pod deployments; dryrun/tests)
+            # — but only once the batch is big enough to amortize the
+            # collective/placement overhead; small flushes stay on
+            # the single-chip kernel (the dense-vs-sharded threshold,
+            # BASELINE.md "Pipelined engine")
+            mesh = mesh_mod.get_default_mesh()
+            if mesh is not None and nbytes < self._mesh_flush_bytes:
+                mesh = None
             batcher = ec_util.StripeBatcher(
-                sinfo, codec, mesh=mesh_mod.get_default_mesh(),
+                sinfo, codec, mesh=mesh,
                 on_fallback=self._note_fused_fallback)
-            nbytes = 0
             for i, (_key, data, _cont, _span, _ts) in \
                     enumerate(items):
                 batcher.append(i, data)
-                nbytes += data.nbytes
+            if mesh is not None:
+                self.stats["mesh_flushes"] += 1
             try:
                 finalize = batcher.flush_async(
                     with_crcs=ec_util.fuse_crc_policy(codec))
@@ -306,8 +364,9 @@ class DeviceEncodeEngine:
                     span.finish()
                     self._dispatch(key, _bind(cont, None, None, exc))
                 continue
-            # batch launched (async): NOW harvest the previous one —
-            # its download overlaps this batch's upload/compute
+            # batch launched (async): park it on the in-flight deque
+            # — its compute+download overlaps the NEXT batch's
+            # staging/upload; only the window bound forces a harvest
             if _TP_FLUSH.enabled:
                 _TP_FLUSH(len(items), nbytes)
             launched = _time.monotonic()
@@ -321,24 +380,39 @@ class DeviceEncodeEngine:
                     span.event(f"batch_flush ops={len(items)} "
                                f"bytes={nbytes}")
                 kspans.append(span.child("kernel_dispatch"))
-            drained += self._drain_inflight()
-            self._inflight = (items, finalize, kspans)
+            self._inflight.append(
+                (items, finalize, kspans, _time.perf_counter()))
+            depth = len(self._inflight)
+            self.stats["max_inflight_depth"] = max(
+                self.stats["max_inflight_depth"], depth)
+            tel.note_inflight_depth(depth)
+            while len(self._inflight) >= self._window:
+                drained += self._retire_oldest()
         if pending:
-            # drain time self-accounts inside _drain_inflight; only
+            # retirement time self-accounts in _retire_oldest; only
             # the launch-side time is added here (no double count)
             self.stats["busy_s"] += \
                 _time.perf_counter() - t0 - drained
         pending.clear()
 
     def _drain_inflight(self) -> float:
-        """Harvest the in-flight batch; returns seconds spent (also
-        accumulated into busy_s here)."""
+        """Retire EVERY in-flight batch in launch order (ordering
+        points: barrier, run_sync, stop, launch failure); returns
+        seconds spent (also accumulated into busy_s)."""
+        dt = 0.0
+        while self._inflight:
+            dt += self._retire_oldest()
+        return dt
+
+    def _retire_oldest(self) -> float:
+        """Harvest the OLDEST in-flight batch (download + dispatch its
+        continuations); returns seconds spent (also accumulated into
+        busy_s here)."""
         import time as _time
-        if self._inflight is None:
+        if not self._inflight:
             return 0.0
         t0 = _time.perf_counter()
-        items, finalize, kspans = self._inflight
-        self._inflight = None
+        items, finalize, kspans, launch_t = self._inflight.popleft()
         try:
             results = finalize()
         except Exception as exc:
@@ -372,6 +446,11 @@ class DeviceEncodeEngine:
             _telemetry().note_encode_flush(
                 len(items), nbytes, _time.perf_counter() - t0)
         dt = _time.perf_counter() - t0
+        # overlap: launch->harvest-begin passed while the engine did
+        # OTHER work (younger batches staged/launched); the remainder
+        # of the lifetime is this harvest's blocking download
+        _telemetry().note_overlap(t0 - launch_t,
+                                  _time.perf_counter() - launch_t)
         self.stats["busy_s"] += dt
         return dt
 

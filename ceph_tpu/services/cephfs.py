@@ -269,18 +269,33 @@ class CephFS:
                 inode = dict(self._read_inode(rec["parent"]))
                 snaps = dict(inode.get("snaps", {}))
                 if snaps.get(rec["name"]) != rec["ino"]:
+                    # the live path writes with the realm INCLUDING
+                    # the new snapid, so the pre-snapshot dir state is
+                    # COW-preserved under it; replay must match, or a
+                    # crash mid-mksnap loses that clone (and the COW
+                    # owed to other governing realm snaps)
+                    realm = self._realm_for_ino(rec["parent"]) or []
                     snaps[rec["name"]] = rec["ino"]
                     inode["snaps"] = snaps
-                    self._write_inode(rec["parent"], inode)
+                    self._write_inode(
+                        rec["parent"], inode,
+                        snapc=self._realm_snapc(
+                            sorted(set(realm) | {rec["ino"]})))
             self._step(addsnap)
         elif op == "rmsnap":
             def dropsnap():
                 inode = dict(self._read_inode(rec["parent"]))
                 snaps = dict(inode.get("snaps", {}))
                 if rec["name"] in snaps:
+                    # live rmsnap writes under the REMAINING realm so
+                    # older snapshots keep their COW; replay matches
+                    realm = self._realm_for_ino(rec["parent"]) or []
                     del snaps[rec["name"]]
                     inode["snaps"] = snaps
-                    self._write_inode(rec["parent"], inode)
+                    self._write_inode(
+                        rec["parent"], inode,
+                        snapc=self._realm_snapc(
+                            sorted(set(realm) - {rec["ino"]})))
             self._step(dropsnap)
             self._step(lambda: self.io.selfmanaged_snap_remove(
                 rec["ino"]))
@@ -290,6 +305,38 @@ class CephFS:
                                               rec["ino"]))
             self._step(lambda: self._dir_unlink(rec["old_parent"],
                                                 rec["old_name"]))
+
+    def _realm_for_ino(self, target: int) -> list[int] | None:
+        """Rebuild the governing realm for ``target`` by walking the
+        tree from the root (journal replay records inos, not paths):
+        the union of every directory's snapids on the root->target
+        path, INCLUDING the target's own — exactly what _resolve2
+        collects during a live descent. Returns None when the ino is
+        unreachable (caller degrades to no SnapContext, the pre-fix
+        behavior)."""
+        def walk(ino: int, realm: frozenset,
+                 seen: set) -> frozenset | None:
+            try:
+                inode = self._read_inode(ino)
+            except FSError:
+                return None
+            realm = realm | frozenset(
+                inode.get("snaps", {}).values())
+            if ino == target:
+                return realm
+            if inode.get("type") != "dir":
+                return None
+            for child in inode.get("entries", {}).values():
+                if child in seen:
+                    continue
+                seen.add(child)
+                got = walk(child, realm, seen)
+                if got is not None:
+                    return got
+            return None
+
+        got = walk(ROOT_INO, frozenset(), {ROOT_INO})
+        return sorted(got) if got is not None else None
 
     # -- inode plumbing ------------------------------------------------
     def _read_inode(self, ino: int, snap: int = 0) -> dict:

@@ -432,11 +432,17 @@ class RGWGateway:
     #: (grace for the inline delete still running)
     GC_DEFER = 2.0
 
-    def _gc_enroll(self, soid: str) -> None:
+    def _gc_enroll(self, soid: str, tag: str | None = None) -> None:
+        """Record the pending delete WITH the doomed generation's tag
+        (read from the stripe meta): the reaper only touches pieces
+        carrying this tag, so a crash-orphaned enrollment can never
+        eat a concurrently re-uploaded object's live pieces (the
+        reference keys gc chains to per-write tail tags the same way,
+        rgw_gc)."""
         import time as _t
         try:
-            self.io.omap_set(self.GC_OID,
-                             {soid: str(_t.time()).encode()})
+            self.io.omap_set(self.GC_OID, {soid: json.dumps(
+                {"t": _t.time(), "tag": tag}).encode()})
         except Exception:
             pass                  # GC is belt-and-braces; the inline
             # delete still runs
@@ -448,37 +454,67 @@ class RGWGateway:
             pass
 
     def _remove_striped(self, soid: str) -> None:
-        """Crash-safe striped-object removal: enroll -> inline remove
-        -> de-enroll. Tails orphaned by a crash between the steps are
-        reaped by the gc pass."""
-        self._gc_enroll(soid)
-        StripedObject(self.io, soid).remove()
+        """Crash-safe striped-object removal: enroll (tagged) ->
+        inline remove -> de-enroll. Tails orphaned by a crash between
+        the steps are reaped by the gc pass."""
+        so = StripedObject(self.io, soid)
+        self._gc_enroll(soid, so.tag)
+        so.remove()
         self._gc_done(soid)
+
+    def _gc_pending(self) -> dict[str, tuple[float, str | None]]:
+        """{soid: (stamp, generation tag)} — tag None for legacy
+        (pre-tagging) enrollments, which keep the old prefix-reap."""
+        from ceph_tpu.client.rados import RadosError
+        try:
+            raw = self.io.omap_get(self.GC_OID)
+        except RadosError:
+            return {}
+        out: dict[str, tuple[float, str | None]] = {}
+        for k, v in raw.items():
+            try:
+                ent = json.loads(v)
+                out[k] = (float(ent["t"]), ent.get("tag"))
+            except Exception:
+                try:
+                    out[k] = (float(v), None)   # legacy plain stamp
+                except Exception:
+                    pass
+        return out
 
     def gc_list(self) -> dict[str, float]:
         """Pending gc enrollments {soid: stamp} (radosgw-admin gc
         list role)."""
-        from ceph_tpu.client.rados import RadosError
+        return {soid: stamp
+                for soid, (stamp, _tag) in self._gc_pending().items()}
+
+    def _gc_tag_matches(self, name: str, soid: str, tag: str) -> bool:
+        """Whether piece/meta ``name`` belongs to the enrolled
+        generation ``tag``. Unattributable objects (missing tag, read
+        fault) are NOT reaped — a leaked tail is recoverable, a
+        deleted live piece is not."""
         try:
-            return {k: float(v) for k, v in
-                    self.io.omap_get(self.GC_OID).items()}
-        except RadosError:
-            return {}
+            if name == soid + StripedObject.META_SUFFIX:
+                return json.loads(self.io.read(name)).get("tag") == tag
+            return self.io.getxattr(name, "gc_tag").decode() == tag
+        except Exception:
+            return False
 
     def gc_process(self, grace: float | None = None) -> dict:
-        """Reap aged enrollments: remove every surviving piece of the
-        enrolled stripe (meta + data pieces found by prefix listing),
-        then drop the entry. Returns {"entries": n, "objects": n}
+        """Reap aged enrollments: remove every surviving piece OF THE
+        ENROLLED GENERATION (meta + data pieces found by prefix
+        listing, then filtered by generation tag), then drop the
+        entry. Returns {"entries": n, "objects": n}
         (RGWGC::process, src/rgw/rgw_gc.cc:257)."""
         import time as _t
         grace = self.GC_DEFER if grace is None else grace
         now = _t.time()
         stats = {"entries": 0, "objects": 0}
-        pending = self.gc_list()
+        pending = self._gc_pending()
         if not pending:
             return stats
         names = None
-        for soid, stamp in pending.items():
+        for soid, (stamp, tag) in pending.items():
             if now - stamp < grace:
                 continue
             if names is None:       # one listing serves the pass
@@ -488,6 +524,9 @@ class RGWGateway:
                       or (n.startswith(soid + ".")
                           and n[len(soid) + 1:].isalnum())]
             for n in doomed:
+                if tag is not None and \
+                        not self._gc_tag_matches(n, soid, tag):
+                    continue        # another generation's live piece
                 try:
                     self.io.remove(n)
                     stats["objects"] += 1

@@ -71,6 +71,12 @@ class DeviceTelemetry:
                              "(shape leaking into a jit cache)")
         perf.add_time_avg("compile_time",
                           "wall seconds per compilation")
+        perf.add_u64_counter("compile_cache_hits",
+                             "compiles of a signature the persistent "
+                             "XLA cache already held (warm)")
+        perf.add_u64_counter("compile_cache_misses",
+                             "compiles of a first-ever signature "
+                             "(cold; ledger seeded for next process)")
         perf.add_histogram("encode_batch_ops",
                            "ops per stage_encode flush (occupancy)")
         perf.add_histogram("decode_batch_ops",
@@ -103,6 +109,17 @@ class DeviceTelemetry:
                              "clay linearized-transform LRU builds")
         perf.add_u64_counter("mesh_dispatches",
                              "multi-chip sharded-codec step calls")
+        # pipelined engine (osd/device_engine.py): launch-window
+        # accounting — depth proves batches overlap, overlap-pct is
+        # the share of a batch's device lifetime hidden behind other
+        # engine work (100% = the download wait fully overlapped)
+        perf.add_histogram("engine_inflight_depth",
+                           "launched-not-retired batches at each "
+                           "flush launch (window occupancy)")
+        perf.add_histogram("engine_overlap_pct",
+                           "percent of a batch's launch->retire "
+                           "lifetime spent overlapped with other "
+                           "engine work")
         # deep-scrub engine (osd/scrub_engine.py): the background-
         # verification pipeline's own accounting
         perf.add_u64_counter("scrub_batches",
@@ -130,9 +147,21 @@ class DeviceTelemetry:
         """One compilation of ``signature`` took ``seconds`` wall.
         The second compile of the same signature counts a recompile —
         the bug-class every pow2-bucketed entry point exists to
-        prevent."""
+        prevent. When the persistent compilation cache is enabled
+        (utils/compile_cache), the signature is checked against the
+        cross-process ledger: a signature a previous process already
+        compiled counts a cache hit (the disk cache served it)."""
         self.perf.inc("compiles")
         self.perf.tinc("compile_time", seconds)
+        try:
+            from ceph_tpu.utils import compile_cache
+            if compile_cache.enabled_dir() is not None:
+                if compile_cache.note_compile(signature, seconds):
+                    self.perf.inc("compile_cache_hits")
+                else:
+                    self.perf.inc("compile_cache_misses")
+        except Exception:
+            pass                   # ledger faults never cost the path
         with self._lock:
             ent = self._compiles.get(signature)
             if ent is None:
@@ -200,6 +229,24 @@ class DeviceTelemetry:
     def note_fused_fallback(self) -> None:
         self.perf.inc("fused_fallbacks")
 
+    def note_inflight_depth(self, depth: int) -> None:
+        """Launch-window occupancy at one flush launch (pipelined
+        engine): depth >= 2 is the proof batches overlap."""
+        self.perf.hinc("engine_inflight_depth", depth)
+
+    def note_overlap(self, overlapped_s: float,
+                     lifetime_s: float) -> None:
+        """One retired batch's overlap: ``overlapped_s`` of its
+        ``lifetime_s`` launch->retire window passed while the engine
+        did other work (staging/launching younger batches) instead of
+        blocking on this one's download."""
+        if lifetime_s <= 0:
+            return
+        pct = int(round(100.0 * max(0.0, min(overlapped_s,
+                                             lifetime_s))
+                        / lifetime_s))
+        self.perf.hinc("engine_overlap_pct", pct)
+
     # -- codec-layer accounting ---------------------------------------
     def note_calibration(self, label: str, signature: str,
                          winner: str, measured: dict) -> None:
@@ -257,10 +304,12 @@ class DeviceTelemetry:
         one readable line)."""
         counters = self.perf.dump()
         brief = {}
-        for key in ("compiles", "recompiles", "bytes_encoded",
+        for key in ("compiles", "recompiles", "compile_cache_hits",
+                    "compile_cache_misses", "bytes_encoded",
                     "bytes_decoded", "fused_fallbacks", "calibrations",
                     "calibrations_sparse_won", "lin_matvec_hits",
-                    "lin_matvec_misses", "scrub_batches",
+                    "lin_matvec_misses", "mesh_dispatches",
+                    "scrub_batches",
                     "scrub_bytes_verified", "scrub_mismatch_stripes",
                     "scrub_repaired_shards", "scrub_host_fallbacks"):
             val = counters.get(key)

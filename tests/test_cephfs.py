@@ -153,6 +153,48 @@ def test_mds_journal_replays_half_done_unlink(cluster):
         io.read(f"inode.{ino}")      # replay removed the orphan
 
 
+def test_mds_journal_replays_half_done_mksnap(cluster):
+    """Regression (cephfs.py _apply_mds_event): replaying a mksnap
+    intent used to rewrite the parent inode with NO SnapContext. The
+    pool-context fallback still COWs, but tags the clone with only
+    the LATEST pool seq — not the governing realm — so once the new
+    snapid is retired, the trimmer reclaims a clone an ANCESTOR
+    snapshot still needs, and the ancestor's frozen view silently
+    picks up later mutations. Replay now rebuilds the parent's realm
+    and passes the live path's snapc (realm + new snapid)."""
+    from ceph_tpu.services.cephfs import CephFS
+    io = cluster._clients[0].open_ioctx("fspool")
+    fs = CephFS(io)
+    fs.mkdir("/p")
+    fs.mkdir("/p/d")
+    fs.create("/p/d/A").write(b"pre-snapshot")
+    ino_d, _ = fs._resolve("/p/d")
+    sp = fs.mksnap("/p", "sp")        # ancestor realm over /p/d
+    # the crash: /p/d's own snapshot s1 — snapid allocated + intent
+    # journaled, nothing applied
+    s1 = io.selfmanaged_snap_create()
+    fs._mds_event("mksnap", parent=ino_d, name="s1", ino=s1)
+    fs2 = CephFS(io)              # failover mount replays the intent
+    assert fs2.lssnap("/p/d") == {"s1": s1}
+    # pre-snapshot dir state is readable through BOTH governing snaps
+    assert fs2.readdir("/p/.snap/sp/d") == ["A"]
+    assert fs2.readdir("/p/d/.snap/s1") == ["A"]
+    # mutate after replay (no new clone: the inode's snapset seq is
+    # already s1, so the replay-time clone is the only copy of {A})
+    fs2.create("/p/d/B").write(b"post")
+    # retire s1; the replayed clone must be tagged with the WHOLE
+    # realm [sp, s1] — tagged [s1] alone (the no-snapc fallback), the
+    # trimmer reclaims it here and sp's view leaks B
+    fs2.rmsnap("/p/d", "s1")
+    for osd in cluster.osds.values():
+        for pg in list(osd.pgs.values()):
+            osd._snap_trim(pg)
+    assert fs2.readdir("/p/.snap/sp/d") == ["A"], \
+        "trim reclaimed the replayed mksnap clone the ancestor " \
+        "snapshot still needed"
+    assert fs2.open("/p/.snap/sp/d/A").read() == b"pre-snapshot"
+
+
 def test_two_client_caps_coherence(cluster):
     """Two concurrent mounts (Capability.h role): exclusive-write /
     shared-read caps serialize file access cluster-wide; a reader

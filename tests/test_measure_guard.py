@@ -98,21 +98,37 @@ def test_last_good_roundtrip(tmp_path, monkeypatch):
 def test_bench_budget_sum_bounded():
     """The r5 failure mode was rc=124: per-metric budgets worst-cased
     to ~1950 s against the driver's 870 s timeout, and the process
-    was killed with every result unprinted. The configured worst case
-    must stay under 700 s (sampling budgets + global deadline; the
-    post-deadline tail is per-metric warmup compiles)."""
+    was killed with every result unprinted. Round-9 re-derivation:
+    sampling is hard-stopped by the global TOTAL_BUDGET deadline, and
+    the only post-deadline tail is warmup compiles — one per BUDGETS
+    metric plus the health probe, each at most COLD_COMPILE_S when
+    the persistent compilation cache is fully cold (warm runs pay
+    ~0). The fully-cold structural worst case must clear the 870 s
+    driver timeout with >= 60 s slack, so an rc=124 needs the
+    physics, not the configuration, to break."""
     import bench
 
     budget_sum = sum(tb + eb for tb, eb in bench.BUDGETS.values())
-    assert budget_sum <= 700, budget_sum
-    assert bench.TOTAL_BUDGET <= 600
     # the global deadline must not be looser than the per-metric sum
-    assert bench.TOTAL_BUDGET <= budget_sum
+    assert bench.TOTAL_BUDGET <= budget_sum, (bench.TOTAL_BUDGET,
+                                              budget_sum)
+    # one warmup per metric + the probe — the model must cover every
+    # stable_best_slope site (BUDGETS gains an entry => this grows)
+    assert bench.N_WARMUP_COMPILES >= len(bench.BUDGETS) + 1
+    worst = bench.TOTAL_BUDGET + \
+        bench.N_WARMUP_COMPILES * bench.COLD_COMPILE_S
+    assert worst <= 870 - 60, (
+        f"fully-cold worst case {worst}s leaves less than 60s slack "
+        "under the 870s driver timeout (the r5 rc=124 class)")
     # the deep-scrub verify metric has its OWN sampling budget (it
     # must not ride free on another metric's share and push the
     # worst case past the driver timeout)
     assert "scrub_verify" in bench.BUDGETS
     tb, eb = bench.BUDGETS["scrub_verify"]
+    assert 0 < tb and tb + eb <= 100, (tb, eb)
+    # the round-9 mesh row is budgeted like every other metric
+    assert "multichip_encode" in bench.BUDGETS
+    tb, eb = bench.BUDGETS["multichip_encode"]
     assert 0 < tb and tb + eb <= 100, (tb, eb)
 
 

@@ -1,0 +1,35 @@
+"""Tier-1 smoke for the MULTICHIP dryrun (round 9).
+
+``__graft_entry__.dryrun_multichip`` is the driver's multi-chip gate:
+it builds the ('stripe' x 'shard') mesh, runs the distributed
+encode/degraded-read/clay-repair collectives, AND (round 9) pushes one
+real stripe batch through the DeviceEncodeEngine's mesh route. It must
+run in a FRESH process (it steers JAX onto the virtual host-platform
+mesh before the backend initializes), so this test execs it as a
+subprocess on 8 host-platform devices — a mesh/engine regression fails
+here in tier-1 instead of burning a TPU round.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_8_host_devices():
+    env = dict(os.environ)
+    # a fresh process: dryrun_multichip sets the host-platform device
+    # count and jax_platforms itself; scrub the test session's values
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); "
+         "print('DRYRUN_OK')"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=480)
+    assert proc.returncode == 0, (
+        f"dryrun_multichip(8) failed rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "DRYRUN_OK" in proc.stdout

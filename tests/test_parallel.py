@@ -109,8 +109,9 @@ def test_batcher_flush_routes_through_mesh(mesh):
 
 def test_engine_uses_default_mesh(mesh):
     """The device engine picks up the process default mesh: flushes
-    run the sharded encode step (multi-chip data plane engaged from
-    the daemon seam)."""
+    AT OR ABOVE the dense-vs-sharded threshold run the sharded encode
+    step (multi-chip data plane engaged from the daemon seam), while
+    smaller flushes stay on the single-chip path — both bit-exact."""
     from ceph_tpu.models import registry as ec_registry
     from ceph_tpu.osd import ec_util
     from ceph_tpu.osd.device_engine import DeviceEncodeEngine
@@ -123,27 +124,43 @@ def test_engine_uses_default_mesh(mesh):
     cs = mesh.shape["shard"] * 64
     si = StripeInfo(stripe_width=4 * cs, chunk_size=cs)
     rng = np.random.default_rng(8)
-    data = rng.integers(0, 256, size=2 * si.stripe_width,
-                        dtype=np.uint8)
-    got = []
-    eng = DeviceEncodeEngine(lambda key, fn: fn())
+    big = rng.integers(0, 256, size=2 * si.stripe_width,
+                       dtype=np.uint8)
+    small = rng.integers(0, 256, size=si.stripe_width,
+                         dtype=np.uint8)
+    got = {}
+    # threshold between the two payloads: the big flush routes
+    # through the mesh, the small one stays dense
+    eng = DeviceEncodeEngine(lambda key, fn: fn(),
+                             mesh_flush_bytes=len(big))
     mesh_mod.set_default_mesh(mesh)
     try:
-        eng.stage_encode("pg", codec, si, data,
-                         lambda s, c, e: got.append((s, e)))
+        eng.stage_encode("pg", codec, si, big,
+                         lambda s, c, e: got.setdefault("big",
+                                                        (s, e)))
         deadline = time.monotonic() + 15
-        while not got and time.monotonic() < deadline:
+        while "big" not in got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.stats["mesh_flushes"] == 1, eng.stats
+        eng.stage_encode("pg", codec, si, small,
+                         lambda s, c, e: got.setdefault("small",
+                                                        (s, e)))
+        deadline = time.monotonic() + 15
+        while "small" not in got and time.monotonic() < deadline:
             time.sleep(0.02)
     finally:
         mesh_mod.set_default_mesh(None)
         eng.stop()
-    assert got and got[0][1] is None
+    assert eng.stats["mesh_flushes"] == 1, \
+        (eng.stats, "sub-threshold flush must stay single-chip")
     host = ec_registry.instance().factory(
         "jerasure", {"plugin": "jerasure", "k": "4", "m": "2",
                      "backend": "numpy"})
-    want = ec_util.encode(si, host, data)
-    for i in range(6):
-        assert np.array_equal(got[0][0][i], want[i])
+    for name, payload in (("big", big), ("small", small)):
+        assert name in got and got[name][1] is None, got
+        want = ec_util.encode(si, host, payload)
+        for i in range(6):
+            assert np.array_equal(got[name][0][i], want[i]), (name, i)
 
 
 def test_distributed_clay_repair(mesh):

@@ -487,3 +487,59 @@ def test_gc_reaps_orphaned_tails_after_crash_mid_delete(setup):
     gw.put_object("gcb", "fine", b"x" * 100)
     gw.delete_object("gcb", "fine")
     assert gw.gc_list() == {}
+
+
+def test_gc_stale_enrollment_spares_reuploaded_object(setup,
+                                                      monkeypatch):
+    """Regression (rgw.py gc_process generation tags): a reaper pass
+    that read its pending set BEFORE a concurrent re-upload of the
+    same key used to reap by untagged name-prefix — deleting the
+    re-uploaded object's LIVE pieces. Each write generation now
+    carries a tag (stripe meta + per-piece gc_tag xattr) recorded in
+    the enrollment, and the reaper only touches matching pieces."""
+    from ceph_tpu.client.striper import StripedObject
+    _, gw, _ = setup
+    gw.create_bucket("gcrace")
+    gw.put_object("gcrace", "obj", os.urandom(2 << 20))
+    soid = "gcrace/obj"
+    old_tag = StripedObject(gw.io, soid).tag
+    assert old_tag, "write generations must be tagged"
+    # the crash-then-reupload interleaving, deterministically:
+    # 1. a delete enrolls generation A and dies before removing
+    #    anything (and before de-enrolling)
+    gw._gc_enroll(soid, old_tag)
+    # 2. the gc pass reads its pending set NOW (stale snapshot) ...
+    stale = {soid: (time.time() - 3600.0, old_tag)}
+    # 3. ... while the key is concurrently re-uploaded: replace
+    #    semantics clear the enrollment and lay generation B's pieces
+    new_payload = os.urandom(2 << 20)
+    gw.put_object("gcrace", "obj", new_payload)
+    new_tag = StripedObject(gw.io, soid).tag
+    assert new_tag and new_tag != old_tag
+    # 4. the reaper resumes with the stale snapshot: nothing of
+    #    generation B may be touched
+    monkeypatch.setattr(gw, "_gc_pending", lambda: stale)
+    stats = gw.gc_process(grace=0)
+    assert stats["entries"] == 1
+    assert stats["objects"] == 0, stats   # no live piece reaped
+    data, _meta = gw.get_object("gcrace", "obj")
+    assert data == new_payload, \
+        "stale gc enrollment reaped the re-uploaded object's pieces"
+    # the guard is generation-keyed, not a blanket no-op: the SAME
+    # stale entry against generation-A pieces still reaps (the
+    # orphan case) — re-enroll and crash a real delete of gen B
+    monkeypatch.undo()
+    orig_remove = StripedObject.remove
+    monkeypatch.setattr(StripedObject, "remove",
+                        lambda self: (_ for _ in ()).throw(
+                            ConnectionError("died mid-delete")))
+    with pytest.raises(ConnectionError):
+        gw.delete_object("gcrace", "obj")
+    monkeypatch.setattr(StripedObject, "remove", orig_remove)
+    assert soid in gw.gc_list()
+    time.sleep(0.01)
+    stats = gw.gc_process(grace=0)
+    assert stats["objects"] > 0, stats    # the orphaned gen-B pieces
+    assert [n for n in gw.io.list_objects()
+            if n.startswith(soid + ".")] == []
+    assert gw.gc_list() == {}
