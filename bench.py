@@ -103,13 +103,28 @@ def _telemetry_snapshot() -> dict:
         return {}
 
 
+def _health_snapshot() -> dict:
+    """Device-side health brief for metric lines (mgr/health.py): a
+    bench row that ran during a recompile storm or a cache-miss storm
+    says so itself. Pure counter reads — no recorder sampling, no
+    cluster, nothing added to the bench budget. Degrades to an
+    all-clear shape so a health fault can never cost a metric line."""
+    try:
+        from ceph_tpu.mgr.health import device_health_brief
+        return device_health_brief()
+    except Exception:
+        return {"status": "HEALTH_OK", "checks": {}}
+
+
 def emit(metric: str, fields: dict) -> None:
     """Print one metric's JSON line NOW (progressive emission) and
     fold it into the final combined record. Every line carries a
-    ``telemetry`` snapshot (see _telemetry_snapshot)."""
+    ``telemetry`` snapshot (see _telemetry_snapshot) and a ``health``
+    brief (see _health_snapshot)."""
     line = {"metric": metric}
     line.update(fields)
     line["telemetry"] = _telemetry_snapshot()
+    line["health"] = _health_snapshot()
     print(json.dumps(line), flush=True)
     _RESULTS[metric] = fields
 
@@ -319,6 +334,7 @@ def _combined(any_contended: bool) -> dict:
         out["contended"] = True
     out["elapsed_s"] = round(time.perf_counter() - _T0, 1)
     out["telemetry"] = _telemetry_snapshot()
+    out["health"] = _health_snapshot()
     return out
 
 

@@ -5,7 +5,8 @@ self-refreshing HTML page plus the JSON endpoints it reads, straight
 from the mgr's cluster view:
 
     GET /             HTML overview (health, OSDs, pools, PGs, balancer)
-    GET /api/health   {"status": ...}
+    GET /api/health   {"status", "checks", "rates", "recorder"} — the
+                      structured health report + flight-recorder rates
     GET /api/status   full mon status JSON
     GET /api/osds     per-OSD up/in table
     GET /api/pools    pool table (type, pg_num, size)
@@ -36,6 +37,10 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h2>ceph_tpu cluster</h2>
 <p class="{hclass}">{health}</p>
+<h3>health checks</h3>
+<table><tr><th>check</th><th>severity</th><th>summary</th></tr>
+{check_rows}</table>
+<p>flight recorder: {recorder} · rates: {rates}</p>
 <h3>osds ({n_up}/{n_osds} up, {n_in} in)</h3>
 <table><tr><th>osd</th><th>up</th><th>in</th></tr>{osd_rows}</table>
 <h3>pools</h3>
@@ -73,7 +78,7 @@ class Module(MgrModule):
         osdmap = self.get_osdmap()
         if path == "/api/health":
             return 200, "application/json", json.dumps(
-                {"status": status.get("health", "unknown")}).encode()
+                self._health_payload(status)).encode()
         if path == "/api/status":
             return 200, "application/json", json.dumps(status).encode()
         if path == "/api/osds":
@@ -100,6 +105,30 @@ class Module(MgrModule):
             return 200, "text/html", self._page(status, osdmap)
         return 404, "text/plain", b"not found"
 
+    def _health_payload(self, status: dict) -> dict:
+        """Structured health for /api/health: the mon's merged check
+        map (``status`` carries it), the local health engine's recent
+        transitions, and the flight recorder's derived rate series."""
+        out = {"status": status.get("health", "unknown"),
+               "checks": status.get("health_checks", {})}
+        health_mod = self.mgr.modules.get("health")
+        if health_mod is not None:
+            out["history"] = health_mod.engine.history_dump()
+            try:
+                from ceph_tpu.utils.config import g_conf
+                window = g_conf()["health_window_seconds"]
+                out["rates"] = health_mod.recorder.rates_brief(window)
+                out["recorder"] = health_mod.recorder.stats()
+                out["series"] = {
+                    key: health_mod.recorder.series(key, window)
+                    for key in ("device.recompiles",
+                                "device.bytes_encoded",
+                                "device.engine_retired",
+                                "device.compile_cache_misses")}
+            except Exception:
+                pass
+        return out
+
     @staticmethod
     def _scrub_counters(tel) -> dict:
         counters = tel.snapshot()["counters"]
@@ -111,6 +140,13 @@ class Module(MgrModule):
 
     def _page(self, status: dict, osdmap) -> bytes:
         health = status.get("health", "unknown")
+        hp = self._health_payload(status)
+        check_rows = "".join(
+            f"<tr><td>{html.escape(name)}</td>"
+            f"<td>{html.escape(chk.get('severity', ''))}</td>"
+            f"<td>{html.escape(chk.get('summary', ''))}</td></tr>"
+            for name, chk in sorted(hp.get("checks", {}).items())) \
+            or "<tr><td colspan=3>no checks raised</td></tr>"
         osd_rows = "".join(
             f"<tr><td>osd.{o}</td><td>{'up' if i.up else 'DOWN'}</td>"
             f"<td>{'in' if i.in_cluster else 'out'}</td></tr>"
@@ -149,6 +185,9 @@ class Module(MgrModule):
             f"<td>{counters.get('compile_cache_hits', 0)}</td></tr>")
         return _PAGE.format(
             health=html.escape(health),
+            check_rows=check_rows,
+            recorder=html.escape(json.dumps(hp.get("recorder", {}))),
+            rates=html.escape(json.dumps(hp.get("rates", {}))),
             hclass="ok" if health.startswith("HEALTH_OK") else "warn",
             n_osds=len(osdmap.osds),
             n_up=sum(1 for i in osdmap.osds.values() if i.up),

@@ -26,7 +26,7 @@ log = Dout("mgr")
 
 #: default module set (the reference's always-on + default-on modules)
 DEFAULT_MODULES = ("balancer", "progress", "telemetry",
-                   "dashboard")
+                   "dashboard", "health")
 
 
 class Mgr:

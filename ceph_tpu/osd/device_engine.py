@@ -141,6 +141,7 @@ class DeviceEncodeEngine:
                       # analysis divides out (BASELINE.md cluster
                       # table)
                       "busy_s": 0.0}
+        _telemetry().note_engine_window(self._window)
         self._thread = threading.Thread(
             target=self._run, name="ec-device-engine", daemon=True)
         self._thread.start()
@@ -386,6 +387,7 @@ class DeviceEncodeEngine:
             self.stats["max_inflight_depth"] = max(
                 self.stats["max_inflight_depth"], depth)
             tel.note_inflight_depth(depth)
+            tel.note_engine_inflight(depth)
             while len(self._inflight) >= self._window:
                 drained += self._retire_oldest()
         if pending:
@@ -449,8 +451,11 @@ class DeviceEncodeEngine:
         # overlap: launch->harvest-begin passed while the engine did
         # OTHER work (younger batches staged/launched); the remainder
         # of the lifetime is this harvest's blocking download
-        _telemetry().note_overlap(t0 - launch_t,
-                                  _time.perf_counter() - launch_t)
+        tel = _telemetry()
+        tel.note_overlap(t0 - launch_t,
+                         _time.perf_counter() - launch_t)
+        tel.note_engine_retired()
+        tel.note_engine_inflight(len(self._inflight))
         self.stats["busy_s"] += dt
         return dt
 

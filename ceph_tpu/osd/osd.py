@@ -408,7 +408,8 @@ class OSD:
         self._stopping = False
         self.op_tracker = OpTracker(
             complaint_time=g_conf()["osd_op_complaint_time"],
-            history_size=g_conf()["op_history_size"])
+            history_size=g_conf()["op_history_size"],
+            name=f"osd.{osd_id}")
         self.asok = AdminSocket(
             f"osd.{osd_id}", g_conf()["admin_socket_dir"] or None)
         self._perf_name = f"osd.{osd_id}"
@@ -469,6 +470,10 @@ class OSD:
             "dump_historic_ops",
             lambda a: self.op_tracker.dump_historic(),
             "recently finished ops with event timelines")
+        self.asok.register_command(
+            "dump_historic_slow_ops",
+            lambda a: self.op_tracker.dump_slowest(),
+            "top-K slowest finished ops by age")
         self.asok.register_command(
             "status", lambda a: self._asok_status(), "daemon status")
         self.asok.register_command(

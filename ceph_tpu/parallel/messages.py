@@ -579,3 +579,13 @@ class MMonElection(Message):
 ELECTION_PROPOSE = 1
 ELECTION_DEFER = 2
 ELECTION_VICTORY = 3
+
+
+class MMgrHealthReport(Message):
+    """Mgr -> mon: the health engine's structured check report (the
+    MMonMgrReport health_checks payload role). ``report`` is the
+    JSON-encoded {"status", "checks": {name: {severity, summary,
+    detail}}} map; soft state on the mon, merged into ``status`` /
+    ``health detail`` answers."""
+    MSG_TYPE = 66
+    FIELDS = [("entity", "str"), ("report", "bytes")]

@@ -68,8 +68,13 @@ log = Dout("mon")
 _READONLY_COMMANDS = frozenset({
     "osd erasure-code-profile ls", "osd erasure-code-profile get",
     "osd pool ls", "osd pool lssnap", "osd tree", "osd dump",
-    "status", "health", "config dump", "osd blocklist ls",
+    "status", "health", "health detail", "config dump",
+    "osd blocklist ls",
 })
+
+#: seconds after which a pushed mgr health report stops being merged
+#: into status/health answers (a dead mgr must not pin stale checks)
+MGR_HEALTH_STALE = 30.0
 
 
 class Monitor:
@@ -99,6 +104,9 @@ class Monitor:
         # osd -> (monotonic ts, [pg stat dicts]) — pgmap soft state
         # (the mgr's aggregation role)
         self._pg_stats: dict[int, tuple[float, list]] = {}
+        # latest mgr health-engine report (monotonic ts, checks dict)
+        # — soft state like pg stats, merged into status/health
+        self._mgr_health: tuple[float, dict] | None = None
         self._failure_reports: dict[int, dict[int, float]] = {}
         # epoch at which each osd last booted (up_from role): failure
         # reports carrying an older epoch were formed before the boot
@@ -1161,6 +1169,17 @@ class Monitor:
                 self._pg_stats[msg.osd_id] = (time.monotonic(), stats)
                 if not self.is_leader():
                     self.msgr.send_message(msg, self.leader_addr())
+            elif isinstance(msg, M.MMgrHealthReport):
+                # soft state like pg stats: keep what we hear AND
+                # relay to the leader (whose status answers commands)
+                try:
+                    report = json.loads(msg.report)
+                except ValueError:
+                    report = {}
+                if isinstance(report, dict):
+                    self._mgr_health = (time.monotonic(), report)
+                if not self.is_leader():
+                    self.msgr.send_message(msg, self.leader_addr())
             elif isinstance(msg, (M.MOSDBoot, M.MOSDFailure,
                                   M.MOSDAlive)) and not self.is_leader():
                 # only the leader mutates cluster state; relay the
@@ -1765,6 +1784,9 @@ class Monitor:
                 return 0, "", json.dumps(self._status()).encode()
             if prefix == "health":
                 return 0, self._health(), b""
+            if prefix == "health detail":
+                return 0, self._health(), json.dumps(
+                    self._health_detail()).encode()
             return -22, f"unknown command {prefix!r}", b""
         except KeyError as exc:
             return -22, f"missing argument: {exc}", b""
@@ -1911,8 +1933,10 @@ class Monitor:
     def _status(self) -> dict:
         up = sum(1 for o in self.osdmap.osds.values() if o.up)
         inc = sum(1 for o in self.osdmap.osds.values() if o.in_cluster)
+        checks = self._health_checks()
         return {
-            "health": self._health(),
+            "health": self._health(checks),
+            "health_checks": checks,
             "epoch": self.osdmap.epoch,
             "num_osds": len(self.osdmap.osds),
             "num_up_osds": up,
@@ -1924,18 +1948,73 @@ class Monitor:
                        "mons": len(self.monmap)},
         }
 
-    def _health(self) -> str:
-        down = [o.osd_id for o in self.osdmap.osds.values() if not o.up]
-        warns = []
+    @staticmethod
+    def _worst_severity(checks: dict) -> str:
+        rank = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+        out = "HEALTH_OK"
+        for c in checks.values():
+            if rank.get(c.get("severity"), 0) > rank[out]:
+                out = c["severity"]
+        return out
+
+    def _health_checks(self) -> dict:
+        """Structured named checks (health_check_map_t role): the
+        mon's own up/in + pg accounting, merged with the latest
+        mgr health-engine report (mgr/health.py) when fresh. The
+        mon's own accounting wins on name collisions — it is
+        authoritative for map-derived state."""
+        checks: dict[str, dict] = {}
+        down = [o.osd_id for o in self.osdmap.osds.values()
+                if not o.up]
         if down:
-            warns.append(f"{len(down)} osds down: {down}")
+            up = len(self.osdmap.osds) - len(down)
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_ERR" if up == 0
+                else "HEALTH_WARN",
+                "summary": f"{len(down)} osds down: {down}",
+                "detail": [f"osd.{o} is down" for o in sorted(down)]}
         pgmap = self._pgmap()
         if pgmap["degraded_pgs"]:
-            warns.append(f"{pgmap['degraded_pgs']} pgs degraded")
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{pgmap['degraded_pgs']} pgs degraded",
+                "detail": []}
         notactive = sum(n for st, n in pgmap["by_state"].items()
                         if st != "active")
         if notactive:
-            warns.append(f"{notactive} pgs not active")
-        if warns:
-            return "HEALTH_WARN: " + "; ".join(warns)
-        return "HEALTH_OK"
+            checks["PG_NOT_ACTIVE"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{notactive} pgs not active",
+                "detail": [f"{n} pgs {st}" for st, n in
+                           sorted(pgmap["by_state"].items())
+                           if st != "active"]}
+        rep = self._mgr_health
+        if rep is not None and \
+                time.monotonic() - rep[0] <= MGR_HEALTH_STALE:
+            for name, chk in rep[1].get("checks", {}).items():
+                if isinstance(chk, dict) and name not in checks:
+                    checks[name] = chk
+        return checks
+
+    def _health_detail(self) -> dict:
+        """The ``health detail`` answer: overall status + every named
+        check with severity/summary/detail."""
+        checks = self._health_checks()
+        rep = self._mgr_health
+        age = None
+        if rep is not None:
+            age = round(time.monotonic() - rep[0], 3)
+        return {"status": self._worst_severity(checks),
+                "checks": checks,
+                "mgr_report_age_s": age}
+
+    def _health(self, checks: dict | None = None) -> str:
+        """The one-line answer, derived from the structured checks
+        (summaries joined, worst severity as the prefix)."""
+        if checks is None:
+            checks = self._health_checks()
+        if not checks:
+            return "HEALTH_OK"
+        status = self._worst_severity(checks)
+        return status + ": " + "; ".join(
+            c["summary"] for c in checks.values())

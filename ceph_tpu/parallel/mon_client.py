@@ -256,6 +256,14 @@ class MonClient:
         self.msgr.send_message(
             M.MOSDAlive(osd_id=osd_id, epoch=epoch), self.mon_addr)
 
+    def report_health(self, report: bytes,
+                      entity: str = "mgr") -> None:
+        """Push the mgr health engine's structured check report
+        (mgr/health.py) to the mon as soft state."""
+        self.msgr.send_message(
+            M.MMgrHealthReport(entity=entity, report=report),
+            self.mon_addr)
+
     def report_failure(self, target: int, reporter: int, epoch: int,
                        failed_for: float) -> None:
         self.msgr.send_message(

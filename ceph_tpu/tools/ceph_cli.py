@@ -4,6 +4,7 @@ Usage (python -m ceph_tpu.tools.ceph_cli):
 
     ceph -m HOST:PORT status
     ceph -m HOST:PORT health
+    ceph -m HOST:PORT health detail      # structured named checks
     ceph -m HOST:PORT osd tree
     ceph -m HOST:PORT osd pool create NAME [pg_num] [size]
     ceph -m HOST:PORT osd pool ls

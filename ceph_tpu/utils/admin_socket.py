@@ -139,12 +139,15 @@ def asok_command(path: str, prefix: str, timeout: float = 5.0,
 
 
 def register_common_commands(asok: AdminSocket, perf=None) -> None:
-    """The command set every daemon serves (perf dump / config ...)."""
+    """The command set every daemon serves (perf dump / config /
+    log dump ...)."""
+    from ceph_tpu.utils import dout as _dout
     from ceph_tpu.utils.config import g_conf
 
     if perf is not None:
         asok.register_command(
             "perf dump", lambda a: perf.dump(), "dump perf counters")
+    _dout.register_asok(asok)
     asok.register_command(
         "config show", lambda a: g_conf().dump(), "dump all config")
     asok.register_command(

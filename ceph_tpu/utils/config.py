@@ -340,6 +340,33 @@ for _o in [
            "directory for daemon .asok files (empty = per-daemon tmpdir)"),
     Option("trace_all", bool, False, "dev",
            "dataflow tracing for every op (blkin_trace_all role)"),
+    Option("flight_recorder_enabled", bool, True, "advanced",
+           "sample every PerfCounters dict into the counter flight "
+           "recorder ring (off = zero overhead, nothing retained)"),
+    Option("flight_recorder_interval", float, 1.0, "advanced",
+           "seconds between flight-recorder samples", min=0.05),
+    Option("flight_recorder_capacity", int, 600, "advanced",
+           "flight-recorder ring entries (fixed memory)", min=2),
+    Option("health_tick_period", float, 0.5, "advanced",
+           "seconds between mgr health-engine evaluations", min=0.05),
+    Option("health_slow_ops_warn", int, 1, "advanced",
+           "SLOW_OPS raises when this many ops exceed "
+           "osd_op_complaint_time", min=1),
+    Option("health_recompile_warn", int, 1, "advanced",
+           "DEVICE_RECOMPILE_STORM raises when recompiles grow by "
+           "this much inside one health window", min=1),
+    Option("health_cache_miss_warn", int, 8, "advanced",
+           "COMPILE_CACHE_MISS_STORM raises when cold compile-cache "
+           "misses grow by this much inside one health window", min=1),
+    Option("health_window_seconds", float, 60.0, "advanced",
+           "flight-recorder lookback the storm/stall checks derive "
+           "their rates over", min=1.0),
+    Option("health_history_size", int, 128, "advanced",
+           "health-check transitions kept for 'health history' and "
+           "the diagnostic bundle", min=1),
+    Option("health_bundle_dir", str, "", "advanced",
+           "directory for auto-emitted HEALTH_ERR diagnostic bundles "
+           "(empty = keep in memory only, serve over the asok)"),
 ]:
     SCHEMA.add(_o)
 

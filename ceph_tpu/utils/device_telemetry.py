@@ -120,6 +120,17 @@ class DeviceTelemetry:
                            "percent of a batch's launch->retire "
                            "lifetime spent overlapped with other "
                            "engine work")
+        # stall detection inputs (mgr/health.py ENGINE_STALL): the
+        # health engine reads the current window occupancy and checks
+        # the retirement counter for progress over its window
+        perf.add_gauge("engine_inflight",
+                       "launched-not-retired batches right now")
+        perf.add_gauge("engine_window",
+                       "configured launch-window depth (0 = no "
+                       "engine constructed yet)")
+        perf.add_u64_counter("engine_retired",
+                             "batches retired (downloaded + "
+                             "continuations dispatched)")
         # deep-scrub engine (osd/scrub_engine.py): the background-
         # verification pipeline's own accounting
         perf.add_u64_counter("scrub_batches",
@@ -233,6 +244,18 @@ class DeviceTelemetry:
         """Launch-window occupancy at one flush launch (pipelined
         engine): depth >= 2 is the proof batches overlap."""
         self.perf.hinc("engine_inflight_depth", depth)
+
+    def note_engine_window(self, window: int) -> None:
+        """An engine came up with this launch-window depth."""
+        self.perf.set_gauge("engine_window", window)
+
+    def note_engine_inflight(self, depth: int) -> None:
+        """Current launched-not-retired count (set on every launch
+        AND retire, so the health engine sees saturation live)."""
+        self.perf.set_gauge("engine_inflight", depth)
+
+    def note_engine_retired(self) -> None:
+        self.perf.inc("engine_retired")
 
     def note_overlap(self, overlapped_s: float,
                      lifetime_s: float) -> None:

@@ -52,10 +52,46 @@ def get_subsys_level(subsys: str) -> int:
 
 
 def dump_recent(count: int = 1000) -> list[str]:
-    """The crash-dump ring (Log.cc dump_recent role)."""
+    """The crash-dump ring (Log.cc dump_recent role): EVERYTHING the
+    ring holds, formatted — the diagnostic-bundle view."""
     with _lock:
         items = list(_ring)[-count:]
-    return items
+    return [rec for _lvl, _sub, rec in items]
+
+
+def dump_structured(count: int = 1000,
+                    honor_levels: bool = True) -> list[dict]:
+    """The operator-facing ring dump (asok ``log dump``). With
+    ``honor_levels`` each record is gated on its subsystem's CURRENT
+    effective level — the reference workflow: raise ``debug_<subsys>``,
+    reproduce, ``log dump``. ``honor_levels=False`` returns the whole
+    ring (what the crash/diagnostic path wants)."""
+    with _lock:
+        items = list(_ring)
+        levels = dict(_levels)
+    default = g_conf()["debug_default_level"]
+    out = []
+    for lvl, sub, rec in items:
+        if honor_levels and lvl > levels.get(sub, default):
+            continue
+        out.append({"level": lvl, "subsys": sub, "record": rec})
+    return out[-count:]
+
+
+def register_asok(asok) -> None:
+    """The ``log dump`` admin command (Log.cc dump_recent over the
+    asok), so operators and the diagnostic bundle share one path."""
+
+    def _dump(args: dict) -> dict:
+        count = int(args.get("count", 1000))
+        honor = not bool(int(args.get("all", 0)))
+        recs = dump_structured(count, honor_levels=honor)
+        return {"num_records": len(recs), "records": recs}
+
+    asok.register_command(
+        "log dump", _dump,
+        "recent in-memory log records, gated on per-subsys levels "
+        "(all=1 dumps the whole ring; count=N bounds it)")
 
 
 class Dout:
@@ -71,7 +107,7 @@ class Dout:
                   f"{level:2d} {self.subsys}: {msg}")
         if level <= RING_LEVEL:
             with _lock:
-                _ring.append(record)
+                _ring.append((level, self.subsys, record))
         if level <= get_subsys_level(self.subsys):
             try:
                 print(record, file=self.stream)

@@ -5,19 +5,57 @@ client op gets a TrackedOp; code marks named events as the op moves
 through the pipeline (queued -> reached_pg -> sub_op_sent -> commit).
 Ops alive longer than ``osd_op_complaint_time`` are reported as slow;
 finished ops land in a bounded history ring served over the admin
-socket (dump_historic_ops), like the reference's.
+socket (dump_historic_ops), like the reference's. A separate TOP-K
+table keeps the record slowest ops by age (dump_historic_slow_ops
+role) — a true top-K heap, not a ring, so a burst of mildly-slow ops
+can never evict the record holder.
+
+Trackers register in a process-wide weak registry so the mgr health
+engine (mgr/health.py SLOW_OPS check) can aggregate slow ops across
+every daemon in the process — the aggregation seam the reference
+routes through mgr daemon state.
 """
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import threading
 import time
+import weakref
 from collections import deque
 
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("optracker")
+
+#: process-wide tracker registry (weak: a stopped daemon's tracker
+#: unregisters itself by dying)
+_registry_lock = threading.Lock()
+_registry: "weakref.WeakSet[OpTracker]" = weakref.WeakSet()
+
+
+def all_slow_ops() -> list[tuple[str, dict]]:
+    """Every registered tracker's slow ops as (tracker_name, op dump)
+    pairs — the mgr health engine's SLOW_OPS input."""
+    with _registry_lock:
+        trackers = list(_registry)
+    out = []
+    for t in trackers:
+        for op in t.get_slow_ops():
+            out.append((t.name, op))
+    return out
+
+
+def dump_all_trackers() -> dict:
+    """Per-tracker in-flight + historic + slowest ops (the diagnostic
+    bundle's ops section)."""
+    with _registry_lock:
+        trackers = list(_registry)
+    return {t.name: {"in_flight": t.dump_in_flight(),
+                     "historic": t.dump_historic(),
+                     "slowest": t.dump_slowest()}
+            for t in sorted(trackers, key=lambda t: t.name)}
 
 
 class TrackedOp:
@@ -53,13 +91,22 @@ class TrackedOp:
 
 class OpTracker:
     def __init__(self, complaint_time: float = 30.0,
-                 history_size: int = 20) -> None:
+                 history_size: int = 20,
+                 name: str = "optracker") -> None:
+        self.name = name
         self.complaint_time = complaint_time
         self._lock = threading.Lock()
         self._seq = itertools.count(1)
         self._in_flight: dict[int, TrackedOp] = {}
         self._history: deque[dict] = deque(maxlen=history_size)
-        self._slowest: deque[dict] = deque(maxlen=history_size)
+        # true top-K by age: a min-heap of (age, seq, dump) whose root
+        # is the CHEAPEST record to beat. The old deque gated on
+        # ``age >= min(...)`` but evicted FIFO at maxlen, so a burst
+        # of mildly-slow ops pushed the record slowest op out.
+        self._slowest_k = history_size
+        self._slowest: list[tuple[float, int, dict]] = []
+        with _registry_lock:
+            _registry.add(self)
 
     def create(self, desc: str) -> TrackedOp:
         op = TrackedOp(next(self._seq), desc, self)
@@ -72,9 +119,11 @@ class OpTracker:
             self._in_flight.pop(op.seq, None)
             d = op.dump()
             self._history.append(d)
-            if not self._slowest or d["age"] >= min(
-                    s["age"] for s in self._slowest):
-                self._slowest.append(d)
+            ent = (d["age"], d["seq"], d)
+            if len(self._slowest) < self._slowest_k:
+                heapq.heappush(self._slowest, ent)
+            elif d["age"] > self._slowest[0][0]:
+                heapq.heapreplace(self._slowest, ent)
 
     # -- introspection (asok command backends) ------------------------
     def dump_in_flight(self) -> dict:
@@ -86,6 +135,14 @@ class OpTracker:
         with self._lock:
             return {"num_ops": len(self._history),
                     "ops": list(self._history)}
+
+    def dump_slowest(self) -> dict:
+        """Top-K finished ops by age, slowest first (the reference's
+        dump_historic_slow_ops)."""
+        with self._lock:
+            ops = [d for _, _, d in sorted(self._slowest,
+                                           reverse=True)]
+        return {"num_ops": len(ops), "ops": ops}
 
     def get_slow_ops(self) -> list[dict]:
         """Ops in flight longer than the complaint time (the reference

@@ -21,12 +21,21 @@ def _sanitize(name: str) -> str:
     return _NAME_RE.sub("_", name)
 
 
+def _escape_label(value: str) -> str:
+    """Label-value escaping per the exposition-format spec: backslash,
+    double-quote and newline must be escaped — a daemon name
+    containing any of them would otherwise corrupt the whole scrape."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def render_text() -> str:
     """All daemons' counters, one metric per counter with a ``daemon``
     label (the mgr module's layout)."""
     lines: list[str] = []
     seen_types: set[str] = set()
     for daemon, counters in sorted(collection().dump().items()):
+        daemon = _escape_label(daemon)
         for key, val in sorted(counters.items()):
             metric = f"ceph_tpu_{_sanitize(key)}"
             if isinstance(val, dict):

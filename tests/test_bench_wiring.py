@@ -29,10 +29,17 @@ def test_metric_line_roundtrips_with_telemetry(capsys):
     assert rec["value"] == 1.23
     assert isinstance(rec["telemetry"], dict)
     assert rec["telemetry"].get("compiles", 0) >= 1
-    # the combined (historical-schema) line carries it too
+    # every metric line carries a structured health brief that
+    # round-trips json.loads (HEALTH_OK-shaped on a clean CPU run)
+    assert isinstance(rec["health"], dict)
+    assert rec["health"]["status"] in ("HEALTH_OK", "HEALTH_WARN",
+                                       "HEALTH_ERR")
+    assert isinstance(rec["health"]["checks"], dict)
+    # the combined (historical-schema) line carries both too
     combined = bench._combined(any_contended=False)
     rec2 = json.loads(json.dumps(combined))
     assert isinstance(rec2["telemetry"], dict)
+    assert rec2["health"]["status"].startswith("HEALTH")
     bench._RESULTS.pop("smoke_metric", None)
 
 
@@ -47,6 +54,21 @@ def test_telemetry_snapshot_degrades_to_empty(monkeypatch):
 
     monkeypatch.setattr(dt, "telemetry", boom)
     assert bench._telemetry_snapshot() == {}
+
+
+def test_health_snapshot_degrades_to_ok_shape(monkeypatch):
+    """A health-engine fault must never cost a metric line: the field
+    degrades to a HEALTH_OK-shaped brief, not an exception."""
+    import bench
+
+    import ceph_tpu.mgr.health as hm
+
+    def boom():
+        raise RuntimeError("health engine down")
+
+    monkeypatch.setattr(hm, "device_health_brief", boom)
+    assert bench._health_snapshot() == {"status": "HEALTH_OK",
+                                        "checks": {}}
 
 
 def test_multichip_metric_emits_parseable_line(capsys, monkeypatch):

@@ -149,6 +149,29 @@ def test_deadline_caps_sampling(monkeypatch):
         f"deadline must dominate the 60s configured budget ({elapsed=})"
 
 
+def test_health_field_adds_no_bench_budget(capsys):
+    """The health brief on metric lines is a pure counter read: it
+    must not sample the flight recorder (mgr-tick territory), must
+    not add a BUDGETS entry, and must leave the r5 rc=124 worst-case
+    budget identity intact."""
+    import bench
+    from ceph_tpu.utils import flight_recorder as fr
+
+    fr.reset_for_tests()
+    before = fr.recorder().stats()["samples"]
+    bench.emit("budget_probe", {"value": 0})
+    bench._RESULTS.pop("budget_probe", None)
+    capsys.readouterr()
+    assert fr.recorder().stats()["samples"] == before, \
+        "emitting a metric line must not sample the recorder"
+    assert "health" not in bench.BUDGETS
+    assert "recorder" not in bench.BUDGETS
+    # the structural worst case still clears the driver timeout
+    worst = bench.TOTAL_BUDGET + \
+        bench.N_WARMUP_COMPILES * bench.COLD_COMPILE_S
+    assert worst <= 870 - 60
+
+
 def test_repo_last_good_seeded():
     # the committed expectation file holds the r3 driver-captured rows
     lg = measure.load_last_good()
