@@ -26,6 +26,20 @@ def _quiet(fut) -> bool:
         return False
 
 
+def attach_stage_breakdown(out: dict) -> dict:
+    """Fold the data-plane stage decomposition into a metric line
+    (ISSUE 6): per-stage share of the summed end-to-end latency +
+    the coverage the gap report asserts. Degrades to {} so a
+    telemetry fault can never cost a metric line. Mutates and
+    returns ``out``."""
+    try:
+        from ceph_tpu.utils.dataplane import dataplane
+        out["stage_breakdown"] = dataplane().stage_breakdown()
+    except Exception:
+        out["stage_breakdown"] = {}
+    return out
+
+
 def run_one(backend: str, seconds: float, n_osds: int, obj_size: int,
             threads: int, k: int = 8, m: int = 3) -> dict:
     from ceph_tpu.qa.cluster import MiniCluster
@@ -82,7 +96,7 @@ def run_one(backend: str, seconds: float, n_osds: int, obj_size: int,
                                      for s in stats),
                 "errors": sum(s["errors"] for s in stats),
             }
-        return out
+        return attach_stage_breakdown(out)
 
 
 def _engine_stats(cluster) -> dict:
@@ -187,6 +201,7 @@ def run_curve(seconds: float, n_osds: int, obj_size: int,
                 "busy_ms_per_launch": round(
                     d.get("busy_s", 0.0) * 1000 / launches, 1),
             }
+            attach_stage_breakdown(row)
             rows.append(row)
             print(json.dumps({"curve": row}, sort_keys=True),
                   flush=True)

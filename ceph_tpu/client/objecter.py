@@ -28,7 +28,9 @@ from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Connection, Messenger
 from ceph_tpu.parallel.mon_client import MonClient
 from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.utils import stage_clock
 from ceph_tpu.utils.config import g_conf
+from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("objecter")
@@ -126,6 +128,10 @@ class Objecter:
                 EBLOCKLISTED,
                 f"client instance {self.client_id!r} is fenced "
                 "(blocklisted); reconnect for a fresh instance")
+        # the op's StageClock anchors here: the per-op data-plane
+        # timeline every daemon downstream continues (always on —
+        # marks are a list append, recording a few histogram incs)
+        clock = stage_clock.StageClock()
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
@@ -139,6 +145,10 @@ class Objecter:
                        snapid=snapid, xname=xname, xop=xop,
                        gname=gname, gop=gop, gval=bytes(gval),
                        gflags=gflags)
+        clock.mark("objecter_encode")
+        # the messenger marks send_queue_wait and serializes the
+        # marks-so-far into msg.stages right before the frame build
+        msg._stage_clock = clock
         rec = _Op(tid, msg)
         with self._lock:
             self._pending[tid] = rec
@@ -154,6 +164,16 @@ class Objecter:
             reply = rec.reply
             if reply.code < 0:
                 raise ObjecterError(reply.code)
+            # the reply carries the merged timeline (client marks +
+            # primary + shard children): close it and record the
+            # client-owned stages + end-to-end total
+            timeline = stage_clock.StageClock.from_wire(reply.stages)
+            if timeline is not stage_clock.NOOP:
+                timeline.mark("commit_reply")
+                try:
+                    dataplane().record_op(timeline)
+                except Exception:
+                    pass   # telemetry faults never cost an op
             return reply
         finally:
             span.finish()

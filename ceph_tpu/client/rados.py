@@ -633,6 +633,16 @@ class RadosClient:
                     ) -> tuple[int, str, bytes]:
         return self.monc.command(cmd, timeout)
 
+    @staticmethod
+    def dump_op_timelines() -> list[dict]:
+        """Recently completed per-op stage timelines (the data-plane
+        decomposition this client's ops contributed to): the merged
+        client/primary/shard view, newest last. The same payload the
+        OSD serves as ``dump_op_timeline``; here for tools (gap
+        report) and tests that sit on the client side."""
+        from ceph_tpu.utils.dataplane import dataplane
+        return dataplane().recent()
+
     def open_ioctx(self, pool_name: str) -> IoCtx:
         osdmap = self.monc.osdmap
         pid = osdmap.pool_by_name.get(pool_name)

@@ -12,6 +12,9 @@ from the mgr's cluster view:
     GET /api/pools    pool table (type, pg_num, size)
     GET /api/device   device-path telemetry snapshot (compiles,
                       flushes, occupancy, calibration outcomes)
+    GET /api/dataplane  per-op stage-latency decomposition (stage
+                      breakdown + messenger counters + recent merged
+                      timelines)
 
 Commands: ``dashboard status|on|off`` over the mgr asok; ``on`` binds
 an ephemeral port (reported by status) on 127.0.0.1.
@@ -58,6 +61,12 @@ _PAGE = """<!doctype html>
 <h3>deep scrub</h3>
 <table><tr><th>batches</th><th>bytes verified</th><th>mismatches</th>
 <th>repaired shards</th><th>host fallbacks</th></tr>{scrub_row}</table>
+<h3>data plane</h3>
+<p>ops {dp_ops} · p50 {dp_p50} ms · p99 {dp_p99} ms · coverage
+{dp_coverage}% · msgr send errors {dp_send_errors} · dropped
+{dp_dropped}</p>
+<table><tr><th>stage</th><th>mean ms</th><th>share</th></tr>
+{dp_rows}</table>
 </body></html>"""
 
 
@@ -101,6 +110,13 @@ class Module(MgrModule):
             from ceph_tpu.utils.device_telemetry import telemetry
             return 200, "application/json", json.dumps(
                 self._scrub_counters(telemetry())).encode()
+        if path == "/api/dataplane":
+            from ceph_tpu.utils.dataplane import dataplane
+            from ceph_tpu.utils.msgr_telemetry import telemetry as mt
+            return 200, "application/json", json.dumps(
+                {"breakdown": dataplane().stage_breakdown(),
+                 "recent": dataplane().recent(),
+                 "msgr": mt().snapshot()}).encode()
         if path == "/":
             return 200, "text/html", self._page(status, osdmap)
         return 404, "text/plain", b"not found"
@@ -173,6 +189,16 @@ class Module(MgrModule):
             f"<td>{sc['scrub_mismatch_stripes']}</td>"
             f"<td>{sc['scrub_repaired_shards']}</td>"
             f"<td>{sc['scrub_host_fallbacks']}</td></tr>")
+        from ceph_tpu.utils.dataplane import dataplane
+        from ceph_tpu.utils.msgr_telemetry import telemetry as _mt
+        bd = dataplane().stage_breakdown()
+        dp_rows = "".join(
+            f"<tr><td>{html.escape(stage)}</td>"
+            f"<td>{ent['mean_ms']}</td>"
+            f"<td>{ent['share_pct']}%</td></tr>"
+            for stage, ent in bd.get("stages", {}).items()) \
+            or "<tr><td colspan=3>no timed ops yet</td></tr>"
+        mc = _mt().perf.dump()
         counters = tel.snapshot()["counters"]
         depth = counters.get("engine_inflight_depth", [])
         overlap = counters.get("engine_overlap_pct", [])
@@ -200,6 +226,13 @@ class Module(MgrModule):
             device_rows=device_rows,
             scrub_row=scrub_row,
             pipeline_row=pipeline_row,
+            dp_ops=bd.get("ops", 0),
+            dp_p50=bd.get("p50_ms", 0),
+            dp_p99=bd.get("p99_ms", 0),
+            dp_coverage=bd.get("coverage_pct", 0),
+            dp_send_errors=mc.get("send_errors", 0),
+            dp_dropped=mc.get("dropped_msgs", 0),
+            dp_rows=dp_rows,
         ).encode()
 
     # -- server --------------------------------------------------------

@@ -74,6 +74,7 @@ from typing import Callable
 import numpy as np
 
 from ceph_tpu.osd import ec_util
+from ceph_tpu.utils import stage_clock as _stage_clock
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.tracing import NOOP
@@ -151,17 +152,20 @@ class DeviceEncodeEngine:
                      data: np.ndarray,
                      cont: Callable[[dict | None, dict | None,
                                      Exception | None], None],
-                     span=NOOP) -> None:
+                     span=NOOP, clock=_stage_clock.NOOP) -> None:
         """Queue one op's stripe-aligned payload for batched device
         encode; ``cont(shards, crcs, err)`` is dispatched on ``key``
         (crcs = per-shard LINEAR crc parts computed on device from the
         same buffers, or None; err set and shards None on device
         failure — caller falls back). ``span``: the op's dataflow
         trace continues through the engine (flush launch, kernel
-        dispatch, crc pass events); default NOOP is free."""
+        dispatch, crc pass events); ``clock``: the op's StageClock —
+        the engine marks engine_stage_wait / device_window_wait /
+        device_finalize on it, so the per-op timeline survives the
+        engine boundary. Both defaults are free no-ops."""
         import time as _time
         self._q.put(("enc", key, codec, sinfo, data, cont, span,
-                     _time.monotonic()))
+                     clock, _time.monotonic()))
 
     def stage_barrier(self, key, fn: Callable[[], None]) -> None:
         """Queue an ordering barrier: ``fn`` dispatches on ``key``
@@ -171,7 +175,8 @@ class DeviceEncodeEngine:
     def stage_decode(self, key, codec, sinfo: ec_util.StripeInfo,
                      shards: dict[int, np.ndarray], want: list[int],
                      cont: Callable[[dict | None, Exception | None],
-                                    None], span=NOOP) -> None:
+                                    None], span=NOOP,
+                     clock=_stage_clock.NOOP) -> None:
         """Queue a reconstruct of ``want`` chunk streams from the
         surviving ``shards``; ``cont(decoded, err)`` runs INLINE on
         the engine thread (must be cheap and lock-free — the typical
@@ -179,12 +184,13 @@ class DeviceEncodeEngine:
         blocked decode_sync caller)."""
         import time as _time
         self._q.put(("dec", key, codec, sinfo, shards, want, cont,
-                     span, _time.monotonic()))
+                     span, clock, _time.monotonic()))
 
     def decode_sync(self, key, codec, sinfo: ec_util.StripeInfo,
                     shards: dict[int, np.ndarray], want: list[int],
                     timeout: float = 60.0,
-                    span=NOOP) -> dict[int, np.ndarray] | None:
+                    span=NOOP,
+                    clock=_stage_clock.NOOP) -> dict[int, np.ndarray] | None:
         """Blocking decode through the batched engine; returns the
         decoded {chunk: bytes} map or None on device fault/timeout
         (the caller falls back to its host twin). Safe to call from
@@ -198,7 +204,7 @@ class DeviceEncodeEngine:
             ev.set()
 
         self.stage_decode(key, codec, sinfo, shards, want, cont,
-                          span=span)
+                          span=span, clock=clock)
         if not ev.wait(timeout):
             log(0, f"device decode timed out after {timeout}s; "
                 "host fallback")
@@ -256,10 +262,11 @@ class DeviceEncodeEngine:
                     self._drain_inflight()
                     return
                 if item[0] == "enc":
-                    _, key, codec, sinfo, data, cont, span, ts = item
+                    (_, key, codec, sinfo, data, cont, span, clock,
+                     ts) = item
                     _, _, items = pending.setdefault(
                         id(codec), (codec, sinfo, []))
-                    items.append((key, data, cont, span, ts))
+                    items.append((key, data, cont, span, clock, ts))
                     nbytes += data.nbytes
                     if nbytes >= self._flush_bytes:
                         # flush BOTH kinds: the byte counter is
@@ -271,12 +278,13 @@ class DeviceEncodeEngine:
                         pending, dec_pending, nbytes = {}, {}, 0
                 elif item[0] == "dec":
                     (_, key, codec, sinfo, shards, want, cont, span,
-                     ts) = item
+                     clock, ts) = item
                     sig = (id(codec),
                            tuple(sorted(shards)), tuple(sorted(want)))
                     _, _, items = dec_pending.setdefault(
                         sig, (codec, sinfo, []))
-                    items.append((key, shards, want, cont, span, ts))
+                    items.append((key, shards, want, cont, span,
+                                  clock, ts))
                     nbytes += sum(np.asarray(v).nbytes
                                   for v in shards.values())
                     if nbytes >= self._flush_bytes:
@@ -331,7 +339,7 @@ class DeviceEncodeEngine:
         t0 = _time.perf_counter()
         drained = 0.0                 # retirement self-accounts
         for codec, sinfo, items in pending.values():
-            nbytes = sum(d.nbytes for _k, d, _c, _s, _t in items)
+            nbytes = sum(d.nbytes for _k, d, _c, _s, _cl, _t in items)
             # a configured default mesh takes the flush through the
             # multi-chip encode step (pod deployments; dryrun/tests)
             # — but only once the batch is big enough to amortize the
@@ -344,7 +352,7 @@ class DeviceEncodeEngine:
             batcher = ec_util.StripeBatcher(
                 sinfo, codec, mesh=mesh,
                 on_fallback=self._note_fused_fallback)
-            for i, (_key, data, _cont, _span, _ts) in \
+            for i, (_key, data, _cont, _span, _clock, _ts) in \
                     enumerate(items):
                 batcher.append(i, data)
             if mesh is not None:
@@ -360,7 +368,7 @@ class DeviceEncodeEngine:
                 log(0, f"device encode batch of {len(items)} ops "
                     f"failed: {exc!r}")
                 self.stats["errors"] += 1
-                for key, _data, cont, span, _ts in items:
+                for key, _data, cont, span, _clock, _ts in items:
                     span.event(f"device_error {exc!r}")
                     span.finish()
                     self._dispatch(key, _bind(cont, None, None, exc))
@@ -373,10 +381,11 @@ class DeviceEncodeEngine:
             launched = _time.monotonic()
             tel = _telemetry()
             kspans = []
-            for _key, _data, _cont, span, ts in items:
+            for _key, _data, _cont, span, clock, ts in items:
                 # queue wait = stage -> launch (the batching latency
                 # an op paid for its amortization win)
                 tel.note_queue_wait("encode", launched - ts)
+                clock.mark("engine_stage_wait", t=launched)
                 if span is not NOOP:   # no formatting when untraced
                     span.event(f"batch_flush ops={len(items)} "
                                f"bytes={nbytes}")
@@ -414,22 +423,28 @@ class DeviceEncodeEngine:
         if not self._inflight:
             return 0.0
         t0 = _time.perf_counter()
+        harvest_t = _time.monotonic()
         items, finalize, kspans, launch_t = self._inflight.popleft()
+        # per-op timeline: launch -> harvest begin is the pipeline-
+        # window wait (overlapped with younger batches' staging)
+        for _key, _data, _cont, _span, clock, _ts in items:
+            clock.mark("device_window_wait", t=harvest_t)
         try:
             results = finalize()
         except Exception as exc:
             log(0, f"device encode batch of {len(items)} ops "
                 f"failed: {exc!r}")
             self.stats["errors"] += 1
-            for (key, _data, cont, span, _ts), kspan in zip(items,
-                                                            kspans):
+            for (key, _data, cont, span, _clock, _ts), kspan in \
+                    zip(items, kspans):
                 kspan.event(f"device_error {exc!r}")
                 kspan.finish()
                 span.finish()
                 self._dispatch(key, _bind(cont, None, None, exc))
             results = None
         if results is not None:
-            nbytes = sum(d.nbytes for _, d, _c, _s, _t in items)
+            done_t = _time.monotonic()
+            nbytes = sum(d.nbytes for _, d, _c, _s, _cl, _t in items)
             self.stats["flushes"] += 1
             self.stats["ops"] += len(items)
             self.stats["bytes"] += nbytes
@@ -438,12 +453,14 @@ class DeviceEncodeEngine:
             if self._counters is not None:
                 self._counters.inc("device_batches")
                 self._counters.inc("device_batch_ops", len(items))
-            for (key, _data, cont, span, _ts), (_i, shards, crcs), \
-                    kspan in zip(items, results, kspans):
+            for (key, _data, cont, span, clock, _ts), \
+                    (_i, shards, crcs), kspan in zip(items, results,
+                                                     kspans):
                 if crcs is not None:
                     kspan.event("crc_pass")
                 kspan.finish()
                 span.finish()
+                clock.mark("device_finalize", t=done_t)
                 self._dispatch(key, _bind(cont, shards, crcs, None))
             _telemetry().note_encode_flush(
                 len(items), nbytes, _time.perf_counter() - t0)
@@ -482,8 +499,9 @@ class DeviceEncodeEngine:
             launched = _time.monotonic()
             t0 = _time.perf_counter()
             tel = _telemetry()
-            for _key, _shards, _want, _cont, span, ts in items:
+            for _key, _shards, _want, _cont, span, clock, ts in items:
                 tel.note_queue_wait("decode", launched - ts)
+                clock.mark("engine_stage_wait", t=launched)
                 if span is not NOOP:   # no formatting when untraced
                     span.event(f"decode_flush ops={len(items)} "
                                f"sig={list(present)}->{list(want)}")
@@ -491,16 +509,17 @@ class DeviceEncodeEngine:
                 merged = {
                     c: np.concatenate(
                         [np.asarray(shards[c], dtype=np.uint8)
-                         for _k, shards, _w, _c, _s, _t in items])
+                         for _k, shards, _w, _c, _s, _cl, _t in items])
                     for c in present}
                 lens = [len(np.asarray(shards[present[0]]))
-                        for _k, shards, _w, _c, _s, _t in items]
+                        for _k, shards, _w, _c, _s, _cl, _t in items]
                 out = ec_util.decode(sinfo, codec, merged, list(want))
             except Exception as exc:
                 log(0, f"device decode batch of {len(items)} ops "
                     f"(sig {present}->{want}) failed: {exc!r}")
                 self.stats["decode_errors"] += 1
-                for _key, _shards, _want, cont, span, _ts in items:
+                for (_key, _shards, _want, cont, span, _clock,
+                     _ts) in items:
                     span.event(f"device_error {exc!r}")
                     span.finish()
                     cont(None, exc)
@@ -518,11 +537,13 @@ class DeviceEncodeEngine:
                 self._counters.inc("device_decode_ops", len(items))
             tel.note_decode_flush(len(items), nbytes,
                                   _time.perf_counter() - t0)
+            done_t = _time.monotonic()
             off = 0
-            for (_key, _shards, _want, cont, span, _ts), ln in zip(
-                    items, lens):
+            for (_key, _shards, _want, cont, span, clock, _ts), ln \
+                    in zip(items, lens):
                 span.event("decode_done")
                 span.finish()
+                clock.mark("device_finalize", t=done_t)
                 cont({c: v[off:off + ln] for c, v in out.items()},
                      None)
                 off += ln

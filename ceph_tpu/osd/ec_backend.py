@@ -62,7 +62,7 @@ from ceph_tpu.store.object_store import (
     StoreError,
     Transaction,
 )
-from ceph_tpu.utils import tracing
+from ceph_tpu.utils import stage_clock, tracing
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("osd")
@@ -147,10 +147,12 @@ class ECBackend(PGBackend):
                 self.device_codec is not None and \
                 ec_util.device_decodable(self.device_codec):
             # the op's dataflow trace continues into the engine's
-            # signature-batched decode flush (NOOP when tracing off)
+            # signature-batched decode flush (NOOP when tracing off),
+            # and so does its stage timeline
             out = self.device.decode_sync(
                 pg.pgid, self.device_codec, self.sinfo, shards, want,
-                span=tracing.current().child("engine_decode"))
+                span=tracing.current().child("engine_decode"),
+                clock=stage_clock.current())
             if out is not None:
                 return out
             log(1, f"{pg}: device decode fell back to host "
@@ -189,9 +191,14 @@ class ECBackend(PGBackend):
         self.parent.register_write(iw)
         epoch = self.parent.get_osdmap().epoch
         # dataflow trace: one child span per shard sub-op, carried in
-        # the message (ECBackend.cc:2022-2026 role)
+        # the message (ECBackend.cc:2022-2026 role); the op's stage
+        # timeline hangs on the inflight record so shard sub-op
+        # timelines returning in MECSubWriteReply merge under it
         op_span = tracing.current()
         op_span.event(f"start {span_label}")
+        op_clock = stage_clock.current()
+        if op_clock is not stage_clock.NOOP:
+            iw.clock = op_clock
         for pos in positions:
             osd = pg.acting[pos]
             cid = pg_cid(pg.pool, pg.ps, pos)
@@ -203,10 +210,16 @@ class ECBackend(PGBackend):
                     lambda p=pos: iw.complete(p) and iw.on_all_commit())
             else:
                 child = op_span.child(f"{span_label}(shard={pos})")
-                self.parent.send_osd(osd, M.MECSubWrite(
+                sub = M.MECSubWrite(
                     tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
                     epoch=epoch, oid=oid, version=version,
-                    txn_bytes=txn.encode(), trace=child.wire()))
+                    txn_bytes=txn.encode(), trace=child.wire())
+                if op_clock is not stage_clock.NOOP:
+                    # child timeline anchor: handed to the messenger
+                    # (the messenger serializes it into sub.stages)
+                    sub._stage_clock = stage_clock.StageClock(
+                        name="subop_send")
+                self.parent.send_osd(osd, sub)
                 child.finish()
         if supersedes_recovery:
             # a write of every shard supersedes pending recovery for it
@@ -234,13 +247,16 @@ class ECBackend(PGBackend):
             buf = np.frombuffer(self._pad(data), dtype=np.uint8)
 
             # the continuation runs on an op-wq thread whose current
-            # span is NOOP: carry the op span across the engine
-            # boundary or the sub-write child spans die here
+            # span is NOOP: carry the op span AND the op's stage
+            # clock across the engine boundary or both die here
             op_span = tracing.current()
+            op_clock = stage_clock.current()
+            # pg_process ends where the engine staging begins
+            op_clock.mark("pg_process")
 
             def cont(shards, crcs, err, pg=pg, oid=oid, data=data,
                      version=version, on_commit=on_commit,
-                     op_span=op_span):
+                     op_span=op_span, op_clock=op_clock):
                 if shards is None:
                     log(0, f"device encode failed for {oid} "
                         f"({err!r}); host fallback")
@@ -249,12 +265,14 @@ class ECBackend(PGBackend):
                     crcs = None
                 with pg.lock:
                     tracing.set_current(op_span)
+                    stage_clock.set_current(op_clock)
                     try:
                         self._finish_write(pg, oid, data, version,
                                            shards, on_commit,
                                            crcs=crcs)
                     finally:
                         tracing.set_current(tracing.NOOP)
+                        stage_clock.set_current(stage_clock.NOOP)
 
             # dataflow trace across the engine boundary: one child
             # span rides the staged op through batch flush + kernel
@@ -264,8 +282,9 @@ class ECBackend(PGBackend):
                 eng_span.event(f"staged oid={oid}")
             self.device.stage_encode(pg.pgid, self.device_codec,
                                      self.sinfo, buf, cont,
-                                     span=eng_span)
+                                     span=eng_span, clock=op_clock)
             return
+        stage_clock.current().mark("pg_process")
         shards = ec_util.encode(self.sinfo, self.codec, self._pad(data))
         self._finish_write(pg, oid, data, version, shards, on_commit)
 
@@ -314,13 +333,19 @@ class ECBackend(PGBackend):
             # dispatch, where current() is NOOP)
             op_span = tracing.current()
 
-            def barrier(pg=pg, op_span=op_span) -> None:
+            op_clock = stage_clock.current()
+            op_clock.mark("pg_process")
+
+            def barrier(pg=pg, op_span=op_span,
+                        op_clock=op_clock) -> None:
                 with pg.lock:
                     tracing.set_current(op_span)
+                    stage_clock.set_current(op_clock)
                     try:
                         run()
                     finally:
                         tracing.set_current(tracing.NOOP)
+                        stage_clock.set_current(stage_clock.NOOP)
             self.device.stage_barrier(pg.pgid, barrier)
             return
         run()
@@ -350,13 +375,19 @@ class ECBackend(PGBackend):
         if self.device is not None:
             op_span = tracing.current()
 
-            def barrier(pg=pg, op_span=op_span) -> None:
+            op_clock = stage_clock.current()
+            op_clock.mark("pg_process")
+
+            def barrier(pg=pg, op_span=op_span,
+                        op_clock=op_clock) -> None:
                 with pg.lock:
                     tracing.set_current(op_span)
+                    stage_clock.set_current(op_clock)
                     try:
                         run()
                     finally:
                         tracing.set_current(tracing.NOOP)
+                        stage_clock.set_current(stage_clock.NOOP)
             self.device.stage_barrier(pg.pgid, barrier)
             return
         run()
@@ -406,13 +437,19 @@ class ECBackend(PGBackend):
             # regress against the log
             op_span = tracing.current()
 
-            def barrier(pg=pg, op_span=op_span) -> None:
+            op_clock = stage_clock.current()
+            op_clock.mark("pg_process")
+
+            def barrier(pg=pg, op_span=op_span,
+                        op_clock=op_clock) -> None:
                 with pg.lock:
                     tracing.set_current(op_span)
+                    stage_clock.set_current(op_clock)
                     try:
                         run()
                     finally:
                         tracing.set_current(tracing.NOOP)
+                        stage_clock.set_current(stage_clock.NOOP)
             self.device.stage_barrier(pg.pgid, barrier)
             return
         run()
@@ -476,12 +513,16 @@ class ECBackend(PGBackend):
                                 max(base, end), full=False)
 
             op_span = tracing.current()
+            op_clock = stage_clock.current()
+            op_clock.mark("pg_process")
 
             def barrier(pg=pg, oid=oid, offset=offset, data=data,
                         version=version, on_commit=on_commit,
-                        old_size=old_size, op_span=op_span) -> None:
+                        old_size=old_size, op_span=op_span,
+                        op_clock=op_clock) -> None:
                 with pg.lock:
                     tracing.set_current(op_span)
+                    stage_clock.set_current(op_clock)
                     try:
                         self._submit_partial_write_sync(
                             pg, oid, offset, data, version, on_commit,
@@ -493,6 +534,7 @@ class ECBackend(PGBackend):
                         on_commit(-5)
                     finally:
                         tracing.set_current(tracing.NOOP)
+                        stage_clock.set_current(stage_clock.NOOP)
 
             self.device.stage_barrier(pg.pgid, barrier)
             return

@@ -65,7 +65,9 @@ from ceph_tpu.utils.admin_socket import (
 )
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
-from ceph_tpu.utils import tracing
+from ceph_tpu.utils import stage_clock, tracing
+from ceph_tpu.utils.dataplane import dataplane
+from ceph_tpu.utils.msgr_telemetry import telemetry as _msgr_telemetry
 from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
@@ -271,6 +273,11 @@ class ShardedOpWQ:
             else:
                 sh.queues.get(qos, sh.queues[QOS_CLIENT]).append(fn)
             sh.cv.notify()
+        # dispatch-queue depth (process-wide gauge over every sharded
+        # queue): decremented by the worker at dequeue, so the gauge
+        # reads the enqueued-not-yet-served backlog and returns to 0
+        # at idle — the dispatch-wait saturation signal
+        _msgr_telemetry().dispatch_queue_delta(1)
 
     def _dequeue(self, sh: _WQShard):
         """Weighted round-robin pick (caller holds sh.cv): serve each
@@ -315,6 +322,7 @@ class ShardedOpWQ:
                             return
                         sh.cv.wait()
                         fn = self._dequeue(sh)
+            _msgr_telemetry().dispatch_queue_delta(-1)
             try:
                 fn()
             except Exception as exc:
@@ -327,6 +335,18 @@ class ShardedOpWQ:
                 sh.cv.notify_all()
         for t in self._threads:
             t.join(timeout=5)
+        # gauge reconciliation: an item enqueued after a worker's
+        # final drain check is dropped with the daemon — settle its
+        # share so the process dispatch_queue_depth gauge still reads
+        # 0 at idle
+        leftover = 0
+        for sh in self._shards:
+            with sh.cv:
+                for q in sh.queues.values():
+                    leftover += len(q)
+                    q.clear()
+        if leftover:
+            _msgr_telemetry().dispatch_queue_delta(-leftover)
 
 
 class OSD:
@@ -493,6 +513,10 @@ class OSD:
         _dt.register_asok(self.asok)
         from ceph_tpu.utils import tracepoints as _tp
         _tp.register_asok(self.asok)
+        from ceph_tpu.utils import dataplane as _dp
+        _dp.register_asok(self.asok)
+        from ceph_tpu.utils import msgr_telemetry as _mt
+        _mt.register_asok(self.asok)
         self.asok.start()
         self.addr = self.msgr.bind(host, port)
         self._refresh_rotating()   # before boot: fetched-mode daemons
@@ -989,13 +1013,28 @@ class OSD:
         span = tracing.tracer().from_wire(
             msg.trace, f"sub_write(shard={msg.shard})",
             f"osd.{self.whoami}")
+        # the sub-op's child stage timeline (anchor set on the
+        # primary): wire interval ends at the messenger rx stamp,
+        # dispatch wait ends here; the commit mark rides the reply
+        # back for the primary to merge under the client op
+        sclock = stage_clock.StageClock.from_wire(msg.stages)
+        rx_t = getattr(msg, "_rx_t", None)
+        if rx_t is not None:
+            sclock.mark("subop_wire", t=rx_t)
+        sclock.mark("subop_dispatch_wait")
 
         def committed() -> None:
             span.event("committed")
             span.finish()
+            sclock.mark("subop_commit")
+            try:
+                dataplane().record_stages(sclock.own_durations())
+            except Exception:
+                pass
             conn.send_message(M.MECSubWriteReply(
                 tid=msg.tid, pool=msg.pool, ps=msg.ps, shard=msg.shard,
-                committed=True, version=msg.version))
+                committed=True, version=msg.version,
+                stages=sclock.to_wire()))
 
         self.store.queue_transaction(txn, committed)
 
@@ -1076,6 +1115,13 @@ class OSD:
             iw = self._inflight.get(msg.tid)
         if iw is None:
             return
+        if msg.stages and iw.clock is not None:
+            # fold the shard's completed sub-op timeline under the
+            # client op (the cross-daemon merge: client + primary +
+            # shard OSDs in one dump)
+            iw.clock.merge_child(
+                f"shard{msg.shard}",
+                stage_clock.StageClock.from_wire(msg.stages))
         if iw.complete(msg.shard):
             with self._sub_lock:
                 self._inflight.pop(msg.tid, None)
@@ -1107,6 +1153,15 @@ class OSD:
         span = tracing.tracer().from_wire(
             msg.trace, f"handle_osd_op(oid={msg.oid})",
             f"osd.{self.whoami}")
+        # continue the op's stage timeline (NOOP when the client sent
+        # none): the ``wire`` interval ends at the messenger's receive
+        # stamp, the dispatch-queue wait ends here on the op worker
+        clock = stage_clock.StageClock.from_wire(msg.stages)
+        rx_t = getattr(msg, "_rx_t", None)
+        if rx_t is not None:
+            clock.mark("wire", t=rx_t)
+        clock.mark("dispatch_queue_wait")
+        track.stages = clock
         if msg.epoch > osdmap.epoch:
             # the client targeted a newer map than we hold — park
             # until the mon push catches us up. Required for the
@@ -1150,12 +1205,22 @@ class OSD:
             self.logger.tinc("op_latency", time.perf_counter() - t0)
             _TP_OP_REPLY(msg.oid, code,
                          int((time.perf_counter() - t0) * 1e6))
+            # close the primary's side of the stage timeline: the
+            # interval since the last mark is the commit wait (shard
+            # fan-out for writes, op execution for reads); record the
+            # stages THIS daemon owns and ship the merged timeline
+            # home in the reply
+            clock.mark("commit_wait")
+            try:
+                dataplane().record_stages(clock.own_durations())
+            except Exception:
+                pass           # telemetry faults never cost an op
             track.finish()
             span.event(f"reply code={code}")
             span.finish()
             out = M.MOSDOpReply(
                 tid=msg.tid, code=code, epoch=osdmap.epoch, data=data,
-                version=version)
+                version=version, stages=clock.to_wire())
             if msg.op in self._MUTATING_OPS and code == 0:
                 with self._op_cache_lock:
                     if cache_key not in self._op_cache:
@@ -1215,10 +1280,12 @@ class OSD:
                 if handled:
                     return        # replied by the intercept
             tracing.set_current(span)
+            stage_clock.set_current(clock)
             try:
                 self._execute_op(pg, msg, reply)
             finally:
                 tracing.set_current(tracing.NOOP)
+                stage_clock.set_current(stage_clock.NOOP)
 
     def _flush_waiting(self, pg: PG) -> None:
         """Re-run parked ops (caller holds pg.lock, state ACTIVE)."""

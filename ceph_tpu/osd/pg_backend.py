@@ -106,6 +106,10 @@ class InflightWrite:
         #: for a commit that will never be confirmed (e.g. extent-
         #: cache unpin; a leaked pin would poison later RMWs forever)
         self.on_expire: Callable[[], None] | None = None
+        #: the client op's StageClock (utils/stage_clock), set by the
+        #: EC fan-out so shard sub-op timelines arriving in
+        #: MECSubWriteReply merge under the op (None = untimed)
+        self.clock = None
         self.created_at = time.monotonic()
         self._lock = threading.Lock()
         self._done = False

@@ -235,13 +235,20 @@ class MOSDOp(Message):
               ("gname", "str"), ("gop", "u8"), ("gval", "bytes"),
               # appended round 4 (old readers skip): guard flags
               # (GUARD_OMAP selects the omap namespace for the guard)
-              ("gflags", "u8")]
+              ("gflags", "u8"),
+              # appended round 11: the op's StageClock marks so far
+              # (utils/stage_clock wire form, "" = untimed) — the
+              # per-op data-plane timeline the OSD continues
+              ("stages", "str")]
 
 
 class MOSDOpReply(Message):
     MSG_TYPE = 21
     FIELDS = [("tid", "u64"), ("code", "i32"), ("epoch", "u32"),
-              ("data", "bytes"), ("version", "u64")]
+              ("data", "bytes"), ("version", "u64"),
+              # appended round 11: the merged stage timeline (client
+              # marks + primary marks + shard children) coming home
+              ("stages", "str")]
 
 
 class MPGStats(Message):
@@ -380,13 +387,19 @@ class MECSubWrite(Message):
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
               ("shard", "u8"), ("epoch", "u32"), ("oid", "str"),
               ("version", "u64"), ("txn_bytes", "bytes"),
-              ("trace", "str")]
+              ("trace", "str"),
+              # appended round 11: the sub-op's child StageClock
+              # (anchor = handed to the messenger on the primary)
+              ("stages", "str")]
 
 
 class MECSubWriteReply(Message):
     MSG_TYPE = 31
     FIELDS = [("tid", "u64"), ("pool", "i32"), ("ps", "u32"),
-              ("shard", "u8"), ("committed", "bool"), ("version", "u64")]
+              ("shard", "u8"), ("committed", "bool"), ("version", "u64"),
+              # appended round 11: the shard's completed sub-op
+              # timeline, merged into the primary op's children
+              ("stages", "str")]
 
 
 class MECSubRead(Message):

@@ -28,12 +28,14 @@ import asyncio
 import random
 import struct
 import threading
+import time
 from typing import Callable
 
 from ceph_tpu.parallel.messages import Message, decode_message
 from ceph_tpu.utils import checksum
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
+from ceph_tpu.utils.msgr_telemetry import telemetry as _telemetry
 
 log = Dout("ms")
 
@@ -62,7 +64,8 @@ class Connection:
 
     def send_message(self, msg: Message) -> None:
         """Thread-safe fire-and-forget reply path."""
-        self.msgr._submit(self.msgr._send_on(self, msg))
+        self.msgr._submit(
+            self.msgr._send_direct(self, msg, time.monotonic()))
 
     def close(self) -> None:
         self.closed = True
@@ -130,6 +133,11 @@ class Messenger:
         self.signer = None
         self.verifier = None
         self._running = False
+        #: sends submitted to the loop and not yet concluded — the
+        #: per-messenger share of the process send_queue_depth gauge,
+        #: reconciled at shutdown (a coroutine the dying loop never
+        #: ran can no longer decrement itself)
+        self._sends_outstanding = 0
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> None:
@@ -181,13 +189,31 @@ class Messenger:
             pass
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)
+        # gauge reconciliation: a send the dying loop never got to run
+        # (or whose cancellation was dropped with the loop) can no
+        # longer decrement itself — settle its share so the process
+        # send_queue_depth gauge still reads 0 at idle
+        leaked, self._sends_outstanding = self._sends_outstanding, 0
+        if leaked:
+            _telemetry().send_queue_delta(-leaked)
 
     def _submit(self, coro) -> None:
+        """Schedule a send coroutine on the messenger loop. The send-
+        queue depth gauge counts it from here until the coroutine
+        finishes (its own finally); a submit that cannot be scheduled
+        (shutdown race) closes the coroutine and takes the count
+        straight back down so the gauge returns to zero at idle."""
+        _telemetry().send_queue_delta(1)
+        self._sends_outstanding += 1
         if self._running:
             try:
                 asyncio.run_coroutine_threadsafe(coro, self._loop)
+                return
             except RuntimeError:
                 pass
+        coro.close()
+        self._sends_outstanding -= 1
+        _telemetry().send_queue_delta(-1)
 
     # -- receive path -------------------------------------------------
     async def _accept(self, reader: asyncio.StreamReader,
@@ -222,7 +248,10 @@ class Messenger:
                 # throttle BEFORE buffering the body: the budget bounds
                 # in-memory message bytes (the reference throttles the
                 # same way, before reading the frame body)
+                _tt0 = time.monotonic()
                 await self._throttle.acquire(plen)
+                _telemetry().note_throttle_wait(
+                    time.monotonic() - _tt0)
                 try:
                     payload = await conn.reader.readexactly(plen)
                     # crc==0 marks an unchecksummed frame (ms_crc_data
@@ -244,6 +273,11 @@ class Messenger:
                     try:
                         msg = decode_message(mtype, payload)
                         msg.seq = seq
+                        # wire receive stamp: the dispatch layer's
+                        # queue-wait measurement anchors here (and a
+                        # StageClock's ``wire`` interval ends here)
+                        msg._rx_t = time.monotonic()
+                        _telemetry().note_recv(mtype, plen)
                         if peer_addr in self.blocked_peers:
                             log(5, f"partition: dropping {mtype} from "
                                 f"{peer_name}")
@@ -265,7 +299,7 @@ class Messenger:
     def send_message(self, msg: Message, dest_addr: str) -> None:
         """Thread-safe, fire-and-forget (the reference's send_message
         contract). Lossy: upper layers own retries."""
-        self._submit(self._send_to(msg, dest_addr))
+        self._submit(self._send_to(msg, dest_addr, time.monotonic()))
 
     async def _get_conn(self, dest_addr: str) -> Connection | None:
         """Resolve (or establish) the one cached connection to a peer.
@@ -295,20 +329,51 @@ class Messenger:
         self._loop.create_task(self._read_loop(conn))
         return conn
 
-    async def _send_to(self, msg: Message, dest_addr: str) -> None:
-        for _attempt in (0, 1):   # one transparent reconnect
-            conn = await self._get_conn(dest_addr)
-            if conn is None:
-                return
-            if await self._send_on(conn, msg):
-                return
-            if self._out.get(dest_addr) is conn:
-                self._out.pop(dest_addr, None)
+    async def _send_to(self, msg: Message, dest_addr: str,
+                       t_submit: float) -> None:
+        try:
+            for _attempt in (0, 1):   # one transparent reconnect
+                conn = await self._get_conn(dest_addr)
+                if conn is None:
+                    # message lost on a failed connect — the lossy
+                    # contract allows it, but it must be VISIBLE
+                    # (flight recorder / SLOW_OPS wire-trouble signal)
+                    log(1, f"dropping type {msg.MSG_TYPE} to "
+                        f"{dest_addr}: connect failed")
+                    _telemetry().note_drop(msg.MSG_TYPE)
+                    return
+                if await self._send_on(conn, msg, t_submit):
+                    return
+                if self._out.get(dest_addr) is conn:
+                    self._out.pop(dest_addr, None)
+            log(1, f"dropping type {msg.MSG_TYPE} to {dest_addr}: "
+                "send failed after reconnect")
+            _telemetry().note_drop(msg.MSG_TYPE)
+        finally:
+            self._sends_outstanding -= 1
+            _telemetry().send_queue_delta(-1)
 
-    async def _send_on(self, conn: Connection, msg: Message) -> bool:
+    async def _send_direct(self, conn: Connection, msg: Message,
+                           t_submit: float) -> None:
+        """Reply path (Connection.send_message): one shot on the very
+        connection the request arrived on; a failed write is a lost
+        reply (client resends), logged + counted, never retried."""
+        try:
+            if not await self._send_on(conn, msg, t_submit):
+                log(1, f"dropping type {msg.MSG_TYPE} reply to "
+                    f"{conn.peer_name or conn.peer_addr}: send failed")
+                _telemetry().note_drop(msg.MSG_TYPE)
+        finally:
+            self._sends_outstanding -= 1
+            _telemetry().send_queue_delta(-1)
+
+    async def _send_on(self, conn: Connection, msg: Message,
+                       t_submit: float | None = None) -> bool:
+        tel = _telemetry()
         if conn.peer_addr in self.blocked_peers:
             log(5, f"partition: dropping {msg.MSG_TYPE} to "
                 f"{conn.peer_addr}")
+            tel.note_drop(msg.MSG_TYPE)
             return True     # silently lost (lossy semantics)
         if self._inject_every and \
                 self._inject_rng.randrange(self._inject_every) == 0:
@@ -316,7 +381,16 @@ class Messenger:
             conn.close()
             if self._out.get(conn.peer_addr) is conn:
                 self._out.pop(conn.peer_addr, None)
+            tel.note_drop(msg.MSG_TYPE)
             return True   # message silently lost (lossy semantics)
+        t_pick = time.monotonic()
+        # an attached StageClock (client ops, EC sub-writes) gets its
+        # send-queue-wait mark here and ships every mark so far in the
+        # message's ``stages`` field — serialized below with the rest
+        clock = getattr(msg, "_stage_clock", None)
+        if clock is not None:
+            clock.mark_once("send_queue_wait", t=t_pick)
+            msg.stages = clock.to_wire()
         payload = msg.encode_payload()
         self._seq += 1
         auth = self.signer.sign(payload) if self.signer else ""
@@ -326,12 +400,20 @@ class Messenger:
                  + struct.pack("<H", len(meta)) + meta
                  + struct.pack("<II", len(payload), crc)
                  + payload)
+        tel.note_send(msg.MSG_TYPE, len(frame),
+                      time.monotonic() - t_pick,
+                      0.0 if t_submit is None else t_pick - t_submit)
         try:
             async with conn.lock:
                 conn.writer.write(frame)
                 await conn.writer.drain()
             return True
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as exc:
+            # the silent-loss bug class this PR closes: a failed write
+            # now says WHAT was lost and to WHOM, and counts
+            log(1, f"send of type {msg.MSG_TYPE} to "
+                f"{conn.peer_name or conn.peer_addr} failed: {exc!r}")
+            tel.note_send_error(msg.MSG_TYPE)
             conn.close()
             return False
 

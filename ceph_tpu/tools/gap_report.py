@@ -1,0 +1,176 @@
+"""gap_report — the daemon->engine gap, attributed to stages.
+
+ROADMAP item 1's ~1000x gap (engine closed-loop ~87.9 GB/s vs the
+Python OSD daemons' ~89.6 MB/s) was "wire/dispatch-bound" by
+hand-waving. This tool makes it a table: it runs the cluster bench
+(``cluster_bench.run_one`` — real daemons, real messenger, the device
+stripe-batch engine) and the engine closed-loop bench
+(``bench/engine_loop``) back to back, then prints ONE attribution
+table built from the per-op stage timelines (utils/stage_clock +
+utils/dataplane): X% serialize/wire, Y% dispatch wait, Z% engine
+queue, ... — shares of the measured end-to-end client-op latency,
+whose stage sums account for the whole op (coverage_pct; the
+acceptance bar is >= 90%).
+
+Output: a human table plus one machine-readable JSON line
+(``{"gap_report": {...}}``) a driver can parse.
+
+    python -m ceph_tpu.tools.gap_report                 # quick (CPU ok)
+    python -m ceph_tpu.tools.gap_report --full          # driver scale
+    python -m ceph_tpu.tools.gap_report --run-engine-loop  # chip only
+
+On a CPU-only host the engine side defaults to the recorded BASELINE
+capacity (marked ``engine_source: baseline``) instead of re-measuring
+a number the host cannot produce; ``--engine-gbps`` overrides, and
+``--run-engine-loop`` measures for real (serialize with other chip
+work).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+#: BASELINE.md "Engine capacity": the chip-measured closed-loop GB/s
+#: used when this host cannot measure it (CPU-only quick runs)
+BASELINE_ENGINE_GBPS = 87.9
+
+#: stage -> short attribution label for the table
+_LABELS = {
+    "objecter_encode": "client encode/target",
+    "send_queue_wait": "send-queue wait",
+    "wire": "serialize + wire",
+    "dispatch_queue_wait": "dispatch-queue wait",
+    "pg_process": "PG lock/process",
+    "engine_stage_wait": "engine staging queue",
+    "device_window_wait": "device window wait",
+    "device_finalize": "device compute+download",
+    "commit_wait": "shard fan-out + commit",
+    "commit_reply": "reply wire + wakeup",
+}
+
+
+def _engine_side(args) -> dict:
+    """The engine half of the comparison: measured when asked/possible,
+    else the recorded baseline — always labeled with its provenance."""
+    if args.engine_gbps is not None:
+        return {"engine_GBps": float(args.engine_gbps),
+                "engine_source": "cli"}
+    if args.run_engine_loop:
+        from ceph_tpu.bench import engine_loop
+        out = engine_loop.run()
+        return {"engine_GBps": out["value"],
+                "engine_source": "engine_loop",
+                "engine_loop": out}
+    try:
+        import jax
+        on_chip = jax.default_backend() not in ("cpu",)
+    except Exception:
+        on_chip = False
+    if on_chip:
+        from ceph_tpu.bench import engine_loop
+        out = engine_loop.run()
+        return {"engine_GBps": out["value"],
+                "engine_source": "engine_loop",
+                "engine_loop": out}
+    return {"engine_GBps": BASELINE_ENGINE_GBPS,
+            "engine_source": "baseline"}
+
+
+def run_report(seconds: float, n_osds: int, obj_size: int,
+               threads: int, k: int, m: int, backend: str,
+               args) -> dict:
+    from ceph_tpu.bench import cluster_bench
+    from ceph_tpu.utils.dataplane import dataplane
+
+    # fresh stage registry: the table attributes THIS run, not
+    # whatever the process did before
+    dataplane().reset()
+    cluster = cluster_bench.run_one(backend, seconds, n_osds,
+                                    obj_size, threads, k=k, m=m)
+    engine = _engine_side(args)
+    breakdown = cluster.get("stage_breakdown") or \
+        dataplane().stage_breakdown()
+
+    cluster_mbps = cluster.get("bandwidth_MBps") or 0.0
+    engine_gbps = engine["engine_GBps"]
+    report = {
+        "cluster_MBps": cluster_mbps,
+        "cluster_p50_ms": cluster.get("p50_ms"),
+        "cluster_p99_ms": cluster.get("p99_ms"),
+        "engine_GBps": engine_gbps,
+        "engine_source": engine["engine_source"],
+        "gap_x": round(engine_gbps * 1e3 / cluster_mbps, 1)
+        if cluster_mbps else None,
+        "ops": breakdown.get("ops", 0),
+        "mean_ms": breakdown.get("mean_ms"),
+        "coverage_pct": breakdown.get("coverage_pct", 0.0),
+        "stages": breakdown.get("stages", {}),
+        "subops": breakdown.get("subops", {}),
+        "profile": cluster.get("profile"),
+        "backend": cluster.get("backend"),
+    }
+    return report
+
+
+def print_table(report: dict) -> None:
+    print()
+    print("=== data-plane gap report ===")
+    print(f"cluster (daemon path): {report['cluster_MBps']} MB/s   "
+          f"p50 {report['cluster_p50_ms']} ms / "
+          f"p99 {report['cluster_p99_ms']} ms   "
+          f"[{report['backend']}, {report['profile']}]")
+    print(f"engine (closed loop):  {report['engine_GBps']} GB/s   "
+          f"(source: {report['engine_source']})")
+    if report["gap_x"]:
+        print(f"gap: {report['gap_x']}x")
+    print()
+    print(f"{'stage':<22}{'label':<26}{'mean_ms':>9}{'share':>8}")
+    print("-" * 65)
+    for stage, ent in report["stages"].items():
+        print(f"{stage:<22}{_LABELS.get(stage, ''):<26}"
+              f"{ent['mean_ms']:>9.3f}{ent['share_pct']:>7.1f}%")
+    print("-" * 65)
+    print(f"{'stage sum coverage of e2e latency':<48}"
+          f"{report['coverage_pct']:>16.1f}%")
+    for stage, ent in report.get("subops", {}).items():
+        print(f"  (subop) {stage:<20}{ent['mean_ms']:>9.3f} ms")
+    print()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="gap_report")
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--osds", type=int, default=3)
+    ap.add_argument("--obj-kb", type=float, default=64.0)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--k", type=int, default=2)
+    ap.add_argument("--m", type=int, default=1)
+    ap.add_argument("--backend", default="jax",
+                    help="EC profile backend (jax runs the device "
+                         "engine path on any platform)")
+    ap.add_argument("--full", action="store_true",
+                    help="driver-scale run: 12 osds, k=8 m=3, 4 MiB "
+                         "objects, 20 s")
+    ap.add_argument("--engine-gbps", type=float, default=None,
+                    help="use this engine capacity instead of "
+                         "measuring / the baseline")
+    ap.add_argument("--run-engine-loop", action="store_true",
+                    help="measure the engine closed loop here "
+                         "(serialize with other chip work)")
+    args = ap.parse_args(argv)
+    if args.full:
+        args.osds, args.k, args.m = 12, 8, 3
+        args.obj_kb, args.seconds, args.threads = 4096, 20.0, 8
+        args.backend = "pallas"
+    report = run_report(args.seconds, args.osds,
+                        int(args.obj_kb * 1024), args.threads,
+                        args.k, args.m, args.backend, args)
+    print_table(report)
+    print(json.dumps({"gap_report": report}, sort_keys=True),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

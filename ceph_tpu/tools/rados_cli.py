@@ -22,6 +22,16 @@ import sys
 import time
 
 
+def _percentile_ms(lats: list[float], q: float) -> float:
+    """Nearest-rank percentile over the timed ops, in ms (zero extra
+    bench budget: same list avg/max already read)."""
+    if not lats:
+        return 0.0
+    ordered = sorted(lats)
+    idx = min(len(ordered) - 1, max(0, int(q * len(ordered)) - 1))
+    return round(ordered[idx] * 1e3, 3)
+
+
 def _bench(io, seconds: float, mode: str, obj_size: int,
            concurrency: int) -> dict:
     payload = bytes((i * 131) & 0xFF for i in range(obj_size))
@@ -59,6 +69,10 @@ def _bench(io, seconds: float, mode: str, obj_size: int,
         "iops": round(len(written) / write_elapsed, 1),
         "avg_latency_s": round(sum(lats) / max(len(lats), 1), 5),
         "max_latency_s": round(max(lats, default=0.0), 5),
+        # client-op latency tails from the SAME timed ops (ISSUE 6
+        # satellite; pinned by tests/test_bench_wiring.py)
+        "p50_ms": _percentile_ms(lats, 0.50),
+        "p99_ms": _percentile_ms(lats, 0.99),
     }
     if mode == "seq":
         rlats: list[float] = []

@@ -59,13 +59,18 @@ def dump_all_trackers() -> dict:
 
 
 class TrackedOp:
-    __slots__ = ("seq", "desc", "start", "events", "_tracker")
+    __slots__ = ("seq", "desc", "start", "events", "stages",
+                 "_tracker")
 
     def __init__(self, seq: int, desc: str, tracker: "OpTracker") -> None:
         self.seq = seq
         self.desc = desc
         self.start = time.monotonic()
         self.events: list[tuple[float, str]] = [(self.start, "initiated")]
+        #: the op's StageClock (utils/stage_clock) when the data-plane
+        #: timeline rides this op — dumped alongside the event list so
+        #: dump_historic_ops shows the per-stage decomposition
+        self.stages = None
         self._tracker = tracker
 
     def mark_event(self, name: str) -> None:
@@ -80,13 +85,18 @@ class TrackedOp:
         return time.monotonic() - self.start
 
     def dump(self) -> dict:
-        return {
+        out = {
             "seq": self.seq,
             "desc": self.desc,
             "age": round(self.age, 6),
             "events": [{"t": round(t - self.start, 6), "event": e}
                        for t, e in self.events],
         }
+        if self.stages is not None:
+            timeline = self.stages.dump()
+            if timeline:
+                out["stages"] = timeline
+        return out
 
 
 class OpTracker:

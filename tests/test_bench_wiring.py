@@ -71,6 +71,64 @@ def test_health_snapshot_degrades_to_ok_shape(monkeypatch):
                                         "checks": {}}
 
 
+class _StubIo:
+    """Minimal io surface _bench drives (write_full/read/remove)."""
+
+    def __init__(self):
+        self.objects = {}
+
+    def write_full(self, oid, data):
+        self.objects[oid] = bytes(data)
+        return 1
+
+    def read(self, oid):
+        return self.objects[oid]
+
+    def remove(self, oid):
+        self.objects.pop(oid, None)
+
+
+def test_cluster_bench_line_carries_p50_p99_and_stage_breakdown():
+    """ISSUE 6 satellites, pinned: cluster_bench metric lines carry
+    p50_ms/p99_ms (from the same timed ops, zero extra budget) and a
+    stage_breakdown — and the whole line round-trips json.loads."""
+    from ceph_tpu.bench import cluster_bench
+    from ceph_tpu.tools.rados_cli import _bench
+    from ceph_tpu.utils.dataplane import dataplane
+
+    # seed the stage registry so the breakdown is non-trivial
+    dataplane().record_stages([("wire", 0.001),
+                               ("commit_wait", 0.003)])
+    dataplane().perf.hinc("op_total_us", 4000.0)
+    dataplane().perf.tinc("op_total", 0.004)
+    dataplane().perf.inc("ops_timed")
+
+    out = _bench(_StubIo(), 0.05, "write", 1024, 2)
+    cluster_bench.attach_stage_breakdown(out)
+    rec = json.loads(json.dumps(out))
+    assert rec["p99_ms"] >= rec["p50_ms"] > 0
+    bd = rec["stage_breakdown"]
+    assert bd["ops"] >= 1
+    assert "wire" in bd["stages"]
+    assert bd["stages"]["wire"]["share_pct"] >= 0
+    assert "coverage_pct" in bd
+
+
+def test_stage_breakdown_degrades_to_empty(monkeypatch):
+    """A dataplane fault must never cost a cluster_bench line."""
+    from ceph_tpu.bench import cluster_bench
+
+    import ceph_tpu.utils.dataplane as dp
+
+    def boom():
+        raise RuntimeError("dataplane down")
+
+    monkeypatch.setattr(dp, "dataplane", boom)
+    out = cluster_bench.attach_stage_breakdown({"value": 1})
+    assert out["stage_breakdown"] == {}
+    json.loads(json.dumps(out))
+
+
 def test_multichip_metric_emits_parseable_line(capsys, monkeypatch):
     """The round-9 acceptance gate: on >= 2 devices (the conftest's 8
     virtual CPU devices here) bench's multichip row measures the real

@@ -1,0 +1,112 @@
+"""Counter-schema lint (ISSUE 6 satellite): every registered
+PerfCounter must actually be exported — present in the prometheus
+exposition, and (for the device logger) in the ``device perf dump``
+asok payload. Catches the "registered but never exported" drift
+class: a counter added to a registry but dropped by an exporter
+renders every dashboard built on it silently blind.
+"""
+
+import re
+
+from ceph_tpu.utils import prometheus
+from ceph_tpu.utils.perf_counters import CounterType, collection
+
+
+def _ensure_registries():
+    """Instantiate every process-wide registry this repo declares so
+    the lint covers their full schemas."""
+    from ceph_tpu.utils.dataplane import dataplane
+    from ceph_tpu.utils.device_telemetry import telemetry
+    from ceph_tpu.utils.msgr_telemetry import telemetry as msgr
+    telemetry()
+    dataplane()
+    msgr()
+
+
+def test_every_counter_reaches_prometheus():
+    _ensure_registries()
+    text = prometheus.render_text()
+    missing = []
+    for daemon, counters in collection().dump().items():
+        for key in counters:
+            metric = "ceph_tpu_" + prometheus._sanitize(key)
+            # a counter exports as the bare metric (u64/gauge), the
+            # summary pair (time_avg), or the histogram family
+            pat = re.compile(
+                rf"^{re.escape(metric)}(_sum|_avgcount|_bucket|"
+                rf"_count)?\{{", re.M)
+            if not pat.search(text):
+                missing.append(f"{daemon}/{key}")
+    assert not missing, \
+        f"registered but not in prometheus exposition: {missing}"
+
+
+def test_every_daemon_label_reaches_prometheus():
+    _ensure_registries()
+    text = prometheus.render_text()
+    for daemon in collection().dump():
+        esc = prometheus._escape_label(daemon)
+        assert f'daemon="{esc}"' in text, \
+            f"daemon {daemon!r} missing from the exposition"
+
+
+def test_device_counters_reach_asok_dump():
+    """The ``device perf dump`` asok payload must carry every counter
+    the device logger registers (same drift class, asok side)."""
+    from ceph_tpu.utils import device_telemetry
+
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    device_telemetry.register_asok(asok)
+    payload = asok.commands["device perf dump"]({})
+    exported = set(payload["counters"])
+    registered = set(device_telemetry.telemetry().perf.dump())
+    assert registered <= exported, \
+        f"missing from device perf dump: {registered - exported}"
+
+
+def test_dataplane_counters_reach_asok_dump():
+    """Same lint for the dataplane registry's asok command."""
+    from ceph_tpu.utils import dataplane as dp_mod
+
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    dp_mod.register_asok(asok)
+    payload = asok.commands["dump_op_timeline"]({})
+    exported = set(payload["counters"])
+    registered = set(dp_mod.dataplane().perf.dump())
+    assert registered <= exported, \
+        f"missing from dump_op_timeline: {registered - exported}"
+
+
+def test_histogram_exposition_is_cumulative_and_typed():
+    """The histogram family renders the full prometheus shape: TYPE
+    line, monotone cumulative buckets, +Inf, and _count == +Inf."""
+    _ensure_registries()
+    from ceph_tpu.utils.dataplane import dataplane
+    dataplane().perf.hinc("op_total_us", 100.0)
+    text = prometheus.render_text()
+    assert "# TYPE ceph_tpu_op_total_us histogram" in text
+    buckets = [
+        int(m.group(2))
+        for m in re.finditer(
+            r'ceph_tpu_op_total_us_bucket\{daemon="dataplane",'
+            r'le="([^"]+)"\} (\d+)', text)]
+    assert buckets, "op_total_us histogram missing"
+    assert buckets == sorted(buckets), "buckets not cumulative"
+    count = re.search(
+        r'ceph_tpu_op_total_us_count\{daemon="dataplane"\} (\d+)',
+        text)
+    assert count and int(count.group(1)) == buckets[-1]

@@ -26,6 +26,20 @@ def cluster():
         ):
             code, outs, _ = rados.mon_command(cmd)
             assert code == 0, outs
+        # wait until the CLIENT's cached osdmap shows the overlay:
+        # mon commits propagate by async push, and a write_full racing
+        # the push goes straight to base instead of redirecting (the
+        # in-suite failure mode of the first test)
+        base_id = c.mon.osdmap.pool_by_name["base"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            m = rados.monc.osdmap
+            pool = m.pools.get(base_id) if m else None
+            if pool is not None and pool.read_tier >= 0:
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError("overlay never reached the client map")
         yield c
 
 
@@ -56,18 +70,30 @@ def test_write_lands_in_cache_and_agent_flushes(cluster, rados):
     the agent writes it back to base."""
     base_io = rados.open_ioctx("base")
     hot_io = rados.open_ioctx("hot")
-    base_io.write_full("obj1", b"tiered-payload")   # redirected
-    # the object materialized in the CACHE pool, not base (PGLS is
-    # not redirected, so the two listings tell them apart). Base is
-    # checked FIRST (before the agent can flush); the hot listing is
-    # polled briefly — PGLS fans per-PG ops that can transiently race
-    # the map churn right after pool/tier creation (pre-existing
-    # ~5% flake on the seed: an acked write's listing came back [])
-    assert "obj1" not in base_io.list_objects()
-    _wait(lambda: "obj1" in hot_io.list_objects(), timeout=10,
-          msg="write visible in cache-pool listing")
-    # reads through the overlay serve from cache
-    assert base_io.read("obj1") == b"tiered-payload"
+    # hold the heartbeat-driven agent off while asserting the
+    # PRE-flush state: under suite load a tick could flush obj1 to
+    # base between the write and the first listing (the other
+    # direction of the seed's ~5% PGLS flake), which is legitimate
+    # agent behavior but not what this phase asserts
+    for osd in cluster.osds.values():
+        with osd.tier._agent_lock:
+            osd.tier._agent_running = True
+    try:
+        base_io.write_full("obj1", b"tiered-payload")   # redirected
+        # the object materialized in the CACHE pool, not base (PGLS
+        # is not redirected, so the two listings tell them apart);
+        # the hot listing is polled briefly — PGLS fans per-PG ops
+        # that can transiently race the map churn right after
+        # pool/tier creation
+        assert "obj1" not in base_io.list_objects()
+        _wait(lambda: "obj1" in hot_io.list_objects(), timeout=10,
+              msg="write visible in cache-pool listing")
+        # reads through the overlay serve from cache
+        assert base_io.read("obj1") == b"tiered-payload"
+    finally:
+        for osd in cluster.osds.values():
+            with osd.tier._agent_lock:
+                osd.tier._agent_running = False
     # agent flush propagates to base
     _wait(lambda: "obj1" in base_io.list_objects(),
           msg="agent flush to base")

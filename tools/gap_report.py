@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo-root shim for the data-plane gap-attribution profiler:
+
+    python tools/gap_report.py [--full] [--run-engine-loop] ...
+
+Real implementation: ceph_tpu/tools/gap_report.py (also runnable as
+``python -m ceph_tpu.tools.gap_report``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.tools.gap_report import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
