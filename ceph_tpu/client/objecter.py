@@ -28,6 +28,7 @@ from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Connection, Messenger
 from ceph_tpu.parallel.mon_client import MonClient
 from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.utils import profiler as _profiler
 from ceph_tpu.utils import stage_clock
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dataplane import dataplane
@@ -130,7 +131,11 @@ class Objecter:
                 "(blocklisted); reconnect for a fresh instance")
         # the op's StageClock anchors here: the per-op data-plane
         # timeline every daemon downstream continues (always on —
-        # marks are a list append, recording a few histogram incs)
+        # marks are a list append, recording a few histogram incs).
+        # The profiler stage join brackets the same interval: a
+        # sample of this thread until the send hand-off is
+        # objecter_encode work.
+        _pstage = _profiler.push_stage("objecter_encode")
         clock = stage_clock.StageClock()
         with self._lock:
             tid = self._next_tid
@@ -153,9 +158,20 @@ class Objecter:
         with self._lock:
             self._pending[tid] = rec
         span.event("submitted")
-        self._send(rec)
         try:
-            if not rec.event.wait(timeout):
+            self._send(rec)
+        finally:
+            _profiler.pop_stage(_pstage)
+        try:
+            # blocked on the cluster: a sample of this thread here is
+            # client wait, not encode work (the classifier would
+            # otherwise charge the park to objecter_encode)
+            _pwait = _profiler.push_stage("client_wait")
+            try:
+                committed = rec.event.wait(timeout)
+            finally:
+                _profiler.pop_stage(_pwait)
+            if not committed:
                 with self._lock:
                     self._pending.pop(tid, None)
                 span.event("timeout")

@@ -15,6 +15,8 @@ from the mgr's cluster view:
     GET /api/dataplane  per-op stage-latency decomposition (stage
                       breakdown + messenger counters + recent merged
                       timelines)
+    GET /api/profile  continuous-profiler aggregate (status, per-stage
+                      sample shares, top-N hot frames, folded stacks)
 
 Commands: ``dashboard status|on|off`` over the mgr asok; ``on`` binds
 an ephemeral port (reported by status) on 127.0.0.1.
@@ -67,6 +69,10 @@ _PAGE = """<!doctype html>
 {dp_dropped}</p>
 <table><tr><th>stage</th><th>mean ms</th><th>share</th></tr>
 {dp_rows}</table>
+<h3>profiler</h3>
+<p>{prof_status}</p>
+<table><tr><th>stage</th><th>hot frame</th><th>samples</th>
+<th>share</th></tr>{prof_rows}</table>
 </body></html>"""
 
 
@@ -110,6 +116,14 @@ class Module(MgrModule):
             from ceph_tpu.utils.device_telemetry import telemetry
             return 200, "application/json", json.dumps(
                 self._scrub_counters(telemetry())).encode()
+        if path == "/api/profile":
+            from ceph_tpu.utils.profiler import profiler
+            prof = profiler()
+            return 200, "application/json", json.dumps(
+                {"status": prof.status(),
+                 "dump": prof.dump(),
+                 "top_frames": prof.top_frames(10),
+                 "folded": prof.folded()}).encode()
         if path == "/api/dataplane":
             from ceph_tpu.utils.dataplane import dataplane
             from ceph_tpu.utils.msgr_telemetry import telemetry as mt
@@ -198,6 +212,15 @@ class Module(MgrModule):
             f"<td>{ent['share_pct']}%</td></tr>"
             for stage, ent in bd.get("stages", {}).items()) \
             or "<tr><td colspan=3>no timed ops yet</td></tr>"
+        from ceph_tpu.utils.profiler import profiler as _profiler
+        prof = _profiler()
+        prof_rows = "".join(
+            f"<tr><td>{html.escape(stage)}</td>"
+            f"<td>{html.escape(f['frame'])}</td>"
+            f"<td>{f['samples']}</td><td>{f['pct']}%</td></tr>"
+            for stage, frames in sorted(prof.top_frames(3).items())
+            for f in frames) \
+            or "<tr><td colspan=4>no samples (profile start)</td></tr>"
         mc = _mt().perf.dump()
         counters = tel.snapshot()["counters"]
         depth = counters.get("engine_inflight_depth", [])
@@ -233,6 +256,8 @@ class Module(MgrModule):
             dp_send_errors=mc.get("send_errors", 0),
             dp_dropped=mc.get("dropped_msgs", 0),
             dp_rows=dp_rows,
+            prof_status=html.escape(json.dumps(prof.status())),
+            prof_rows=prof_rows,
         ).encode()
 
     # -- server --------------------------------------------------------

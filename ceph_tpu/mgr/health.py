@@ -220,6 +220,34 @@ def _check_cache_miss_storm(ctx: CheckContext) -> dict | None:
          f"{int(ctx.value('device.compile_cache_hits'))}"])
 
 
+def _check_hbm_pressure(ctx: CheckContext) -> dict | None:
+    """The device engine's live buffer bytes (staged + launch-window,
+    utils/device_telemetry HBM ledger) holding at warning level: the
+    encode window is outrunning retirement — op backpressure and,
+    on a real chip, HBM exhaustion are next. The gauges reconcile to
+    zero at idle, so a raised check always means live load."""
+    limit = g_conf()["health_hbm_warn_bytes"]
+    if limit <= 0:
+        return None
+    live = ctx.value("device.hbm_live_bytes")
+    if live < limit:
+        return None
+    staged = int(ctx.value("device.hbm_staged_bytes"))
+    inflight = int(ctx.value("device.hbm_inflight_bytes"))
+    peak = int(ctx.value("device.hbm_peak_live_bytes"))
+    return check(
+        "HBM_PRESSURE", WARN,
+        f"{live / 1e6:.0f} MB live device buffer bytes "
+        f"(staged {staged / 1e6:.0f} MB + in-window "
+        f"{inflight / 1e6:.0f} MB) >= {limit / 1e6:.0f} MB",
+        [f"hbm_peak_live_bytes: {peak}",
+         f"engine_inflight: "
+         f"{int(ctx.value('device.engine_inflight'))}/"
+         f"{int(ctx.value('device.engine_window'))} batches",
+         f"hbm_retired_bytes total: "
+         f"{int(ctx.value('device.hbm_retired_bytes'))}"])
+
+
 BUILTIN_CHECKS = (
     ("SLOW_OPS", _check_slow_ops),
     ("OSD_DOWN", _check_osd_down),
@@ -228,6 +256,7 @@ BUILTIN_CHECKS = (
     ("ENGINE_STALL", _check_engine_stall),
     ("SCRUB_MISMATCH", _check_scrub_mismatch),
     ("COMPILE_CACHE_MISS_STORM", _check_cache_miss_storm),
+    ("HBM_PRESSURE", _check_hbm_pressure),
 )
 
 
@@ -397,6 +426,14 @@ class HealthEngine:
         section("traces", lambda: tracer().dump())
         from ceph_tpu.utils.device_telemetry import telemetry
         section("device", lambda: telemetry().snapshot())
+        from ceph_tpu.utils import profiler as _profiler
+        # status + hot frames only when a profiler EXISTS — diagnosing
+        # must not allocate one (the OFF-cost contract)
+        prof = _profiler.profiler_if_exists()
+        if prof is not None:
+            section("profiler", lambda: {
+                "status": prof.status(),
+                "top_frames": prof.top_frames(10)})
         from ceph_tpu.utils import compile_cache
         section("compile_cache", lambda: {
             "dir": compile_cache.enabled_dir(),

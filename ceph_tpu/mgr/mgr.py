@@ -15,6 +15,7 @@ import threading
 import time
 
 from ceph_tpu.client.rados import RadosClient
+from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils.admin_socket import (
     AdminSocket,
     register_common_commands,
@@ -119,11 +120,14 @@ class Mgr:
                 if period <= 0 or now - last.get(name, 0.0) < period:
                     continue
                 last[name] = now
+                _pstage = _prof.push_stage("mgr_tick")
                 try:
                     mod.tick()
                 except Exception as exc:
                     self.logger.inc("module_errors")
                     log(1, f"mgr module {name} tick failed: {exc!r}")
+                finally:
+                    _prof.pop_stage(_pstage)
             self.logger.inc("tick_rounds")
 
     def _asok_module(self, mod, sub: str, args: dict) -> dict:

@@ -1,0 +1,137 @@
+"""Compiled cost analysis + roofline estimates for device programs.
+
+The bench gate reports MEASURED GB/s; this module adds the number to
+judge it against: XLA's compiled cost analysis (FLOPs and bytes
+accessed per execution of the exact compiled program) and the chip's
+peak FLOP/s + HBM bandwidth give the roofline estimate — the best
+GB/s this program could reach if it were perfectly scheduled. A bench
+line running far under its roofline is leaving device performance on
+the table (kernel/layout work pays); a line AT its roofline can only
+get faster by moving less data (algorithm work pays). RapidRAID's
+pipelining argument (PAPERS.md) only holds where the host, not the
+device, bottlenecks — the roofline check is how a signature proves
+which side it is on.
+
+Everything degrades to ``None``/``{}``: cost analysis is an XLA
+introspection (``compiled.cost_analysis()``) whose availability and
+key set vary by backend and jax version, and a bench line must never
+die for a missing estimate.
+
+Peaks default per backend (order-of-magnitude numbers for the
+roofline RATIO, not marketing claims) and are overridable via
+``CEPH_TPU_PEAK_HBM_GBPS`` / ``CEPH_TPU_PEAK_TFLOPS`` when the real
+chip generation is known.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: backend -> (HBM/memory GB/s, peak TFLOP/s): deliberately coarse
+#: defaults — the roofline is a sanity ratio, and the env overrides
+#: pin it to a real part when precision matters
+_PEAKS = {
+    "tpu": (1200.0, 275.0),
+    "gpu": (900.0, 60.0),
+    "cpu": (25.0, 0.5),
+}
+
+
+def peaks() -> tuple[float, float]:
+    """(peak_GBps, peak_TFLOPs) for the active backend, env-
+    overridable."""
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    bw, tf = _PEAKS.get(backend, _PEAKS["cpu"])
+    bw = float(os.environ.get("CEPH_TPU_PEAK_HBM_GBPS", bw))
+    tf = float(os.environ.get("CEPH_TPU_PEAK_TFLOPS", tf))
+    return bw, tf
+
+
+def _extract(ca) -> dict | None:
+    """Normalize cost_analysis() output across jax versions: a dict,
+    or a one-element list of dicts, keyed 'flops' / 'bytes accessed'
+    (utilization keys ignored)."""
+    if ca is None:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+        if ca is None:
+            return None
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed")
+    if flops is None and nbytes is None:
+        return None
+    out = {}
+    if flops is not None and flops == flops:   # NaN guard
+        out["flops"] = float(flops)
+    if nbytes is not None and nbytes == nbytes:
+        out["bytes_accessed"] = float(nbytes)
+    return out or None
+
+
+def analyze(fn, *args, signature: str | None = None) -> dict | None:
+    """Lower+compile ``fn`` on the concrete ``args`` and return
+    ``{"flops", "bytes_accessed"}`` (whichever the backend reports),
+    or None. ``fn`` may be jitted or plain (plain is wrapped). With
+    ``signature`` the outcome is recorded in the device-telemetry
+    per-signature cost table (``device perf dump`` / dashboard).
+
+    This COMPILES the program (the AOT path does not share the jit
+    call cache), so call it off the hot path — bench warmups, cache
+    misses behind ``CEPH_TPU_COST_ANALYSIS``, tests.
+    """
+    try:
+        import jax
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = jitted.lower(*args).compile()
+        cost = _extract(compiled.cost_analysis())
+    except Exception:
+        return None
+    if cost and signature:
+        try:
+            from ceph_tpu.utils.device_telemetry import telemetry
+            telemetry().note_cost(signature, cost)
+        except Exception:
+            pass
+    return cost
+
+
+def roofline_gbps(flops: float | None, bytes_accessed: float | None,
+                  traffic_bytes: float) -> float | None:
+    """Best-case GB/s for a program serving ``traffic_bytes`` of
+    logical traffic per execution: execution time is bounded below by
+    max(bytes/peak_bw, flops/peak_flops)."""
+    bw_gbps, tflops = peaks()
+    t = 0.0
+    if bytes_accessed:
+        t = max(t, bytes_accessed / (bw_gbps * 1e9))
+    if flops:
+        t = max(t, flops / (tflops * 1e12))
+    if t <= 0:
+        return None
+    return traffic_bytes / t / 1e9
+
+
+def bench_fields(fn, args, traffic_bytes: float,
+                 signature: str | None = None) -> dict:
+    """The bench-line payload: ``{"cost_flops", "cost_bytes",
+    "roofline_GBps"}`` for the compiled program, or ``{}`` when the
+    backend cannot say (a metric line must never lose fields to a
+    cost-analysis fault)."""
+    cost = analyze(fn, *args, signature=signature)
+    if not cost:
+        return {}
+    out = {}
+    if "flops" in cost:
+        out["cost_flops"] = round(cost["flops"])
+    if "bytes_accessed" in cost:
+        out["cost_bytes"] = round(cost["bytes_accessed"])
+    rl = roofline_gbps(cost.get("flops"), cost.get("bytes_accessed"),
+                       traffic_bytes)
+    if rl is not None:
+        out["roofline_GBps"] = round(rl, 2)
+    return out

@@ -74,6 +74,7 @@ from typing import Callable
 import numpy as np
 
 from ceph_tpu.osd import ec_util
+from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils import stage_clock as _stage_clock
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils.dout import Dout
@@ -164,6 +165,9 @@ class DeviceEncodeEngine:
         device_finalize on it, so the per-op timeline survives the
         engine boundary. Both defaults are free no-ops."""
         import time as _time
+        # HBM ledger: bytes enter the staged bucket here and leave it
+        # at launch (-> in-window) or on a launch fault (-> retired)
+        _telemetry().note_hbm(staged_delta=data.nbytes)
         self._q.put(("enc", key, codec, sinfo, data, cont, span,
                      clock, _time.monotonic()))
 
@@ -183,6 +187,7 @@ class DeviceEncodeEngine:
         continuation publishes the result and sets an event for a
         blocked decode_sync caller)."""
         import time as _time
+        _telemetry().note_hbm(staged_delta=_shards_nbytes(shards))
         self._q.put(("dec", key, codec, sinfo, shards, want, cont,
                      span, clock, _time.monotonic()))
 
@@ -247,7 +252,12 @@ class DeviceEncodeEngine:
         #: order equals submission order.
         self._inflight = collections.deque()
         while True:
+            # profiler join: blocking on an empty queue is idle time,
+            # not engine work — without the mark, every sample of the
+            # parked engine thread would inflate engine_stage_wait
+            _pidle = _prof.push_stage("idle")
             item = self._q.get()
+            _prof.pop_stage(_pidle)
             if item is None:
                 self._drain_inflight()
                 return
@@ -302,10 +312,13 @@ class DeviceEncodeEngine:
                     pending, dec_pending, nbytes = {}, {}, 0
                     _, fn, box, ev = item
                     t0 = _time.perf_counter()
+                    prev_stage = _prof.push_stage("scrub")
                     try:
                         box[0] = fn()
                     except Exception as exc:
                         box[1] = exc
+                    finally:
+                        _prof.pop_stage(prev_stage)
                     self.stats["aux_runs"] += 1
                     self.stats["busy_s"] += _time.perf_counter() - t0
                     ev.set()
@@ -334,6 +347,17 @@ class DeviceEncodeEngine:
             # flag here raced the idle drain and dropped them)
 
     def _flush(self, pending: dict) -> None:
+        if not pending:
+            return
+        # profiler join: while the engine thread stages/launches, a
+        # sample of it belongs to the op's engine_stage_wait interval
+        prev_stage = _prof.push_stage("engine_stage_wait")
+        try:
+            self._flush_inner(pending)
+        finally:
+            _prof.pop_stage(prev_stage)
+
+    def _flush_inner(self, pending: dict) -> None:
         import time as _time
         from ceph_tpu.parallel import mesh as mesh_mod
         t0 = _time.perf_counter()
@@ -363,7 +387,10 @@ class DeviceEncodeEngine:
             except Exception as exc:
                 # launch failed: older batches' continuations must
                 # still run BEFORE these error continuations (per-PG
-                # order), so drain first
+                # order), so drain first. The batch's bytes leave the
+                # staged bucket here (fate decided: host fallback).
+                _telemetry().note_hbm(staged_delta=-nbytes,
+                                      retired=nbytes)
                 drained += self._drain_inflight()
                 log(0, f"device encode batch of {len(items)} ops "
                     f"failed: {exc!r}")
@@ -390,8 +417,14 @@ class DeviceEncodeEngine:
                     span.event(f"batch_flush ops={len(items)} "
                                f"bytes={nbytes}")
                 kspans.append(span.child("kernel_dispatch"))
+            # staged -> in-window (the batch byte count RIDES the
+            # in-flight entry so retirement can reconcile it — the
+            # pre-PR-7 engine dropped it here and the live gauges
+            # could never return to zero)
+            tel.note_hbm(staged_delta=-nbytes, inflight_delta=nbytes)
             self._inflight.append(
-                (items, finalize, kspans, _time.perf_counter()))
+                (items, finalize, kspans, _time.perf_counter(),
+                 nbytes))
             depth = len(self._inflight)
             self.stats["max_inflight_depth"] = max(
                 self.stats["max_inflight_depth"], depth)
@@ -422,9 +455,11 @@ class DeviceEncodeEngine:
         import time as _time
         if not self._inflight:
             return 0.0
+        prev_stage = _prof.push_stage("device_finalize")
         t0 = _time.perf_counter()
         harvest_t = _time.monotonic()
-        items, finalize, kspans, launch_t = self._inflight.popleft()
+        (items, finalize, kspans, launch_t,
+         nbytes) = self._inflight.popleft()
         # per-op timeline: launch -> harvest begin is the pipeline-
         # window wait (overlapped with younger batches' staging)
         for _key, _data, _cont, _span, clock, _ts in items:
@@ -444,7 +479,6 @@ class DeviceEncodeEngine:
             results = None
         if results is not None:
             done_t = _time.monotonic()
-            nbytes = sum(d.nbytes for _, d, _c, _s, _cl, _t in items)
             self.stats["flushes"] += 1
             self.stats["ops"] += len(items)
             self.stats["bytes"] += nbytes
@@ -473,7 +507,11 @@ class DeviceEncodeEngine:
                          _time.perf_counter() - launch_t)
         tel.note_engine_retired()
         tel.note_engine_inflight(len(self._inflight))
+        # the batch's bytes leave the window on BOTH outcomes
+        # (download or failover) — the gauges-to-zero invariant
+        tel.note_hbm(inflight_delta=-nbytes, retired=nbytes)
         self.stats["busy_s"] += dt
+        _prof.pop_stage(prev_stage)
         return dt
 
 
@@ -494,11 +532,26 @@ class DeviceEncodeEngine:
         streams concatenate along the byte axis into a single launch.
         Continuations run inline (see stage_decode)."""
         import time as _time
+        if not dec_pending:
+            return
+        prev_stage = _prof.push_stage("device_finalize")
+        try:
+            self._flush_decodes_inner(dec_pending)
+        finally:
+            _prof.pop_stage(prev_stage)
+
+    def _flush_decodes_inner(self, dec_pending: dict) -> None:
+        import time as _time
         for (_cid, present, want), (codec, sinfo, items) in \
                 dec_pending.items():
             launched = _time.monotonic()
             t0 = _time.perf_counter()
             tel = _telemetry()
+            # staged bytes leave the ledger here: whatever happens
+            # below (decode or fault), this group's buffers are done
+            staged = sum(_shards_nbytes(shards)
+                         for _k, shards, _w, _c, _s, _cl, _t in items)
+            tel.note_hbm(staged_delta=-staged, retired=staged)
             for _key, _shards, _want, _cont, span, clock, ts in items:
                 tel.note_queue_wait("decode", launched - ts)
                 clock.mark("engine_stage_wait", t=launched)
@@ -550,5 +603,17 @@ class DeviceEncodeEngine:
         dec_pending.clear()
 
 
+def _shards_nbytes(shards: dict) -> int:
+    """Byte count of one staged decode's survivor map — the SAME
+    expression on the staging and retiring side, so the HBM ledger
+    reconciles exactly."""
+    return sum(np.asarray(v).nbytes for v in shards.values())
+
+
 def _bind(cont, shards, crcs, err):
-    return lambda: cont(shards, crcs, err)
+    fn = lambda: cont(shards, crcs, err)   # noqa: E731
+    # the continuation builds hinfo/shard txns and fans sub-writes out
+    # — commit_wait work; the op-wq worker running it picks the tag up
+    # for the profiler's stage join
+    fn._profile_stage = "commit_wait"
+    return fn

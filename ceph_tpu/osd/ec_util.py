@@ -467,6 +467,7 @@ def _flush_device_fused_async(sinfo: StripeInfo, codec, ops, bufs):
     key = (codec.backend, codec.coding_matrix.tobytes(),
            n_b, lmax_b, nops_b)
     fn = _fused_cache.get(key)
+    fn_is_new = fn is None
     if fn is None:
         if len(_fused_cache) > 256:
             _fused_cache.clear()
@@ -506,11 +507,21 @@ def _flush_device_fused_async(sinfo: StripeInfo, codec, ops, bufs):
     lens_arr = np.zeros(nops_b, dtype=np.int32)
     lens_arr[:len(ops)] = lens
     from ceph_tpu.utils.device_telemetry import telemetry
+    signature = (f"fused_crc[{codec.backend}"
+                 f"{list(codec.coding_matrix.shape)}]"
+                 f"N{n_b}L{lmax_b}ops{nops_b}")
+    if fn_is_new:
+        import os as _os
+        if _os.environ.get("CEPH_TPU_COST_ANALYSIS"):
+            # per-signature compiled cost analysis (FLOPs / bytes
+            # accessed) into the device telemetry table; opt-in — the
+            # AOT lower+compile does not share the jit call cache, so
+            # it would double the cold-compile cost of the hot path
+            from ceph_tpu.ops import cost_model
+            cost_model.analyze(fn, data_dev, offs_arr, lens_arr,
+                               signature=signature)
     parity_dev, lin_dev = telemetry().timed_call(
-        f"fused_crc[{codec.backend}"
-        f"{list(codec.coding_matrix.shape)}]"
-        f"N{n_b}L{lmax_b}ops{nops_b}",
-        fn, data_dev, offs_arr, lens_arr)
+        signature, fn, data_dev, offs_arr, lens_arr)
 
     def finalize():
         parity = np.asarray(parity_dev)

@@ -66,6 +66,7 @@ from ceph_tpu.utils.admin_socket import (
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils import stage_clock, tracing
+from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.msgr_telemetry import telemetry as _msgr_telemetry
 from ceph_tpu.utils.optracker import OpTracker
@@ -300,6 +301,10 @@ class ShardedOpWQ:
     def _worker(self, sh) -> None:
         mclock = isinstance(sh, _MClockShard)
         while True:
+            # profiler join: a worker parked on its cv is idle, not
+            # pg_process work (the classifier would otherwise charge
+            # the wait to this file's stage bucket)
+            _pidle = _prof.push_stage("idle")
             with sh.cv:
                 if mclock:
                     fn, wake = sh.pick(pace=self._running)
@@ -322,11 +327,20 @@ class ShardedOpWQ:
                             return
                         sh.cv.wait()
                         fn = self._dequeue(sh)
+            _prof.pop_stage(_pidle)
             _msgr_telemetry().dispatch_queue_delta(-1)
+            # profiler stage join: a worker sample belongs to the
+            # stage of the work it runs — PG/op processing by default,
+            # or the stage a producer tagged on the continuation
+            # (device-engine commit fan-out tags commit_wait)
+            _pstage = _prof.push_stage(
+                getattr(fn, "_profile_stage", "pg_process"))
             try:
                 fn()
             except Exception as exc:
                 log(0, f"op worker exception: {exc!r}")
+            finally:
+                _prof.pop_stage(_pstage)
 
     def drain_stop(self) -> None:
         self._running = False

@@ -33,6 +33,7 @@ from typing import Callable
 
 from ceph_tpu.parallel.messages import Message, decode_message
 from ceph_tpu.utils import checksum
+from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.msgr_telemetry import telemetry as _telemetry
@@ -107,7 +108,7 @@ class Messenger:
         self._dispatcher: Callable[[Message, Connection], None] | None = None
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
-            target=self._loop.run_forever,
+            target=self._run_loop,
             name=f"ms-{entity_name}", daemon=True)
         self._server: asyncio.AbstractServer | None = None
         # dest addr -> Connection, or a Future while a connect is in
@@ -138,6 +139,15 @@ class Messenger:
         #: reconciled at shutdown (a coroutine the dying loop never
         #: ran can no longer decrement itself)
         self._sends_outstanding = 0
+
+    def _run_loop(self) -> None:
+        # profiler stage join: every cycle this thread spends —
+        # serialize, socket writes, frame reads, fast dispatch — is
+        # the data plane's ``wire`` stage, so the whole event-loop
+        # thread carries the mark (never popped; the thread dies with
+        # the loop)
+        _prof.push_stage("wire")
+        self._loop.run_forever()
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> None:

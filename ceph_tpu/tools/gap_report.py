@@ -77,6 +77,24 @@ def _engine_side(args) -> dict:
             "engine_source": "baseline"}
 
 
+def _profile_section(prof, top_n: int = 10) -> dict:
+    """The hot-frame join: per-stage top-N leaf frames from the
+    sampled stacks, keyed by the same stage names as the attribution
+    rows — the table finally bottoms out in function names."""
+    dump = prof.dump()
+    return {
+        "hz": dump["hz"],
+        "samples": dump["samples"],
+        "cpu_samples": dump["cpu_samples"],
+        "attributed_pct": dump["attributed_pct"],
+        "sampler_overhead_pct":
+            prof.status()["sampler_overhead_pct"],
+        "by_stage": {stage: ent["samples"]
+                     for stage, ent in dump["by_stage"].items()},
+        "hot_frames": prof.top_frames(top_n),
+    }
+
+
 def run_report(seconds: float, n_osds: int, obj_size: int,
                threads: int, k: int, m: int, backend: str,
                args) -> dict:
@@ -86,8 +104,16 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
     # fresh stage registry: the table attributes THIS run, not
     # whatever the process did before
     dataplane().reset()
+    prof = None
+    if getattr(args, "profile", False):
+        from ceph_tpu.utils.profiler import profiler
+        prof = profiler()
+        prof.reset()
+        prof.start(hz=getattr(args, "profile_hz", None))
     cluster = cluster_bench.run_one(backend, seconds, n_osds,
                                     obj_size, threads, k=k, m=m)
+    if prof is not None:
+        prof.stop()
     engine = _engine_side(args)
     breakdown = cluster.get("stage_breakdown") or \
         dataplane().stage_breakdown()
@@ -110,6 +136,8 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
         "profile": cluster.get("profile"),
         "backend": cluster.get("backend"),
     }
+    if prof is not None:
+        report["profiler"] = _profile_section(prof)
     return report
 
 
@@ -125,16 +153,34 @@ def print_table(report: dict) -> None:
     if report["gap_x"]:
         print(f"gap: {report['gap_x']}x")
     print()
+    prof = report.get("profiler") or {}
+    hot = prof.get("hot_frames", {})
     print(f"{'stage':<22}{'label':<26}{'mean_ms':>9}{'share':>8}")
     print("-" * 65)
     for stage, ent in report["stages"].items():
         print(f"{stage:<22}{_LABELS.get(stage, ''):<26}"
               f"{ent['mean_ms']:>9.3f}{ent['share_pct']:>7.1f}%")
+        # --profile: the hot frames sampled while THIS stage owned
+        # the thread, so each row bottoms out in function names
+        for f in hot.get(stage, []):
+            print(f"    ↳ {f['frame']:<48}"
+                  f"{f['samples']:>6}{f['pct']:>7.1f}%")
     print("-" * 65)
     print(f"{'stage sum coverage of e2e latency':<48}"
           f"{report['coverage_pct']:>16.1f}%")
     for stage, ent in report.get("subops", {}).items():
         print(f"  (subop) {stage:<20}{ent['mean_ms']:>9.3f} ms")
+    if prof:
+        print(f"profiler: {prof['samples']} samples @ {prof['hz']} Hz"
+              f", {prof['attributed_pct']}% stage-attributed, "
+              f"sampler overhead {prof['sampler_overhead_pct']}%")
+        extra = {s: n for s, n in prof.get("by_stage", {}).items()
+                 if s not in report["stages"]}
+        for stage in sorted(extra, key=lambda s: -extra[s])[:6]:
+            frames = hot.get(stage, [])
+            lead = frames[0]["frame"] if frames else ""
+            print(f"  (off-table) {stage:<22}{extra[stage]:>6} "
+                  f"samples  {lead}")
     print()
 
 
@@ -158,6 +204,13 @@ def main(argv=None) -> int:
     ap.add_argument("--run-engine-loop", action="store_true",
                     help="measure the engine closed loop here "
                          "(serialize with other chip work)")
+    ap.add_argument("--profile", action="store_true",
+                    help="run the cluster bench under the stack-"
+                         "sampling profiler and append per-stage "
+                         "top-10 hot frames to the table and the "
+                         "JSON line")
+    ap.add_argument("--profile-hz", type=float, default=50.0,
+                    help="sampling rate for --profile")
     args = ap.parse_args(argv)
     if args.full:
         args.osds, args.k, args.m = 12, 8, 3

@@ -24,12 +24,14 @@ import time
 
 def _percentile_ms(lats: list[float], q: float) -> float:
     """Nearest-rank percentile over the timed ops, in ms (zero extra
-    bench budget: same list avg/max already read)."""
+    bench budget: same list avg/max already read). Six decimals: a
+    sub-microsecond latency (in-process stub stores) must round to a
+    nonzero value, not masquerade as an unmeasured op."""
     if not lats:
         return 0.0
     ordered = sorted(lats)
     idx = min(len(ordered) - 1, max(0, int(q * len(ordered)) - 1))
-    return round(ordered[idx] * 1e3, 3)
+    return round(ordered[idx] * 1e3, 6)
 
 
 def _bench(io, seconds: float, mode: str, obj_size: int,

@@ -148,6 +148,10 @@ def register_common_commands(asok: AdminSocket, perf=None) -> None:
         asok.register_command(
             "perf dump", lambda a: perf.dump(), "dump perf counters")
     _dout.register_asok(asok)
+    # the continuous profiler is process-wide (daemons share the
+    # process); every daemon's socket drives the same sampler
+    from ceph_tpu.utils import profiler as _profiler
+    _profiler.register_asok(asok)
     asok.register_command(
         "config show", lambda a: g_conf().dump(), "dump all config")
     asok.register_command(

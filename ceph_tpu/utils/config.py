@@ -367,6 +367,17 @@ for _o in [
     Option("health_bundle_dir", str, "", "advanced",
            "directory for auto-emitted HEALTH_ERR diagnostic bundles "
            "(empty = keep in memory only, serve over the asok)"),
+    Option("health_hbm_warn_bytes", int, 1 << 30, "advanced",
+           "HBM_PRESSURE raises when the device engine's live buffer "
+           "bytes (staged + in-window) reach this level (0 disables)",
+           min=0),
+    Option("profiler_hz", float, 50.0, "advanced",
+           "stack-sampling profiler rate while running "
+           "(profile start)", min=0.1, max=1000.0),
+    Option("profiler_max_stacks", int, 2048, "advanced",
+           "distinct folded stacks the profiler holds (fixed "
+           "memory; overflow aggregates under one sentinel key)",
+           min=1),
 ]:
     SCHEMA.add(_o)
 

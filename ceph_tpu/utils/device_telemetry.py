@@ -61,6 +61,13 @@ class DeviceTelemetry:
         self._compiles: dict[str, dict] = {}
         #: "label|signature" -> calibration outcome dict
         self._calibrations: dict[str, dict] = {}
+        #: signature -> compiled cost analysis (flops/bytes_accessed)
+        self._costs: dict[str, dict] = {}
+        #: exact live-byte mirrors of the hbm gauges (kept here so
+        #: the peak update is race-free under one lock)
+        self._hbm_staged = 0
+        self._hbm_inflight = 0
+        self._hbm_peak = 0
 
     @staticmethod
     def _declare(perf: PerfCounters) -> None:
@@ -152,6 +159,26 @@ class DeviceTelemetry:
                            "objects per deep-scrub verify launch")
         perf.add_time_avg("scrub_device_time",
                           "wall seconds per deep-scrub verify launch")
+        # live HBM accounting (osd/device_engine.py): every buffer
+        # byte the engine holds is in exactly one of staged (queued,
+        # pre-launch) or in-window (launched, not retired); both
+        # gauges reconcile to 0 at idle — the shutdown-safety bar the
+        # PR-6 queue-depth gauges set — and the peak gauges feed the
+        # HBM_PRESSURE health check (mgr/health.py)
+        perf.add_gauge("hbm_staged_bytes",
+                       "payload bytes queued in the engine, not yet "
+                       "launched")
+        perf.add_gauge("hbm_inflight_bytes",
+                       "payload bytes in launched-not-retired "
+                       "batches (the pipeline window's working set)")
+        perf.add_gauge("hbm_live_bytes",
+                       "staged + in-window bytes (the HBM_PRESSURE "
+                       "input)")
+        perf.add_gauge("hbm_peak_live_bytes",
+                       "high-water mark of hbm_live_bytes")
+        perf.add_u64_counter("hbm_retired_bytes",
+                             "bytes that left the launch window "
+                             "(downloaded or failed over)")
 
     # -- compile accounting -------------------------------------------
     def note_compile(self, signature: str, seconds: float) -> None:
@@ -290,6 +317,43 @@ class DeviceTelemetry:
     def note_mesh_dispatch(self) -> None:
         self.perf.inc("mesh_dispatches")
 
+    def note_cost(self, signature: str, cost: dict) -> None:
+        """One compiled cost analysis (ops/cost_model.analyze): the
+        per-signature FLOPs/bytes table the dashboard and ``device
+        perf dump`` serve next to the compile table."""
+        with self._lock:
+            if signature not in self._costs and \
+                    len(self._costs) >= _MAX_SIGNATURES:
+                self._costs.pop(next(iter(self._costs)))
+            self._costs[signature] = dict(cost)
+
+    # -- HBM accounting (osd/device_engine.py) ------------------------
+    def note_hbm(self, staged_delta: int = 0,
+                 inflight_delta: int = 0, retired: int = 0) -> None:
+        """Move bytes between the engine's HBM buckets. Every staged
+        byte is later either launched (staged->inflight) or abandoned
+        (staged->out); every launched byte retires — so live bytes
+        read 0 at idle (asserted across cluster lifecycles)."""
+        with self._lock:
+            self._hbm_staged = max(0, self._hbm_staged + staged_delta)
+            self._hbm_inflight = max(
+                0, self._hbm_inflight + inflight_delta)
+            live = self._hbm_staged + self._hbm_inflight
+            self._hbm_peak = max(self._hbm_peak, live)
+            staged, inflight, peak = (self._hbm_staged,
+                                      self._hbm_inflight,
+                                      self._hbm_peak)
+        self.perf.set_gauge("hbm_staged_bytes", staged)
+        self.perf.set_gauge("hbm_inflight_bytes", inflight)
+        self.perf.set_gauge("hbm_live_bytes", staged + inflight)
+        self.perf.set_gauge("hbm_peak_live_bytes", peak)
+        if retired > 0:
+            self.perf.inc("hbm_retired_bytes", retired)
+
+    def hbm_live_bytes(self) -> int:
+        with self._lock:
+            return self._hbm_staged + self._hbm_inflight
+
     # -- deep-scrub accounting ----------------------------------------
     def note_scrub_flush(self, objs: int, nbytes: int,
                          device_s: float) -> None:
@@ -317,9 +381,11 @@ class DeviceTelemetry:
             compiles = {s: dict(v) for s, v in self._compiles.items()}
             calibrations = {s: dict(v)
                             for s, v in self._calibrations.items()}
+            costs = {s: dict(v) for s, v in self._costs.items()}
         return {"counters": self.perf.dump(),
                 "compiles_by_signature": compiles,
-                "calibrations": calibrations}
+                "calibrations": calibrations,
+                "costs_by_signature": costs}
 
     def snapshot_brief(self) -> dict:
         """Compact view for bench metric lines: scalar counters plus

@@ -63,6 +63,14 @@ class PerfCounters:
             assert self._types[key] == CounterType.GAUGE
             self._values[key] = value
 
+    def ginc(self, key: str, by: float) -> None:
+        """Adjust a gauge by a (possibly negative) delta atomically —
+        the live-level accounting pattern (queue depths, HBM buffer
+        bytes): producers inc, consumers dec, idle reads 0."""
+        with self._lock:
+            assert self._types[key] == CounterType.GAUGE
+            self._values[key] += by
+
     def tinc(self, key: str, seconds: float) -> None:
         with self._lock:
             assert self._types[key] == CounterType.TIME_AVG

@@ -129,6 +129,73 @@ def test_stage_breakdown_degrades_to_empty(monkeypatch):
     json.loads(json.dumps(out))
 
 
+def test_cost_fields_roofline_next_to_measured(capsys, monkeypatch):
+    """ISSUE 7 satellite: device metric lines carry cost_flops /
+    cost_bytes / roofline_GBps from the compiled cost analysis of
+    the exact step — and the whole line still round-trips json."""
+    import time
+
+    import jax.numpy as jnp
+
+    import bench
+
+    monkeypatch.setattr(bench, "_T0", time.perf_counter())
+
+    def step(x):
+        return (x.astype(jnp.float32) * 2).sum()
+
+    x = jnp.zeros((1 << 14,), jnp.uint8)
+    fields = bench._cost_fields(step, (x,), 1 << 14,
+                                "bench[wiring_smoke]")
+    # CPU XLA reports cost analysis; if a backend ever stops, the
+    # contract is graceful degradation to {}
+    if fields:
+        assert fields["cost_flops"] > 0
+        assert fields["cost_bytes"] > 0
+        assert fields["roofline_GBps"] > 0
+        # the signature landed in the device cost table
+        from ceph_tpu.utils.device_telemetry import telemetry
+        snap = telemetry().snapshot()
+        assert "bench[wiring_smoke]" in snap["costs_by_signature"]
+    line = {"value": 1.0, "unit": "GB/s"}
+    line.update(fields)
+    bench.emit("cost_smoke", line)
+    out = [ln for ln in capsys.readouterr().out.splitlines()
+           if ln.strip()]
+    rec = json.loads(out[-1])
+    assert rec["metric"] == "cost_smoke"
+    if fields:
+        assert rec["roofline_GBps"] == fields["roofline_GBps"]
+    bench._RESULTS.pop("cost_smoke", None)
+
+
+def test_cost_fields_degrade_and_respect_deadline(monkeypatch):
+    """A cost-model fault returns {} (never costs a metric line), and
+    a nearly-spent global deadline skips the extra compile entirely
+    (the test_measure_guard budget identity stays intact)."""
+    import time
+
+    import bench
+    from ceph_tpu.ops import cost_model
+
+    monkeypatch.setattr(bench, "_T0", time.perf_counter())
+
+    def boom(*a, **k):
+        raise RuntimeError("cost model down")
+
+    monkeypatch.setattr(cost_model, "bench_fields", boom)
+    assert bench._cost_fields(lambda x: x, (1,), 10, "sig") == {}
+    # deadline nearly spent: the helper must not even try
+    monkeypatch.setattr(
+        bench, "_T0",
+        time.perf_counter() - bench.TOTAL_BUDGET + 1.0)
+    called = []
+    monkeypatch.setattr(cost_model, "bench_fields",
+                        lambda *a, **k: called.append(1) or {})
+    assert bench._cost_fields(lambda x: x, (1,), 10, "sig") == {}
+    assert not called, "cost analysis ran inside the compile tail"
+
+
 def test_multichip_metric_emits_parseable_line(capsys, monkeypatch):
     """The round-9 acceptance gate: on >= 2 devices (the conftest's 8
     virtual CPU devices here) bench's multichip row measures the real

@@ -18,9 +18,11 @@ def _ensure_registries():
     from ceph_tpu.utils.dataplane import dataplane
     from ceph_tpu.utils.device_telemetry import telemetry
     from ceph_tpu.utils.msgr_telemetry import telemetry as msgr
+    from ceph_tpu.utils.profiler import profiler
     telemetry()
     dataplane()
     msgr()
+    profiler()
 
 
 def test_every_counter_reaches_prometheus():
@@ -89,6 +91,44 @@ def test_dataplane_counters_reach_asok_dump():
     registered = set(dp_mod.dataplane().perf.dump())
     assert registered <= exported, \
         f"missing from dump_op_timeline: {registered - exported}"
+
+
+def test_profiler_and_hbm_counters_covered_by_lint():
+    """ISSUE 7: the new profiler counters and device HBM gauges are
+    registered (so the two generic lints above cover them) and reach
+    both exporters — the drift class the PR-6 lint exists for."""
+    _ensure_registries()
+    from ceph_tpu.utils.device_telemetry import telemetry
+    from ceph_tpu.utils.profiler import profiler
+    dev_keys = set(telemetry().perf.dump())
+    assert {"hbm_staged_bytes", "hbm_inflight_bytes",
+            "hbm_live_bytes", "hbm_peak_live_bytes",
+            "hbm_retired_bytes"} <= dev_keys
+    prof_keys = set(profiler().perf.dump())
+    assert {"profile_samples", "profile_cpu_samples",
+            "profile_dropped_stacks", "profile_running",
+            "profile_hz", "profile_unique_stacks",
+            "profile_sweep_time"} <= prof_keys
+    text = prometheus.render_text()
+    for key in ("hbm_live_bytes", "hbm_peak_live_bytes",
+                "profile_samples", "profile_running"):
+        assert f"ceph_tpu_{key}" in text, key
+    assert 'daemon="profiler"' in text
+    # asok side: the device dump carries the hbm gauges
+    from ceph_tpu.utils import device_telemetry
+
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    device_telemetry.register_asok(asok)
+    payload = asok.commands["device perf dump"]({})
+    assert "hbm_live_bytes" in payload["counters"]
+    assert "costs_by_signature" in payload
 
 
 def test_histogram_exposition_is_cumulative_and_typed():

@@ -1,18 +1,24 @@
-"""Acceptance gate for tools/gap_report.py (ISSUE 6): on a CPU-only
-MiniCluster run the profiler prints a stage-attribution table whose
-stage sums account for >= 90% of the measured end-to-end client-op
-latency, plus one machine-parseable JSON line, and the cluster_bench
-metric machinery it reuses carries stage_breakdown + p50/p99."""
+"""Acceptance gate for tools/gap_report.py (ISSUE 6 + ISSUE 7): on a
+CPU-only MiniCluster run the profiler prints a stage-attribution
+table whose stage sums account for >= 90% of the measured end-to-end
+client-op latency, plus one machine-parseable JSON line, and the
+cluster_bench metric machinery it reuses carries stage_breakdown +
+p50/p99. With ``--profile`` the run is sampled at 50 Hz and the
+table bottoms out in function names: per-stage top-10 hot frames,
+>= 80% of sampled wall time attributed to named stages."""
 
 import json
+
+from ceph_tpu.utils import profiler as prof_mod
 
 
 def test_gap_report_quick_run_attributes_latency(capsys):
     from ceph_tpu.tools import gap_report
 
+    prof_mod.reset_for_tests()
     rc = gap_report.main([
         "--seconds", "0.5", "--osds", "3", "--obj-kb", "32",
-        "--threads", "2", "--backend", "jax"])
+        "--threads", "2", "--backend", "jax", "--profile"])
     assert rc == 0
     out = capsys.readouterr().out
     # the human table landed
@@ -40,3 +46,47 @@ def test_gap_report_quick_run_attributes_latency(capsys):
     # the cluster_bench line it wraps carried the tail latencies
     assert rep["cluster_p50_ms"] > 0
     assert rep["cluster_p99_ms"] >= rep["cluster_p50_ms"]
+
+    # -- ISSUE 7: --profile joins hot frames under the stage rows --
+    prof = rep["profiler"]
+    assert prof["hz"] == 50.0
+    assert prof["samples"] > 0
+    # >= 80% of sampled wall time attributed to named stages
+    assert prof["attributed_pct"] >= 80.0, prof["by_stage"]
+    hot = prof["hot_frames"]
+    assert hot, "no hot frames sampled"
+    for stage, frames in hot.items():
+        assert len(frames) <= 10
+        for f in frames:
+            assert f["frame"] and f["samples"] > 0
+            assert 0.0 <= f["pct"] <= 100.0
+    # frames landed under stages the attribution table knows
+    assert set(hot) & (set(rep["stages"]) | {"idle", "client_wait"}), \
+        set(hot)
+    # the table view prints frames indented under stage rows
+    assert "↳" in out
+    # the sampler's own cost is visible and small
+    assert prof["sampler_overhead_pct"] < 25.0
+    # sampler stopped with the run
+    assert not [t for t in __import__("threading").enumerate()
+                if t.name == "py-profiler"]
+    prof_mod.reset_for_tests()
+
+
+def test_gap_report_without_profile_has_no_profiler_field(capsys):
+    """--profile stays opt-in: the plain run neither starts a sampler
+    nor carries the profiler JSON field."""
+    from ceph_tpu.tools import gap_report
+
+    prof_mod.reset_for_tests()
+    rc = gap_report.main([
+        "--seconds", "0.2", "--osds", "2", "--obj-kb", "16",
+        "--threads", "1", "--backend", "native"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith('{"gap_report"')][-1]
+    rep = json.loads(line)["gap_report"]
+    assert "profiler" not in rep
+    assert prof_mod.profiler_if_exists() is None, \
+        "a plain gap_report run must not allocate a profiler"

@@ -185,6 +185,40 @@ def test_engine_stall_check_raises_and_clears():
     telemetry().reset()
 
 
+def test_hbm_pressure_check_raises_and_clears():
+    """ISSUE 7: the device engine's live-buffer gauges holding at
+    warning level raise HBM_PRESSURE; reconciling them to zero (the
+    retirement path) clears it."""
+    from ceph_tpu.utils.device_telemetry import telemetry
+    telemetry().reset()
+    tel = telemetry()
+    eng = H.HealthEngine(publish_perf=False, bundle_on_err=False)
+    assert "HBM_PRESSURE" not in eng.evaluate()["checks"]
+    limit = g_conf()["health_hbm_warn_bytes"]
+    # scripted pressure: a window full of staged + in-flight bytes
+    tel.note_hbm(staged_delta=limit // 2, inflight_delta=limit)
+    rep = eng.evaluate()
+    chk = rep["checks"]["HBM_PRESSURE"]
+    assert chk["severity"] == H.WARN
+    assert "live device buffer bytes" in chk["summary"]
+    assert any("hbm_peak_live_bytes" in d for d in chk["detail"])
+    # retirement reconciles the ledger: live -> 0 clears the check
+    tel.note_hbm(staged_delta=-(limit // 2), inflight_delta=-limit,
+                 retired=limit + limit // 2)
+    assert tel.hbm_live_bytes() == 0
+    assert "HBM_PRESSURE" not in eng.evaluate()["checks"]
+    # the peak survives for forensics; the disable knob works
+    assert tel.perf.get("hbm_peak_live_bytes") >= limit
+    g_conf().set("health_hbm_warn_bytes", 0)
+    try:
+        tel.note_hbm(staged_delta=limit * 2)
+        assert "HBM_PRESSURE" not in eng.evaluate()["checks"]
+    finally:
+        g_conf().set("health_hbm_warn_bytes", limit)
+        tel.note_hbm(staged_delta=-limit * 2)
+    telemetry().reset()
+
+
 # -- optracker: true top-K slowest ------------------------------------
 
 def test_optracker_topk_survives_mildly_slow_burst():

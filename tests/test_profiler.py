@@ -1,0 +1,228 @@
+"""ISSUE 7 tentpole coverage: the continuous stack-sampling profiler.
+
+- OFF (the default) spawns zero sampler threads and allocates zero
+  sample objects — the zero-Spans contract, profiler edition.
+- ON at 50 Hz during a MiniCluster write burst: the folded output
+  parses, samples join to the PR-6 stage vocabulary, per-stage
+  attribution sums stay inside the sampled wall-time budget, the
+  fixed-memory stack cap holds, and the asok profile commands
+  round-trip over a real admin socket.
+"""
+
+import concurrent.futures
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.utils import profiler as prof_mod
+from ceph_tpu.utils.profiler import (
+    OVERFLOW_KEY,
+    StackProfiler,
+    profiler,
+    profiler_if_exists,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    prof_mod.reset_for_tests()
+    yield
+    prof_mod.reset_for_tests()
+
+
+def _sampler_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "py-profiler"]
+
+
+# -- OFF = free --------------------------------------------------------
+
+def test_off_zero_threads_zero_objects():
+    """With the sampler off, no sampler thread exists and no sample
+    objects are allocated — daemon code paths only perform dict
+    stores via push/pop_stage."""
+    assert profiler_if_exists() is None
+    # the daemon hot-path marks cost nothing and create nothing
+    prev = prof_mod.push_stage("pg_process")
+    prof_mod.pop_stage(prev)
+    assert profiler_if_exists() is None, \
+        "a stage mark must not allocate a profiler"
+    assert not _sampler_threads()
+    # creating the (process-wide) object still samples nothing
+    prof = profiler()
+    assert not prof.running
+    assert not _sampler_threads()
+    assert prof._stacks == {} and prof._threads == {}
+    assert prof.perf.get("profile_samples") == 0
+    assert prof.perf.get("profile_running") == 0
+
+
+def test_stage_push_pop_nests_and_restores():
+    ident = threading.get_ident()
+    assert prof_mod._thread_stage.get(ident) is None
+    outer = prof_mod.push_stage("wire")
+    inner = prof_mod.push_stage("commit_wait")
+    assert prof_mod._thread_stage[ident] == "commit_wait"
+    prof_mod.pop_stage(inner)
+    assert prof_mod._thread_stage[ident] == "wire"
+    prof_mod.pop_stage(outer)
+    assert ident not in prof_mod._thread_stage
+
+
+# -- ON: the MiniCluster burst ----------------------------------------
+
+N_BURST = 6
+OBJ_BYTES = 16_000
+
+
+@pytest.fixture(scope="module")
+def prof_run():
+    """One MiniCluster write burst sampled at 50 Hz."""
+    prof_mod.reset_for_tests()
+    from ceph_tpu.qa.cluster import MiniCluster
+    prof = profiler()
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("pf", k=2, m=1, pg_num=4,
+                               backend="jax")
+        io = rados.open_ioctx("pf")
+        io.op_timeout = 120.0
+        io.write_full("warm", b"w" * OBJ_BYTES)   # compiles pre-start
+        assert prof.start(hz=50)
+        t0 = time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(N_BURST) as p:
+            list(p.map(lambda i: io.write_full(f"obj{i}",
+                                               b"d" * OBJ_BYTES),
+                       range(N_BURST)))
+        # let the sampler see the idle cluster too
+        time.sleep(0.25)
+        elapsed = time.monotonic() - t0
+        prof.stop()
+        yield {"prof": prof, "elapsed": elapsed,
+               "dump": prof.dump(), "folded": prof.folded(),
+               "asok_path": next(iter(
+                   cluster.osds.values())).asok.path,
+               "cluster": cluster}
+    prof_mod.reset_for_tests()
+
+
+def test_burst_sampled_and_folded_parses(prof_run):
+    d = prof_run["dump"]
+    assert d["samples"] > 20, d
+    assert not prof_run["prof"].running
+    assert not _sampler_threads()
+    # folded format: every line is "stage;frame[;frame...] count"
+    lines = prof_run["folded"].splitlines()
+    assert lines
+    total = 0
+    for line in lines:
+        body, _, count = line.rpartition(" ")
+        assert body and ";" in body, line
+        total += int(count)
+    assert total == d["samples"]
+    # the flame renderer consumes its own export
+    from ceph_tpu.tools import flame
+    stacks = flame.parse_folded(prof_run["folded"])
+    assert sum(stacks.values()) == d["samples"]
+    assert flame.render_tree(flame.build_tree(stacks))
+    assert flame.render_top(stacks, 5)
+
+
+def test_burst_joins_stages(prof_run):
+    """Samples land under the PR-6 stage vocabulary and attribution
+    stays high (>= 80% of sampled wall time names a stage)."""
+    d = prof_run["dump"]
+    assert d["attributed_pct"] >= 80.0, d["by_stage"]
+    # the messenger loop and the op-wq/engine side both sampled
+    assert "wire" in d["by_stage"], d["by_stage"]
+    assert {"pg_process", "engine_stage_wait", "commit_wait",
+            "idle"} & set(d["by_stage"]), d["by_stage"]
+    # per-thread wall/CPU split is populated and sane
+    assert d["threads"]
+    for ent in d["threads"].values():
+        assert ent["cpu_samples"] <= ent["wall_samples"]
+
+
+def test_attribution_sums_bounded_by_wall_time(prof_run):
+    """Per-stage attributed seconds (samples/hz) sum to the total
+    sampled wall time, which cannot exceed elapsed x threads."""
+    d = prof_run["dump"]
+    est = sum(ent["est_s"] for ent in d["by_stage"].values())
+    assert abs(est - d["samples"] / d["hz"]) < 1e-6
+    n_threads = len(d["threads"])
+    budget = prof_run["elapsed"] * (n_threads + 1) * 1.2
+    assert est <= budget, (est, budget)
+    # each single thread's wall samples fit its own elapsed time
+    for name, ent in d["threads"].items():
+        assert ent["wall_samples"] / d["hz"] <= \
+            prof_run["elapsed"] * 1.5, (name, ent)
+
+
+def test_asok_profile_roundtrip(prof_run):
+    """profile start/status/dump/flame/stop over a real daemon
+    socket (the commands every daemon registers)."""
+    from ceph_tpu.utils.admin_socket import asok_command
+    path = prof_run["asok_path"]
+    st = asok_command(path, "profile start", hz=100)
+    assert st["running"] is True and st["hz"] == 100.0
+    time.sleep(0.1)
+    st = asok_command(path, "profile status")
+    assert st["running"] is True
+    d = asok_command(path, "profile dump")
+    assert d["hz"] == 100.0
+    fl = asok_command(path, "profile flame")
+    assert isinstance(fl["folded"], str)
+    st = asok_command(path, "profile stop")
+    assert st["running"] is False
+    assert not _sampler_threads()
+
+
+# -- fixed memory ------------------------------------------------------
+
+def test_fixed_memory_cap_honored():
+    """Past max_stacks, new distinct stacks fold into the overflow
+    sentinel and count as dropped — the table never grows past
+    cap + one sentinel per stage."""
+    prof = StackProfiler(hz=400, max_stacks=2)
+
+    def burn_a(depth=3):
+        if depth:
+            return burn_a(depth - 1)
+        t0 = time.time()
+        while time.time() - t0 < 0.4:
+            sum(i for i in range(500))
+
+    def burn_b():
+        t0 = time.time()
+        while time.time() - t0 < 0.4:
+            sorted(range(500), reverse=True)
+
+    prof.start()
+    threads = [threading.Thread(target=f, name=f"burn{i}")
+               for i, f in enumerate((burn_a, burn_b, burn_a))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    prof.stop()
+    d = prof.dump()
+    stages = set(d["by_stage"])
+    assert d["unique_stacks"] <= 2 + len(stages), d
+    assert d["dropped_stacks"] > 0
+    assert prof.perf.get("profile_dropped_stacks") > 0
+    # overflow samples are still counted, under the sentinel
+    assert any(OVERFLOW_KEY in folded
+               for _stage, folded in prof._stacks)
+
+
+def test_overhead_counter_records_sweeps():
+    prof = StackProfiler(hz=200)
+    base = prof.perf.get("profile_sweeps")
+    prof.start()
+    time.sleep(0.2)
+    prof.stop()
+    assert prof.perf.get("profile_sweeps") > base
+    sweep = prof.perf.get("profile_sweep_time")
+    assert sweep["avgcount"] > 0
+    assert prof.status()["sampler_overhead_pct"] < 50.0

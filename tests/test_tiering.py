@@ -26,20 +26,32 @@ def cluster():
         ):
             code, outs, _ = rados.mon_command(cmd)
             assert code == 0, outs
-        # wait until the CLIENT's cached osdmap shows the overlay:
-        # mon commits propagate by async push, and a write_full racing
-        # the push goes straight to base instead of redirecting (the
-        # in-suite failure mode of the first test)
+        # wait until the overlay is visible in the CLIENT's cached
+        # osdmap AND every OSD's: mon commits propagate by async
+        # push, and a write_full racing the push goes straight to
+        # base instead of redirecting — either because the client
+        # targeted base directly or because the serving OSD's map
+        # predates the overlay (the second, rarer window showed up
+        # once per ~5 full-tier runs after round-12's scheduling
+        # shifts)
         base_id = c.mon.osdmap.pool_by_name["base"]
+
+        def overlay_everywhere() -> bool:
+            maps = [rados.monc.osdmap]
+            maps += [o.get_osdmap() for o in c.osds.values()]
+            for m in maps:
+                pool = m.pools.get(base_id) if m else None
+                if pool is None or pool.read_tier < 0:
+                    return False
+            return True
+
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
-            m = rados.monc.osdmap
-            pool = m.pools.get(base_id) if m else None
-            if pool is not None and pool.read_tier >= 0:
+            if overlay_everywhere():
                 break
             time.sleep(0.05)
         else:
-            raise TimeoutError("overlay never reached the client map")
+            raise TimeoutError("overlay never reached every map")
         yield c
 
 

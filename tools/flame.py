@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo-root shim for the flamegraph folded-stack renderer:
+
+    python tools/flame.py [--top N] [--stage S] <folded-file|->
+
+Real implementation: ceph_tpu/tools/flame.py (also runnable as
+``python -m ceph_tpu.tools.flame``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.tools.flame import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
