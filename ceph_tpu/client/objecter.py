@@ -179,7 +179,19 @@ class Objecter:
             span.event("reply")
             reply = rec.reply
             if reply.code < 0:
-                raise ObjecterError(reply.code)
+                # errno replies may carry the daemon's diagnostic as
+                # data (e.g. the EC read ladder naming the unreachable
+                # shard set) — surface it instead of a bare code
+                detail = b""
+                try:
+                    detail = bytes(reply.data or b"")
+                except Exception:
+                    pass
+                raise ObjecterError(
+                    reply.code,
+                    f"op failed: code {reply.code}: "
+                    f"{detail.decode('utf-8', 'replace')}"
+                    if detail else "")
             # the reply carries the merged timeline (client marks +
             # primary + shard children): close it and record the
             # client-owned stages + end-to-end total
@@ -226,12 +238,27 @@ class Objecter:
             self._send(op)
 
     def _tick_loop(self) -> None:
+        import random
         interval = g_conf()["objecter_resend_interval"]
+        cap = g_conf()["objecter_resend_max"]
         while not self._stop.wait(interval / 2):
             now = time.monotonic()
             with self._lock:
-                ops = [o for o in self._pending.values()
-                       if now - o.sent_at > interval]
+                # bounded exponential backoff + full jitter per op
+                # (ISSUE 8): a resend storm against a struggling
+                # primary is exactly the cascade the online-EC study
+                # warns about — each unanswered attempt doubles the
+                # op's resend delay up to the cap, while a map change
+                # still retargets/resends immediately (_on_map)
+                ops = []
+                for o in self._pending.values():
+                    delay = min(interval * (1 << min(o.attempts - 1,
+                                                     16)), cap) \
+                        if o.attempts else 0.0
+                    if now - o.sent_at > delay * (0.5 +
+                                                  random.random() / 2):
+                        ops.append(o)
             for op in ops:
-                log(10, f"resending tid {op.tid} ({op.msg.oid})")
+                log(10, f"resending tid {op.tid} ({op.msg.oid}) "
+                    f"attempt {op.attempts + 1}")
                 self._send(op)

@@ -74,6 +74,7 @@ from typing import Callable
 import numpy as np
 
 from ceph_tpu.osd import ec_util
+from ceph_tpu.utils import faults as _faults
 from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils import stage_clock as _stage_clock
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
@@ -382,6 +383,10 @@ class DeviceEncodeEngine:
             if mesh is not None:
                 self.stats["mesh_flushes"] += 1
             try:
+                # chaos-harness seam (utils/faults engine_launch
+                # rules): an injected launch failure rides the exact
+                # failure-drain path a real device fault takes
+                _faults.engine_fault("launch")
                 finalize = batcher.flush_async(
                     with_crcs=ec_util.fuse_crc_policy(codec))
             except Exception as exc:
@@ -559,6 +564,9 @@ class DeviceEncodeEngine:
                     span.event(f"decode_flush ops={len(items)} "
                                f"sig={list(present)}->{list(want)}")
             try:
+                # chaos-harness seam: injected decode-flush failure ->
+                # every op in the group falls back to its host twin
+                _faults.engine_fault("decode")
                 merged = {
                     c: np.concatenate(
                         [np.asarray(shards[c], dtype=np.uint8)

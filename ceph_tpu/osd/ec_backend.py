@@ -30,6 +30,7 @@ stripes (what ECTransaction::encode_and_write writes per shard); attrs:
 from __future__ import annotations
 
 import json
+import random
 import time
 from typing import Callable
 
@@ -63,6 +64,8 @@ from ceph_tpu.store.object_store import (
     Transaction,
 )
 from ceph_tpu.utils import stage_clock, tracing
+from ceph_tpu.utils.config import g_conf
+from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("osd")
@@ -155,6 +158,7 @@ class ECBackend(PGBackend):
                 clock=stage_clock.current())
             if out is not None:
                 return out
+            _telemetry().note_decode_fallback()
             log(1, f"{pg}: device decode fell back to host "
                 f"(want {want})")
         return ec_util.decode(self.sinfo, self.codec, shards, want)
@@ -629,6 +633,45 @@ class ECBackend(PGBackend):
     # -- shard read fan-out -------------------------------------------
     MAX_READ_ATTEMPTS = 6
 
+    def _backoff_sleep(self, attempt: int) -> None:
+        """Jittered bounded exponential backoff between shard-read
+        fan-out attempts (ISSUE 8: the ladder used to re-fan
+        back-to-back, so a degraded burst turned every retry into
+        synchronized load on the surviving shards — the retry-storm
+        pathology the online-EC study measures). Full jitter keeps
+        concurrent retriers decorrelated."""
+        conf = g_conf()
+        base = conf["osd_ec_read_backoff_base"]
+        cap = conf["osd_ec_read_backoff_max"]
+        time.sleep(min(cap, base * (1 << attempt))
+                   * (0.5 + random.random() * 0.5))
+
+    def _shard_osd_map(self, pg: PG, positions) -> dict[int, int]:
+        return {p: pg.acting[p] for p in sorted(positions)
+                if 0 <= p < len(pg.acting)}
+
+    def _version_split_avoid(self, pg: PG, want_chunks: list[int],
+                             base_avoid: set[int],
+                             known_vers: dict[int, int]) -> set[int]:
+        """Resolve a persistent shard-version split: pick the NEWEST
+        observed version that still leaves a decodable shard set and
+        return the positions to read around (shards at other
+        versions). Positions whose version is still unknown stay in
+        play — the next attempt observes them and the caller
+        re-resolves with the grown evidence."""
+        up = self.up_positions(pg)
+        for target in sorted(set(known_vers.values()), reverse=True):
+            ver_avoid = {p for p, v in known_vers.items()
+                         if v != target}
+            available = [p for p in up
+                         if p not in base_avoid and p not in ver_avoid]
+            try:
+                self.codec.minimum_to_decode(want_chunks, available)
+            except Exception:
+                continue
+            return ver_avoid
+        return set()
+
     def _read_shards(self, pg: PG, oid: str, want_chunks: list[int],
                      avoid: set[int] | None = None,
                      chunk_off: int = 0, chunk_len: int = 0,
@@ -659,16 +702,29 @@ class ECBackend(PGBackend):
         mix is safe. attrs returned are the FLOOR shard's (the overlay
         base version).
         """
-        base_avoid = set(avoid or ())
+        orig_avoid = set(avoid or ())
+        base_avoid = set(orig_avoid)
         mypos = self.my_position(pg)
         enoent_everywhere = True
+        logger = getattr(self.parent, "logger", None)
+        vers: dict[int, int] = {}
+        #: versions observed across ALL attempts (a shard outside the
+        #: current plan keeps its last known version) — the evidence
+        #: the version-split resolution below works from
+        known_vers: dict[int, int] = {}
+        #: shards excluded because their version disagrees with the
+        #: currently targeted one (NOT failures: never in base_avoid)
+        ver_avoid: set[int] = set()
+        disagreements = 0
         for attempt in range(self.MAX_READ_ATTEMPTS):
+            if attempt and logger is not None:
+                logger.inc("read_retries")
             # re-seed from peer_missing every attempt: a degraded
             # object's entries drain as recovery pushes land, so a read
             # that initially lacks enough shards waits for recovery
             # (the reference blocks reads on degraded objects) instead
             # of failing on the first try
-            avoid = set(base_avoid)
+            avoid = set(base_avoid) | ver_avoid
             with pg.lock:
                 for pos, missing in pg.peer_missing.items():
                     if oid in missing:
@@ -684,11 +740,13 @@ class ECBackend(PGBackend):
                     # exist — exit fast, don't burn the retry ladder
                     raise NoSuchObject(oid)
                 if attempt < self.MAX_READ_ATTEMPTS - 1:
-                    time.sleep(0.1 * (attempt + 1))
+                    self._backoff_sleep(attempt)
                     continue
                 raise ECReadError(
                     f"{oid}: cannot reconstruct chunks {want_chunks} "
-                    f"from positions {available}")
+                    f"from positions {available} after {attempt + 1} "
+                    f"attempts (unreachable shards->osds "
+                    f"{self._shard_osd_map(pg, avoid)})")
             need = sorted(plan)
             results: dict[int, np.ndarray] = {}
             vers: dict[int, int] = {}
@@ -746,7 +804,13 @@ class ECBackend(PGBackend):
             missing_reads = set(need) - set(results)
             if missing_reads:
                 base_avoid |= failed | missing_reads
+                # back off before re-fanning around the failed shards:
+                # if they are waiting on recovery pushes, an immediate
+                # re-read just re-times-out against the same hole
+                if attempt < self.MAX_READ_ATTEMPTS - 1:
+                    self._backoff_sleep(attempt)
                 continue
+            known_vers.update(vers)
             if len(set(vers.values())) > 1:
                 floor = min(vers.values())
                 if accept_versions is not None and all(
@@ -759,12 +823,32 @@ class ECBackend(PGBackend):
                         if v == floor and pos in attrs_by_pos:
                             attrs = attrs_by_pos[pos]
                             break
+                elif attempt >= self.MAX_READ_ATTEMPTS - 1:
+                    break      # ladder spent: terminal error below
                 else:
-                    # a shard is mid-commit: back off and re-read; do
-                    # NOT avoid it — it is catching up, not failing
-                    log(10, f"{oid}: shard versions disagree {vers}, "
-                        "retrying")
-                    time.sleep(0.05 * (attempt + 1))
+                    disagreements += 1
+                    if disagreements <= 2:
+                        # a shard is mid-commit: back off and re-read;
+                        # do NOT avoid it — it is catching up
+                        log(10, f"{oid}: shard versions disagree "
+                            f"{vers}, retrying")
+                    else:
+                        # the split PERSISTS: the ahead shards hold an
+                        # UNACKED write (acks require every position's
+                        # commit), e.g. a fan-out cut short by an OSD
+                        # kill. Stop waiting for a catch-up that is
+                        # not coming and serve the newest version that
+                        # can still assemble k shards — exactly the
+                        # content recovery's roll-forward/rollback
+                        # converges to (test_cluster_failure pins it)
+                        ver_avoid = self._version_split_avoid(
+                            pg, want_chunks, base_avoid, known_vers)
+                        log(1, f"{oid}: persistent shard version "
+                            f"split {known_vers}; re-reading around "
+                            f"positions {sorted(ver_avoid)}")
+                        if logger is not None:
+                            logger.inc("read_version_splits")
+                    self._backoff_sleep(attempt)
                     continue
             if chunk_len:
                 # ranged read: short shards (range beyond their data)
@@ -774,12 +858,21 @@ class ECBackend(PGBackend):
                         results[pos] = np.concatenate(
                             [arr, np.zeros(chunk_len - len(arr),
                                            dtype=np.uint8)])
+            if logger is not None:
+                logger.hinc("read_retry_attempts", attempt + 1)
             return results, attrs
         if enoent_everywhere:
             raise NoSuchObject(oid)
+        # the terminal error names WHICH shards were unreachable and
+        # on which OSDs (ISSUE 8: it used to say only "no consistent
+        # readable shard set", leaving the operator to re-derive the
+        # failure domain from scattered logs)
+        bad = self._shard_osd_map(pg, base_avoid - orig_avoid)
         raise ECReadError(
             f"{oid}: no consistent readable shard set after "
-            f"{self.MAX_READ_ATTEMPTS} attempts")
+            f"{self.MAX_READ_ATTEMPTS} attempts (want {want_chunks}; "
+            f"unreachable shards->osds {bad}; "
+            f"observed shard versions {known_vers or vers})")
 
     def _attr_size(self, attrs: dict[str, bytes]) -> int:
         raw = attrs.get("sz")
@@ -796,6 +889,75 @@ class ECBackend(PGBackend):
             return self._chunks_to_logical(chunks, size)
         decoded = self._decode(pg, chunks, want)
         return self._chunks_to_logical(decoded, size)
+
+    def read_object_async(self, pg: PG, oid: str,
+                          cont: Callable[[bytes | None,
+                                          Exception | None],
+                                         None]) -> None:
+        """Batched decode-on-read (ISSUE 8). Intact objects answer
+        inline (the fast path is unchanged). A DEGRADED read stages
+        its reconstruct on the device engine and returns — the op
+        worker is free for the next op, so concurrent degraded reads
+        of objects sharing an erasure signature (same survivor set,
+        same missing set — exactly the post-failure steady state,
+        where ONE dead OSD degrades every object of a PG the same
+        way) land in the engine queue together and coalesce into one
+        signature-grouped decode flush instead of N serial
+        ``decode_sync`` launches. ``cont(data, err)`` then runs on
+        the engine thread; a device fault falls back to the host twin
+        inline (counted, never silent)."""
+        want = list(range(self.k))
+        try:
+            chunks, attrs = self._read_shards(pg, oid, want)
+            size = self._attr_size(attrs)
+        except Exception as exc:
+            cont(None, exc)
+            return
+        if all(i in chunks for i in want):
+            cont(self._chunks_to_logical(chunks, size), None)
+            return
+        logger = getattr(self.parent, "logger", None)
+        if logger is not None:
+            logger.inc("degraded_reads")
+        missing = [i for i in want if i not in chunks]
+        if self.device is not None and self.device_codec is not None \
+                and ec_util.device_decodable(self.device_codec):
+            span = tracing.current().child("engine_decode")
+
+            def decoded(out, err, chunks=chunks, size=size):
+                if out is None:
+                    # device fault: the host twin still owes the
+                    # client its bytes (counted — ISSUE 8 satellite)
+                    _telemetry().note_decode_fallback()
+                    log(1, f"{pg}: batched decode-on-read fell back "
+                        f"to host for {oid} ({err!r})")
+                    try:
+                        dec = ec_util.decode(self.sinfo, self.codec,
+                                             chunks, missing)
+                    except Exception as exc:
+                        cont(None, exc)
+                        return
+                    out = dec
+                merged = dict(chunks)
+                merged.update(out)
+                try:
+                    data = self._chunks_to_logical(
+                        {i: merged[i] for i in want}, size)
+                except Exception as exc:
+                    cont(None, exc)
+                    return
+                cont(data, None)
+
+            self.device.stage_decode(
+                pg.pgid, self.device_codec, self.sinfo, chunks,
+                missing, decoded, span=span,
+                clock=stage_clock.current())
+            return
+        try:
+            dec = self._decode(pg, chunks, want)
+            cont(self._chunks_to_logical(dec, size), None)
+        except Exception as exc:
+            cont(None, exc)
 
     def stat_object(self, pg: PG, oid: str) -> int:
         mypos = self.my_position(pg)

@@ -489,6 +489,24 @@ class OSD:
         perf.add_u64_counter("device_fused_fallbacks",
                              "mesh/fused flush failures that fell back "
                              "to the plain encode path")
+        # the degraded path's previously-silent signals (ISSUE 8):
+        # how often EC shard reads had to re-fan-out, how deep each
+        # op's retry ladder went, and how many client reads took the
+        # reconstruct route at all
+        perf.add_u64_counter("read_retries",
+                             "EC shard-read fan-outs repeated (shard "
+                             "EIO/timeout/version disagreement)")
+        perf.add_histogram("read_retry_attempts",
+                           "attempts one EC read op needed before a "
+                           "consistent shard set (bucket 1 = first "
+                           "try)")
+        perf.add_u64_counter("degraded_reads",
+                             "client reads served through shard "
+                             "reconstruction (decode-on-read)")
+        perf.add_u64_counter("read_version_splits",
+                             "EC reads that resolved a persistent "
+                             "shard-version split (unacked write cut "
+                             "short) to a k-agreed version")
         perf.add_time_avg("op_latency", "client op latency")
         return perf
 
@@ -531,6 +549,8 @@ class OSD:
         _dp.register_asok(self.asok)
         from ceph_tpu.utils import msgr_telemetry as _mt
         _mt.register_asok(self.asok)
+        from ceph_tpu.utils import faults as _faults
+        _faults.register_asok(self.asok)
         self.asok.start()
         self.addr = self.msgr.bind(host, port)
         self._refresh_rotating()   # before boot: fetched-mode daemons
@@ -1310,6 +1330,14 @@ class OSD:
                                self._handle_osd_op(m, c))
 
     @staticmethod
+    def _errno_for(exc: Exception) -> int:
+        """Map a backend read failure to the wire errno (the async
+        read continuation cannot rely on _execute_op's except ladder)."""
+        if isinstance(exc, (NoSuchObject, NoSuchCollection)):
+            return ENOENT
+        return EIO
+
+    @staticmethod
     def _cmpxattr(stored: bytes | None, xop: int, operand: bytes) -> int:
         """CEPH_OSD_OP_CMPXATTR comparison: 0 = match, ECANCELED =
         mismatch, EINVAL = bad mode/operand. EQ/NE compare bytes;
@@ -1456,12 +1484,30 @@ class OSD:
                         lambda code, v=version: reply(code, b"", v))
             elif op == M.OSD_OP_READ:
                 self.logger.inc("op_r")
-                data = be.read_object(pg, msg.oid)
-                if msg.length:
-                    data = data[msg.offset:msg.offset + msg.length]
-                elif msg.offset:
-                    data = data[msg.offset:]
-                reply(0, bytes(data))
+
+                def read_done(data, err, msg=msg, reply=reply):
+                    # may run inline (intact object / host decode) or
+                    # on the engine thread when a degraded read rode
+                    # the signature-batched decode flush — either way
+                    # reply() owns the timeline close and the send
+                    if err is not None:
+                        log(1, f"read {msg.oid} failed: {err}")
+                        reply(self._errno_for(err),
+                              b"" if isinstance(err, NoSuchObject)
+                              else str(err).encode())
+                        return
+                    if msg.length:
+                        data = data[msg.offset:msg.offset + msg.length]
+                    elif msg.offset:
+                        data = data[msg.offset:]
+                    reply(0, bytes(data))
+
+                # batched decode-on-read (ISSUE 8): a degraded read
+                # STAGES its reconstruct on the device engine and
+                # frees this op worker, so concurrent degraded reads
+                # sharing an erasure signature coalesce into ONE
+                # engine flush instead of serial decode_sync launches
+                be.read_object_async(pg, msg.oid, read_done)
             elif op == M.OSD_OP_STAT:
                 size = be.stat_object(pg, msg.oid)
                 reply(0, json.dumps({"size": size}).encode())
@@ -1758,7 +1804,10 @@ class OSD:
             reply(ENOENT)
         except StoreError as exc:
             log(1, f"op {msg.oid} failed: {exc}")
-            reply(EIO)
+            # carry the diagnostic to the client (ISSUE 8: the
+            # terminal ECReadError names the unreachable shard set —
+            # useless if the wire flattens it to a bare errno)
+            reply(EIO, str(exc).encode())
 
     def _list_pg(self, pg: PG) -> list[str]:
         cid = pg.backend.local_cid(pg)

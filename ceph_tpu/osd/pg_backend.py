@@ -205,6 +205,22 @@ class PGBackend:
         StoreError/NoSuchObject on failure."""
         raise NotImplementedError
 
+    def read_object_async(self, pg: PG, oid: str,
+                          cont: Callable[[bytes | None,
+                                          Exception | None],
+                                         None]) -> None:
+        """Async-capable full-object read: ``cont(data, err)`` fires
+        exactly once — inline here (no batched decode route for this
+        backend); ECBackend overrides it so a degraded read stages a
+        signature-batched engine decode and frees the op worker.
+        Failures route to ``cont``, never raise to the caller."""
+        try:
+            data = self.read_object(pg, oid)
+        except Exception as exc:
+            cont(None, exc)
+            return
+        cont(data, None)
+
     def stat_object(self, pg: PG, oid: str) -> int:
         raise NotImplementedError
 

@@ -33,6 +33,7 @@ from typing import Callable
 
 from ceph_tpu.parallel.messages import Message, decode_message
 from ceph_tpu.utils import checksum
+from ceph_tpu.utils import faults as _faults
 from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
@@ -288,9 +289,19 @@ class Messenger:
                         # StageClock's ``wire`` interval ends here)
                         msg._rx_t = time.monotonic()
                         _telemetry().note_recv(mtype, plen)
-                        if peer_addr in self.blocked_peers:
+                        # inbound side of the fault registry's
+                        # drop/partition windows (utils/faults): a
+                        # symmetric partition needs the receive leg
+                        # too. Scope convention: ``entity`` is the
+                        # SENDER (the frame header's peer_name here),
+                        # ``peer`` the receiver.
+                        in_drop, _ = _faults.message_fault(
+                            peer_name, self.entity_name, mtype)
+                        if peer_addr in self.blocked_peers or in_drop:
                             log(5, f"partition: dropping {mtype} from "
                                 f"{peer_name}")
+                            if in_drop:
+                                _telemetry().note_drop(mtype)
                         elif self._dispatcher:
                             self._dispatcher(msg, conn)
                     except Exception as exc:  # dispatcher bugs can't kill IO
@@ -383,6 +394,22 @@ class Messenger:
         if conn.peer_addr in self.blocked_peers:
             log(5, f"partition: dropping {msg.MSG_TYPE} to "
                 f"{conn.peer_addr}")
+            tel.note_drop(msg.MSG_TYPE)
+            return True     # silently lost (lossy semantics)
+        # the seeded chaos registry (utils/faults): scoped drop/delay
+        # windows, decided deterministically per (rule, match index) —
+        # the scheduled successor of the blanket ms_inject knob below
+        f_drop, f_delay = _faults.message_fault(
+            self.entity_name, conn.peer_addr or conn.peer_name,
+            msg.MSG_TYPE)
+        if f_delay > 0:
+            # hold only THIS send coroutine; other sends proceed
+            # (lossy, unordered across messages — upper layers already
+            # tolerate reordering via tids/epochs)
+            await asyncio.sleep(f_delay)
+        if f_drop:
+            log(5, f"fault injection: dropping {msg.MSG_TYPE} to "
+                f"{conn.peer_addr or conn.peer_name}")
             tel.note_drop(msg.MSG_TYPE)
             return True     # silently lost (lossy semantics)
         if self._inject_every and \

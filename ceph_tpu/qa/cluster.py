@@ -156,16 +156,29 @@ class MiniCluster:
             erasure_code_profile=f"{name}_profile")
         assert code == 0, outs
 
+    @property
+    def faults(self):
+        """The process-wide seeded fault registry (utils/faults) —
+        the ONE injection API: scoped messenger drop/delay windows,
+        store EIO/latency, device-engine launch failures, and the
+        kill/revive schedule the load generator executes. Cluster
+        fault actions below record themselves into its event log so
+        a run's whole fault sequence reads back from one place."""
+        from ceph_tpu.utils import faults as F
+        return F.registry()
+
     def kill_osd(self, osd_id: int) -> None:
         """Hard-stop an OSD (Thrasher.kill_osd role): the daemon dies,
         its store survives for revive."""
         osd = self.osds.pop(osd_id)
         osd.stop()
+        self.faults.note_action("kill_osd", f"osd.{osd_id}")
         log(1, f"killed osd.{osd_id}")
 
     def revive_osd(self, osd_id: int) -> OSD:
         assert osd_id not in self.osds
         osd = self.start_osd(osd_id)
+        self.faults.note_action("revive_osd", f"osd.{osd_id}")
         log(1, f"revived osd.{osd_id}")
         return osd
 

@@ -424,6 +424,9 @@ class BlockStore(ObjectStore):
 
     def read(self, cid: str, oid: str, off: int = 0,
              length: int | None = None) -> bytes:
+        from ceph_tpu.utils import faults as _faults
+        if _faults.check_store_read(cid, oid):
+            raise EIOError(f"injected fault EIO on {cid}/{oid}")
         if (cid, oid) in self._eio:
             raise EIOError(f"injected EIO on {cid}/{oid}")
         m = self._meta(cid, oid)

@@ -281,6 +281,11 @@ class KStore(ObjectStore):
     # -- reads --------------------------------------------------------
     def read(self, cid: str, oid: str, off: int = 0,
              length: int | None = None) -> bytes:
+        from ceph_tpu.utils import faults as _faults
+        # registry check OUTSIDE the store lock: an injected latency
+        # window must stall this read, not every reader of the store
+        if _faults.check_store_read(cid, oid):
+            raise EIOError(f"injected fault EIO on {cid}/{oid}")
         with self._lock:
             if (cid, oid) in self._eio:
                 raise EIOError(f"injected EIO on {cid}/{oid}")

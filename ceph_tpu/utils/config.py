@@ -261,6 +261,21 @@ for _o in [
            "osd_recovery_max_single_start role)"),
     Option("objecter_resend_interval", float, 2.0, "advanced",
            "client op resend period over the lossy messenger"),
+    Option("objecter_resend_max", float, 8.0, "advanced",
+           "resend backoff ceiling: per-op delay doubles from "
+           "objecter_resend_interval up to this (jittered) — a dead "
+           "primary must not be hammered at RTT rate by every parked "
+           "client (ISSUE 8)"),
+    Option("osd_ec_read_backoff_base", float, 0.02, "advanced",
+           "EC shard-read retry ladder: first-retry backoff seconds "
+           "(doubles per attempt, full jitter)"),
+    Option("osd_ec_read_backoff_max", float, 0.5, "advanced",
+           "EC shard-read retry ladder: backoff ceiling seconds"),
+    Option("degraded_qos_p99_ms", float, 1500.0, "advanced",
+           "the degraded-mode serving QoS bar: client p99 latency "
+           "(ms) the load generator holds the cluster to while "
+           "recovery makes progress (BASELINE.md 'Degraded-mode "
+           "serving')"),
     Option("osd_heartbeat_interval", float, 1.0, "advanced",
            "seconds between peer pings (scaled down from the reference's 6)"),
     Option("osd_heartbeat_grace", float, 4.0, "advanced",

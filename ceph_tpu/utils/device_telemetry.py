@@ -106,6 +106,10 @@ class DeviceTelemetry:
                              "shard bytes through device decode")
         perf.add_u64_counter("fused_fallbacks",
                              "mesh/fused flush paths that fell back")
+        perf.add_u64_counter("engine_decode_fallbacks",
+                             "degraded-read/recovery decodes that fell "
+                             "back from the batched engine route to "
+                             "the host twin (ISSUE 8: silent before)")
         perf.add_u64_counter("calibrations",
                              "sparse-vs-dense on-device calibrations")
         perf.add_u64_counter("calibrations_sparse_won",
@@ -266,6 +270,13 @@ class DeviceTelemetry:
 
     def note_fused_fallback(self) -> None:
         self.perf.inc("fused_fallbacks")
+
+    def note_decode_fallback(self) -> None:
+        """A degraded read / recovery decode left the batched engine
+        route for the host twin (device fault, timeout, or injected
+        failure) — previously invisible; the degraded path's health
+        depends on this staying near zero."""
+        self.perf.inc("engine_decode_fallbacks")
 
     def note_inflight_depth(self, depth: int) -> None:
         """Launch-window occupancy at one flush launch (pipelined

@@ -6,6 +6,8 @@ last-JSON-line reader and the BENCH history depend on."""
 
 import json
 
+import pytest
+
 
 def test_bench_imports_cleanly():
     """Importing the module must not touch a device or run main()."""
@@ -194,6 +196,52 @@ def test_cost_fields_degrade_and_respect_deadline(monkeypatch):
                         lambda *a, **k: called.append(1) or {})
     assert bench._cost_fields(lambda x: x, (1,), 10, "sig") == {}
     assert not called, "cost analysis ran inside the compile tail"
+
+
+def test_degraded_rows_emit_parseable_lines(capsys, monkeypatch):
+    """ISSUE 8: the two degraded-mode serving rows. The GB/s row
+    measures the exact signature-grouped decode matvec the batched
+    decode-on-read route launches (bit-exactness gate inside), the
+    p99 row times individual blocked launches of the same program —
+    both must land parseable lines with the coalescing factor on
+    them."""
+    import time
+
+    import bench
+
+    monkeypatch.setitem(bench.BUDGETS, "degraded_read", (2.0, 0.0))
+    monkeypatch.setitem(bench.BUDGETS, "degraded_p99", (1.0, 0.0))
+    monkeypatch.setattr(bench, "_T0", time.perf_counter())
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 60.0)
+
+    contended = bench._bench_degraded_read(lambda *a, **k: None, {})
+    assert isinstance(contended, bool)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip()]
+    recs = {json.loads(ln)["metric"]: json.loads(ln) for ln in lines}
+    read = recs["degraded_read_GBps"]
+    assert "error" not in read, read
+    assert read["value"] > 0
+    assert read["unit"] == "GB/s"
+    assert read["objects_per_flush"] == bench.DEGRADED_OBJECTS
+    assert isinstance(read["telemetry"], dict)
+    p99 = recs["degraded_p99_ms"]
+    assert "error" not in p99, p99
+    assert p99["value"] > 0
+    assert p99["unit"] == "ms"
+    assert p99["p50_ms"] <= p99["value"]
+    assert p99["samples"] >= 1
+    # the per-object floor is the flush latency amortized over the
+    # coalesced batch — the number the QoS bar is derived from
+    assert p99["per_object_p99_ms"] == pytest.approx(
+        p99["value"] / bench.DEGRADED_OBJECTS, rel=0.01)
+    # the combined historical line carries both families
+    combined = bench._combined(any_contended=False)
+    assert "degraded_read_value" in combined
+    assert "degraded_p99_value" in combined
+    json.loads(json.dumps(combined))
+    bench._RESULTS.pop("degraded_read_GBps", None)
+    bench._RESULTS.pop("degraded_p99_ms", None)
 
 
 def test_multichip_metric_emits_parseable_line(capsys, monkeypatch):

@@ -17,12 +17,14 @@ def _ensure_registries():
     the lint covers their full schemas."""
     from ceph_tpu.utils.dataplane import dataplane
     from ceph_tpu.utils.device_telemetry import telemetry
+    from ceph_tpu.utils.faults import registry as fault_registry
     from ceph_tpu.utils.msgr_telemetry import telemetry as msgr
     from ceph_tpu.utils.profiler import profiler
     telemetry()
     dataplane()
     msgr()
     profiler()
+    fault_registry()
 
 
 def test_every_counter_reaches_prometheus():
@@ -129,6 +131,57 @@ def test_profiler_and_hbm_counters_covered_by_lint():
     payload = asok.commands["device perf dump"]({})
     assert "hbm_live_bytes" in payload["counters"]
     assert "costs_by_signature" in payload
+
+
+def test_fault_and_degraded_counters_covered_by_lint():
+    """ISSUE 8: the chaos registry's fire counters and the degraded
+    path's previously-silent signals are registered (so the generic
+    lints above cover them) and reach both exporters. The per-OSD
+    keys (read_retries / read_retry_attempts / degraded_reads /
+    read_version_splits) are additionally pinned live in
+    tests/test_degraded_serving.py, where an OSD daemon exists."""
+    _ensure_registries()
+    from ceph_tpu.utils import faults
+    keys = set(faults._make_perf().dump())
+    assert {"fault_rules", "faults_fired", "faults_msgr_drop",
+            "faults_msgr_delay", "faults_store_eio",
+            "faults_store_latency", "faults_engine_launch",
+            "faults_engine_decode", "faults_actions"} <= keys
+    from ceph_tpu.utils.device_telemetry import telemetry
+    assert "engine_decode_fallbacks" in set(telemetry().perf.dump())
+    text = prometheus.render_text()
+    for key in ("faults_fired", "faults_msgr_drop",
+                "engine_decode_fallbacks"):
+        assert f"ceph_tpu_{key}" in text, key
+    assert 'daemon="faults"' in text
+    # asok side: ``fault status`` carries the counters dump
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    faults.register_asok(asok)
+    payload = asok.commands["fault status"]({})
+    assert set(payload["counters"]) >= keys
+    # the OSD schema itself registers the degraded-path keys (pin the
+    # schema without booting a daemon: a throwaway logger)
+    from ceph_tpu.osd.osd import OSD
+    from ceph_tpu.utils.perf_counters import collection
+    perf = OSD._make_perf("osd.schema_lint")
+    try:
+        osd_keys = set(perf.dump())
+        assert {"read_retries", "read_retry_attempts",
+                "degraded_reads", "read_version_splits"} <= osd_keys
+        text = prometheus.render_text()
+        for key in ("read_retries", "degraded_reads",
+                    "read_version_splits"):
+            assert f"ceph_tpu_{key}" in text, key
+        assert "ceph_tpu_read_retry_attempts_bucket" in text
+    finally:
+        collection().remove("osd.schema_lint")
 
 
 def test_histogram_exposition_is_cumulative_and_typed():
