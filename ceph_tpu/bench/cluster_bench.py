@@ -84,9 +84,13 @@ def run_one(backend: str, seconds: float, n_osds: int, obj_size: int,
         out["wall"] = round(time.monotonic() - t0, 2)
         out["backend"] = backend
         out["profile"] = f"k={k},m={m}"
-        stats = [dict(o._device_engine.stats)
-                 for o in cluster.osds.values()
-                 if o._device_engine is not None]
+        # dedupe by stats-dict identity: with the shared engine
+        # service every OSD's handle reports the SAME engine — summing
+        # per-OSD views would triple-count one pipeline
+        stats = list({id(o._device_engine.stats):
+                      dict(o._device_engine.stats)
+                      for o in cluster.osds.values()
+                      if o._device_engine is not None}.values())
         if stats:
             out["device_engine"] = {
                 "launches": sum(s["flushes"] for s in stats),
@@ -101,9 +105,12 @@ def run_one(backend: str, seconds: float, n_osds: int, obj_size: int,
 
 def _engine_stats(cluster) -> dict:
     tot: dict = {}
+    seen: set[int] = set()   # shared engine: one stats dict, N OSDs
     for o in cluster.osds.values():
-        if o._device_engine is None:
+        if o._device_engine is None or \
+                id(o._device_engine.stats) in seen:
             continue
+        seen.add(id(o._device_engine.stats))
         for name, v in o._device_engine.stats.items():
             tot[name] = tot.get(name, 0) + v
     return tot

@@ -63,6 +63,33 @@ BASELINE.md "Pipelined engine").
 Failure containment: a device encode error fails over to the op
 continuations with the error; ECBackend re-encodes those ops on its
 host codec (the daemon must never wedge on an accelerator fault).
+
+Bulk ingest (ISSUE 9, ``CEPH_TPU_BULK_INGEST``, default on) — three
+coupled changes that move work across every boundary in batches:
+
+- **Zero-copy staging**: ``stage_encode`` writes each op's payload
+  into a per-signature preallocated concat buffer at staging time
+  (:class:`_ConcatStager`), so the flush hands the device ONE
+  contiguous view instead of re-concatenating N per-op arrays on the
+  engine thread (``staging_copies_avoided_bytes`` counts the bytes
+  that skipped the flush-time copy). Buffer ownership passes to the
+  flush results; a fresh buffer backs the next flush.
+- **Batched continuation dispatch**: a retired flush dispatches ONE
+  wrapper per distinct key (pgid) instead of one callable per op;
+  the wrappers share a :class:`FlushGroup`, and the LAST one to
+  finish ships the flush's deferred cross-PG work — the per-peer
+  MECSubWriteBatch fan-out and the merged local txn group ECBackend
+  registers via :func:`current_group`. Groups flush in strict flush
+  order (each waits its predecessor), and barriers chain behind the
+  last group's flush, so per-PG commit order is exactly the
+  pre-batching order.
+- **Shared engine service**: co-located OSDs attach to one
+  process-wide engine (:func:`shared_engine_attach`) instead of one
+  engine each — cross-OSD flushes aggregate into bigger batches and
+  the >= 1 MiB mesh route fires more often. Each attach wraps keys
+  with its token (:class:`AttachedKey`) so continuations dispatch on
+  the owner OSD's op queue; the engine stops when the last OSD
+  detaches.
 """
 
 from __future__ import annotations
@@ -91,8 +118,197 @@ _TP_DECODE_FLUSH = _tracepoints.provider("osd").point(
     "device_decode_flush", "ops", "signature")
 
 
+def bulk_ingest_enabled() -> bool:
+    """The ISSUE-9 data-plane master switch: batched sub-write
+    fan-out + zero-copy staging + the shared engine service. Read at
+    engine/OSD construction time so ``CEPH_TPU_BULK_INGEST=0|1`` can
+    A/B consecutive clusters in one process (the gap report's
+    before/after regression mode)."""
+    import os
+    return os.environ.get("CEPH_TPU_BULK_INGEST", "1") != "0"
+
+
+class _ConcatStager:
+    """Per-signature preallocated concat buffers, written at staging
+    time (the zero-copy leg of ISSUE 9). ``append`` copies the op's
+    payload into the signature's open buffer on the PRODUCER thread;
+    ``take`` hands the engine the consumed prefix as one contiguous
+    view plus per-op views into it — no flush-time np.concatenate.
+    Ownership of the handed buffer passes to the flush (result shard
+    views may alias it); unconsumed tail bytes (ops racing the flush
+    cut) relocate into a fresh buffer."""
+
+    _MIN_CAP = 256 << 10
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        #: id(codec) -> {"buf", "used", "slots": [[off, len], ...]}
+        self._by_codec: dict[int, dict] = {}
+        self.stats = {"staged_bytes": 0, "relocated_bytes": 0}
+
+    def _state(self, codec) -> dict:
+        st = self._by_codec.get(id(codec))
+        if st is None:
+            st = self._by_codec[id(codec)] = {
+                "buf": np.empty(self._MIN_CAP, dtype=np.uint8),
+                "used": 0, "slots": []}
+        return st
+
+    def append_locked(self, codec, data: np.ndarray) -> None:
+        """Caller holds ``self.lock`` (the engine queue put rides the
+        same critical section so per-codec slot order == queue
+        order)."""
+        st = self._state(codec)
+        need = st["used"] + data.nbytes
+        if need > len(st["buf"]):
+            cap = max(len(st["buf"]), self._MIN_CAP)
+            while cap < need:
+                cap <<= 1
+            buf = np.empty(cap, dtype=np.uint8)
+            buf[:st["used"]] = st["buf"][:st["used"]]
+            st["buf"] = buf
+        st["buf"][st["used"]:need] = data.ravel()
+        st["slots"].append([st["used"], data.nbytes])
+        st["used"] = need
+        self.stats["staged_bytes"] += data.nbytes
+
+    def take(self, codec, count: int
+             ) -> tuple[np.ndarray, list[np.ndarray]]:
+        """Detach the first ``count`` staged ops of this signature:
+        returns (contiguous batch view, per-op views). The tail (ops
+        staged after the engine decided to flush) moves to a fresh
+        buffer so its queued tokens stay valid."""
+        with self.lock:
+            st = self._state(codec)
+            slots = st["slots"][:count]
+            tail = st["slots"][count:]
+            buf = st["buf"]
+            cut = (slots[-1][0] + slots[-1][1]) if slots else 0
+            if tail:
+                tail_bytes = st["used"] - cut
+                cap = self._MIN_CAP
+                while cap < tail_bytes:
+                    cap <<= 1
+                fresh = np.empty(cap, dtype=np.uint8)
+                fresh[:tail_bytes] = buf[cut:st["used"]]
+                for slot in tail:
+                    slot[0] -= cut
+                st["buf"] = fresh
+                st["used"] = tail_bytes
+                st["slots"] = tail
+                self.stats["relocated_bytes"] += tail_bytes
+            else:
+                st["buf"] = np.empty(self._MIN_CAP, dtype=np.uint8)
+                st["used"] = 0
+                st["slots"] = []
+            views = [buf[off:off + ln] for off, ln in slots]
+            return buf[:cut], views
+
+
+class FlushGroup:
+    """Per-retired-flush rendezvous (the batched fan-out leg of
+    ISSUE 9): the engine dispatches one continuation wrapper per
+    distinct key; each wrapper's ops may :meth:`defer` cross-PG work
+    (per-peer sub-write batches, merged local txn groups), and the
+    LAST wrapper to finish ships it — after the PREVIOUS flush's
+    group shipped, so sends to a peer keep flush order (the per-PG
+    commit-order contract extended across the batch boundary).
+    Barriers chain behind the flush via :meth:`after_flush`."""
+
+    def __init__(self, nkeys: int,
+                 prev_group: "FlushGroup | None") -> None:
+        self._lock = threading.Lock()
+        self._pending = max(1, nkeys)
+        #: bucket -> (ship_fn, [items]); insertion-ordered
+        self._deferred: dict = {}
+        self._after: list = []
+        self._prev_group = prev_group
+        self._flushed = False
+        self.event = threading.Event()
+
+    def defer(self, bucket, ship_fn, item) -> None:
+        """Queue ``item`` for ``ship_fn(items)`` at group flush;
+        items of one bucket ship together (one message / one txn
+        group)."""
+        with self._lock:
+            ent = self._deferred.get(bucket)
+            if ent is None:
+                ent = self._deferred[bucket] = (ship_fn, [])
+            ent[1].append(item)
+
+    def after_flush(self, cb) -> None:
+        """Run ``cb`` once the group has shipped (immediately if it
+        already has)."""
+        with self._lock:
+            if not self._flushed:
+                self._after.append(cb)
+                return
+        cb()
+
+    def done(self) -> None:
+        """One per-key wrapper finished; the last one ships — after
+        the PREVIOUS flush's group shipped (cross-key wq interleaving
+        could otherwise reorder two flushes' sends to one peer). The
+        fence is NON-blocking: when the predecessor is still open,
+        the ship runs as its after-flush callback instead of parking
+        this wq worker on a wait (a blocked worker would serialize
+        unrelated PGs' continuations behind the fence)."""
+        with self._lock:
+            self._pending -= 1
+            if self._pending > 0:
+                return
+        prev, self._prev_group = self._prev_group, None
+        if prev is not None:
+            prev.after_flush(self._ship)
+        else:
+            self._ship()
+
+    def _ship(self) -> None:
+        with self._lock:
+            deferred = list(self._deferred.values())
+            self._deferred = {}
+        for ship_fn, items in deferred:
+            try:
+                ship_fn(items)
+            except Exception as exc:
+                log(0, f"flush-group ship failed: {exc!r}")
+        with self._lock:
+            self._flushed = True
+            after, self._after = self._after, []
+        self.event.set()
+        for cb in after:
+            try:
+                cb()
+            except Exception as exc:
+                log(0, f"flush-group after-flush cb failed: {exc!r}")
+
+
+_group_tls = threading.local()
+
+
+def current_group() -> "FlushGroup | None":
+    """The FlushGroup whose continuation wrapper is running on this
+    thread (None outside one) — how ECBackend's fan-out discovers it
+    can defer sends into the per-peer batch instead of shipping one
+    MECSubWrite per shard."""
+    return getattr(_group_tls, "group", None)
+
+
+class _StagedRef:
+    """Placeholder riding the queue in place of the payload when the
+    bytes already live in the stager's concat buffer (only the byte
+    count is still needed on the engine loop's flush threshold)."""
+
+    __slots__ = ("nbytes",)
+
+    def __init__(self, nbytes: int) -> None:
+        self.nbytes = nbytes
+
+
 class DeviceEncodeEngine:
-    """One per OSD; owns the device dispatch thread."""
+    """One per OSD — or one per PROCESS through the shared engine
+    service (:func:`shared_engine_attach`); owns the device dispatch
+    thread."""
 
     def __init__(self, dispatch: Callable[[object, Callable], None],
                  flush_bytes: int = 64 << 20,
@@ -100,9 +316,21 @@ class DeviceEncodeEngine:
                  mesh_flush_bytes: int | None = None) -> None:
         import os
         #: dispatch(key, fn): run fn on the per-key FIFO executor (the
-        #: OSD passes op_wq.enqueue, keyed by pgid)
-        self._dispatch = dispatch
+        #: OSD passes op_wq.enqueue, keyed by pgid). None for the
+        #: shared engine service, where every key is an AttachedKey
+        #: routed through the per-OSD dispatcher table below.
+        self._dispatch_default = dispatch
         self._flush_bytes = flush_bytes
+        #: attach token -> that OSD's dispatch fn (shared engine)
+        self._dispatchers: dict[int, Callable] = {}
+        #: ISSUE 9 bulk-ingest legs, captured at construction so
+        #: CEPH_TPU_BULK_INGEST can A/B consecutive clusters
+        self._bulk = bulk_ingest_enabled()
+        self._stager = _ConcatStager() if self._bulk else None
+        #: flush-order chain: each retired flush's FlushGroup waits
+        #: for its predecessor's event before shipping
+        self._last_group: FlushGroup | None = None
+        self._last_group_event: threading.Event | None = None
         self._counters = counters
         #: max launched-not-retired encode batches (the pipeline
         #: depth); 1 = the old serial engine
@@ -116,6 +344,13 @@ class DeviceEncodeEngine:
             mesh_flush_bytes = int(os.environ.get(
                 "CEPH_TPU_MESH_FLUSH_BYTES", 1 << 20))
         self._mesh_flush_bytes = mesh_flush_bytes
+        #: flushes SMALLER than this take the host matvec instead of
+        #: a device launch (the fixed dispatch cost dominates tiny
+        #: batches — the bottom end of the routing ladder: host <
+        #: host_flush_bytes <= single-chip device < mesh_flush_bytes
+        #: <= mesh). 0 disables; bulk-ingest only.
+        self._host_flush_bytes = int(os.environ.get(
+            "CEPH_TPU_HOST_FLUSH_BYTES", 512 << 10))
         # warmup-kill: per-signature device programs persist across
         # processes (best-effort; a disabled/failed cache only costs
         # recompiles, never correctness)
@@ -135,6 +370,9 @@ class DeviceEncodeEngine:
                       # upload/compute/download overlapped) and how
                       # many flushes routed through the mesh
                       "max_inflight_depth": 0, "mesh_flushes": 0,
+                      # small flushes routed to the host matvec (the
+                      # bulk-ingest bottom rung of the routing ladder)
+                      "host_flushes": 0,
                       # auxiliary device work run via run_sync (deep
                       # scrub verify launches)
                       "aux_runs": 0,
@@ -145,9 +383,89 @@ class DeviceEncodeEngine:
                       # table)
                       "busy_s": 0.0}
         _telemetry().note_engine_window(self._window)
+        #: launch pipeline: deque of (items, finalize, kspans,
+        #: launch_t, nbytes) batches whose device programs are queued
+        #: but not yet downloaded — up to ``window`` deep. The RETIRE
+        #: thread harvests strictly FIFO, so continuation order equals
+        #: launch order; the engine thread never blocks on a download
+        #: (ops staged during batch N's device round coalesce into
+        #: batch N+1 instead of waiting behind its harvest — the
+        #: bulk-ingest batching lever).
+        import collections
+        self._inflight: collections.deque = collections.deque()
+        self._ifcv = threading.Condition()
+        self._retiring = False        # retire thread mid-harvest
+        self._retire_stop = False
         self._thread = threading.Thread(
             target=self._run, name="ec-device-engine", daemon=True)
         self._thread.start()
+        self._retire_thread = threading.Thread(
+            target=self._retire_run, name="ec-device-retire",
+            daemon=True)
+        self._retire_thread.start()
+
+    # -- dispatch routing (per-OSD when shared) -----------------------
+    def _dispatch(self, key, fn) -> None:
+        if isinstance(key, AttachedKey):
+            d = self._dispatchers.get(key[0])
+            if d is None:
+                log(1, "dropping continuation for detached engine "
+                    f"attachment {key[0]}")
+                return
+            d(key[1], fn)
+            return
+        self._dispatch_default(key, fn)
+
+    def register_dispatcher(self, token: int, dispatch) -> None:
+        self._dispatchers[token] = dispatch
+        _telemetry().note_attached_osds(len(self._dispatchers))
+
+    def unregister_dispatcher(self, token: int) -> None:
+        self._dispatchers.pop(token, None)
+        _telemetry().note_attached_osds(len(self._dispatchers))
+
+    # -- batched continuation dispatch (ISSUE 9) ----------------------
+    def _dispatch_entries(self, entries) -> None:
+        """Dispatch a retired flush's continuations: one wrapper per
+        distinct key (batched mode) sharing a FlushGroup, or the
+        legacy one-callable-per-op dispatch. ``entries`` is ordered
+        [(key, fn)]."""
+        if not self._bulk:
+            for key, fn in entries:
+                self._dispatch(key, fn)
+            return
+        by_key: dict = {}
+        for key, fn in entries:
+            by_key.setdefault(key, []).append(fn)
+        group = FlushGroup(len(by_key), self._last_group)
+        self._last_group = group
+        self._last_group_event = group.event
+
+        for key, fns in by_key.items():
+            def run(fns=fns, group=group):
+                _group_tls.group = group
+                try:
+                    for fn in fns:
+                        try:
+                            fn()
+                        except Exception as exc:
+                            log(0, f"batched continuation failed: "
+                                f"{exc!r}")
+                finally:
+                    _group_tls.group = None
+                    group.done()
+            run._profile_stage = "commit_wait"
+            self._dispatch(key, run)
+
+    def _after_last_group(self, cb) -> None:
+        """Run ``cb`` after the most recently dispatched flush group
+        has shipped (immediately when there is none) — the barrier
+        ordering point extended across deferred batch sends."""
+        group = self._last_group
+        if group is not None and self._bulk:
+            group.after_flush(cb)
+        else:
+            cb()
 
     # -- producer side (op-shard threads) -----------------------------
     def stage_encode(self, key, codec, sinfo: ec_util.StripeInfo,
@@ -169,6 +487,17 @@ class DeviceEncodeEngine:
         # HBM ledger: bytes enter the staged bucket here and leave it
         # at launch (-> in-window) or on a launch fault (-> retired)
         _telemetry().note_hbm(staged_delta=data.nbytes)
+        if self._stager is not None:
+            # zero-copy staging: the payload lands in the signature's
+            # concat buffer NOW, on this producer thread; the engine
+            # flush takes one contiguous view. The queue put rides the
+            # stager lock so per-signature slot order == queue order.
+            ref = _StagedRef(data.nbytes)
+            with self._stager.lock:
+                self._stager.append_locked(codec, data)
+                self._q.put(("enc", key, codec, sinfo, ref, cont,
+                             span, clock, _time.monotonic()))
+            return
         self._q.put(("enc", key, codec, sinfo, data, cont, span,
                      clock, _time.monotonic()))
 
@@ -241,17 +570,46 @@ class DeviceEncodeEngine:
         self._running = False
         self._q.put(None)
         self._thread.join(timeout=10)
+        with self._ifcv:
+            self._retire_stop = True
+            self._ifcv.notify_all()
+        self._retire_thread.join(timeout=10)
+        # shutdown drain, batched edition: the engine thread has
+        # DISPATCHED every continuation wrapper, but the last flush
+        # group ships its deferred sub-write batches on an op-wq
+        # worker — wait for that ship so nothing chained behind it
+        # (barriers, local txn groups) is dropped by a wq that stops
+        # right after us
+        ev = self._last_group_event
+        if ev is not None and not ev.wait(10):
+            log(1, "engine stop: last flush group never shipped")
+
+    # -- retire thread ------------------------------------------------
+    def _retire_run(self) -> None:
+        """Harvest launched batches strictly FIFO on a dedicated
+        thread: while batch N's download blocks HERE, the engine
+        thread keeps accumulating and launching batches N+1.. — ops
+        no longer queue behind a blocking drain (the measured
+        engine_stage_wait share), and bigger flushes amortize the
+        per-peer sub-write batches."""
+        while True:
+            with self._ifcv:
+                while not self._inflight and not self._retire_stop:
+                    self._ifcv.wait()
+                if not self._inflight and self._retire_stop:
+                    return
+                entry = self._inflight.popleft()
+                self._retiring = True
+                self._ifcv.notify_all()
+            try:
+                self._retire_one(entry)
+            finally:
+                with self._ifcv:
+                    self._retiring = False
+                    self._ifcv.notify_all()
 
     # -- engine thread ------------------------------------------------
     def _run(self) -> None:
-        import collections
-        #: launch pipeline: deque of (items, finalize, kspans,
-        #: launch_t) for batches whose device programs are queued but
-        #: not yet downloaded — up to ``window`` deep. While batch N
-        #: computes, batch N+1 concatenates/uploads and batch N-1
-        #: downloads; retirement is strictly FIFO so continuation
-        #: order equals submission order.
-        self._inflight = collections.deque()
         while True:
             # profiler join: blocking on an empty queue is idle time,
             # not engine work — without the mark, every sample of the
@@ -331,16 +689,24 @@ class DeviceEncodeEngine:
                     self._drain_inflight()
                     pending, dec_pending, nbytes = {}, {}, 0
                     _, key, fn = item
-                    self._dispatch(key, fn)
+                    # ...and after the last flush group SHIPPED its
+                    # deferred batch sends: a barrier's own fan-out
+                    # (remove/RMW) must not beat the older writes'
+                    # batched sub-writes to the shards
+                    self._after_last_group(
+                        lambda key=key, fn=fn:
+                        self._dispatch(key, fn))
                 try:
                     item = self._q.get_nowait()
                 except queue.Empty:
                     # nothing else queued: launch what we have now
-                    # (an idle engine adds no batching latency) and
-                    # drain — continuations must not wait for load
+                    # (an idle engine adds no batching latency). The
+                    # RETIRE thread harvests it — no drain here, so
+                    # ops arriving during the device round coalesce
+                    # into the next flush instead of queueing behind
+                    # a blocking download
                     self._flush(pending)
                     self._flush_decodes(dec_pending)
-                    self._drain_inflight()
                     pending, dec_pending, nbytes = {}, {}, 0
                     break
             # shutdown is the None sentinel, NOT self._running: ops
@@ -364,7 +730,17 @@ class DeviceEncodeEngine:
         t0 = _time.perf_counter()
         drained = 0.0                 # retirement self-accounts
         for codec, sinfo, items in pending.values():
-            nbytes = sum(d.nbytes for _k, d, _c, _s, _cl, _t in items)
+            if self._stager is not None:
+                # zero-copy staging: the payloads are already
+                # contiguous in the signature's concat buffer —
+                # detach the consumed prefix as one view (no
+                # flush-time np.concatenate on this thread)
+                batch, views = self._stager.take(codec, len(items))
+                nbytes = batch.nbytes
+            else:
+                batch = None
+                views = [d for _k, d, _c, _s, _cl, _t in items]
+                nbytes = sum(d.nbytes for d in views)
             # a configured default mesh takes the flush through the
             # multi-chip encode step (pod deployments; dryrun/tests)
             # — but only once the batch is big enough to amortize the
@@ -374,36 +750,63 @@ class DeviceEncodeEngine:
             mesh = mesh_mod.get_default_mesh()
             if mesh is not None and nbytes < self._mesh_flush_bytes:
                 mesh = None
-            batcher = ec_util.StripeBatcher(
-                sinfo, codec, mesh=mesh,
-                on_fallback=self._note_fused_fallback)
-            for i, (_key, data, _cont, _span, _clock, _ts) in \
-                    enumerate(items):
-                batcher.append(i, data)
+            # SMALL flushes route to the HOST matvec (bulk ingest):
+            # below host_flush_bytes the fixed device dispatch cost
+            # (jit call + transfer round trip, ~5 ms measured on the
+            # CPU quick run) dwarfs the host encode (~0.4 ms at
+            # 64 KiB) — the same measured-crossover policy shape as
+            # the mesh threshold above it and the sparse-vs-dense
+            # calibration below it. The encode runs at finalize time
+            # on the RETIRE thread, riding the same FIFO as device
+            # batches, so ordering is identical.
+            host = (self._bulk and mesh is None
+                    and nbytes < self._host_flush_bytes
+                    and ec_util.host_flushable(codec))
+            if batch is not None:
+                _telemetry().note_staging_copies_avoided(nbytes)
+            if not host:
+                batcher = ec_util.StripeBatcher(
+                    sinfo, codec, mesh=mesh,
+                    on_fallback=self._note_fused_fallback)
+                for i, buf in enumerate(views):
+                    batcher.append(i, buf)
+                if batch is not None:
+                    batcher.set_preconcat(batch)
             if mesh is not None:
                 self.stats["mesh_flushes"] += 1
+            # window backpressure BEFORE the launch: with window=1
+            # batch N+1 launches only after N fully retired (the old
+            # serial engine); deeper windows overlap N+1's staging/
+            # upload with N's compute and N-1's download
+            self._wait_window()
             try:
                 # chaos-harness seam (utils/faults engine_launch
                 # rules): an injected launch failure rides the exact
                 # failure-drain path a real device fault takes
                 _faults.engine_fault("launch")
-                finalize = batcher.flush_async(
-                    with_crcs=ec_util.fuse_crc_policy(codec))
+                if host:
+                    finalize = ec_util.flush_host_async(
+                        sinfo, codec, list(range(len(views))),
+                        views, batch=batch)
+                    self.stats["host_flushes"] += 1
+                else:
+                    finalize = batcher.flush_async(
+                        with_crcs=ec_util.fuse_crc_policy(codec))
             except Exception as exc:
                 # launch failed: older batches' continuations must
                 # still run BEFORE these error continuations (per-PG
-                # order), so drain first. The batch's bytes leave the
-                # staged bucket here (fate decided: host fallback).
-                _telemetry().note_hbm(staged_delta=-nbytes,
-                                      retired=nbytes)
-                drained += self._drain_inflight()
-                log(0, f"device encode batch of {len(items)} ops "
-                    f"failed: {exc!r}")
-                self.stats["errors"] += 1
-                for key, _data, cont, span, _clock, _ts in items:
-                    span.event(f"device_error {exc!r}")
-                    span.finish()
-                    self._dispatch(key, _bind(cont, None, None, exc))
+                # order) — ride the SAME in-flight FIFO as a poison
+                # entry whose "finalize" raises; the retire thread's
+                # failure-drain path dispatches the error
+                # continuations in exact launch order. Bytes move
+                # staged -> in-window here and leave at retirement
+                # (fate decided there: host fallback).
+                def _poison(exc=exc):
+                    raise exc
+                kspans = [span.child("kernel_dispatch")
+                          for _k, _d, _c, span, _cl, _t in items]
+                self._park((items, _poison, kspans,
+                            _time.perf_counter(), nbytes))
                 continue
             # batch launched (async): park it on the in-flight deque
             # — its compute+download overlaps the NEXT batch's
@@ -422,49 +825,74 @@ class DeviceEncodeEngine:
                     span.event(f"batch_flush ops={len(items)} "
                                f"bytes={nbytes}")
                 kspans.append(span.child("kernel_dispatch"))
-            # staged -> in-window (the batch byte count RIDES the
-            # in-flight entry so retirement can reconcile it — the
-            # pre-PR-7 engine dropped it here and the live gauges
-            # could never return to zero)
-            tel.note_hbm(staged_delta=-nbytes, inflight_delta=nbytes)
-            self._inflight.append(
-                (items, finalize, kspans, _time.perf_counter(),
-                 nbytes))
-            depth = len(self._inflight)
-            self.stats["max_inflight_depth"] = max(
-                self.stats["max_inflight_depth"], depth)
-            tel.note_inflight_depth(depth)
-            tel.note_engine_inflight(depth)
-            while len(self._inflight) >= self._window:
-                drained += self._retire_oldest()
+            entry = (items, finalize, kspans,
+                     _time.perf_counter(), nbytes)
+            if host and not self._inflight and not self._retiring:
+                # light-load fast path: nothing in flight, so FIFO
+                # order is trivially kept — retire the host flush
+                # INLINE instead of paying a retire-thread handoff
+                # (one fewer cross-thread wakeup on the op's
+                # critical path; the wait chain IS the measured
+                # latency). Only the engine thread parks entries, so
+                # the emptiness check cannot race.
+                tel.note_hbm(staged_delta=-nbytes,
+                             inflight_delta=nbytes)
+                self._retire_one(entry)
+            else:
+                self._park(entry)
         if pending:
-            # retirement time self-accounts in _retire_oldest; only
+            # retirement time self-accounts in _retire_one; only
             # the launch-side time is added here (no double count)
-            self.stats["busy_s"] += \
-                _time.perf_counter() - t0 - drained
+            with self._ifcv:
+                self.stats["busy_s"] += \
+                    _time.perf_counter() - t0 - drained
         pending.clear()
 
-    def _drain_inflight(self) -> float:
-        """Retire EVERY in-flight batch in launch order (ordering
-        points: barrier, run_sync, stop, launch failure); returns
-        seconds spent (also accumulated into busy_s)."""
-        dt = 0.0
-        while self._inflight:
-            dt += self._retire_oldest()
-        return dt
+    def _wait_window(self) -> None:
+        """Block until the launch window has a free slot (counting a
+        batch mid-harvest): with window=1 this is the old serial
+        engine — batch N+1 launches only after N fully retired."""
+        with self._ifcv:
+            while len(self._inflight) + \
+                    (1 if self._retiring else 0) >= self._window:
+                self._ifcv.wait()
 
-    def _retire_oldest(self) -> float:
-        """Harvest the OLDEST in-flight batch (download + dispatch its
+    def _park(self, entry) -> None:
+        """Hand a launched (or poison) batch to the retire thread:
+        staged -> in-window on the HBM ledger; the byte count rides
+        the entry so retirement reconciles it on both outcomes."""
+        nbytes = entry[-1]
+        tel = _telemetry()
+        tel.note_hbm(staged_delta=-nbytes, inflight_delta=nbytes)
+        with self._ifcv:
+            self._inflight.append(entry)
+            depth = len(self._inflight) + \
+                (1 if self._retiring else 0)
+            self._ifcv.notify_all()
+        self.stats["max_inflight_depth"] = max(
+            self.stats["max_inflight_depth"], depth)
+        tel.note_inflight_depth(depth)
+        tel.note_engine_inflight(depth)
+
+    def _drain_inflight(self) -> float:
+        """Wait until the retire thread has harvested EVERY in-flight
+        batch (ordering points: barrier, run_sync, stop). Returns 0.0
+        — the retire thread self-accounts its harvest time."""
+        with self._ifcv:
+            while self._inflight or self._retiring:
+                self._ifcv.wait()
+        return 0.0
+
+    def _retire_one(self, entry) -> float:
+        """Harvest one in-flight batch (download + dispatch its
         continuations); returns seconds spent (also accumulated into
-        busy_s here)."""
+        busy_s here). Runs on the retire thread only — it is the sole
+        creator of FlushGroups, so group chaining is single-writer."""
         import time as _time
-        if not self._inflight:
-            return 0.0
         prev_stage = _prof.push_stage("device_finalize")
         t0 = _time.perf_counter()
         harvest_t = _time.monotonic()
-        (items, finalize, kspans, launch_t,
-         nbytes) = self._inflight.popleft()
+        (items, finalize, kspans, launch_t, nbytes) = entry
         # per-op timeline: launch -> harvest begin is the pipeline-
         # window wait (overlapped with younger batches' staging)
         for _key, _data, _cont, _span, clock, _ts in items:
@@ -475,12 +903,14 @@ class DeviceEncodeEngine:
             log(0, f"device encode batch of {len(items)} ops "
                 f"failed: {exc!r}")
             self.stats["errors"] += 1
+            entries = []
             for (key, _data, cont, span, _clock, _ts), kspan in \
                     zip(items, kspans):
                 kspan.event(f"device_error {exc!r}")
                 kspan.finish()
                 span.finish()
-                self._dispatch(key, _bind(cont, None, None, exc))
+                entries.append((key, _bind(cont, None, None, exc)))
+            self._dispatch_entries(entries)
             results = None
         if results is not None:
             done_t = _time.monotonic()
@@ -492,6 +922,7 @@ class DeviceEncodeEngine:
             if self._counters is not None:
                 self._counters.inc("device_batches")
                 self._counters.inc("device_batch_ops", len(items))
+            entries = []
             for (key, _data, cont, span, clock, _ts), \
                     (_i, shards, crcs), kspan in zip(items, results,
                                                      kspans):
@@ -500,7 +931,12 @@ class DeviceEncodeEngine:
                 kspan.finish()
                 span.finish()
                 clock.mark("device_finalize", t=done_t)
-                self._dispatch(key, _bind(cont, shards, crcs, None))
+                entries.append((key, _bind(cont, shards, crcs, None)))
+            # ONE wrapper per distinct key instead of one callable
+            # per op: the flush's continuations share a FlushGroup
+            # whose last member ships the per-peer sub-write batches
+            # and the merged local txn groups (ISSUE 9)
+            self._dispatch_entries(entries)
             _telemetry().note_encode_flush(
                 len(items), nbytes, _time.perf_counter() - t0)
         dt = _time.perf_counter() - t0
@@ -515,7 +951,8 @@ class DeviceEncodeEngine:
         # the batch's bytes leave the window on BOTH outcomes
         # (download or failover) — the gauges-to-zero invariant
         tel.note_hbm(inflight_delta=-nbytes, retired=nbytes)
-        self.stats["busy_s"] += dt
+        with self._ifcv:     # busy_s has two writers (launch/retire)
+            self.stats["busy_s"] += dt
         _prof.pop_stage(prev_stage)
         return dt
 
@@ -616,6 +1053,102 @@ def _shards_nbytes(shards: dict) -> int:
     expression on the staging and retiring side, so the HBM ledger
     reconciles exactly."""
     return sum(np.asarray(v).nbytes for v in shards.values())
+
+
+class AttachedKey(tuple):
+    """(attach token, key): routes a shared-engine continuation to
+    the attaching OSD's dispatcher while hashing like the wrapped key
+    for per-PG FIFO placement. A plain tuple subclass so it stays
+    hashable and cheap."""
+    __slots__ = ()
+
+
+class EngineHandle:
+    """One OSD's view of the process-wide shared engine: the same
+    surface as a private DeviceEncodeEngine (stage_*, decode_sync,
+    run_sync, stats, stop), with every key wrapped in this
+    attachment's token so continuations land on the owner OSD's op
+    queue. ``stop`` detaches; the engine itself stops when the last
+    attachment leaves."""
+
+    def __init__(self, engine: DeviceEncodeEngine, token: int) -> None:
+        self.engine = engine
+        self._token = token
+        self._detached = False
+
+    @property
+    def stats(self) -> dict:
+        return self.engine.stats
+
+    def _key(self, key) -> AttachedKey:
+        return AttachedKey((self._token, key))
+
+    def stage_encode(self, key, *a, **kw) -> None:
+        self.engine.stage_encode(self._key(key), *a, **kw)
+
+    def stage_barrier(self, key, fn) -> None:
+        self.engine.stage_barrier(self._key(key), fn)
+
+    def stage_decode(self, key, *a, **kw) -> None:
+        self.engine.stage_decode(self._key(key), *a, **kw)
+
+    def decode_sync(self, key, *a, **kw):
+        return self.engine.decode_sync(self._key(key), *a, **kw)
+
+    def run_sync(self, fn, timeout: float = 120.0):
+        return self.engine.run_sync(fn, timeout)
+
+    def stop(self) -> None:
+        """Detach this OSD: drain everything staged so far (its
+        continuations are dispatched before the dispatcher goes), then
+        stop the engine if this was the last attachment."""
+        if self._detached:
+            return
+        self._detached = True
+        try:
+            # a run_sync flushes all pending work and drains the
+            # in-flight window on the engine thread
+            self.engine.run_sync(lambda: None, timeout=30)
+        except Exception:
+            pass
+        _detach(self.engine, self._token)
+
+
+_shared_lock = threading.Lock()
+_shared_engine: DeviceEncodeEngine | None = None
+_attach_seq = 0
+
+
+def shared_engine_attach(dispatch, flush_bytes: int = 64 << 20
+                         ) -> EngineHandle:
+    """Attach one OSD to the process-wide shared engine (the ISSUE-9
+    shared engine service): co-located OSDs feed ONE device pipeline,
+    so cross-OSD flushes aggregate into bigger batches and the mesh
+    threshold fires more often. Creates the engine on first attach,
+    restarts it if a previous generation fully detached."""
+    global _shared_engine, _attach_seq
+    with _shared_lock:
+        eng = _shared_engine
+        if eng is None or not eng._running:
+            eng = _shared_engine = DeviceEncodeEngine(
+                None, flush_bytes=flush_bytes)
+        _attach_seq += 1
+        token = _attach_seq
+        eng.register_dispatcher(token, dispatch)
+        return EngineHandle(eng, token)
+
+
+def _detach(engine: DeviceEncodeEngine, token: int) -> None:
+    global _shared_engine
+    stop = False
+    with _shared_lock:
+        engine.unregister_dispatcher(token)
+        if not engine._dispatchers:
+            stop = True
+            if _shared_engine is engine:
+                _shared_engine = None
+    if stop:
+        engine.stop()
 
 
 def _bind(cont, shards, crcs, err):

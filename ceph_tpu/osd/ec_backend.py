@@ -37,6 +37,7 @@ from typing import Callable
 import numpy as np
 
 from ceph_tpu.models import registry as ec_registry
+from ceph_tpu.osd import device_engine as _dev_engine
 from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.ec_util import HashInfo, StripeInfo
 from ceph_tpu.osd.pg import (
@@ -203,32 +204,93 @@ class ECBackend(PGBackend):
         op_clock = stage_clock.current()
         if op_clock is not stage_clock.NOOP:
             iw.clock = op_clock
+        # bulk ingest (ISSUE 9): inside a flush-group continuation the
+        # fan-out DEFERS its cross-PG work — every shard sub-write of
+        # the whole flush destined for one peer ships as ONE
+        # MECSubWriteBatch, and this OSD's local shard txns apply as
+        # one queued txn group — instead of one message / one store
+        # txn per (op, shard). Outside a group (host backends,
+        # barriers, host-fallback-after-drain) everything ships
+        # immediately, exactly as before.
+        group = _dev_engine.current_group()
         for pos in positions:
             osd = pg.acting[pos]
             cid = pg_cid(pg.pool, pg.ps, pos)
             txn = txn_builder(pos, cid)
             pg.log.apply_to_txn(txn, cid, kv, drop)
             if osd == self.parent.whoami:
-                self.parent.queue_local_txn(
-                    txn,
-                    lambda p=pos: iw.complete(p) and iw.on_all_commit())
+                commit_cb = (lambda p=pos:
+                             iw.complete(p) and iw.on_all_commit())
+                if group is not None:
+                    group.defer((id(self.parent), "local"),
+                                self._apply_local_txn_group,
+                                (txn, commit_cb))
+                else:
+                    self.parent.queue_local_txn(txn, commit_cb)
             else:
                 child = op_span.child(f"{span_label}(shard={pos})")
-                sub = M.MECSubWrite(
-                    tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
-                    epoch=epoch, oid=oid, version=version,
-                    txn_bytes=txn.encode(), trace=child.wire())
-                if op_clock is not stage_clock.NOOP:
-                    # child timeline anchor: handed to the messenger
-                    # (the messenger serializes it into sub.stages)
-                    sub._stage_clock = stage_clock.StageClock(
-                        name="subop_send")
-                self.parent.send_osd(osd, sub)
+                if group is not None:
+                    group.defer(
+                        (id(self.parent), osd),
+                        lambda items, osd=osd:
+                        self._ship_subwrite_batch(osd, items),
+                        (tid, pg.pool, pg.ps, pos, oid, version,
+                         txn.encode(), child.wire(), epoch,
+                         op_clock is not stage_clock.NOOP))
+                else:
+                    sub = M.MECSubWrite(
+                        tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
+                        epoch=epoch, oid=oid, version=version,
+                        txn_bytes=txn.encode(), trace=child.wire())
+                    if op_clock is not stage_clock.NOOP:
+                        # child timeline anchor: handed to the
+                        # messenger (which serializes it into
+                        # sub.stages)
+                        sub._stage_clock = stage_clock.StageClock(
+                            name="subop_send")
+                    self.parent.send_osd(osd, sub)
                 child.finish()
         if supersedes_recovery:
             # a write of every shard supersedes pending recovery for it
             for missing in pg.peer_missing.values():
                 missing.pop(oid, None)
+
+    def _apply_local_txn_group(self, items: list) -> None:
+        """Flush-group ship for this OSD's own shards: every local
+        sub-write txn of the flush applies as ONE queued store txn
+        (one commit callback fans the per-op completions out)."""
+        self.parent.queue_local_txn_group(items)
+
+    def _ship_subwrite_batch(self, osd: int, items: list) -> None:
+        """Flush-group ship for one peer: every sub-write of the
+        flush destined for ``osd`` rides ONE MECSubWriteBatch — one
+        serialize, one dispatch-queue traversal, one batched reply
+        acking every contained tid (the ISSUE-9 fan-out contract).
+        Entry order is continuation order, so two writes of one
+        object reach the shard in version order."""
+        batch = M.MECSubWriteBatch(
+            tid=self.parent.new_tid(),
+            epoch=max(e for *_rest, e, _timed in items),
+            tids=[it[0] for it in items],
+            pools=[it[1] for it in items],
+            pss=[it[2] for it in items],
+            shards=[it[3] for it in items],
+            oids=[it[4] for it in items],
+            versions=[it[5] for it in items],
+            txns=[it[6] for it in items],
+            traces=[it[7] for it in items])
+        if any(timed for *_rest, timed in items):
+            # ONE child-timeline anchor for the whole frame: every
+            # contained sub-op genuinely shares the batch's send/
+            # wire/dispatch intervals; the shard forks a child clock
+            # per entry (one per tid comes home in the reply)
+            batch._stage_clock = stage_clock.StageClock(
+                name="subop_send")
+        logger = getattr(self.parent, "logger", None)
+        if logger is not None:
+            logger.inc("subwrite_batches")
+            logger.hinc("subwrite_batch_size", len(items))
+        self.parent.send_osd(osd, batch)
 
     def _unpin_on_commit(self, pg: PG, oid: str, version: int,
                          on_commit: Callable[[int], None]

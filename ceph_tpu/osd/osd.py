@@ -489,6 +489,14 @@ class OSD:
         perf.add_u64_counter("device_fused_fallbacks",
                              "mesh/fused flush failures that fell back "
                              "to the plain encode path")
+        # bulk-ingest fan-out (ISSUE 9): one message per (peer,
+        # flush) instead of one MECSubWrite per (op, shard)
+        perf.add_u64_counter("subwrite_batches",
+                             "MECSubWriteBatch messages shipped (one "
+                             "per peer per engine flush)")
+        perf.add_histogram("subwrite_batch_size",
+                           "sub-writes per MECSubWriteBatch (the "
+                           "fan-out amortization factor)")
         # the degraded path's previously-silent signals (ISSUE 8):
         # how often EC shard reads had to re-fan-out, how deep each
         # op's retry ladder went, and how many client reads took the
@@ -600,14 +608,22 @@ class OSD:
 
     # -- Listener interface (what backends use) -----------------------
     def device_engine(self):
-        """Lazy per-OSD DeviceEncodeEngine (the stripe-batch
-        accumulator of SURVEY.md §0): continuations dispatch onto the
-        sharded op queue keyed by pgid, preserving per-PG order."""
+        """Lazy device engine (the stripe-batch accumulator of
+        SURVEY.md §0): continuations dispatch onto the sharded op
+        queue keyed by pgid, preserving per-PG order. Under bulk
+        ingest (default) co-located OSDs ATTACH to one process-wide
+        shared engine — cross-OSD flushes aggregate into bigger
+        batches — instead of running one engine each; the handle
+        routes this OSD's continuations back to its own op queue."""
         with self._device_engine_lock:
             if self._device_engine is None:
-                from ceph_tpu.osd.device_engine import DeviceEncodeEngine
-                self._device_engine = DeviceEncodeEngine(
-                    self.op_wq.enqueue, counters=self.logger)
+                from ceph_tpu.osd import device_engine as de
+                if de.bulk_ingest_enabled():
+                    self._device_engine = de.shared_engine_attach(
+                        self.op_wq.enqueue)
+                else:
+                    self._device_engine = de.DeviceEncodeEngine(
+                        self.op_wq.enqueue, counters=self.logger)
             return self._device_engine
 
     def get_osdmap(self) -> OSDMap:
@@ -645,6 +661,31 @@ class OSD:
 
     def queue_local_txn(self, txn: Transaction, on_commit) -> None:
         self.store.queue_transaction(txn, on_commit)
+
+    def queue_local_txn_group(self, pairs: list) -> None:
+        """Apply many (txn, on_commit) pairs as ONE queued store txn
+        (the bulk-ingest local-shard leg: a flush's local sub-writes
+        commit together instead of one store round trip per op).
+        Op order within the merged txn is list order."""
+        if len(pairs) == 1:
+            txn, cb = pairs[0]
+            self.store.queue_transaction(txn, cb)
+            return
+        merged = Transaction()
+        cbs = []
+        for txn, cb in pairs:
+            merged.ops.extend(txn.ops)
+            cbs.append(cb)
+
+        def committed() -> None:
+            for cb in cbs:
+                try:
+                    cb()
+                except Exception as exc:
+                    log(0, f"local txn-group commit cb failed: "
+                        f"{exc!r}")
+
+        self.store.queue_transaction(merged, committed)
 
     # -- asok backends -------------------------------------------------
     def _asok_status(self) -> dict:
@@ -863,6 +904,9 @@ class OSD:
         if isinstance(msg, M.MECSubWriteReply):
             self._handle_sub_write_reply(msg)
             return
+        if isinstance(msg, M.MECSubWriteBatchReply):
+            self._handle_sub_write_batch_reply(msg)
+            return
         if isinstance(msg, M.MECSubReadReply):
             with self._sub_lock:
                 wait = self._waits.get(msg.tid)
@@ -889,6 +933,8 @@ class OSD:
         elif isinstance(msg, M.MECSubWrite):
             self.op_wq.enqueue(pgid,
                                lambda: self._handle_sub_write(msg, conn))
+        elif isinstance(msg, M.MECSubWriteBatch):
+            self._handle_sub_write_batch(msg, conn)
         elif isinstance(msg, M.MECSubRead):
             self.reader_wq.enqueue(
                 pgid, lambda: self._handle_sub_read(msg, conn))
@@ -1071,6 +1117,96 @@ class OSD:
                 stages=sclock.to_wire()))
 
         self.store.queue_transaction(txn, committed)
+
+    def _handle_sub_write_batch(self, msg: M.MECSubWriteBatch,
+                                conn: Connection) -> None:
+        """One frame = every sub-write of one engine flush aimed at
+        this OSD (ISSUE 9). Entries group by contained PG; each group
+        enqueues ONE handler on its own pgid key (per-PG FIFO against
+        singleton MECSubWrites is preserved) and applies its txns as
+        ONE queued store txn. The LAST group to commit sends ONE
+        MECSubWriteBatchReply acking every contained tid."""
+        n = len(msg.tids)
+        groups: dict[tuple, list[int]] = {}
+        for i in range(n):
+            groups.setdefault((msg.pools[i], int(msg.pss[i])),
+                              []).append(i)
+        state = {"left": len(groups), "lock": threading.Lock(),
+                 "stages": [""] * n}
+        rx_t = getattr(msg, "_rx_t", None)
+        for pgid, idxs in groups.items():
+            self.op_wq.enqueue(
+                pgid, lambda idxs=idxs: self._apply_sub_write_group(
+                    msg, conn, idxs, state, rx_t))
+
+    def _apply_sub_write_group(self, msg: M.MECSubWriteBatch,
+                               conn: Connection, idxs: list[int],
+                               state: dict, rx_t) -> None:
+        merged = Transaction()
+        entries = []
+        for i in idxs:
+            merged.ops.extend(Transaction.decode(msg.txns[i]).ops)
+            self.logger.inc("subop_w")
+            span = tracing.tracer().from_wire(
+                msg.traces[i] if i < len(msg.traces) else "",
+                f"sub_write(shard={int(msg.shards[i])})",
+                f"osd.{self.whoami}")
+            # per-entry child timeline forked from the batch's shared
+            # clock: every entry rode the same frame, so the send/
+            # wire marks ARE shared; commit is per group
+            sclock = stage_clock.StageClock.from_wire(msg.stages)
+            if rx_t is not None:
+                sclock.mark("subop_wire", t=rx_t)
+            sclock.mark("subop_dispatch_wait")
+            entries.append((i, span, sclock))
+
+        def committed() -> None:
+            for i, span, sclock in entries:
+                span.event("committed")
+                span.finish()
+                sclock.mark("subop_commit")
+                try:
+                    dataplane().record_stages(sclock.own_durations())
+                except Exception:
+                    pass
+                state["stages"][i] = sclock.to_wire()
+            with state["lock"]:
+                state["left"] -= 1
+                last = state["left"] == 0
+            if last:
+                conn.send_message(M.MECSubWriteBatchReply(
+                    tid=msg.tid, committed=True,
+                    tids=list(msg.tids), pools=list(msg.pools),
+                    pss=list(msg.pss), shards=list(msg.shards),
+                    versions=list(msg.versions),
+                    stages=list(state["stages"])))
+
+        self.store.queue_transaction(merged, committed)
+
+    def _handle_sub_write_batch_reply(
+            self, msg: M.MECSubWriteBatchReply) -> None:
+        """One batched ack = N singleton acks: complete every
+        contained (tid, shard), merging each entry's child timeline
+        under its client op exactly like _handle_sub_write_reply."""
+        for i in range(len(msg.tids)):
+            tid = msg.tids[i]
+            shard = int(msg.shards[i])
+            with self._sub_lock:
+                iw = self._inflight.get(tid)
+            if iw is None:
+                continue
+            st = msg.stages[i] if i < len(msg.stages) else ""
+            if st and iw.clock is not None:
+                iw.clock.merge_child(
+                    f"shard{shard}",
+                    stage_clock.StageClock.from_wire(st))
+            if iw.complete(shard):
+                with self._sub_lock:
+                    self._inflight.pop(tid, None)
+                # same rule as the singleton path: completion
+                # callbacks may take pg.lock — never run them on the
+                # messenger event loop
+                self.op_wq.enqueue(iw.pg.pgid, iw.on_all_commit)
 
     def _handle_sub_read(self, msg: M.MECSubRead, conn: Connection) -> None:
         # msg.shard is the acting position; replicated PGs store in the

@@ -24,6 +24,7 @@ _ENC = {
     "i32_list": lambda e, v: e.list(v, Encoder.i32),
     "u64_list": lambda e, v: e.list(v, Encoder.u64),
     "str_list": lambda e, v: e.list(v, Encoder.str),
+    "bytes_list": lambda e, v: e.list(v, Encoder.bytes),
 }
 _DEC = {
     "u8": Decoder.u8, "u16": Decoder.u16, "u32": Decoder.u32,
@@ -35,6 +36,7 @@ _DEC = {
     "i32_list": lambda d: d.list(Decoder.i32),
     "u64_list": lambda d: d.list(Decoder.u64),
     "str_list": lambda d: d.list(Decoder.str),
+    "bytes_list": lambda d: d.list(Decoder.bytes),
 }
 
 _DEFAULTS = {
@@ -400,6 +402,39 @@ class MECSubWriteReply(Message):
               # appended round 11: the shard's completed sub-op
               # timeline, merged into the primary op's children
               ("stages", "str")]
+
+
+class MECSubWriteBatch(Message):
+    """Primary -> one shard OSD: EVERY sub-write of one engine flush
+    destined for that peer, in one frame (the bulk-ingest data plane,
+    ROADMAP item 1). Entries are parallel lists — entry i is the
+    sub-write (tids[i], pools[i], pss[i], shards[i], oids[i],
+    versions[i], txns[i], traces[i]). One serialize, one dispatch
+    per (peer, flush) instead of one MECSubWrite per (op, shard); the
+    receiver applies each contained PG's txns as ONE queued txn group
+    and acks every tid in one MECSubWriteBatchReply. ``stages`` is the
+    batch's shared wire timeline (every entry rode the same frame, so
+    send/wire/dispatch marks are genuinely shared; the receiver forks
+    a child clock per entry)."""
+    MSG_TYPE = 67
+    FIELDS = [("tid", "u64"), ("epoch", "u32"),
+              ("tids", "u64_list"), ("pools", "i32_list"),
+              ("pss", "u64_list"), ("shards", "u64_list"),
+              ("oids", "str_list"), ("versions", "u64_list"),
+              ("txns", "bytes_list"), ("traces", "str_list"),
+              ("stages", "str")]
+
+
+class MECSubWriteBatchReply(Message):
+    """One ack for every sub-write the batch carried: entry i commits
+    (tids[i], shards[i]) at versions[i]; ``stages[i]`` is that
+    entry's completed child timeline (merged under the client op by
+    the primary, exactly like a singleton MECSubWriteReply)."""
+    MSG_TYPE = 68
+    FIELDS = [("tid", "u64"), ("committed", "bool"),
+              ("tids", "u64_list"), ("pools", "i32_list"),
+              ("pss", "u64_list"), ("shards", "u64_list"),
+              ("versions", "u64_list"), ("stages", "str_list")]
 
 
 class MECSubRead(Message):

@@ -47,6 +47,70 @@ _HDR = struct.Struct("<IQH")   # magic, seq, msg type
 #: message types allowed before authentication (the MAuth exchange)
 _PREAUTH_TYPES = (38, 39, 63, 64)
 
+#: in-process peer registry (bulk ingest, ISSUE 9): listening addr ->
+#: Messenger for every bound endpoint in THIS process. Co-located
+#: daemons — the shared-engine topology (MiniCluster, multi-daemon
+#: hosts) — deliver frames directly: still one serialize + decode per
+#: frame (peers never alias each other's message objects), and the
+#: dispatch still runs on the RECEIVER's event loop (the TCP thread
+#: contract), but no sender event-loop wakeup, no TCP socket, no
+#: framing, no receiver read-loop pass — one cross-thread handoff
+#: per message leg instead of three.
+_local_peers: dict[str, "Messenger"] = {}
+_local_lock = threading.Lock()
+
+
+def _loopback_enabled() -> bool:
+    """Read per Messenger construction (CEPH_TPU_BULK_INGEST=0 A/Bs
+    consecutive clusters in one process; CEPH_TPU_MSGR_LOOPBACK
+    overrides just this leg of the bulk-ingest work)."""
+    import os
+    env = os.environ
+    if env.get("CEPH_TPU_MSGR_LOOPBACK") is not None:
+        return env["CEPH_TPU_MSGR_LOOPBACK"] != "0"
+    return env.get("CEPH_TPU_BULK_INGEST", "1") != "0"
+
+
+class _LoopbackConnection:
+    """Stand-in Connection for a locally delivered frame: replies
+    route back through the receiving messenger's send path by the
+    sender's listening address (looping back again while the sender
+    stays local; falling out to TCP the moment it is not)."""
+
+    __slots__ = ("msgr", "peer_name", "peer_addr", "auth_entity",
+                 "_closed")
+
+    def __init__(self, msgr: "Messenger", peer_name: str,
+                 peer_addr: str) -> None:
+        self.msgr = msgr              # the RECEIVING messenger
+        self.peer_name = peer_name    # the sender's entity
+        self.peer_addr = peer_addr    # the sender's listening addr
+        self.auth_entity = ""
+        self._closed = False
+
+    @property
+    def closed(self) -> bool:
+        """Live liveness, not a latch: a TCP Connection's ``closed``
+        flips when the socket dies, so holders (the OSD's watcher
+        table ages out dead watchers through it) must see a loopback
+        peer's death the same way — the peer is gone from the local
+        registry (or stopped) the moment its messenger shuts down."""
+        if self._closed:
+            return True
+        peer = _local_peers.get(self.peer_addr)
+        return peer is None or not peer._running
+
+    def send_message(self, msg: Message) -> None:
+        if not self.peer_addr:
+            log(1, f"dropping type {msg.MSG_TYPE} reply: loopback "
+                "peer has no listening addr")
+            _telemetry().note_drop(msg.MSG_TYPE)
+            return
+        self.msgr.send_message(msg, self.peer_addr)
+
+    def close(self) -> None:
+        self._closed = True
+
 
 class Connection:
     """One live peer link. ``peer_name`` ("osd.3") and ``peer_addr``
@@ -140,6 +204,9 @@ class Messenger:
         #: reconciled at shutdown (a coroutine the dying loop never
         #: ran can no longer decrement itself)
         self._sends_outstanding = 0
+        #: bulk-ingest in-process delivery (ISSUE 9); captured here so
+        #: CEPH_TPU_BULK_INGEST=0 A/Bs consecutive clusters
+        self._loopback = _loopback_enabled()
 
     def _run_loop(self) -> None:
         # profiler stage join: every cycle this thread spends —
@@ -169,6 +236,8 @@ class Messenger:
 
         self.addr = asyncio.run_coroutine_threadsafe(
             _bind(), self._loop).result(timeout=10)
+        with _local_lock:
+            _local_peers[self.addr] = self
         return self.addr
 
     def set_dispatcher(self, fn: Callable[[Message, Connection], None]) -> None:
@@ -181,6 +250,10 @@ class Messenger:
         if not self._running:
             return
         self._running = False
+        if self.addr:
+            with _local_lock:
+                if _local_peers.get(self.addr) is self:
+                    del _local_peers[self.addr]
 
         async def _stop():
             if self._server:
@@ -319,8 +392,93 @@ class Messenger:
     # -- send path ----------------------------------------------------
     def send_message(self, msg: Message, dest_addr: str) -> None:
         """Thread-safe, fire-and-forget (the reference's send_message
-        contract). Lossy: upper layers own retries."""
+        contract). Lossy: upper layers own retries. Co-located peers
+        (the shared-engine topology) take the in-process loopback
+        below; everything that needs real wire semantics — auth,
+        partitions, socket-failure injection, any installed chaos
+        rule — falls through to the TCP path unchanged."""
+        if self._try_loopback(msg, dest_addr):
+            return
         self._submit(self._send_to(msg, dest_addr, time.monotonic()))
+
+    def _try_loopback(self, msg: Message, dest_addr: str) -> bool:
+        """Deliver directly to a bound messenger in this process: one
+        serialize + decode (no aliasing between peers), zero event
+        loops, zero sockets. Returns False — caller takes the TCP
+        path — whenever fidelity needs the real wire: loopback off,
+        unbound sender (replies route by the sender's listening
+        addr), unknown/foreign peer, auth configured on either end, a
+        partition window, ms_inject_socket_failures, or ANY msgr
+        chaos rule installed (drop/delay semantics stay exactly the
+        tested TCP ones)."""
+        if not (self._loopback and self._running):
+            return False
+        if not self.addr:
+            # unbound (client-style) sender: replies can only route
+            # back over the connection itself — take the TCP path
+            return False
+        peer = _local_peers.get(dest_addr)
+        if peer is None or not peer._running or \
+                not peer._loopback or peer._dispatcher is None:
+            return False
+        if self.signer is not None or peer.verifier is not None:
+            return False
+        if self.blocked_peers or peer.blocked_peers:
+            return False
+        if self._inject_every or peer._inject_every:
+            return False
+        if _faults.msgr_rules_active():
+            return False
+        tel = _telemetry()
+        t_pick = time.monotonic()
+        clock = getattr(msg, "_stage_clock", None)
+        if clock is not None:
+            # no send queue on this path: the wait mark closes at
+            # the moment of hand-off (its interval reads ~0)
+            clock.mark_once("send_queue_wait", t=t_pick)
+            msg.stages = clock.to_wire()
+        payload = msg.encode_payload()
+        self._seq += 1
+        mtype = msg.MSG_TYPE
+        tel.note_send(mtype, len(payload) + _HDR.size,
+                      time.monotonic() - t_pick, 0.0)
+        try:
+            m2 = decode_message(mtype, payload)
+        except Exception as exc:
+            log(0, f"loopback decode of type {mtype} failed: "
+                f"{exc!r}")
+            tel.note_drop(mtype)
+            return True
+        m2.seq = self._seq
+        m2._rx_t = time.monotonic()
+        tel.note_recv(mtype, len(payload))
+        conn = _LoopbackConnection(peer, self.entity_name, self.addr)
+        try:
+            # deliver on the RECEIVER's event loop — the exact thread
+            # the TCP read loop dispatches from. Never dispatch on the
+            # sending thread: a sender holding its daemon lock would
+            # re-enter the peer's dispatcher, and two daemons sending
+            # to each other under their own locks deadlock AB-BA (the
+            # mon heartbeat tick found this immediately)
+            peer._loop.call_soon_threadsafe(
+                peer._dispatch_loopback, m2, conn)
+        except RuntimeError:
+            # peer's loop closed mid-shutdown: same as a dead socket
+            tel.note_drop(mtype)
+        return True
+
+    def _dispatch_loopback(self, msg: Message, conn: Connection
+                           ) -> None:
+        """Runs on this messenger's OWN event loop (scheduled by a
+        co-located sender's _try_loopback)."""
+        if not self._running or self._dispatcher is None:
+            _telemetry().note_drop(msg.MSG_TYPE)
+            return
+        try:
+            self._dispatcher(msg, conn)
+        except Exception as exc:
+            log(0, f"loopback dispatch error for type "
+                f"{msg.MSG_TYPE}: {exc!r}")
 
     async def _get_conn(self, dest_addr: str) -> Connection | None:
         """Resolve (or establish) the one cached connection to a peer.

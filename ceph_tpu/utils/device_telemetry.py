@@ -183,6 +183,22 @@ class DeviceTelemetry:
         perf.add_u64_counter("hbm_retired_bytes",
                              "bytes that left the launch window "
                              "(downloaded or failed over)")
+        # bulk-ingest data plane (ISSUE 9)
+        perf.add_u64_counter("staging_copies_avoided_bytes",
+                             "flush bytes handed to the device as one "
+                             "preconcatenated staging view (no flush-"
+                             "time np.concatenate on the engine "
+                             "thread)")
+        perf.add_gauge("attached_osds",
+                       "OSDs attached to the shared device engine "
+                       "(0 = per-OSD engines / none attached)")
+
+    # -- bulk-ingest accounting (ISSUE 9) -----------------------------
+    def note_staging_copies_avoided(self, nbytes: int) -> None:
+        self.perf.inc("staging_copies_avoided_bytes", nbytes)
+
+    def note_attached_osds(self, n: int) -> None:
+        self.perf.set_gauge("attached_osds", n)
 
     # -- compile accounting -------------------------------------------
     def note_compile(self, signature: str, seconds: float) -> None:

@@ -70,6 +70,20 @@ KINDS = MSGR_KINDS + STORE_KINDS + ENGINE_KINDS
 
 _EVENT_LOG_MAX = 4096
 
+#: msg-type FAMILIES: a rule naming the singleton sub-write types also
+#: matches their batched twins (ISSUE 9 — a chaos rule written against
+#: MECSubWrite/MECSubWriteReply must keep biting when the bulk-ingest
+#: path ships the same payload as one MECSubWriteBatch per peer, so a
+#: dropped/delayed batch degrades exactly like N dropped singletons)
+_MSG_TYPE_FAMILY = {
+    30: (30, 67),     # MECSubWrite -> + MECSubWriteBatch
+    31: (31, 68),     # MECSubWriteReply -> + MECSubWriteBatchReply
+}
+
+
+def _msg_type_matches(rule_type: int, msg_type: int) -> bool:
+    return msg_type in _MSG_TYPE_FAMILY.get(rule_type, (rule_type,))
+
 
 class InjectedFault(RuntimeError):
     """Raised for injected engine faults (flows down the engine's
@@ -271,7 +285,7 @@ class FaultRegistry:
         with self._lock:
             for rule in self._msgr_rules:
                 if rule.msg_type is not None and \
-                        rule.msg_type != msg_type:
+                        not _msg_type_matches(rule.msg_type, msg_type):
                     continue
                 if not _match_name(rule.entity, entity):
                     continue
@@ -462,6 +476,14 @@ def message_fault(entity: str, peer: str, msg_type: int
     if reg is None or not reg._msgr_rules:
         return False, 0.0
     return reg.message_fault(entity, peer, msg_type)
+
+
+def msgr_rules_active() -> bool:
+    """Cheap probe for the messenger's loopback gate: while ANY msgr
+    chaos rule is installed, in-process sends take the full TCP path,
+    so drop/delay windows keep their exact wire semantics."""
+    reg = _registry
+    return reg is not None and bool(reg._msgr_rules)
 
 
 def store_read_fault(cid: str, oid: str) -> tuple[bool, float]:

@@ -115,9 +115,13 @@ def test_timeline_spans_shard_osds(dp_run):
 def test_messenger_per_type_counters_advance(dp_run):
     snap = msgr_telemetry().snapshot()
     by_type = snap["by_type"]
+    # under bulk ingest (the default) shard fan-out rides
+    # MECSubWriteBatch/-Reply — ONE frame per (peer, flush) — instead
+    # of per-(op, shard) MECSubWrite singletons (the ISSUE-9 fan-out
+    # contract, asserted exactly in test_bulk_ingest)
     for mtype in (M.MOSDOp.MSG_TYPE, M.MOSDOpReply.MSG_TYPE,
-                  M.MECSubWrite.MSG_TYPE,
-                  M.MECSubWriteReply.MSG_TYPE):
+                  M.MECSubWriteBatch.MSG_TYPE,
+                  M.MECSubWriteBatchReply.MSG_TYPE):
         ent = by_type.get(str(mtype))
         assert ent is not None, f"type {mtype} missing: {by_type}"
         assert ent["sent"] > 0 and ent["sent_bytes"] > 0, ent

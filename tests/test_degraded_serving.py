@@ -137,6 +137,56 @@ def test_midburst_kill_zero_lost_writes_and_health_recovers(fast_death):
         assert "ceph_tpu_faults_fired" in text
 
 
+def test_dropped_subwrite_batch_degrades_like_singletons(fast_death):
+    """Satellite (ISSUE 9): a dropped MECSubWriteBatch must retry/
+    degrade exactly like N dropped MECSubWrites. The chaos rule is
+    written against the SINGLETON sub-write type — the registry's
+    msg-type FAMILY matching must make it bite the batch frames the
+    bulk-ingest path actually ships — and the client resend ladder
+    re-drives every affected write: zero lost acked writes, every
+    readback byte-exact."""
+    from ceph_tpu.parallel import messages as M
+    conf = g_conf()
+    old_resend = conf["objecter_resend_interval"]
+    conf.set("objecter_resend_interval", 0.3)
+    try:
+        with MiniCluster(n_osds=3) as cluster:
+            reg = cluster.faults
+            reg.reseed(11)
+            cluster.create_ec_pool("bd", k=2, m=1, pg_num=8,
+                                   backend="jax")
+            io = cluster.client().open_ioctx("bd")
+            io.op_timeout = 60.0
+            payloads = {f"bd{i}": bytes(((i * 37 + j) & 0xFF)
+                                        for j in range(8192))
+                        for i in range(24)}
+            # warm a few writes so the drop window hits MID-burst
+            for oid in list(payloads)[:4]:
+                io.write_full(oid, payloads[oid])
+            rule = reg.add("msgr_drop", entity="osd.*",
+                           msg_type=M.MECSubWrite.MSG_TYPE,
+                           every=4, max_fires=3)
+            import concurrent.futures
+            with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                list(pool.map(
+                    lambda oid: io.write_full(oid, payloads[oid]),
+                    list(payloads)[4:]))
+            rule.remove()
+            # every acked write survives, byte-exact (zero lost)
+            for oid, want in payloads.items():
+                assert io.read(oid) == want, f"{oid} lost or wrong"
+            # the rule REALLY fired, and on batch frames: family
+            # matching mapped the singleton type onto type 67
+            assert rule.fires >= 1
+            fired_types = [e["detail"] for e in reg.fired()
+                           if e["kind"] == "msgr_drop"]
+            assert any(
+                f"type={M.MECSubWriteBatch.MSG_TYPE}" in d
+                for d in fired_types), fired_types
+    finally:
+        conf.set("objecter_resend_interval", old_resend)
+
+
 def test_concurrent_degraded_reads_coalesce_into_fewer_flushes(
         fast_death):
     """The batched decode-on-read pin: N concurrent degraded reads of

@@ -10,6 +10,7 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 from ceph_tpu.models import registry as ec_registry
 from ceph_tpu.osd.device_engine import DeviceEncodeEngine
@@ -20,6 +21,16 @@ from ceph_tpu.utils.admin_socket import asok_command
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.device_telemetry import telemetry
 from ceph_tpu.utils.perf_counters import PerfCounters
+
+
+@pytest.fixture(autouse=True)
+def _pin_device_route(monkeypatch):
+    """These tests gate the DEVICE flush machinery (codec._matvec
+    fakes, held StripeBatcher.flush_async); keep the tiny test
+    flushes off the bulk-ingest small-flush host route, which
+    encodes with a direct host matvec and would never hit the
+    gates."""
+    monkeypatch.setenv("CEPH_TPU_HOST_FLUSH_BYTES", "0")
 
 
 def _codec(backend="numpy", k=2, m=1):

@@ -21,6 +21,14 @@ from ceph_tpu.osd.device_engine import DeviceEncodeEngine
 from ceph_tpu.osd.ec_util import StripeInfo
 
 
+@pytest.fixture(autouse=True)
+def _pin_device_route(monkeypatch):
+    """These tests pin the DEVICE launch pipeline (fused-flush
+    fakes); keep the tiny test flushes off the bulk-ingest
+    small-flush host route."""
+    monkeypatch.setenv("CEPH_TPU_HOST_FLUSH_BYTES", "0")
+
+
 def _codec(backend="jax", k=2, m=1):
     return ec_registry.instance().factory(
         "jerasure", {"plugin": "jerasure", "k": str(k), "m": str(m),
@@ -38,7 +46,7 @@ def _fake_device(monkeypatch, launches: list):
 
     real_encode = ec_util.encode    # survives later encode poisoning
 
-    def fake_async(sinfo, codec, ops, bufs):
+    def fake_async(sinfo, codec, ops, bufs, batch=None):
         t_launch = time.perf_counter()
         launches.append(t_launch)
         host = _codec(backend="numpy",
@@ -224,11 +232,11 @@ def test_launch_failure_drains_older_batches_first(monkeypatch):
     orig = ec_util._flush_device_fused_async
     calls = {"n": 0}
 
-    def flaky(sinfo_, codec_, ops, bufs):
+    def flaky(sinfo_, codec_, ops, bufs, **kw):
         calls["n"] += 1
         if calls["n"] == 2:            # second batch's launch dies
             raise RuntimeError("injected launch fault")
-        return orig(sinfo_, codec_, ops, bufs)
+        return orig(sinfo_, codec_, ops, bufs, **kw)
 
     monkeypatch.setattr(ec_util, "_flush_device_fused_async", flaky)
     # the plain-path fallback would normally re-encode; poison it so
@@ -440,3 +448,95 @@ def test_compile_cache_warm_process_counts_hits(tmp_path):
     finally:
         compile_cache._reset_for_tests()
         telemetry().reset()
+
+
+# -- ISSUE 9: ordering + shutdown drain under the shared engine -------
+
+def test_interleaved_write_remove_order_through_shared_engine():
+    """Per-PG commit order across the BATCHED fan-out: interleaved
+    write/remove rounds on one object through the shared engine
+    (writes ride flush-group batches, removes the barrier path) must
+    leave every shard consistent — the deep scrub's fused parity
+    verify is the cross-shard ordering oracle, and the final write
+    must win the readback."""
+    import concurrent.futures
+
+    from ceph_tpu.qa.cluster import MiniCluster
+
+    with MiniCluster(n_osds=3) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("ord", k=2, m=1, pg_num=4,
+                               backend="jax")
+        io = rados.open_ioctx("ord")
+        io.op_timeout = 120.0
+
+        def _quiet(fn, *a):
+            try:
+                fn(*a)
+            except Exception:
+                pass        # remove of a not-yet-created oid etc.
+
+        for r in range(6):
+            pay = bytes(((r * 41 + j) & 0xFF) for j in range(8192))
+            alt = bytes(((r * 43 + j) & 0xFF) for j in range(8192))
+            with concurrent.futures.ThreadPoolExecutor(2) as pool:
+                if r % 2:
+                    fs = [pool.submit(io.write_full, "hot", pay),
+                          pool.submit(_quiet, io.remove, "hot")]
+                else:
+                    fs = [pool.submit(io.write_full, "hot", pay),
+                          pool.submit(io.write_full, "hot", alt)]
+                for f in fs:
+                    f.result()
+        final = b"f" * 8192
+        io.write_full("hot", final)
+        assert io.read("hot") == final
+        # cross-shard consistency: a reordered sub-write batch would
+        # leave shards encoding different object versions
+        rep = cluster.scrub_pool("ord", repair=False, deep=True)
+        assert rep["inconsistent"] == {}, rep
+
+
+def test_shared_engine_shutdown_drain_multiple_attachments():
+    """The shutdown drain with ONE engine serving several OSDs: a
+    detaching attachment drains its own staged work (continuations
+    dispatched before its dispatcher goes), later attachments keep
+    the engine alive, and the LAST detach stops it and releases the
+    process-wide instance."""
+    import numpy as np
+
+    from ceph_tpu.osd import device_engine as de
+
+    codec = _codec()
+    sinfo = StripeInfo(stripe_width=2 * 1024, chunk_size=1024)
+    done_a: list = []
+    done_b: list = []
+    h1 = de.shared_engine_attach(lambda k, fn: fn())
+    h2 = de.shared_engine_attach(lambda k, fn: fn())
+    try:
+        assert h1.engine is h2.engine
+        for i in range(4):
+            h1.stage_encode(f"pg{i}", codec, sinfo,
+                            np.zeros(2048, dtype=np.uint8),
+                            lambda s, c, e, i=i: done_a.append((i, e)))
+            h2.stage_encode(f"pg{i}", codec, sinfo,
+                            np.zeros(2048, dtype=np.uint8),
+                            lambda s, c, e, i=i: done_b.append((i, e)))
+        h1.stop()
+        # h1's staged work was drained before its dispatcher left
+        assert [i for i, _ in done_a] == [0, 1, 2, 3]
+        assert all(e is None for _, e in done_a)
+        # the engine survives for h2...
+        assert h1.engine._running
+        h2.stage_encode("pg9", codec, sinfo,
+                        np.zeros(2048, dtype=np.uint8),
+                        lambda s, c, e: done_b.append((9, e)))
+        h2.stop()
+        assert [i for i, _ in done_b] == [0, 1, 2, 3, 9]
+        assert all(e is None for _, e in done_b)
+        # ...and the LAST detach stopped and released it
+        assert not h2.engine._running
+        assert de._shared_engine is None
+    finally:
+        h1.stop()
+        h2.stop()

@@ -138,6 +138,13 @@ def test_bench_budget_sum_bounded():
         assert key in bench.BUDGETS, key
         tb, eb = bench.BUDGETS[key]
         assert 0 < tb and tb + eb <= 100, (key, tb, eb)
+    # ISSUE 9: the load-generator cluster row is budgeted like every
+    # other metric and the global deadline identity absorbed it
+    # (TOTAL_BUDGET 460 -> 425 covers the extra warmup reservation
+    # its BUDGETS entry adds, so the 870 s worst case is preserved)
+    assert "load_gen" in bench.BUDGETS
+    tb, eb = bench.BUDGETS["load_gen"]
+    assert 0 < tb and tb + eb <= 100, (tb, eb)
 
 
 def test_deadline_caps_sampling(monkeypatch):

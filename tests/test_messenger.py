@@ -92,6 +92,9 @@ def test_reply_rides_same_connection(pair):
 
 def test_many_messages_in_order(pair):
     a, b = pair
+    # this test pins the TCP path's connection-sharing (no cold-start
+    # stampede); in-process loopback would bypass sockets entirely
+    a._loopback = b._loopback = False
     sink = Sink()
     b.set_dispatcher(sink)
     for i in range(200):
