@@ -37,6 +37,25 @@ def attach_stage_breakdown(out: dict) -> dict:
         out["stage_breakdown"] = dataplane().stage_breakdown()
     except Exception:
         out["stage_breakdown"] = {}
+    return attach_trace_brief(out)
+
+
+def attach_trace_brief(out: dict) -> dict:
+    """Tail-sampled tracing rides every bench run by default (ISSUE
+    10): the metric line says how many traces the run kept/dropped so
+    an outlier row is one ``trace ls`` away from its causes. Degrades
+    to {} like the stage breakdown."""
+    try:
+        from ceph_tpu.utils.tracing import tracer
+        c = tracer().perf.dump()
+        out["trace"] = {"enabled": tracer().enabled,
+                        "kept": c["trace_kept"],
+                        "dropped": c["trace_dropped"],
+                        "kept_slow": c["trace_kept_slow"],
+                        "kept_error": c["trace_kept_error"],
+                        "autopsies": c["autopsies_recorded"]}
+    except Exception:
+        out["trace"] = {}
     return out
 
 

@@ -419,7 +419,11 @@ class LoadGen:
         }
         out["qos"]["within_bar"] = \
             out["qos"]["p99_worst_degraded_ms"] <= qos_bar
-        return out
+        # tail-sampled tracing is on by default (ISSUE 10): the report
+        # says what the run kept — a fault-window or slow keep here is
+        # the entry point into the autopsy of a degraded-phase outlier
+        from ceph_tpu.bench.cluster_bench import attach_trace_brief
+        return attach_trace_brief(out)
 
 
 def main(argv=None) -> int:

@@ -59,6 +59,13 @@ class _Op:
 
 EBLOCKLISTED = -108
 
+#: errno replies that mark the op's trace errored for the tail
+#: sampler (ISSUE 10). Infrastructure trouble only — EIO and client
+#: timeouts; semantic errnos (ENOENT, EEXIST, ECANCELED...) are
+#: normal protocol outcomes a busy rgw/cephfs workload produces by
+#: the thousand and must not saturate the keep/autopsy rings.
+TRACE_ERRNOS = (-5, -110)
+
 
 class Objecter:
     def __init__(self, msgr: Messenger, monc: MonClient,
@@ -141,7 +148,8 @@ class Objecter:
             tid = self._next_tid
             self._next_tid += 1
         span = tracer().new_trace(f"osd_op(op={op} oid={oid})",
-                                  self.msgr.entity_name)
+                                  self.msgr.entity_name,
+                                  op_type=f"osd_op_{op}")
         msg = M.MOSDOp(tid=tid, client=self.client_id, epoch=0,
                        pool=pool, ps=max(ps, 0), oid=oid, op=op,
                        offset=offset, length=length, data=bytes(data),
@@ -175,9 +183,21 @@ class Objecter:
                 with self._lock:
                     self._pending.pop(tid, None)
                 span.event("timeout")
+                # the tail sampler keeps errored traces: a timed-out
+                # op is exactly the outlier worth an autopsy
+                span.set_error("timeout")
                 raise ObjecterError(-110, f"op on {oid!r} timed out")
             span.event("reply")
             reply = rec.reply
+            # the reply carries the merged timeline (client marks +
+            # primary + shard children): close it, hang it on the
+            # root span (slow/error keeps autopsy it), and — on
+            # success — record the client-owned stages + total with
+            # the trace_id as the histogram exemplar
+            timeline = stage_clock.StageClock.from_wire(reply.stages)
+            if timeline is not stage_clock.NOOP:
+                timeline.mark("commit_reply")
+                span.attach_clock(timeline)
             if reply.code < 0:
                 # errno replies may carry the daemon's diagnostic as
                 # data (e.g. the EC read ladder naming the unreachable
@@ -187,19 +207,21 @@ class Objecter:
                     detail = bytes(reply.data or b"")
                 except Exception:
                     pass
+                if reply.code in TRACE_ERRNOS:
+                    # only infrastructure failures mark the trace:
+                    # semantic errnos (ENOENT stats, EEXIST creates)
+                    # are normal outcomes and must not flood the
+                    # keep ring / autopsy ring
+                    span.set_error(f"code={reply.code}")
                 raise ObjecterError(
                     reply.code,
                     f"op failed: code {reply.code}: "
                     f"{detail.decode('utf-8', 'replace')}"
                     if detail else "")
-            # the reply carries the merged timeline (client marks +
-            # primary + shard children): close it and record the
-            # client-owned stages + end-to-end total
-            timeline = stage_clock.StageClock.from_wire(reply.stages)
             if timeline is not stage_clock.NOOP:
-                timeline.mark("commit_reply")
                 try:
-                    dataplane().record_op(timeline)
+                    dataplane().record_op(
+                        timeline, trace_id=span.trace_id or None)
                 except Exception:
                     pass   # telemetry faults never cost an op
             return reply

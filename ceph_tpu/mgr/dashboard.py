@@ -12,6 +12,8 @@ from the mgr's cluster view:
     GET /api/pools    pool table (type, pg_num, size)
     GET /api/device   device-path telemetry snapshot (compiles,
                       flushes, occupancy, calibration outcomes)
+    GET /api/traces   tail-sampled tracing: keep/drop stats, kept
+                      traces (reason, services), autopsy index
     GET /api/dataplane  per-op stage-latency decomposition (stage
                       breakdown + messenger counters + recent merged
                       timelines)
@@ -130,7 +132,25 @@ class Module(MgrModule):
             return 200, "application/json", json.dumps(
                 {"breakdown": dataplane().stage_breakdown(),
                  "recent": dataplane().recent(),
+                 # p99 -> trace link: per-bucket kept-trace exemplars
+                 "exemplars": dataplane().exemplar_links(),
                  "msgr": mt().snapshot()}).encode()
+        if path == "/api/traces":
+            from ceph_tpu.utils.autopsy import store as autopsy_store
+            from ceph_tpu.utils.tracing import tracer
+            trace_mod = self.mgr.modules.get("trace")
+            kept = trace_mod.archive.rows() if trace_mod is not None \
+                else [{"trace_id": r["trace_id"],
+                       "reason": r["reason"], "root": r["root"],
+                       "duration_ms": round(r["duration_s"] * 1e3, 3)}
+                      for r in tracer().kept()]
+            return 200, "application/json", json.dumps(
+                {"stats": tracer().stats(), "kept": kept,
+                 "autopsies": [
+                     {"trace_id": a["trace_id"],
+                      "reason": a["reason"], "root": a["root"],
+                      "duration_s": a["duration_s"], "ts": a["ts"]}
+                     for a in autopsy_store().dump()]}).encode()
         if path == "/":
             return 200, "text/html", self._page(status, osdmap)
         return 404, "text/plain", b"not found"

@@ -424,6 +424,11 @@ class HealthEngine:
         section("ops", dump_all_trackers)
         from ceph_tpu.utils.tracing import tracer
         section("traces", lambda: tracer().dump())
+        section("trace_stats", lambda: tracer().stats())
+        # slow-op autopsies (ISSUE 10): the per-op post-mortems ride
+        # the bundle so one blob answers "which ops were bad and why"
+        from ceph_tpu.utils.autopsy import store as autopsy_store
+        section("autopsies", lambda: autopsy_store().dump())
         from ceph_tpu.utils.device_telemetry import telemetry
         section("device", lambda: telemetry().snapshot())
         from ceph_tpu.utils import profiler as _profiler

@@ -27,7 +27,7 @@ log = Dout("mgr")
 
 #: default module set (the reference's always-on + default-on modules)
 DEFAULT_MODULES = ("balancer", "progress", "telemetry",
-                   "dashboard", "health")
+                   "dashboard", "health", "trace")
 
 
 class Mgr:

@@ -907,7 +907,11 @@ class DeviceEncodeEngine:
             for (key, _data, cont, span, _clock, _ts), kspan in \
                     zip(items, kspans):
                 kspan.event(f"device_error {exc!r}")
+                # the error rides up so the tail sampler keeps the
+                # whole trace (the op falls back to the host twin)
+                kspan.set_error(f"engine_launch: {exc!r}")
                 kspan.finish()
+                span.set_error(f"engine_launch: {exc!r}")
                 span.finish()
                 entries.append((key, _bind(cont, None, None, exc)))
             self._dispatch_entries(entries)
@@ -938,7 +942,8 @@ class DeviceEncodeEngine:
             # and the merged local txn groups (ISSUE 9)
             self._dispatch_entries(entries)
             _telemetry().note_encode_flush(
-                len(items), nbytes, _time.perf_counter() - t0)
+                len(items), nbytes, _time.perf_counter() - t0,
+                trace_id=_first_trace_id(items, span_idx=3))
         dt = _time.perf_counter() - t0
         # overlap: launch->harvest-begin passed while the engine did
         # OTHER work (younger batches staged/launched); the remainder
@@ -1019,6 +1024,9 @@ class DeviceEncodeEngine:
                 for (_key, _shards, _want, cont, span, _clock,
                      _ts) in items:
                     span.event(f"device_error {exc!r}")
+                    # a failed flush is a keep-worthy outcome: the
+                    # tail sampler retains the op's trace (error rule)
+                    span.set_error(f"engine_decode: {exc!r}")
                     span.finish()
                     cont(None, exc)
                 continue
@@ -1033,8 +1041,9 @@ class DeviceEncodeEngine:
             if self._counters is not None:
                 self._counters.inc("device_decode_batches")
                 self._counters.inc("device_decode_ops", len(items))
-            tel.note_decode_flush(len(items), nbytes,
-                                  _time.perf_counter() - t0)
+            tel.note_decode_flush(
+                len(items), nbytes, _time.perf_counter() - t0,
+                trace_id=_first_trace_id(items, span_idx=4))
             done_t = _time.monotonic()
             off = 0
             for (_key, _shards, _want, cont, span, clock, _ts), ln \
@@ -1046,6 +1055,16 @@ class DeviceEncodeEngine:
                      None)
                 off += ln
         dec_pending.clear()
+
+
+def _first_trace_id(items, span_idx: int) -> str | None:
+    """First traced op's trace_id in a flush batch — the histogram
+    exemplar candidate (NOOP spans carry an empty trace_id)."""
+    for it in items:
+        tid = getattr(it[span_idx], "trace_id", "")
+        if tid:
+            return tid
+    return None
 
 
 def _shards_nbytes(shards: dict) -> int:

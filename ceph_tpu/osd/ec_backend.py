@@ -326,6 +326,9 @@ class ECBackend(PGBackend):
                 if shards is None:
                     log(0, f"device encode failed for {oid} "
                         f"({err!r}); host fallback")
+                    # keep-worthy outcome: the tail sampler retains
+                    # this op's trace (error rule) for the autopsy
+                    op_span.set_error(f"engine_fallback: {err!r}")
                     shards = ec_util.encode(self.sinfo, self.codec,
                                             self._pad(data))
                     crcs = None

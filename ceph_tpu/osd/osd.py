@@ -543,6 +543,9 @@ class OSD:
             "dump_traces",
             lambda a: tracing.tracer().dump(a.get("trace_id")),
             "finished dataflow-trace spans (blkin role)")
+        tracing.register_asok(self.asok)
+        from ceph_tpu.utils import autopsy as _autopsy
+        _autopsy.register_asok(self.asok)
         self.asok.register_command(
             "deep-scrub",
             lambda a: self._asok_deep_scrub(a),
@@ -1108,7 +1111,9 @@ class OSD:
             span.finish()
             sclock.mark("subop_commit")
             try:
-                dataplane().record_stages(sclock.own_durations())
+                dataplane().record_stages(
+                    sclock.own_durations(),
+                    trace_id=getattr(span, "trace_id", "") or None)
             except Exception:
                 pass
             conn.send_message(M.MECSubWriteReply(
@@ -1166,7 +1171,10 @@ class OSD:
                 span.finish()
                 sclock.mark("subop_commit")
                 try:
-                    dataplane().record_stages(sclock.own_durations())
+                    dataplane().record_stages(
+                        sclock.own_durations(),
+                        trace_id=getattr(span, "trace_id", "")
+                        or None)
                 except Exception:
                     pass
                 state["stages"][i] = sclock.to_wire()
@@ -1332,6 +1340,8 @@ class OSD:
             clock.mark("wire", t=rx_t)
         clock.mark("dispatch_queue_wait")
         track.stages = clock
+        # a slow-op report links straight to its kept trace/autopsy
+        track.trace_id = getattr(span, "trace_id", "")
         if msg.epoch > osdmap.epoch:
             # the client targeted a newer map than we hold — park
             # until the mon push catches us up. Required for the
@@ -1382,11 +1392,19 @@ class OSD:
             # home in the reply
             clock.mark("commit_wait")
             try:
-                dataplane().record_stages(clock.own_durations())
+                dataplane().record_stages(
+                    clock.own_durations(),
+                    trace_id=getattr(span, "trace_id", "") or None)
             except Exception:
                 pass           # telemetry faults never cost an op
             track.finish()
             span.event(f"reply code={code}")
+            if code in (EIO,):
+                # infrastructure failure server-side: even if the
+                # client never reads the reply, the trace survives
+                # the tail decision (semantic errnos like ENOENT are
+                # normal outcomes — see objecter.TRACE_ERRNOS)
+                span.set_error(f"code={code}")
             span.finish()
             out = M.MOSDOpReply(
                 tid=msg.tid, code=code, epoch=osdmap.epoch, data=data,

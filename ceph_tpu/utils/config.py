@@ -354,7 +354,36 @@ for _o in [
     Option("admin_socket_dir", str, "", "advanced",
            "directory for daemon .asok files (empty = per-daemon tmpdir)"),
     Option("trace_all", bool, False, "dev",
-           "dataflow tracing for every op (blkin_trace_all role)"),
+           "dataflow tracing keeps EVERY trace (blkin_trace_all "
+           "role; overrides the tail sampler's keep/drop decision)"),
+    Option("trace_enabled", bool, True, "advanced",
+           "always-on tail-sampled dataflow tracing: every op opens "
+           "a real span tree; the keep/drop decision runs at root "
+           "completion (false = literal NOOP spans, zero allocations)"),
+    Option("trace_sample_every", int, 64, "advanced",
+           "head-sample keep rate: every Nth root trace is kept "
+           "regardless of outcome (0 disables head sampling)", min=0),
+    Option("trace_slow_factor", float, 3.0, "advanced",
+           "slowness keep threshold multiplier over the per-op-type "
+           "EWMA / dataplane-p99 baseline", min=1.0),
+    Option("trace_slow_min_ms", float, 25.0, "advanced",
+           "floor (ms) under the adaptive slowness keep threshold — "
+           "sub-floor ops are never kept as slow", min=0.0),
+    Option("trace_pending_traces", int, 1024, "advanced",
+           "traces buffered awaiting their root's tail decision "
+           "(fixed memory; overflow evicts oldest)", min=8),
+    Option("trace_max_spans", int, 128, "advanced",
+           "span cap per trace (pending buffer AND kept record)",
+           min=8),
+    Option("trace_keep_ring", int, 256, "advanced",
+           "kept traces retained for dump/assembly (fixed memory)",
+           min=4),
+    Option("autopsy_ring_size", int, 32, "advanced",
+           "slow-op autopsies retained (timeline + spans + counter "
+           "window + fault events per entry)", min=1),
+    Option("mgr_trace_archive", int, 512, "advanced",
+           "kept traces the mgr trace module archives cluster-wide",
+           min=8),
     Option("flight_recorder_enabled", bool, True, "advanced",
            "sample every PerfCounters dict into the counter flight "
            "recorder ring (off = zero overhead, nothing retained)"),

@@ -74,24 +74,29 @@ class DataplaneTelemetry:
                              "client ops with a completed timeline")
 
     # -- recording -----------------------------------------------------
-    def record_stages(self, durations: list[tuple[str, float]]) -> None:
+    def record_stages(self, durations: list[tuple[str, float]],
+                      trace_id: str | None = None) -> None:
         """Record (stage, seconds) intervals; unknown stage names are
-        dropped (an old peer's custom mark must not raise)."""
+        dropped (an old peer's custom mark must not raise).
+        ``trace_id`` rides into the stage histograms as the bucket
+        exemplar (ISSUE 10: dashboard p99 -> trace link)."""
         for stage, dt in durations:
             if stage in STAGE_KEYS and dt >= 0:
-                self.perf.hinc(f"stage_{stage}_us", dt * 1e6)
+                self.perf.hinc(f"stage_{stage}_us", dt * 1e6,
+                               exemplar=trace_id)
                 self.perf.tinc(f"stage_{stage}", dt)
 
-    def record_op(self, clock) -> None:
+    def record_op(self, clock, trace_id: str | None = None) -> None:
         """Client-side completion: record the client-owned stages,
         the end-to-end total, and stash the full merged timeline."""
         durs = clock.durations()
         self.record_stages([(s, dt) for s, dt in durs
-                            if s in CLIENT_STAGES])
+                            if s in CLIENT_STAGES],
+                           trace_id=trace_id)
         total = clock.total()
         if total < 0:
             return
-        self.perf.hinc("op_total_us", total * 1e6)
+        self.perf.hinc("op_total_us", total * 1e6, exemplar=trace_id)
         self.perf.tinc("op_total", total)
         self.perf.inc("ops_timed")
         with self._lock:
@@ -167,11 +172,34 @@ class DataplaneTelemetry:
             out["subops"] = subops
         return out
 
+    def exemplar_links(self) -> dict:
+        """Per-histogram bucket -> kept trace_id (the dashboard's
+        p99 -> trace link payload). Only buckets whose newest
+        candidate survived the tail sampler appear."""
+        try:
+            from ceph_tpu.utils.tracing import tracer
+            accept = tracer().is_kept
+        except Exception:
+            return {}
+        out: dict[str, dict] = {}
+        for key in ["op_total_us"] + [f"stage_{s}_us"
+                                      for s in STAGE_KEYS]:
+            links = {}
+            for b in self.perf.exemplar_buckets(key):
+                ent = self.perf.exemplar(key, b, accept)
+                if ent is not None:
+                    links[f"le_{0 if b == 0 else (1 << b) - 1}_us"] = {
+                        "trace_id": ent[0], "value_us": ent[1]}
+            if links:
+                out[key] = links
+        return out
+
     def snapshot(self) -> dict:
         """Full JSON-able view (``dump_op_timeline`` payload)."""
         return {"glossary": dict(stage_clock.GLOSSARY),
                 "breakdown": self.stage_breakdown(),
                 "counters": self.perf.dump(),
+                "exemplars": self.exemplar_links(),
                 "recent": self.recent()}
 
     def op_age_histogram(self) -> dict:

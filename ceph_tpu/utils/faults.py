@@ -191,6 +191,10 @@ class FaultRegistry:
         self._engine_rules: list[Rule] = []
         self._schedule: list[dict] = []
         self._events: deque = deque(maxlen=_EVENT_LOG_MAX)
+        #: monotonic fire counter (NOT len(_events) — the bounded
+        #: deque plateaus): the tracer's per-op fault-window probe
+        #: compares this across a root span's lifetime
+        self._fires_total = 0
         self._perf = perf
 
     # -- configuration ------------------------------------------------
@@ -246,6 +250,7 @@ class FaultRegistry:
     # -- accounting ---------------------------------------------------
     def _note(self, rule: Rule | None, kind: str, detail: str) -> None:
         with self._lock:
+            self._fires_total += 1
             self._events.append(
                 {"rule": rule.rule_id if rule else 0, "kind": kind,
                  "detail": detail,
@@ -298,6 +303,7 @@ class FaultRegistry:
                 else:
                     delay = max(delay, rule.delay_s)
                 fired = rule
+                self._fires_total += 1
                 self._events.append(
                     {"rule": fired.rule_id, "kind": fired.kind,
                      "detail": f"{entity}->{peer} type={msg_type}",
@@ -332,6 +338,7 @@ class FaultRegistry:
                     eio = True
                 else:
                     delay = max(delay, rule.delay_s)
+                self._fires_total += 1
                 self._events.append(
                     {"rule": rule.rule_id, "kind": rule.kind,
                      "detail": f"{cid}/{oid}", "n": rule.fires})
@@ -359,6 +366,7 @@ class FaultRegistry:
                     continue
                 if rule._decide(self._seed):
                     fired = rule
+                    self._fires_total += 1
                     self._events.append(
                         {"rule": rule.rule_id, "kind": rule.kind,
                          "detail": point, "n": rule.fires})
@@ -400,6 +408,7 @@ class FaultRegistry:
                 if trig:
                     ent["done"] = True
                     due.append(dict(ent))
+                    self._fires_total += 1
                     self._events.append(
                         {"rule": 0, "kind": "action",
                          "detail": ent["action"],
@@ -460,6 +469,22 @@ def registry() -> FaultRegistry:
         if _registry is None:
             _registry = FaultRegistry(perf=_make_perf())
         return _registry
+
+
+def registry_if_exists() -> FaultRegistry | None:
+    """The registry ONLY if something already created it — probes
+    (autopsies, tracer fault windows) must not allocate one."""
+    return _registry
+
+
+def fire_count() -> int:
+    """Monotonic total of fault fires (0 when no registry exists).
+    The tracer samples this at root-span open and again at the tail
+    decision: a delta means a fault fired inside the op's window."""
+    reg = _registry
+    if reg is None:
+        return 0
+    return reg._fires_total
 
 
 def reset_for_tests(seed: int = 0) -> FaultRegistry:

@@ -60,7 +60,7 @@ def dump_all_trackers() -> dict:
 
 class TrackedOp:
     __slots__ = ("seq", "desc", "start", "events", "stages",
-                 "_tracker")
+                 "trace_id", "_tracker")
 
     def __init__(self, seq: int, desc: str, tracker: "OpTracker") -> None:
         self.seq = seq
@@ -71,6 +71,9 @@ class TrackedOp:
         #: timeline rides this op — dumped alongside the event list so
         #: dump_historic_ops shows the per-stage decomposition
         self.stages = None
+        #: the op's dataflow trace id (ISSUE 10): a slow-op report
+        #: links straight to its kept trace / autopsy
+        self.trace_id = ""
         self._tracker = tracker
 
     def mark_event(self, name: str) -> None:
@@ -96,7 +99,31 @@ class TrackedOp:
             timeline = self.stages.dump()
             if timeline:
                 out["stages"] = timeline
+        if self.trace_id:
+            out["trace_id"] = self.trace_id
+            try:
+                from ceph_tpu.utils.tracing import tracer
+                out["trace_kept"] = tracer().is_kept(self.trace_id)
+            except Exception:
+                pass
         return out
+
+
+def _refresh_trace_links(ops: list[dict]) -> list[dict]:
+    """Historic dumps freeze at op finish, but the TAIL keep decision
+    lands later (the client root completes after the primary replied)
+    — re-resolve trace_kept at serve time so a slow-op report links
+    to the trace that actually survived."""
+    try:
+        from ceph_tpu.utils.tracing import tracer
+        t = tracer()
+    except Exception:
+        return ops
+    for d in ops:
+        tid = d.get("trace_id")
+        if tid:
+            d["trace_kept"] = t.is_kept(tid)
+    return ops
 
 
 class OpTracker:
@@ -143,8 +170,9 @@ class OpTracker:
 
     def dump_historic(self) -> dict:
         with self._lock:
-            return {"num_ops": len(self._history),
-                    "ops": list(self._history)}
+            ops = list(self._history)
+        return {"num_ops": len(ops),
+                "ops": _refresh_trace_links(ops)}
 
     def dump_slowest(self) -> dict:
         """Top-K finished ops by age, slowest first (the reference's
@@ -152,7 +180,7 @@ class OpTracker:
         with self._lock:
             ops = [d for _, _, d in sorted(self._slowest,
                                            reverse=True)]
-        return {"num_ops": len(ops), "ops": ops}
+        return {"num_ops": len(ops), "ops": _refresh_trace_links(ops)}
 
     def get_slow_ops(self) -> list[dict]:
         """Ops in flight longer than the complaint time (the reference

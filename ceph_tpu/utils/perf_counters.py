@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from enum import Enum
 
 
@@ -28,11 +29,20 @@ class PerfCounters:
 
     _HIST_BUCKETS = 32
 
+    #: exemplar candidates retained per histogram bucket (newest
+    #: first); the exposition layer picks the newest one whose trace
+    #: survived the tail sampler
+    _EXEMPLAR_DEPTH = 4
+
     def __init__(self, name: str) -> None:
         self.name = name
         self._lock = threading.Lock()
         self._types: dict[str, CounterType] = {}
         self._values: dict[str, object] = {}
+        #: key -> bucket -> deque[(trace_id, value, wall_ts)] — only
+        #: populated for observations that carried an exemplar, so
+        #: exemplar-free histograms cost nothing extra
+        self._exemplars: dict[str, dict[int, object]] = {}
 
     def add_u64_counter(self, key: str, desc: str = "") -> None:
         self._add(key, CounterType.U64, 0)
@@ -77,13 +87,18 @@ class PerfCounters:
             s, c = self._values[key]
             self._values[key] = (s + seconds, c + 1)
 
-    def hinc(self, key: str, value: float) -> None:
+    def hinc(self, key: str, value: float,
+             exemplar: str | None = None) -> None:
         """Record one observation. Bucket edges (pinned by
         tests/test_device_telemetry.py): bucket 0 holds non-positive
         values only; bucket b >= 1 holds [2^(b-1), 2^b). Positive
         sub-1.0 observations count in bucket 1 with the 1s — they are
         real observations and must not masquerade as zeros (the old
-        ``int(value)`` truncation sent 0.5 to the zero bucket)."""
+        ``int(value)`` truncation sent 0.5 to the zero bucket).
+
+        ``exemplar`` (a trace_id) attaches the observation's identity
+        to its bucket — the prometheus histogram-exemplar role: a
+        dashboard's p99 bucket links to the trace that landed there."""
         with self._lock:
             assert self._types[key] == CounterType.HISTOGRAM
             if value <= 0:
@@ -94,6 +109,31 @@ class PerfCounters:
                 bucket = min(self._HIST_BUCKETS - 1,
                              int(value).bit_length())
             self._values[key][bucket] += 1
+            if exemplar:
+                per = self._exemplars.setdefault(key, {})
+                dq = per.get(bucket)
+                if dq is None:
+                    dq = per[bucket] = deque(
+                        maxlen=self._EXEMPLAR_DEPTH)
+                dq.appendleft((str(exemplar), float(value),
+                               time.time()))
+
+    def exemplar(self, key: str, bucket: int, accept=None):
+        """The newest (trace_id, value, wall_ts) candidate for one
+        bucket passing ``accept(trace_id)`` (all pass when None);
+        None when the bucket has no surviving candidate."""
+        with self._lock:
+            dq = self._exemplars.get(key, {}).get(bucket)
+            cands = list(dq) if dq else ()
+        for trace_id, value, ts in cands:
+            if accept is None or accept(trace_id):
+                return (trace_id, value, ts)
+        return None
+
+    def exemplar_buckets(self, key: str) -> list[int]:
+        """Buckets holding at least one exemplar candidate."""
+        with self._lock:
+            return sorted(self._exemplars.get(key, {}))
 
     def time(self, key: str):
         """Context manager recording elapsed seconds into a time_avg."""
@@ -149,6 +189,12 @@ class PerfCountersCollection:
     def remove(self, name: str) -> None:
         with self._lock:
             self._loggers.pop(name, None)
+
+    def items(self) -> list[tuple[str, PerfCounters]]:
+        """(name, logger) pairs — the exposition layer needs the live
+        objects (exemplar queries), not just the value dump."""
+        with self._lock:
+            return sorted(self._loggers.items())
 
     def dump(self) -> dict:
         with self._lock:

@@ -29,12 +29,41 @@ def _escape_label(value: str) -> str:
             .replace("\n", "\\n"))
 
 
+def _exemplar_filter():
+    """Histogram exemplars must resolve: only trace_ids the tail
+    sampler KEPT are exposed (a dropped trace's id would 404 in the
+    dashboard's p99 -> trace link)."""
+    try:
+        from ceph_tpu.utils.tracing import tracer
+        return tracer().is_kept
+    except Exception:
+        return lambda _tid: False
+
+
+def _exemplar_suffix(pc, key: str, bucket: int, accept) -> str:
+    """OpenMetrics exemplar clause for one bucket line, or "". The
+    clause trails the sample value (`` # {trace_id="..."} v ts``) so
+    classic text-format consumers that split on whitespace still read
+    the sample; OpenMetrics scrapers pick up the exemplar."""
+    if pc is None:
+        return ""
+    ent = pc.exemplar(key, bucket, accept)
+    if ent is None:
+        return ""
+    trace_id, value, ts = ent
+    return (f' # {{trace_id="{_escape_label(trace_id)}"}} '
+            f"{value:g} {ts:.3f}")
+
+
 def render_text() -> str:
     """All daemons' counters, one metric per counter with a ``daemon``
-    label (the mgr module's layout)."""
+    label (the mgr module's layout). Histogram buckets carry
+    OpenMetrics-style exemplars when a kept trace landed in them."""
     lines: list[str] = []
     seen_types: set[str] = set()
-    for daemon, counters in sorted(collection().dump().items()):
+    accept = _exemplar_filter()
+    for daemon, pc in collection().items():
+        counters = pc.dump()
         daemon = _escape_label(daemon)
         for key, val in sorted(counters.items()):
             metric = f"ceph_tpu_{_sanitize(key)}"
@@ -63,7 +92,8 @@ def render_text() -> str:
                     cum += count
                     le = "0" if b == 0 else str((1 << b) - 1)
                     lines.append(
-                        f'{m}{{daemon="{daemon}",le="{le}"}} {cum}')
+                        f'{m}{{daemon="{daemon}",le="{le}"}} {cum}'
+                        + _exemplar_suffix(pc, key, b, accept))
                 lines.append(
                     f'{m}{{daemon="{daemon}",le="+Inf"}} {cum}')
                 lines.append(

@@ -81,13 +81,20 @@ GLOSSARY = {
 class StageClock:
     """Ordered (stage, t) marks for one op; see module docstring."""
 
-    __slots__ = ("marks", "children", "start_idx", "_lock")
+    __slots__ = ("marks", "children", "start_idx", "wall0", "_lock")
 
     def __init__(self, name: str = "client_submit",
                  t: float | None = None) -> None:
         self._lock = threading.Lock()
         self.marks: list[tuple[str, float]] = [
             (name, time.monotonic() if t is None else t)]
+        #: wall-clock epoch of the anchor mark (ISSUE 10): monotonic
+        #: stamps order exactly but cannot be aligned across daemons
+        #: or exported — every dump carries this anchor so the trace
+        #: export and cross-daemon assembly can place the timeline on
+        #: the epoch axis
+        self.wall0 = time.time() - (time.monotonic()
+                                    - self.marks[0][1])
         #: child timelines merged in (shard sub-ops): label -> marks
         self.children: dict[str, list[tuple[str, float]]] = {}
         #: index of the first mark THIS daemon added (from_wire sets
@@ -143,6 +150,11 @@ class StageClock:
             clock = cls.__new__(cls)
             clock._lock = threading.Lock()
             clock.marks = marks
+            # daemons share one process, so the wall anchor derives
+            # exactly from the monotonic offset (a multi-process port
+            # would carry it in the wire form instead)
+            clock.wall0 = time.time() - (time.monotonic()
+                                         - marks[0][1])
             clock.children = {}
             clock.start_idx = len(marks)
             for seg in segs[1:]:
@@ -192,7 +204,10 @@ class StageClock:
                     for i, (s, t) in enumerate(ms)]
 
         out = {"stages": _rows(marks),
-               "total_us": round((marks[-1][1] - t0) * 1e6, 1)}
+               "total_us": round((marks[-1][1] - t0) * 1e6, 1),
+               # epoch anchor of t_us == 0 (dump_op_timeline and the
+               # Perfetto export place rows on the wall axis with it)
+               "wall_epoch": round(self.wall0, 6)}
         if children:
             out["children"] = {label: _rows(ms)
                                for label, ms in sorted(children.items())}

@@ -1,0 +1,111 @@
+"""ISSUE 10 satellite: tools/bench_trend.py — cross-round bench
+comparison with a >10% regression flag, runnable in tier-1 on the
+checked-in BENCH_r*.json files."""
+
+import json
+import os
+
+from ceph_tpu.tools import bench_trend
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round_file(tmp_path, name, metrics, rc=0):
+    tail = "\n".join(
+        json.dumps({"metric": m, "value": v, "unit": "GB/s",
+                    "telemetry": {"nested": {"ok": 1}}})
+        for m, v in metrics.items())
+    path = tmp_path / name
+    path.write_text(json.dumps(
+        {"n": 1, "cmd": "bench", "rc": rc, "tail": tail,
+         "parsed": None}))
+    return str(path)
+
+
+def test_runs_on_checked_in_rounds(capsys):
+    """The real repo files: parse every round (incl. the rc=124
+    timeout round with zero metrics), print the table + one JSON
+    line."""
+    files = bench_trend.default_files(REPO_ROOT)
+    assert len(files) >= 2, "checked-in BENCH_r*.json missing"
+    assert bench_trend.main(files) == 0
+    out = capsys.readouterr().out
+    json_line = [ln for ln in out.splitlines()
+                 if ln.startswith('{"bench_trend"')]
+    assert len(json_line) == 1
+    report = json.loads(json_line[0])["bench_trend"]
+    assert len(report["rounds"]) == len(files)
+    # the r01 metric is present and tracked across rounds
+    assert "ec_encode_rs_k8m3_device_GBps" in report["metrics"]
+    row = report["metrics"]["ec_encode_rs_k8m3_device_GBps"]
+    assert len(row["values"]) >= 2
+    assert "delta_vs_best_pct" in row
+    # a timeout round parses to zero metrics without crashing
+    by_round = {r["round"]: r for r in report["rounds"]}
+    assert by_round["BENCH_r05"]["metrics"] == 0
+    assert by_round["BENCH_r05"]["rc"] == 124
+
+
+def test_regression_flag_direction_aware(tmp_path):
+    """>10% drop on a throughput metric regresses; >10% RISE on a
+    latency metric regresses; gains never flag."""
+    files = [
+        _round_file(tmp_path, "BENCH_r01.json",
+                    {"enc_GBps": 100.0, "lat_p99_ms": 10.0,
+                     "steady_GBps": 50.0}),
+        _round_file(tmp_path, "BENCH_r02.json",
+                    {"enc_GBps": 80.0, "lat_p99_ms": 12.0,
+                     "steady_GBps": 52.0}),
+    ]
+    report = bench_trend.trend(files, threshold_pct=10.0)
+    assert report["metrics"]["enc_GBps"]["regressed"] is True
+    assert report["metrics"]["lat_p99_ms"]["regressed"] is True
+    assert report["metrics"]["steady_GBps"]["regressed"] is False
+    assert sorted(report["regressions"]) == ["enc_GBps",
+                                             "lat_p99_ms"]
+    # deltas are signed better-positive in both directions
+    assert report["metrics"]["enc_GBps"]["delta_vs_best_pct"] == -20.0
+    assert report["metrics"]["lat_p99_ms"]["delta_vs_best_pct"] \
+        == -20.0
+    assert report["metrics"]["steady_GBps"]["delta_vs_best_pct"] > 0
+
+
+def test_latest_vs_best_prior_not_just_previous(tmp_path):
+    """The flag compares against the BEST earlier round: a metric
+    that fell off its best two rounds ago still regresses even if
+    flat since."""
+    files = [
+        _round_file(tmp_path, "BENCH_r01.json", {"x_GBps": 100.0}),
+        _round_file(tmp_path, "BENCH_r02.json", {"x_GBps": 60.0}),
+        _round_file(tmp_path, "BENCH_r03.json", {"x_GBps": 61.0}),
+    ]
+    report = bench_trend.trend(files)
+    assert report["metrics"]["x_GBps"]["regressed"] is True
+    assert report["metrics"]["x_GBps"]["best_prior"] == 100.0
+
+
+def test_strict_exit_code(tmp_path, capsys):
+    files = [
+        _round_file(tmp_path, "BENCH_r01.json", {"x_GBps": 100.0}),
+        _round_file(tmp_path, "BENCH_r02.json", {"x_GBps": 50.0}),
+    ]
+    assert bench_trend.main(files) == 0
+    assert bench_trend.main(files + ["--strict"]) == 2
+    capsys.readouterr()
+
+
+def test_missing_rounds_tolerated(tmp_path):
+    """A metric absent from some rounds compares over the rounds it
+    appeared in; a garbled file reports an error row, not a crash."""
+    bad = tmp_path / "BENCH_r02.json"
+    bad.write_text("not json at all")
+    files = [
+        _round_file(tmp_path, "BENCH_r01.json", {"a_GBps": 10.0}),
+        str(bad),
+        _round_file(tmp_path, "BENCH_r03.json",
+                    {"a_GBps": 10.5, "b_GBps": 3.0}),
+    ]
+    report = bench_trend.trend(files)
+    assert report["metrics"]["a_GBps"]["regressed"] is False
+    assert "regressed" not in report["metrics"]["b_GBps"]
+    assert report["rounds"][1]["metrics"] == 0

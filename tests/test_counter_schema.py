@@ -15,16 +15,20 @@ from ceph_tpu.utils.perf_counters import CounterType, collection
 def _ensure_registries():
     """Instantiate every process-wide registry this repo declares so
     the lint covers their full schemas."""
+    from ceph_tpu.utils.autopsy import store as autopsy_store
     from ceph_tpu.utils.dataplane import dataplane
     from ceph_tpu.utils.device_telemetry import telemetry
     from ceph_tpu.utils.faults import registry as fault_registry
     from ceph_tpu.utils.msgr_telemetry import telemetry as msgr
     from ceph_tpu.utils.profiler import profiler
+    from ceph_tpu.utils.tracing import tracer
     telemetry()
     dataplane()
     msgr()
     profiler()
     fault_registry()
+    tracer()
+    autopsy_store()
 
 
 def test_every_counter_reaches_prometheus():
@@ -182,6 +186,103 @@ def test_fault_and_degraded_counters_covered_by_lint():
         assert "ceph_tpu_read_retry_attempts_bucket" in text
     finally:
         collection().remove("osd.schema_lint")
+
+
+def test_trace_and_autopsy_counters_covered_by_lint():
+    """ISSUE 10: the tail sampler's trace_* counters and the autopsy
+    registry are registered (so the generic lints above cover them)
+    and reach both exporters."""
+    _ensure_registries()
+    from ceph_tpu.utils.autopsy import store as autopsy_store
+    from ceph_tpu.utils.tracing import tracer
+    trace_keys = set(tracer().perf.dump())
+    assert {"trace_kept", "trace_dropped", "trace_evicted",
+            "trace_spans_truncated", "trace_pending",
+            "trace_kept_error", "trace_kept_fault",
+            "trace_kept_slow", "trace_kept_sample",
+            "autopsies_recorded"} <= trace_keys
+    aut_keys = set(autopsy_store().perf.dump())
+    assert {"autopsy_recorded", "autopsy_evicted",
+            "autopsy_ring"} <= aut_keys
+    text = prometheus.render_text()
+    for key in ("trace_kept", "trace_dropped", "trace_evicted",
+                "autopsy_recorded"):
+        assert f"ceph_tpu_{key}" in text, key
+    assert 'daemon="tracing"' in text
+    assert 'daemon="autopsy"' in text
+    # asok side: dump_autopsies and trace status carry the dumps
+    from ceph_tpu.utils import autopsy as autopsy_mod
+    from ceph_tpu.utils import tracing as tracing_mod
+
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    autopsy_mod.register_asok(asok)
+    tracing_mod.register_asok(asok)
+    payload = asok.commands["dump_autopsies"]({})
+    assert set(payload["counters"]) >= aut_keys
+    status = asok.commands["trace status"]({})
+    assert set(status["counters"]) >= trace_keys
+
+
+def test_exemplars_do_not_break_prometheus_parsing():
+    """ISSUE 10 satellite: exemplar-bearing histogram exposition.
+    A bucket line with an OpenMetrics exemplar clause still parses as
+    a classic text-format sample (metric{labels} value [# exemplar]),
+    cumulative shape intact, and the exemplar resolves ONLY to a KEPT
+    trace_id."""
+    _ensure_registries()
+    from ceph_tpu.utils.config import g_conf
+    from ceph_tpu.utils.dataplane import dataplane
+    from ceph_tpu.utils.tracing import tracer
+
+    conf = g_conf()
+    old_all = conf["trace_all"]
+    conf.set("trace_all", True)       # force-keep the exemplar trace
+    tracer().clear()
+    try:
+        span = tracer().new_trace("exemplar_op", "client.lint")
+        tid = span.trace_id
+        span.finish()
+        assert tracer().is_kept(tid)
+        dataplane().perf.hinc("op_total_us", 123456.0, exemplar=tid)
+        # a DROPPED trace's exemplar must not surface
+        conf.set("trace_all", False)
+        conf.set("trace_sample_every", 0)
+        conf.set("trace_slow_min_ms", 1e9)
+        dropped = tracer().new_trace("dropped_op", "client.lint")
+        dropped_tid = dropped.trace_id
+        dropped.finish()
+        assert not tracer().is_kept(dropped_tid)
+        dataplane().perf.hinc("op_total_us", 3.0,
+                              exemplar=dropped_tid)
+        text = prometheus.render_text()
+    finally:
+        conf.set("trace_all", old_all)
+        conf.set("trace_sample_every",
+                 conf.schema.get("trace_sample_every").default)
+        conf.set("trace_slow_min_ms",
+                 conf.schema.get("trace_slow_min_ms").default)
+        tracer().clear()
+    assert f'trace_id="{tid}"' in text
+    assert f'trace_id="{dropped_tid}"' not in text
+    # every line still parses as "name{labels} value [exemplar]":
+    # stripping the clause leaves classic text format
+    bucket_lines = [ln for ln in text.splitlines()
+                    if "op_total_us_bucket" in ln]
+    assert bucket_lines
+    for ln in bucket_lines:
+        sample = ln.split(" # ")[0]
+        m = re.match(r'^(\S+)\{[^}]*\} (\d+(\.\d+)?)$', sample)
+        assert m, f"unparseable bucket sample: {ln!r}"
+    # the exemplar rides a bucket line, not its own line
+    ex_lines = [ln for ln in bucket_lines if f'trace_id="{tid}"' in ln]
+    assert ex_lines and all(" # {" in ln for ln in ex_lines)
 
 
 def test_histogram_exposition_is_cumulative_and_typed():

@@ -36,7 +36,13 @@ OBJ_BYTES = 20_000
 @pytest.fixture(scope="module")
 def dp_run():
     """The shared workload: warm write + pipelined concurrent burst,
-    run with tracing off and Span allocations counted."""
+    run with tracing FULLY disabled (trace_enabled=false restores the
+    literal-NOOP mode of the pre-ISSUE-10 default) and Span
+    allocations counted — stage counters must record regardless."""
+    from ceph_tpu.utils.config import g_conf
+    conf = g_conf()
+    old_enabled = conf["trace_enabled"]
+    conf.set("trace_enabled", False)
     dataplane().reset()
     made = []
     orig_init = tracing.Span.__init__
@@ -67,6 +73,7 @@ def dp_run():
                    "spans": spans_during_io}
     finally:
         tracing.Span.__init__ = orig_init
+        conf.set("trace_enabled", old_enabled)
 
 
 def _write_timelines(timelines):
@@ -150,6 +157,9 @@ def test_queue_depth_gauges_return_to_zero(dp_run):
 
 
 def test_tracing_off_zero_spans_but_counters_recorded(dp_run):
+    """trace_enabled=false is the literal-NOOP escape hatch: zero
+    Span allocations (the always-on default's zero-RETENTION contract
+    is pinned separately in test_trace_sampling.py)."""
     assert dp_run["spans"] == 0, \
         f"{dp_run['spans']} Span objects allocated with tracing off"
     perf = dataplane().perf

@@ -305,8 +305,12 @@ def test_device_perf_dump_and_trace_chain():
 
 
 def test_tracing_off_allocates_no_spans():
-    """With trace_all off the engine path allocates no Span objects
-    (the NOOP discipline: tracing off must stay free)."""
+    """With trace_enabled=false (the literal-NOOP escape hatch under
+    the ISSUE-10 always-on default) the engine path allocates no Span
+    objects (the NOOP discipline: tracing off must stay free)."""
+    conf = g_conf()
+    old_enabled = conf["trace_enabled"]
+    conf.set("trace_enabled", False)
     assert not tracing.tracer().enabled
     made = []
     orig_init = tracing.Span.__init__
@@ -326,6 +330,7 @@ def test_tracing_off_allocates_no_spans():
             assert io.read("quiet_obj") == b"q" * 20_000
     finally:
         tracing.Span.__init__ = orig_init
+        conf.set("trace_enabled", old_enabled)
     assert not made, f"{len(made)} Span objects allocated untraced"
 
 

@@ -267,13 +267,16 @@ def test_prometheus_escapes_hostile_daemon_names():
     try:
         text = render_text()
         assert 'daemon="bad\\"name\\\\x\\ny"' in text
-        # every non-comment line still parses as one sample
+        # every non-comment line still parses as one sample; an
+        # OpenMetrics exemplar clause (`... # {trace_id="..."} v ts`,
+        # ISSUE 10) may trail a histogram bucket sample — strip it
+        # the way an exemplar-aware scraper does before matching
         sample = re.compile(
             r'^[a-zA-Z_][a-zA-Z0-9_]*(\{daemon="(\\.|[^"\\])*"'
             r'(,le="[^"]*")?\})? \S+$')
         for line in text.splitlines():
             if line and not line.startswith("#"):
-                assert sample.match(line), line
+                assert sample.match(line.split(" # ")[0]), line
     finally:
         collection().remove(hostile)
 

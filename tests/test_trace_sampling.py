@@ -1,0 +1,514 @@
+"""ISSUE 10 tentpole coverage: always-on tail-sampled tracing.
+
+Unit level (no cluster): the keep/drop policy — dropped traces retain
+ZERO span objects (weakref-asserted), head sampling, the adaptive
+slowness rule, error and fault-window keeps, the pending/keep-ring
+memory bounds, wall-clock anchors on Span and StageClock dumps, and
+the merged-tree builder.
+
+Cluster level: the acceptance chain — a scripted slow op against a
+device-backend EC pool yields (a) a kept trace whose merged tree
+spans client, primary, shard OSDs and the engine via the mgr trace
+module, (b) an autopsy with stage timeline + counter window + fault
+events over ``dump_autopsies``, (c) a prometheus histogram exemplar
+resolving to that trace_id, (d) a Perfetto-loadable Chrome-trace
+export (mgr ``trace export`` AND the tools/trace_export.py CLI), and
+(e) optracker slow-op entries embedding the kept trace_id. Plus the
+loopback-vs-TCP fidelity pin: trace context, stage timelines and
+sampling decisions are identical for the same ops across the PR-9
+in-process loopback and the real-wire path.
+"""
+
+import gc
+import json
+import time
+import weakref
+
+import pytest
+
+from ceph_tpu.utils import tracing
+from ceph_tpu.utils.config import g_conf
+
+_TRACE_KEYS = ("trace_enabled", "trace_all", "trace_sample_every",
+               "trace_slow_factor", "trace_slow_min_ms",
+               "trace_pending_traces", "trace_max_spans",
+               "trace_keep_ring", "autopsy_ring_size")
+
+
+@pytest.fixture
+def trace_conf():
+    """Save/restore every trace option; fresh tracer + autopsy state
+    on both sides."""
+    from ceph_tpu.utils import autopsy
+    conf = g_conf()
+    old = {k: conf[k] for k in _TRACE_KEYS}
+    tracing.tracer().clear()
+    autopsy.store().clear()
+    yield conf
+    for k, v in old.items():
+        conf.set(k, v)
+    tracing.tracer().clear()
+    autopsy.store().clear()
+
+
+def _no_cause_keeps(conf):
+    """Disable every keep rule (drop-everything baseline)."""
+    conf.set("trace_all", False)
+    conf.set("trace_sample_every", 0)
+    conf.set("trace_slow_min_ms", 1e12)
+    conf.set("trace_slow_factor", 1e6)
+
+
+# -- the zero-retention contract --------------------------------------
+
+def test_dropped_traces_retain_zero_span_objects(trace_conf):
+    """Acceptance bar: a dropped trace keeps NOTHING — no Span object
+    survives, the pending buffer is empty, and only counters moved."""
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    t = tracing.tracer()
+    before = t.perf.get("trace_dropped")
+    refs = []
+    for i in range(100):
+        root = t.new_trace(f"osd_op(op=1 oid=o{i})", "client.zr",
+                           op_type="zr")
+        child = root.child("sub", "osd.0")
+        grand = child.child("engine_flush")
+        refs += [weakref.ref(root), weakref.ref(child),
+                 weakref.ref(grand)]
+        grand.finish()
+        child.finish()
+        root.finish()
+        del root, child, grand
+    gc.collect()
+    alive = [r for r in refs if r() is not None]
+    assert not alive, f"{len(alive)} Span objects retained after drop"
+    st = t.stats()
+    assert st["pending"] == 0 and st["kept"] == 0
+    assert t.perf.get("trace_dropped") - before == 100
+
+
+def test_disabled_mode_allocates_nothing(trace_conf):
+    conf = trace_conf
+    conf.set("trace_enabled", False)
+    t = tracing.tracer()
+    assert not t.enabled
+    span = t.new_trace("x", "svc")
+    assert span is tracing.NOOP
+    assert t.from_wire("abc:7", "x", "svc") is tracing.NOOP
+
+
+# -- keep rules --------------------------------------------------------
+
+def test_head_sampling_keeps_every_nth(trace_conf):
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    conf.set("trace_sample_every", 10)
+    t = tracing.tracer()
+    t.clear()
+    kept = [bool(t.new_trace("op", "c", op_type="hs").finish())
+            for _ in range(30)]
+    assert [i for i, k in enumerate(kept) if k] == [9, 19, 29]
+    for rec in t.kept():
+        assert rec["reason"] == "sample"
+
+
+def test_slow_keep_is_adaptive_per_op_type(trace_conf):
+    """EWMA-relative: a sleep op far above its type's history is
+    kept (reason slow); same-speed ops are not."""
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    conf.set("trace_slow_min_ms", 10.0)
+    conf.set("trace_slow_factor", 3.0)
+    t = tracing.tracer()
+    t.clear()
+    for _ in range(10):           # warm the type's EWMA with fast ops
+        assert not t.new_trace("op", "c", op_type="sl").finish()
+    slow = t.new_trace("op", "c", op_type="sl")
+    time.sleep(0.05)
+    assert slow.finish() is True
+    assert t.keep_reason(slow.trace_id) == "slow"
+    # a different op type has its own baseline: its first op is never
+    # slow-kept off this type's history
+    other = t.new_trace("op", "c", op_type="other_type")
+    assert not other.finish()
+
+
+def test_error_keep_and_autopsy_contents(trace_conf):
+    """An errored op is kept and autopsied: timeline, span tree,
+    counter window (a forced flight-recorder sample), fault log."""
+    from ceph_tpu.utils import autopsy
+    from ceph_tpu.utils.stage_clock import StageClock
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    t = tracing.tracer()
+    root = t.new_trace("osd_op(op=1 oid=boom)", "client.e",
+                       op_type="er")
+    child = root.child("sub", "osd.1")
+    child.finish()
+    clock = StageClock()
+    clock.mark("objecter_encode")
+    clock.mark("commit_reply")
+    root.attach_clock(clock)
+    root.set_error("code=-5")
+    assert root.finish() is True
+    assert t.keep_reason(root.trace_id) == "error"
+    entry = autopsy.store().get(root.trace_id)
+    assert entry is not None
+    assert entry["reason"] == "error" and entry["error"] == "code=-5"
+    assert len(entry["spans"]) == 2
+    names = {s["name"] for s in entry["spans"]}
+    assert names == {"osd_op(op=1 oid=boom)", "sub"}
+    assert entry["timeline"]["stages"][1]["stage"] == "objecter_encode"
+    assert entry["timeline"]["wall_epoch"] > 1e9
+    assert entry["counter_window"], "forced sample missing"
+    assert isinstance(entry["fault_events"], list)
+    json.dumps(entry)             # asok-servable
+
+
+def test_fault_window_keep(trace_conf):
+    """A fault-registry fire inside the op's window keeps the trace
+    (reason fault)."""
+    from ceph_tpu.utils import faults
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    reg = faults.reset_for_tests(seed=3)
+    t = tracing.tracer()
+    quiet = t.new_trace("op", "c", op_type="fw")
+    assert not quiet.finish()          # no fire in window: dropped
+    rule = reg.add("store_eio", oid_prefix="fault_obj")
+    victim = t.new_trace("op", "c", op_type="fw")
+    assert faults.check_store_read("cid", "fault_obj_1") is True
+    assert victim.finish() is True
+    assert t.keep_reason(victim.trace_id) == "fault"
+    reg.remove(rule)
+    reg.reseed(0)
+
+
+# -- memory bounds -----------------------------------------------------
+
+def test_pending_buffer_bounded_and_evicts(trace_conf):
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    conf.set("trace_pending_traces", 8)
+    t = tracing.tracer()
+    t.clear()
+    before = t.perf.get("trace_evicted")
+    for i in range(20):
+        # children finish, roots never do: the never-completed-trace
+        # leak shape the pending bound exists for
+        root = t.new_trace(f"op{i}", "c")
+        root.child("sub").finish()
+    assert t.stats()["pending"] <= 8
+    assert t.perf.get("trace_evicted") - before >= 12
+
+
+def test_keep_ring_bounded(trace_conf):
+    conf = trace_conf
+    conf.set("trace_all", True)
+    conf.set("trace_keep_ring", 4)
+    t = tracing.tracer()
+    t.clear()
+    tids = []
+    for i in range(10):
+        root = t.new_trace(f"op{i}", "c")
+        tids.append(root.trace_id)
+        root.finish()
+    assert t.stats()["kept"] == 4
+    assert all(t.is_kept(tid) for tid in tids[-4:])
+    assert not any(t.is_kept(tid) for tid in tids[:6])
+
+
+def test_span_cap_truncates_not_grows(trace_conf):
+    conf = trace_conf
+    conf.set("trace_all", True)
+    t = tracing.tracer()
+    t.clear()
+    root = t.new_trace("op", "c")
+    for i in range(conf["trace_max_spans"] + 50):
+        root.child(f"s{i}").finish()
+    root.finish()
+    rec = [r for r in t.kept() if r["trace_id"] == root.trace_id][0]
+    assert len(rec["spans"]) <= conf["trace_max_spans"] + 1
+    assert t.perf.get("trace_spans_truncated") >= 50
+
+
+# -- anchors + assembly ------------------------------------------------
+
+def test_wall_anchor_on_span_and_stage_clock(trace_conf):
+    from ceph_tpu.utils.stage_clock import StageClock
+    conf = trace_conf
+    conf.set("trace_all", True)
+    now = time.time()
+    span = tracing.tracer().new_trace("op", "c")
+    span.finish()
+    d = tracing.tracer().dump(span.trace_id)[0]
+    assert abs(d["wall"] - now) < 5.0
+    assert "t0" in d
+    clock = StageClock()
+    clock.mark("objecter_encode")
+    assert abs(clock.dump()["wall_epoch"] - now) < 5.0
+    # a from_wire continuation derives the SAME anchor (shared
+    # monotonic clock): cross-daemon rows align on the epoch axis
+    cont = StageClock.from_wire(clock.to_wire())
+    assert abs(cont.dump()["wall_epoch"]
+               - clock.dump()["wall_epoch"]) < 0.05
+
+
+def test_build_tree_nests_by_parent(trace_conf):
+    conf = trace_conf
+    conf.set("trace_all", True)
+    t = tracing.tracer()
+    t.clear()
+    root = t.new_trace("root_op", "client.x")
+    c1 = root.child("sub1", "osd.0")
+    c2 = root.child("sub2", "osd.1")
+    gc1 = c1.child("engine_flush")
+    for s in (gc1, c1, c2, root):
+        s.finish()
+    tree = t.tree(root.trace_id)
+    assert tree["services"] == sorted({"client.x", "osd.0", "osd.1"})
+    roots = tree["tree"]
+    assert len(roots) == 1 and roots[0]["name"] == "root_op"
+    kids = {c["name"]: c for c in roots[0]["children"]}
+    assert set(kids) == {"sub1", "sub2"}
+    assert kids["sub1"]["children"][0]["name"] == "engine_flush"
+
+
+# -- export tool -------------------------------------------------------
+
+def test_trace_export_cli_round_trip(trace_conf, tmp_path):
+    """tools/trace_export.py on a kept-trace record: valid Chrome
+    trace JSON with per-service process rows and engine async
+    events."""
+    from ceph_tpu.tools import trace_export
+    conf = trace_conf
+    conf.set("trace_all", True)
+    t = tracing.tracer()
+    t.clear()
+    root = t.new_trace("osd_op(op=1 oid=x)", "client.ex")
+    sub = root.child("ec_sub_write", "osd.0")
+    eng = sub.child("engine_flush")
+    eng.event("batch_flush ops=3")
+    for s in (eng, sub, root):
+        s.finish()
+    rec = [r for r in t.kept() if r["trace_id"] == root.trace_id][0]
+    src = tmp_path / "trace.json"
+    dst = tmp_path / "out.json"
+    src.write_text(json.dumps(rec))
+    assert trace_export.main(["--input", str(src),
+                              "--output", str(dst)]) == 0
+    doc = json.loads(dst.read_text())
+    events = doc["traceEvents"]
+    procs = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs == {"client.ex", "osd.0"}
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == \
+        {"osd_op(op=1 oid=x)", "ec_sub_write", "engine_flush"}
+    # engine flush window renders as an async bar too
+    phases = {e["ph"] for e in events
+              if e.get("cat") == "engine"}
+    assert phases == {"b", "e"}
+    # nesting encoded as tid depth
+    by_name = {e["name"]: e for e in spans}
+    assert by_name["engine_flush"]["tid"] == 2
+    assert by_name["osd_op(op=1 oid=x)"]["tid"] == 0
+
+
+# -- the cluster-level acceptance chain --------------------------------
+
+def _find_op(cluster, oid):
+    """(trace_id, optracker entry) for the op on ``oid`` from
+    whichever OSD tracked it."""
+    for osd in cluster.osds.values():
+        for op in osd.op_tracker.dump_historic()["ops"]:
+            if oid in op["desc"]:
+                return op.get("trace_id"), op
+    return None, None
+
+
+def test_scripted_slow_op_full_artifact_chain(trace_conf):
+    """The acceptance bar, end to end: scripted slow write -> kept
+    trace -> mgr merged tree -> autopsy -> exemplar -> Perfetto
+    export -> slow-op report link."""
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.tools.trace_export import export as export_doc
+    from ceph_tpu.utils import autopsy, faults, prometheus
+    from ceph_tpu.utils.admin_socket import asok_command
+
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    conf.set("trace_slow_min_ms", 60.0)
+    conf.set("trace_slow_factor", 3.0)
+    faults.reset_for_tests(seed=11)
+    t = tracing.tracer()
+    with MiniCluster(n_osds=3) as cluster:
+        mgr = cluster.start_mgr()
+        rados = cluster.client()
+        cluster.create_ec_pool("slowpool", k=2, m=1, pg_num=1,
+                               backend="jax")
+        io = rados.open_ioctx("slowpool")
+        io.op_timeout = 240.0     # CPU jit compiles on first write
+        for i in range(6):
+            io.write_full(f"warm{i}", b"w" * 20_000)
+        # script the slow op: hold this write's shard sub-writes
+        # 0.25 s before the wire — commit_wait stretches well past
+        # the adaptive threshold AND the fault window marks the op
+        reg = faults.registry()
+        rule = reg.add("msgr_delay", msg_type=30, delay_s=0.25,
+                       max_fires=2)
+        io.write_full("slow_obj", b"s" * 20_000)
+        reg.remove(rule)
+
+        tid, entry = _find_op(cluster, "slow_obj")
+        assert tid, "primary's optracker lost the op"
+        assert t.is_kept(tid), t.stats()
+        reason = t.keep_reason(tid)
+        assert reason in ("slow", "fault"), reason
+
+        # optracker satellite: the historic/slow-op report links to
+        # the kept trace
+        assert entry["trace_id"] == tid
+        assert entry["trace_kept"] is True
+        slowest = [
+            op for osd in cluster.osds.values()
+            for op in osd.op_tracker.dump_slowest()["ops"]
+            if op.get("trace_id") == tid]
+        assert slowest and slowest[0]["trace_kept"] is True
+
+        # autopsy over the asok: timeline + counter window + faults
+        osd0 = next(iter(cluster.osds.values()))
+        out = asok_command(osd0.asok.path, "dump_autopsies")
+        mine = [a for a in out["autopsies"] if a["trace_id"] == tid]
+        assert mine, [a["trace_id"] for a in out["autopsies"]]
+        aut = mine[-1]
+        stages = {s["stage"] for s in aut["timeline"]["stages"]}
+        assert "commit_wait" in stages and "commit_reply" in stages
+        assert aut["timeline"]["wall_epoch"] > 1e9
+        assert aut["counter_window"], "no flight-recorder window"
+        assert aut["fault_events"], "msgr_delay fire not in autopsy"
+        assert out["counters"]["autopsy_recorded"] >= 1
+
+        # the autopsy also rides the health diagnostics bundle
+        health_mod = mgr.modules["health"]
+        bundle = health_mod.engine.dump_diagnostics("test")
+        assert any(a["trace_id"] == tid
+                   for a in bundle["autopsies"])
+
+        # mgr cluster-wide assembly: ONE merged tree spanning client,
+        # primary, shard OSDs and the engine
+        out = asok_command(mgr.asok.path, "trace dump", trace_id=tid)
+        assert out["code"] == 0, out
+        tree = out["data"]
+        services = set(tree["services"])
+        assert any(s.startswith("client") for s in services)
+        assert sum(1 for s in services if s.startswith("osd.")) >= 2
+
+        def names(node, acc):
+            acc.add(node["name"].split("(")[0])
+            for c in node["children"]:
+                names(c, acc)
+            return acc
+
+        got = set()
+        for root in tree["tree"]:
+            names(root, got)
+        assert "osd_op" in got          # client root
+        assert "handle_osd_op" in got   # primary
+        assert "sub_write" in got       # shard OSDs
+        assert "engine_flush" in got    # engine
+        assert "kernel_dispatch" in got
+
+        # prometheus exemplar: the op_total bucket links to the trace
+        text = prometheus.render_text()
+        ex_lines = [ln for ln in text.splitlines()
+                    if "op_total_us_bucket" in ln and tid in ln]
+        assert ex_lines, f"no op_total exemplar for {tid}"
+
+        # Perfetto export, both surfaces: the mgr command and the
+        # autopsy-entry CLI shape
+        out = asok_command(mgr.asok.path, "trace export",
+                           trace_id=tid)
+        assert out["code"] == 0, out
+        ct = out["data"]
+        assert ct["traceEvents"], ct
+        procs = {e["args"]["name"] for e in ct["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert any(p.startswith("client") for p in procs)
+        assert any(p.startswith("osd.") for p in procs)
+        assert any(e.get("cat") == "engine" and e["ph"] in "be"
+                   for e in ct["traceEvents"])
+        ct2 = export_doc(autopsy.store().get(tid))
+        assert any(e["args"]["name"] == "timeline"
+                   for e in ct2["traceEvents"]
+                   if e["ph"] == "M" and e["name"] == "process_name")
+        json.dumps(ct2)
+
+        # the dashboard trace endpoint serves the same surface
+        dash = mgr.modules.get("dashboard")
+        if dash is not None:
+            code, _, body = dash._api("/api/traces")
+            assert code == 200
+            payload = json.loads(body)
+            assert any(r["trace_id"] == tid for r in payload["kept"])
+            assert any(a["trace_id"] == tid
+                       for a in payload["autopsies"])
+
+
+def _fidelity_run(loopback: bool):
+    """One 4-write run; returns (kept decisions, stage sequences,
+    span shapes) keyed per object."""
+    import os
+
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.utils.dataplane import dataplane
+
+    os.environ["CEPH_TPU_MSGR_LOOPBACK"] = "1" if loopback else "0"
+    t = tracing.tracer()
+    t.clear()
+    dataplane().reset()
+    try:
+        with MiniCluster(n_osds=3) as cluster:
+            rados = cluster.client()
+            cluster.create_ec_pool("fid", k=2, m=1, pg_num=1)
+            io = rados.open_ioctx("fid")
+            for i in range(4):
+                io.write_full(f"fobj{i}", b"f" * 8_000)
+            decisions, shapes = {}, {}
+            for i in range(4):
+                tid, entry = _find_op(cluster, f"fobj{i}")
+                assert tid, f"fobj{i} not tracked"
+                decisions[f"fobj{i}"] = t.is_kept(tid)
+                spans = t.dump(tid)
+                # client instance ids are random per connection:
+                # normalize so the shape compares structure only
+                shapes[f"fobj{i}"] = sorted(
+                    (s["name"].split("(")[0],
+                     "client" if s["service"].startswith("client")
+                     else s["service"])
+                    for s in spans)
+            stage_seqs = [
+                tuple(s["stage"] for s in tl["stages"])
+                for tl in dataplane().recent()]
+        return decisions, shapes, sorted(set(stage_seqs))
+    finally:
+        os.environ.pop("CEPH_TPU_MSGR_LOOPBACK", None)
+
+
+def test_loopback_and_tcp_observability_identical(trace_conf):
+    """Satellite: the PR-9 in-process loopback must be
+    observability-transparent — same trace span shapes, same stage
+    timeline structure, same sampling decisions as the real wire."""
+    conf = trace_conf
+    _no_cause_keeps(conf)
+    conf.set("trace_sample_every", 2)
+    loop = _fidelity_run(loopback=True)
+    wire = _fidelity_run(loopback=False)
+    assert loop[0] == wire[0], (loop[0], wire[0])   # decisions
+    # span shape per object: kept traces carry identical
+    # (name, service) trees on both paths; dropped ones are empty
+    # on both
+    assert loop[1] == wire[1]
+    # stage-name sequences (structure, not values) match
+    assert loop[2] == wire[2]

@@ -19,11 +19,20 @@ def traced():
 
 
 def test_noop_when_disabled():
-    assert not tracing.tracer().enabled
-    span = tracing.tracer().new_trace("x", "svc")
-    span.event("e")
-    span.finish()
-    assert span.wire() == ""
+    """trace_enabled=false restores literal NOOP spans (tracing is
+    otherwise always on under the ISSUE-10 tail sampler)."""
+    conf = g_conf()
+    old = conf["trace_enabled"]
+    conf.set("trace_enabled", False)
+    try:
+        assert not tracing.tracer().enabled
+        span = tracing.tracer().new_trace("x", "svc")
+        span.event("e")
+        span.finish()
+        assert span.wire() == ""
+        assert span is tracing.NOOP
+    finally:
+        conf.set("trace_enabled", old)
 
 
 def test_from_wire_rejects_malformed_ctx(traced):

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo-root shim for the bench-round trend comparator:
+
+    python tools/bench_trend.py [BENCH_r01.json ...] [--strict]
+
+Real implementation: ceph_tpu/tools/bench_trend.py (also runnable as
+``python -m ceph_tpu.tools.bench_trend``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.tools.bench_trend import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

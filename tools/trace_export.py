@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo-root shim for the trace/autopsy Perfetto exporter:
+
+    python tools/trace_export.py --input trace.json [--output out.json]
+
+Real implementation: ceph_tpu/tools/trace_export.py (also runnable as
+``python -m ceph_tpu.tools.trace_export``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.tools.trace_export import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
