@@ -1,0 +1,28 @@
+"""Concurrency and contract analysis suite (ISSUE 11).
+
+Two halves, both gating tier-1:
+
+- :mod:`ceph_tpu.analysis.lock_witness` — a pylockdep: opt-in
+  (``CEPH_TPU_LOCK_WITNESS=1``) runtime instrumentation that names
+  lock construction sites, maintains a process-wide acquisition-order
+  graph, and reports (a) cycles in that graph — potential AB-BA
+  deadlocks even when they never fired in this run (the PR 9 loopback
+  deadlock class) — and (b) blocking-under-lock violations: device
+  barriers, blocking socket commands, store fsync/journal appends,
+  and ``Condition.wait`` under a foreign lock (the PR 4/PR 6
+  shutdown-race shape).
+
+- :mod:`ceph_tpu.analysis.linters` — codebase-specific AST checkers
+  (wire symmetry, jit hygiene, counter/config/asok registry drift,
+  lock discipline) diffed against the justified allowlist in
+  ``analysis/baseline.json``.
+
+Run the lint suite with ``python -m ceph_tpu.analysis`` or
+``tools/analyze.py``; the tier-1 gates live in
+``tests/test_static_analysis.py`` and ``tests/test_lock_witness.py``.
+
+Off = zero cost: with the witness disabled the ``make_lock`` family
+returns the bare ``threading`` primitives (no wrapper objects — the
+zero-Spans contract pattern from tracing/profiler), and the linters
+only ever run inside the analyzer CLI and its gate tests.
+"""

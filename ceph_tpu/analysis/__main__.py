@@ -1,0 +1,6 @@
+"""``python -m ceph_tpu.analysis`` — the static-analysis gate CLI."""
+
+from ceph_tpu.tools.analyze import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,849 @@
+"""Codebase-specific AST lints (ISSUE 11, half 2).
+
+Four checker families over the ``ceph_tpu`` package source — each one
+the *static twin* of a runtime contract this repo already gates:
+
+1. **wire symmetry** — every message class in ``parallel/messages.py``
+   must encode and decode the same field sequence in the same order.
+   The schema-generated path (``FIELDS`` drives both directions) is
+   symmetric by construction; the lint pins the schema well-formedness
+   (known kinds, unique names, unique MSG_TYPE) and polices manual
+   ``encode_payload``/``decode_payload`` overrides: both or neither,
+   identical field order, tail-tolerant decode (the appended-optional
+   ``stages``/``trace`` pattern).
+
+2. **jit hygiene** — inside ``@jax.jit``/Pallas-wrapped functions in
+   ``ops/``/``models/``/``parallel/``: Python ``if``/``while`` on
+   traced values, ``int()``/``float()``/``bool()``/``.item()`` host
+   coercions of traced values, ``np.asarray`` host pulls, and
+   closure-captured device arrays — the static twin of
+   device_telemetry's runtime ``recompiles`` counter (the shape-leak
+   class PR 2 can only detect after it fires).
+
+3. **registry drift** — every PerfCounters key *updated* must be
+   registered and vice versa (static twin of test_counter_schema's
+   exporter lints); every ``g_conf`` key read must be a declared
+   Option; every ``asok_command`` invocation must name a prefix some
+   daemon registers.
+
+4. **lock discipline** — in classes that own a ``_lock``, methods
+   mutating attributes that are elsewhere accessed under that lock
+   must themselves hold it.
+
+Findings diff against the justified allowlist in
+``analysis/baseline.json``; any NEW finding (or a stale baseline
+entry) fails ``tests/test_static_analysis.py`` in tier-1. Keys carry
+no line numbers, so routine edits don't churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+#: field kinds the Encoder/Decoder tables support (mirrors the _ENC
+#: table in parallel/messages.py; the checker prefers the table parsed
+#: from the file itself when present)
+DEFAULT_KINDS = frozenset((
+    "u8", "u16", "u32", "u64", "i32", "i64", "f64", "bool", "str",
+    "bytes", "str_map", "bytes_map", "i32_list", "u64_list",
+    "str_list", "bytes_list"))
+
+#: jit-hygiene scope (repo-relative directory prefixes)
+JIT_DIRS = ("ceph_tpu/ops", "ceph_tpu/models", "ceph_tpu/parallel")
+
+#: attribute reads that turn a traced value into static metadata
+_STATIC_ATTRS = frozenset((
+    "shape", "ndim", "dtype", "size", "itemsize", "nbytes", "sharding"))
+#: calls whose result is static regardless of argument taint
+_STATIC_CALLS = frozenset((
+    "len", "isinstance", "type", "hasattr", "getattr", "id", "repr"))
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str
+    path: str          # repo-relative
+    line: int
+    key: str           # stable id (no line numbers) for the baseline
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] " \
+               f"{self.message}  ({self.key})"
+
+
+class SourceFile:
+    def __init__(self, path: str, text: str,
+                 rel: str | None = None) -> None:
+        self.path = path
+        self.rel = rel or os.path.relpath(path, REPO_ROOT)
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+
+
+def iter_sources(root: str = PKG_ROOT) -> list[SourceFile]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            try:
+                out.append(SourceFile(path, text))
+            except SyntaxError as exc:       # pragma: no cover
+                raise RuntimeError(f"unparseable {path}: {exc}")
+    return out
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:                        # pragma: no cover
+        return "<expr>"
+
+
+def _walk_in_order(node: ast.AST):
+    """DFS in source order (ast.walk is BFS; order matters for the
+    encode/decode sequence extraction)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        yield from _walk_in_order(child)
+
+
+# ---------------------------------------------------------------------------
+# 1. wire symmetry
+# ---------------------------------------------------------------------------
+
+def _literal_fields(node: ast.AST) -> list[tuple[str, str]] | None:
+    """Parse a ``FIELDS = [(name, kind), ...]`` literal."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    out = []
+    for elt in node.elts:
+        if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2
+                and all(isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                        for e in elt.elts)):
+            return None
+        out.append((elt.elts[0].value, elt.elts[1].value))
+    return out
+
+
+def _self_attr_reads(fn: ast.FunctionDef, names: set[str]) -> list[str]:
+    """``self.X`` loads in source order, X restricted to ``names``."""
+    out = []
+    for node in _walk_in_order(fn):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in names:
+            out.append(node.attr)
+    return out
+
+
+def _attr_stores(fn: ast.FunctionDef, names: set[str]) -> list[str]:
+    """``<obj>.X = ...`` stores (plus ``setattr(obj, "X", ...)``) in
+    source order, X restricted to ``names``."""
+    out = []
+    for node in _walk_in_order(fn):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Store) and node.attr in names:
+            out.append(node.attr)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "setattr" and len(node.args) >= 2 and \
+                isinstance(node.args[1], ast.Constant) and \
+                node.args[1].value in names:
+            out.append(node.args[1].value)
+    return out
+
+
+def check_wire_symmetry(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    kinds = set(DEFAULT_KINDS)
+    # prefer the module's own _ENC table as ground truth
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and \
+                any(isinstance(t, ast.Name) and t.id == "_ENC"
+                    for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            parsed = {k.value for k in node.value.keys
+                      if isinstance(k, ast.Constant)}
+            if parsed:
+                kinds = parsed
+
+    msg_types: dict[int, str] = {}
+    for cls in src.tree.body:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        fields = None
+        mtype = None
+        encode_fn = decode_fn = None
+        for item in cls.body:
+            if isinstance(item, ast.Assign):
+                for t in item.targets:
+                    if isinstance(t, ast.Name) and t.id == "FIELDS":
+                        fields = _literal_fields(item.value)
+                    elif isinstance(t, ast.Name) and t.id == "MSG_TYPE" \
+                            and isinstance(item.value, ast.Constant):
+                        mtype = item.value.value
+            elif isinstance(item, ast.FunctionDef):
+                if item.name == "encode_payload":
+                    encode_fn = item
+                elif item.name == "decode_payload":
+                    decode_fn = item
+        if fields is None and mtype is None:
+            continue
+
+        def add(code: str, message: str, line: int = cls.lineno):
+            findings.append(Finding(
+                "wire_symmetry", src.rel, line,
+                f"wire_symmetry:{src.rel}:{cls.name}:{code}", message))
+
+        if fields:
+            seen: set[str] = set()
+            for name, kind in fields:
+                if kind not in kinds:
+                    add(f"unknown-kind:{name}",
+                        f"{cls.name}.{name}: unknown wire kind "
+                        f"{kind!r} (no encoder/decoder)")
+                if name in seen:
+                    add(f"dup-field:{name}",
+                        f"{cls.name}: duplicate field {name!r}")
+                seen.add(name)
+        if isinstance(mtype, int) and mtype:
+            if mtype in msg_types:
+                add(f"dup-msg-type:{mtype}",
+                    f"{cls.name}: MSG_TYPE {mtype} already used by "
+                    f"{msg_types[mtype]}")
+            else:
+                msg_types[mtype] = cls.name
+
+        if fields and (encode_fn or decode_fn):
+            names = {n for n, _ in fields}
+            if encode_fn is None or decode_fn is None:
+                side = "encode_payload" if encode_fn else \
+                    "decode_payload"
+                add("override-asymmetry",
+                    f"{cls.name}: overrides only {side} — the "
+                    "generated twin no longer mirrors it")
+            else:
+                enc = _self_attr_reads(encode_fn, names)
+                dec = _attr_stores(decode_fn, names)
+                if enc != dec:
+                    add("field-order-asymmetry",
+                        f"{cls.name}: encode order {enc} != decode "
+                        f"order {dec}")
+                field_order = [n for n, _ in fields if n in set(enc)]
+                if enc and enc != field_order:
+                    add("encode-diverges-from-fields",
+                        f"{cls.name}: encode order {enc} diverges "
+                        f"from FIELDS order {field_order}")
+                dec_src = ast.get_source_segment(
+                    src.text, decode_fn) or ""
+                if dec and "eof(" not in dec_src:
+                    add("decode-not-tail-tolerant",
+                        f"{cls.name}: custom decode_payload has no "
+                        "eof() guard — appended-optional fields from "
+                        "newer peers will not be tail-tolerated")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 2. jit hygiene
+# ---------------------------------------------------------------------------
+
+def _jit_static_argnames(dec: ast.AST) -> tuple[bool, set[str]]:
+    """(is_jit_decorator, static_argnames) for one decorator node."""
+    if isinstance(dec, ast.IfExp):      # `... if HAVE_JAX else (f->f)`
+        return _jit_static_argnames(dec.body)
+    target = dec
+    statics: set[str] = set()
+    if isinstance(dec, ast.Call):
+        fname = _unparse(dec.func)
+        if fname.endswith("partial") and dec.args:
+            target = dec.args[0]
+        for kw in dec.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                val = kw.value
+                if isinstance(val, ast.Constant) and \
+                        isinstance(val.value, str):
+                    statics.add(val.value)
+                elif isinstance(val, (ast.Tuple, ast.List)):
+                    statics |= {e.value for e in val.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)}
+        if target is dec:
+            target = dec.func
+    name = _unparse(target)
+    is_jit = name == "jit" or name.endswith(".jit")
+    return is_jit, statics
+
+
+def _expr_tainted(node: ast.AST, tainted: set[str]) -> bool:
+    """Does this expression carry a traced value? Static metadata
+    accessors (shape/ndim/dtype/len/...) sanitize."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _STATIC_CALLS:
+            return False
+        parts = [fn.value] if isinstance(fn, ast.Attribute) else []
+        parts += list(node.args) + [kw.value for kw in node.keywords]
+        return any(_expr_tainted(p, tainted) for p in parts)
+    if isinstance(node, ast.Subscript):
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, (ast.Constant, ast.Lambda)):
+        return False
+    return any(_expr_tainted(c, tainted)
+               for c in ast.iter_child_nodes(node))
+
+
+def _assigned_names(target: ast.AST) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            out.extend(_assigned_names(e))
+        return out
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    return []
+
+
+def _check_jit_function(src: SourceFile, fn: ast.FunctionDef,
+                        statics: set[str],
+                        enclosing_arrayish: dict[str, int]
+                        ) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def add(code: str, message: str, line: int):
+        findings.append(Finding(
+            "jit_hygiene", src.rel, line,
+            f"jit_hygiene:{src.rel}:{fn.name}:{code}", message))
+
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                              + fn.args.kwonlyargs)]
+    tainted: set[str] = {p for p in params
+                         if p not in statics
+                         and p not in ("self", "cls")}
+
+    # taint propagation, two passes for loop-carried names
+    for _pass in (0, 1):
+        for node in _walk_in_order(fn):
+            if isinstance(node, ast.Assign):
+                t = _expr_tainted(node.value, tainted)
+                for tgt in node.targets:
+                    for name in _assigned_names(tgt):
+                        if t:
+                            tainted.add(name)
+                        else:
+                            tainted.discard(name)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                if _expr_tainted(node.value, tainted):
+                    tainted.add(node.target.id)
+            elif isinstance(node, ast.For):
+                t = _expr_tainted(node.iter, tainted)
+                for name in _assigned_names(node.target):
+                    if t:
+                        tainted.add(name)
+
+    locals_assigned = set()
+    for node in _walk_in_order(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                locals_assigned.update(_assigned_names(tgt))
+        elif isinstance(node, (ast.For,)):
+            locals_assigned.update(_assigned_names(node.target))
+
+    for node in _walk_in_order(fn):
+        if isinstance(node, (ast.If, ast.While)) and \
+                _expr_tainted(node.test, tainted):
+            snippet = _unparse(node.test)[:48]
+            add(f"traced-branch:{snippet}",
+                f"{fn.name}: Python "
+                f"{'if' if isinstance(node, ast.If) else 'while'} on "
+                f"traced value `{snippet}` — trace-time branch, "
+                "recompiles per value or raises TracerBoolError",
+                node.lineno)
+        elif isinstance(node, ast.Call):
+            cfn = node.func
+            if isinstance(cfn, ast.Name) and \
+                    cfn.id in ("int", "float", "bool") and node.args \
+                    and _expr_tainted(node.args[0], tainted):
+                add(f"traced-coercion:{cfn.id}:"
+                    f"{_unparse(node.args[0])[:32]}",
+                    f"{fn.name}: {cfn.id}() on traced value "
+                    f"`{_unparse(node.args[0])[:48]}` forces a host "
+                    "sync / ConcretizationTypeError under jit",
+                    node.lineno)
+            elif isinstance(cfn, ast.Attribute) and \
+                    cfn.attr in ("item", "tolist") and \
+                    not node.args and \
+                    _expr_tainted(cfn.value, tainted):
+                add(f"traced-coercion:{cfn.attr}:"
+                    f"{_unparse(cfn.value)[:32]}",
+                    f"{fn.name}: .{cfn.attr}() on traced value "
+                    f"`{_unparse(cfn.value)[:48]}` — device barrier "
+                    "inside a traced function", node.lineno)
+            elif isinstance(cfn, ast.Attribute) and \
+                    cfn.attr == "asarray" and \
+                    isinstance(cfn.value, ast.Name) and \
+                    cfn.value.id == "np" and node.args and \
+                    _expr_tainted(node.args[0], tainted):
+                add(f"host-pull:{_unparse(node.args[0])[:32]}",
+                    f"{fn.name}: np.asarray on traced value "
+                    f"`{_unparse(node.args[0])[:48]}` pulls the "
+                    "array to host inside the trace", node.lineno)
+
+    # closure-captured device arrays: free names assigned in an
+    # enclosing function from jnp.*/device_put calls
+    params_set = set(params)
+    for node in _walk_in_order(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and \
+                node.id in enclosing_arrayish and \
+                node.id not in params_set and \
+                node.id not in locals_assigned:
+            add(f"closure-device-array:{node.id}",
+                f"{fn.name}: closure-captures device array "
+                f"`{node.id}` (built at "
+                f"line {enclosing_arrayish[node.id]}) — baked in as "
+                "a constant; a new array identity per call "
+                "recompiles (the shape-leak class)", node.lineno)
+            break        # one per function is enough signal
+    return findings
+
+
+_ARRAYISH_CALLS = ("jnp.asarray", "jnp.array", "jnp.zeros", "jnp.ones",
+                   "jax.device_put", "jnp.arange")
+
+
+def check_jit_hygiene(src: SourceFile) -> list[Finding]:
+    if not any(src.rel.startswith(d + "/") or src.rel.startswith(d)
+               for d in JIT_DIRS):
+        return []
+    findings: list[Finding] = []
+
+    def visit(node: ast.AST, arrayish: dict[str, int]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                statics: set[str] = set()
+                is_jit = False
+                for dec in child.decorator_list:
+                    j, s = _jit_static_argnames(dec)
+                    if j:
+                        is_jit = True
+                        statics |= s
+                if is_jit:
+                    findings.extend(_check_jit_function(
+                        src, child, statics, arrayish))
+                # nested scope: record this function's arrayish
+                # assignments for ITS children
+                inner = dict(arrayish)
+                for n in ast.walk(child):
+                    if isinstance(n, ast.Assign) and \
+                            isinstance(n.value, ast.Call):
+                        fname = _unparse(n.value.func)
+                        if fname in _ARRAYISH_CALLS:
+                            for tgt in n.targets:
+                                for name in _assigned_names(tgt):
+                                    inner[name] = n.lineno
+                visit(child, inner)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, dict(arrayish))
+            else:
+                visit(child, arrayish)
+
+    visit(src.tree, {})
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 3. registry drift (counters / config / asok)
+# ---------------------------------------------------------------------------
+
+_COUNTER_REG = {"add_u64_counter": "u64", "add_gauge": "gauge",
+                "add_time_avg": "time_avg", "add_histogram": "hist"}
+#: update methods that are distinctive enough to always count
+_COUNTER_USE_STRONG = ("ginc", "tinc", "hinc")
+#: generic names counted only on perf-ish receivers ("logger" is the
+#: reference's name for a PerfCounters instance)
+_COUNTER_USE_WEAK = ("inc", "set_gauge", "time")
+_PERF_RECV_HINTS = ("perf", "counter", "logger")
+
+
+def _fstring_affix(node: ast.AST) -> tuple[str, str] | None:
+    """(leading, trailing) constant parts of an f-string key — how
+    dynamic registry keys (``f"faults_{kind}"``,
+    ``f"{name}_tracing"``) still mark their key family as used."""
+    if not isinstance(node, ast.JoinedStr) or not node.values:
+        return None
+    lead = node.values[0]
+    trail = node.values[-1]
+    prefix = lead.value if isinstance(lead, ast.Constant) and \
+        isinstance(lead.value, str) else ""
+    suffix = trail.value if isinstance(trail, ast.Constant) and \
+        isinstance(trail.value, str) else ""
+    if not prefix and not suffix:
+        return None
+    return (prefix, suffix)
+
+
+def _affix_match(key: str, affixes: list[tuple[str, str]]) -> bool:
+    return any(key.startswith(p) and key.endswith(s)
+               for p, s in affixes)
+
+
+class RegistryDrift:
+    """Cross-file collector: feed every SourceFile through
+    :meth:`collect`, then read :meth:`findings`."""
+
+    def __init__(self) -> None:
+        self.counters_registered: dict[str, tuple[str, int]] = {}
+        self.counters_used: dict[str, tuple[str, int]] = {}
+        self.options_declared: dict[str, tuple[str, int]] = {}
+        self.options_read: dict[str, tuple[str, int]] = {}
+        self.asok_registered: dict[str, tuple[str, int]] = {}
+        self.asok_invoked: dict[str, tuple[str, int]] = {}
+        #: (prefix, suffix) families touched via f-string keys
+        self.counter_affixes: list[tuple[str, str]] = []
+        self.option_affixes: list[tuple[str, str]] = []
+
+    # -- collection ----------------------------------------------------
+    def collect(self, src: SourceFile) -> None:
+        conf_aliases = {"conf", "cfg", "_conf", "_g_conf"}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _unparse(node.value.func).endswith("g_conf"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        conf_aliases.add(tgt.id)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                if isinstance(node, ast.Subscript) and \
+                        self._is_conf(node.value, conf_aliases):
+                    if isinstance(node.slice, ast.Constant) and \
+                            isinstance(node.slice.value, str):
+                        self.options_read.setdefault(
+                            node.slice.value,
+                            (src.rel, node.lineno))
+                    else:
+                        affix = _fstring_affix(node.slice)
+                        if affix:
+                            self.option_affixes.append(affix)
+                continue
+            fn = node.func
+            lit0 = node.args[0].value if (
+                node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)) else None
+            dyn0 = _fstring_affix(node.args[0]) if node.args else None
+            # `inc("a" if hit else "b")`: both branches are keys
+            cond0: list[str] = []
+            if node.args and isinstance(node.args[0], ast.IfExp):
+                cond0 = [e.value for e in (node.args[0].body,
+                                           node.args[0].orelse)
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+            if isinstance(fn, ast.Attribute):
+                recv = _unparse(fn.value).lower()
+                perfish = any(h in recv for h in _PERF_RECV_HINTS)
+                if fn.attr in _COUNTER_REG and lit0:
+                    self.counters_registered.setdefault(
+                        lit0, (src.rel, node.lineno))
+                elif fn.attr in _COUNTER_USE_STRONG or \
+                        (fn.attr in _COUNTER_USE_WEAK and perfish):
+                    if lit0:
+                        self.counters_used.setdefault(
+                            lit0, (src.rel, node.lineno))
+                    elif dyn0:
+                        self.counter_affixes.append(dyn0)
+                    for key in cond0:
+                        self.counters_used.setdefault(
+                            key, (src.rel, node.lineno))
+                elif fn.attr in ("get", "set") and \
+                        self._is_conf(fn.value, conf_aliases):
+                    if lit0:
+                        self.options_read.setdefault(
+                            lit0, (src.rel, node.lineno))
+                    elif dyn0:
+                        self.option_affixes.append(dyn0)
+                elif fn.attr == "register_command" and lit0:
+                    self.asok_registered.setdefault(
+                        lit0, (src.rel, node.lineno))
+                elif fn.attr == "asok_command" and len(node.args) >= 2 \
+                        and isinstance(node.args[1], ast.Constant):
+                    self.asok_invoked.setdefault(
+                        node.args[1].value, (src.rel, node.lineno))
+            elif isinstance(fn, ast.Name):
+                if fn.id == "Option" and lit0:
+                    self.options_declared.setdefault(
+                        lit0, (src.rel, node.lineno))
+                elif fn.id == "asok_command" and len(node.args) >= 2 \
+                        and isinstance(node.args[1], ast.Constant):
+                    self.asok_invoked.setdefault(
+                        node.args[1].value, (src.rel, node.lineno))
+
+    @staticmethod
+    def _is_conf(recv: ast.AST, aliases: set[str]) -> bool:
+        if isinstance(recv, ast.Call):
+            return _unparse(recv.func).endswith("g_conf")
+        if isinstance(recv, ast.Name):
+            return recv.id in aliases
+        if isinstance(recv, ast.Attribute):
+            return recv.attr in ("conf", "_conf")
+        return False
+
+    # -- findings ------------------------------------------------------
+    def findings(self) -> list[Finding]:
+        out: list[Finding] = []
+
+        def add(kind: str, key: str, where: tuple[str, int],
+                message: str):
+            out.append(Finding(
+                "registry_drift", where[0], where[1],
+                f"registry_drift:{kind}:{key}", message))
+
+        for key, where in sorted(self.counters_used.items()):
+            if key not in self.counters_registered:
+                add("counter-unregistered", key, where,
+                    f"counter {key!r} updated but never registered "
+                    "(runtime KeyError the first time it fires)")
+        for key, where in sorted(self.counters_registered.items()):
+            if key not in self.counters_used and \
+                    not _affix_match(key, self.counter_affixes):
+                add("counter-unused", key, where,
+                    f"counter {key!r} registered but never updated "
+                    "anywhere — dead metric, dashboards read 0")
+        for key, where in sorted(self.options_read.items()):
+            if key not in self.options_declared:
+                add("unknown-option", key, where,
+                    f"config key {key!r} read but not declared as an "
+                    "Option (g_conf raises KeyError)")
+        for key, where in sorted(self.options_declared.items()):
+            if key not in self.options_read and \
+                    not _affix_match(key, self.option_affixes):
+                add("option-unread", key, where,
+                    f"option {key!r} declared but never read in the "
+                    "package — dead knob")
+        for key, where in sorted(self.asok_invoked.items()):
+            if key not in self.asok_registered:
+                add("asok-unregistered", key, where,
+                    f"asok command {key!r} invoked but no daemon "
+                    "registers it")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 4. lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "make_lock",
+               "make_rlock", "lock_witness.make_lock",
+               "lock_witness.make_rlock")
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            fname = _unparse(node.value.func)
+            if fname in _LOCK_CTORS or fname.endswith(".make_lock") \
+                    or fname.endswith(".make_rlock"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        out.add(tgt.attr)
+    return out
+
+
+def _with_lock_items(node: ast.With, locks: set[str]) -> bool:
+    for item in node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Attribute) and \
+                isinstance(ctx.value, ast.Name) and \
+                ctx.value.id == "self" and ctx.attr in locks:
+            return True
+    return False
+
+
+def _locked_context_methods(methods: list[ast.FunctionDef],
+                            locks: set[str]) -> set[str]:
+    """Methods only ever called (within this class) while the lock is
+    held — the caller-holds-lock idiom (mon's ``_dispatch`` takes
+    ``self._lock`` once and fans out to every handler). Computed to a
+    fixpoint so a handler's helpers inherit the context. A method with
+    any call site outside a locked region (or no internal call sites
+    at all — public API) is NOT lock-held context."""
+    names = {m.name for m in methods}
+    # method -> list of (callee, in_with_lock_span) call sites
+    sites: dict[str, list[tuple[str, bool]]] = {n: [] for n in names}
+    for m in methods:
+        spans = [(n.lineno, n.end_lineno or n.lineno)
+                 for n in ast.walk(m)
+                 if isinstance(n, ast.With)
+                 and _with_lock_items(n, locks)]
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and \
+                    node.func.attr in names:
+                in_span = any(a <= node.lineno <= b
+                              for a, b in spans)
+                sites[node.func.attr].append((m.name, in_span))
+    # greatest fixpoint: assume every internally-called method is
+    # lock-held, then evict any with a call site that is neither
+    # inside a with-lock span nor from a (still-)locked caller —
+    # mutually-recursive helper clusters (paxos pump/collect/begin)
+    # whose every external entry is locked stay locked
+    locked: set[str] = {n for n in names if sites[n]}
+    changed = True
+    while changed:
+        changed = False
+        for name in sorted(locked):
+            if not all(in_span or caller in locked
+                       for caller, in_span in sites[name]):
+                locked.discard(name)
+                changed = True
+    return locked
+
+
+def check_lock_discipline(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in [n for n in ast.walk(src.tree)
+                if isinstance(n, ast.ClassDef)]:
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        methods = [n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)]
+
+        # attrs touched inside with-self-lock blocks anywhere
+        protected: set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.With) and \
+                        _with_lock_items(node, locks):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Attribute) and \
+                                isinstance(sub.value, ast.Name) and \
+                                sub.value.id == "self" and \
+                                sub.attr not in locks:
+                            protected.add(sub.attr)
+        if not protected:
+            continue
+        locked_ctx = _locked_context_methods(methods, locks)
+
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            # caller-holds-lock conventions: the documented ``_locked``
+            # name suffix, and methods only reachable under the lock
+            if m.name.endswith("_locked") or m.name in locked_ctx:
+                continue
+            src_seg = ast.get_source_segment(src.text, m) or ""
+            if ".acquire(" in src_seg:
+                continue           # manual acquire/release pattern
+
+            # collect assignments to protected attrs OUTSIDE any
+            # with-self-lock block
+            locked_spans: list[tuple[int, int]] = []
+            for node in ast.walk(m):
+                if isinstance(node, ast.With) and \
+                        _with_lock_items(node, locks):
+                    locked_spans.append(
+                        (node.lineno, node.end_lineno or node.lineno))
+
+            def in_locked(line: int) -> bool:
+                return any(a <= line <= b for a, b in locked_spans)
+
+            for node in ast.walk(m):
+                target = None
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self" and \
+                                tgt.attr in protected:
+                            target = tgt
+                elif isinstance(node, ast.AugAssign) and \
+                        isinstance(node.target, ast.Attribute) and \
+                        isinstance(node.target.value, ast.Name) and \
+                        node.target.value.id == "self" and \
+                        node.target.attr in protected:
+                    target = node.target
+                if target is not None and not in_locked(node.lineno):
+                    findings.append(Finding(
+                        "lock_discipline", src.rel, node.lineno,
+                        f"lock_discipline:{src.rel}:{cls.name}."
+                        f"{m.name}:{target.attr}",
+                        f"{cls.name}.{m.name}: mutates "
+                        f"self.{target.attr} (elsewhere accessed "
+                        f"under {sorted(locks)}) without holding "
+                        "the lock"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver + baseline
+# ---------------------------------------------------------------------------
+
+def run_all(root: str = PKG_ROOT,
+            sources: list[SourceFile] | None = None) -> list[Finding]:
+    if sources is None:
+        sources = iter_sources(root)
+    findings: list[Finding] = []
+    drift = RegistryDrift()
+    for src in sources:
+        findings.extend(check_wire_symmetry(src))
+        findings.extend(check_jit_hygiene(src))
+        findings.extend(check_lock_discipline(src))
+        drift.collect(src)
+    findings.extend(drift.findings())
+    findings.sort(key=lambda f: (f.path, f.line, f.key))
+    return findings
+
+
+def load_baseline(path: str = BASELINE_PATH) -> dict:
+    if not os.path.exists(path):
+        return {"lint": [], "witness": []}
+    with open(path) as f:
+        return json.load(f)
+
+
+def diff_baseline(findings: list[Finding],
+                  baseline: dict | None = None
+                  ) -> tuple[list[Finding], list[dict]]:
+    """(new findings not in the baseline, stale baseline entries whose
+    violation no longer exists). Both must be empty for the gate."""
+    if baseline is None:
+        baseline = load_baseline()
+    allow = {e["key"]: e for e in baseline.get("lint", ())}
+    keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in allow]
+    stale = [e for k, e in sorted(allow.items()) if k not in keys]
+    return new, stale

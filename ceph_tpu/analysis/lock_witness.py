@@ -1,0 +1,530 @@
+"""Runtime lock-order witness — a pylockdep (ISSUE 11, half 1).
+
+The kernel's lockdep discipline applied to this repo's ~85 lock sites:
+every lock built through :func:`make_lock` / :func:`make_rlock` /
+:func:`make_condition` carries a NAME (its class of construction site,
+e.g. ``"osd.pgs"`` — many PG instances share one name the way lockdep
+keys by lock *class*, keeping witness memory fixed no matter how many
+PGs exist). While enabled, each thread's held-set is tracked and every
+nested acquisition records a directed edge ``held -> acquired`` with a
+stack fingerprint. At report time:
+
+- a cycle in the order graph is a potential AB-BA deadlock **even if
+  it never fired in this run** — the exact class of the PR 9 loopback
+  deadlock (two daemons dispatching into each other under their own
+  locks), found the hard way;
+- a *blocking-under-lock* violation is a blocking operation (device
+  barrier via ``jax.block_until_ready``/``jax.device_get``, a blocking
+  asok round-trip, ``os.fsync``/journal append, store sync, or
+  ``Condition.wait`` on a different lock) executed while holding any
+  witnessed lock — the shape of the PR 4 engine-shutdown race and the
+  PR 6 gauge-accounting race.
+
+Contract when DISABLED (the default): the ``make_*`` constructors
+return the bare ``threading`` primitives — zero wrapper objects, zero
+per-acquire cost, no patched functions (the zero-Spans pattern from
+utils/tracing). Enabling is process-wide and meant for the tier-1 gate
+tests (tests/test_lock_witness.py) and ``CEPH_TPU_LOCK_WITNESS=1``
+runs wired through tests/conftest.py.
+
+State is fixed-memory: edges, fingerprints and violations are capped;
+past the cap new observations only bump counters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import traceback
+
+#: caps — witness memory stays fixed no matter how long the run is
+MAX_EDGES = 4096
+MAX_STACKS_PER_EDGE = 4
+MAX_VIOLATIONS = 512
+_STACK_DEPTH = 8
+
+_ENABLED = False
+_state_lock = threading.Lock()     # guards the graphs below (bare by design)
+_tls = threading.local()
+
+#: (from_name, to_name) -> {"count", "stacks": {fingerprint: sample}}
+_edges: dict[tuple[str, str], dict] = {}
+#: (from_name, to_name) of self-edges where the two instances differed
+_distinct_self_edges: set[tuple[str, str]] = set()
+#: key -> {"kind", "lock", "site", "count", "stack"}
+_violations: dict[str, dict] = {}
+_locks_created = 0
+_edges_dropped = 0
+_saved_hooks: list = []
+
+
+def env_enabled() -> bool:
+    return os.environ.get("CEPH_TPU_LOCK_WITNESS") == "1"
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# -- construction seams (the named-lock adoption surface) ---------------
+
+def make_lock(name: str):
+    """A named mutex. Off: a bare ``threading.Lock`` (zero wrappers)."""
+    if not _ENABLED:
+        return threading.Lock()
+    return WitnessLock(threading.Lock(), name, _site(), reentrant=False)
+
+
+def make_rlock(name: str):
+    if not _ENABLED:
+        return threading.RLock()
+    return WitnessLock(threading.RLock(), name, _site(), reentrant=True)
+
+
+def make_condition(name: str, lock=None):
+    """A condition variable; ``lock`` may be a ``make_lock``/
+    ``make_rlock`` result (witnessed or bare) or None (own RLock)."""
+    if not _ENABLED:
+        if isinstance(lock, WitnessLock):     # enabled->disabled races
+            lock = lock._inner
+        return threading.Condition(lock)
+    if lock is None:
+        lock = WitnessLock(threading.RLock(), name, _site(),
+                           reentrant=True)
+    elif not isinstance(lock, WitnessLock):
+        lock = WitnessLock(lock, name, _site(),
+                           reentrant=isinstance(
+                               lock, type(threading.RLock())))
+    return WitnessCondition(lock, name)
+
+
+def _site() -> str:
+    f = sys._getframe(2)
+    return "%s:%d" % (os.path.basename(f.f_code.co_filename), f.f_lineno)
+
+
+# -- per-thread held-set ------------------------------------------------
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _fingerprint() -> tuple[str, str, str]:
+    """(fingerprint, sample text, call path) of the acquiring stack,
+    app frames only, bounded depth. The fingerprint (dedup within one
+    run) hashes file:line rows; the call path (baseline keys, stable
+    across runs AND line-number drift) joins function names only."""
+    import zlib
+    frames = traceback.extract_stack(sys._getframe(2), limit=_STACK_DEPTH)
+    rows = []
+    names = []
+    for fr in frames:
+        if "lock_witness" in fr.filename:
+            continue
+        rows.append("%s:%d:%s" % (os.path.basename(fr.filename),
+                                  fr.lineno, fr.name))
+        names.append(fr.name)
+    text = " <- ".join(reversed(rows))
+    path = "<-".join(reversed(names[-2:]))
+    fp = "%08x" % zlib.crc32("|".join(rows).encode())
+    return (fp, text, path)
+
+
+def _note_acquired(lock: "WitnessLock") -> None:
+    global _edges_dropped
+    held = _held()
+    if held:
+        fp = None
+        for prior in held:
+            key = (prior.name, lock.name)
+            if prior.name == lock.name and prior is lock:
+                continue                 # RLock re-entry, not an edge
+            with _state_lock:
+                ent = _edges.get(key)
+                if ent is None:
+                    if len(_edges) >= MAX_EDGES:
+                        _edges_dropped += 1
+                        continue
+                    ent = _edges[key] = {"count": 0, "stacks": {}}
+                ent["count"] += 1
+                if prior.name == lock.name:
+                    _distinct_self_edges.add(key)
+                if len(ent["stacks"]) < MAX_STACKS_PER_EDGE:
+                    if fp is None:
+                        fp = _fingerprint()
+                    ent["stacks"].setdefault(fp[0], fp[1])
+    held.append(lock)
+
+
+def _note_released(lock: "WitnessLock") -> None:
+    held = _held()
+    # out-of-order releases are legal (hand-over-hand); drop by identity
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            return
+
+
+def note_blocking(kind: str, detail: str = "") -> None:
+    """Record a blocking-under-lock violation if this thread holds any
+    witnessed lock. No-op (one predicate) while the witness is off —
+    safe to call from hot paths like the store sync sites."""
+    if not _ENABLED:
+        return
+    held = _held()
+    if not held:
+        return
+    _record_violation(kind, held[-1], detail)
+
+
+def _record_violation(kind: str, lock: "WitnessLock",
+                      detail: str = "") -> None:
+    fp, text, path = _fingerprint()
+    key = f"blocking:{kind}:{lock.name}:{path}"
+    with _state_lock:
+        ent = _violations.get(key)
+        if ent is None:
+            if len(_violations) >= MAX_VIOLATIONS:
+                return
+            ent = _violations[key] = {
+                "kind": kind, "lock": lock.name, "site": lock.site,
+                "detail": detail, "count": 0, "stack": text,
+                "key": key}
+        ent["count"] += 1
+
+
+# -- proxies ------------------------------------------------------------
+
+class WitnessLock:
+    """Named, site-attributed lock proxy. Held-set bookkeeping happens
+    only on the transition unlocked->locked (RLock re-entries bump a
+    depth counter instead), so edges are per lock class and the graph
+    stays small."""
+
+    __slots__ = ("_inner", "name", "site", "_reentrant", "_depth")
+
+    def __init__(self, inner, name: str, site: str,
+                 reentrant: bool) -> None:
+        self._inner = inner
+        self.name = name
+        self.site = site
+        self._reentrant = reentrant
+        self._depth = _Tls()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if self._reentrant and self._depth.value > 0:
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._depth.value += 1
+            return ok
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if self._reentrant:
+                self._depth.value = 1
+            _note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        if self._reentrant and self._depth.value > 1:
+            self._depth.value -= 1
+            self._inner.release()
+            return
+        if self._reentrant:
+            self._depth.value = 0
+        self._inner.release()
+        _note_released(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<WitnessLock {self.name} @{self.site}>"
+
+
+class _Tls:
+    """Per-thread int riding a lock proxy (RLock depth)."""
+
+    __slots__ = ("_tls",)
+
+    def __init__(self) -> None:
+        self._tls = threading.local()
+
+    @property
+    def value(self) -> int:
+        return getattr(self._tls, "v", 0)
+
+    @value.setter
+    def value(self, v: int) -> None:
+        self._tls.v = v
+
+
+class WitnessCondition:
+    """Condition proxy over a witnessed lock. ``wait`` checks the
+    foreign-lock rule: waiting on THIS condition while holding any
+    OTHER witnessed lock parks that lock for an unbounded time — the
+    PR 4 / PR 6 shutdown-race shape — and is recorded as a
+    ``cond_wait_under_lock`` violation."""
+
+    def __init__(self, lock: WitnessLock, name: str) -> None:
+        self._lock = lock
+        self.name = name
+        self._cond = threading.Condition(lock._inner)
+
+    # lock surface ----------------------------------------------------
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._lock.release()
+        return False
+
+    # condition surface -----------------------------------------------
+    def wait(self, timeout: float | None = None):
+        for other in _held():
+            if other is not self._lock:
+                _record_violation("cond_wait_under_lock", other,
+                                  f"waiting on {self.name}")
+        # the wait releases our lock; mirror that in the held-set
+        _note_released(self._lock)
+        depth, self._lock._depth.value = self._lock._depth.value, 0
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._lock._depth.value = depth
+            _note_acquired(self._lock)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        # re-implemented over self.wait so the foreign-lock check and
+        # held-set bookkeeping apply per wakeup
+        import time as _time
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = _time.monotonic() + timeout
+                waittime = endtime - _time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait(None)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+# -- blocking hooks (installed only while enabled) ----------------------
+
+def _wrap_blocking(module, attr: str, kind: str) -> bool:
+    orig = getattr(module, attr, None)
+    if orig is None:
+        return False
+
+    def wrapper(*a, **kw):
+        note_blocking(kind)
+        return orig(*a, **kw)
+
+    wrapper.__wrapped__ = orig
+    setattr(module, attr, wrapper)
+    _saved_hooks.append((module, attr, orig))
+    return True
+
+
+def _install_hooks() -> None:
+    _wrap_blocking(os, "fsync", "fsync")
+    try:
+        from ceph_tpu.utils import admin_socket
+        _wrap_blocking(admin_socket, "asok_command", "socket_send")
+    except Exception:
+        pass
+    try:
+        import jax
+        _wrap_blocking(jax, "block_until_ready", "device_barrier")
+        _wrap_blocking(jax, "device_get", "device_barrier")
+    except Exception:
+        pass
+
+
+def _remove_hooks() -> None:
+    while _saved_hooks:
+        module, attr, orig = _saved_hooks.pop()
+        setattr(module, attr, orig)
+
+
+# -- lifecycle ----------------------------------------------------------
+
+def enable() -> None:
+    """Turn the witness on process-wide. Locks constructed through the
+    ``make_*`` seams AFTER this point are witnessed; blocking hooks
+    (fsync / asok / device barriers) are patched in."""
+    global _ENABLED
+    if _ENABLED:
+        return
+    reset()
+    _ENABLED = True
+    _install_hooks()
+
+
+def disable() -> None:
+    global _ENABLED
+    if not _ENABLED:
+        return
+    _ENABLED = False
+    _remove_hooks()
+
+
+def reset() -> None:
+    """Drop all recorded state (test isolation)."""
+    global _locks_created, _edges_dropped
+    with _state_lock:
+        _edges.clear()
+        _distinct_self_edges.clear()
+        _violations.clear()
+        _locks_created = 0
+        _edges_dropped = 0
+
+
+# -- reporting ----------------------------------------------------------
+
+def _find_cycles(adj: dict[str, set[str]]) -> list[list[str]]:
+    """Strongly connected components of size > 1 (iterative Tarjan)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    for root in adj:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in adj:
+                    continue
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+    return sccs
+
+
+def report() -> dict:
+    """The witness's findings as a JSON-ready dict. Cycle keys and
+    violation keys are stable across runs (no line numbers, no
+    counts) so ``analysis/baseline.json`` can acknowledge them."""
+    with _state_lock:
+        edges = {k: dict(v, stacks=dict(v["stacks"]))
+                 for k, v in _edges.items()}
+        self_edges = set(_distinct_self_edges)
+        violations = [dict(v) for v in _violations.values()]
+        dropped = _edges_dropped
+    adj: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set())
+        adj.setdefault(b, set())
+        if a != b:
+            adj[a].add(b)
+    cycles = []
+    for scc in _find_cycles(adj):
+        scc_set = set(scc)
+        cyc_edges = [
+            {"from": a, "to": b, "count": ent["count"],
+             "stacks": list(ent["stacks"].values())}
+            for (a, b), ent in sorted(edges.items())
+            if a in scc_set and b in scc_set and a != b]
+        cycles.append({"key": "cycle:" + "|".join(scc),
+                       "locks": scc, "edges": cyc_edges})
+    # same-name nesting across DISTINCT instances: the two-PG-locks
+    # class — a potential self-deadlock unless instance order is fixed
+    for (a, b) in sorted(self_edges):
+        ent = edges[(a, b)]
+        cycles.append({"key": f"cycle:{a}|{a}",
+                       "locks": [a, a],
+                       "edges": [{"from": a, "to": b,
+                                  "count": ent["count"],
+                                  "stacks": list(
+                                      ent["stacks"].values())}]})
+    return {
+        "enabled": _ENABLED,
+        "edges": len(edges),
+        "edges_dropped": dropped,
+        "cycles": cycles,
+        "blocking": sorted(violations, key=lambda v: v["key"]),
+    }
+
+
+def save_report(path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(report(), f, indent=1, sort_keys=True)
+    return path
+
+
+def unacknowledged(rep: dict | None = None,
+                   baseline: dict | None = None) -> list[dict]:
+    """Findings not acknowledged by the ``witness`` section of
+    analysis/baseline.json — what the tier-1 gate asserts is empty."""
+    if rep is None:
+        rep = report()
+    if baseline is None:
+        from ceph_tpu.analysis import linters
+        baseline = linters.load_baseline()
+    acked = {e["key"] for e in baseline.get("witness", ())}
+    out = [c for c in rep["cycles"] if c["key"] not in acked]
+    out += [v for v in rep["blocking"] if v["key"] not in acked]
+    return out

@@ -22,6 +22,8 @@ reference's reqid-based dup detection in the pg log).
 from __future__ import annotations
 
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_lock
 import time
 
 from ceph_tpu.parallel import messages as M
@@ -83,7 +85,7 @@ class Objecter:
         #: stale state; the process gets a fresh instance by
         #: reconnecting (new RadosClient)
         self.fenced = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("objecter.state")
         self._next_tid = 1
         self._pending: dict[int, _Op] = {}
         self._stop = threading.Event()

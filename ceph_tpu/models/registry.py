@@ -158,9 +158,15 @@ class ErasureCodePluginRegistry:
         codec = plugin.factory(dict(profile))
         return codec
 
-    def preload(self, names: list[str], directory: str | None = None) -> None:
+    def preload(self, names: list[str] | None = None,
+                directory: str | None = None) -> None:
         """Preload plugins at daemon start (reference: config
-        osd_erasure_code_plugins, ErasureCodePlugin.cc:186-202)."""
+        osd_erasure_code_plugins, ErasureCodePlugin.cc:186-202).
+        ``names`` defaults to the ``osd_erasure_code_plugins``
+        option, whitespace-separated as in the reference."""
+        if names is None:
+            from ceph_tpu.utils.config import g_conf
+            names = g_conf()["osd_erasure_code_plugins"].split()
         for name in names:
             self.load(name, directory)
 

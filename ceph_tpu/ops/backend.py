@@ -78,6 +78,13 @@ def resolve(name: str = "auto"):
     _load_lazy()
     if name == "auto":
         forced = os.environ.get("CEPH_TPU_BACKEND")
+        if not forced:
+            # env beats config beats the auto ladder (the layered
+            # precedence the rest of g_conf follows)
+            from ceph_tpu.utils.config import g_conf
+            conf_backend = g_conf()["erasure_code_backend"]
+            if conf_backend != "auto":
+                forced = conf_backend
         if forced:
             name = forced
         else:

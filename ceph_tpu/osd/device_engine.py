@@ -100,6 +100,7 @@ from typing import Callable
 
 import numpy as np
 
+from ceph_tpu.analysis.lock_witness import make_condition, make_lock
 from ceph_tpu.osd import ec_util
 from ceph_tpu.utils import faults as _faults
 from ceph_tpu.utils import profiler as _prof
@@ -141,7 +142,7 @@ class _ConcatStager:
     _MIN_CAP = 256 << 10
 
     def __init__(self) -> None:
-        self.lock = threading.Lock()
+        self.lock = make_lock("engine.stager")
         #: id(codec) -> {"buf", "used", "slots": [[off, len], ...]}
         self._by_codec: dict[int, dict] = {}
         self.stats = {"staged_bytes": 0, "relocated_bytes": 0}
@@ -217,7 +218,7 @@ class FlushGroup:
 
     def __init__(self, nkeys: int,
                  prev_group: "FlushGroup | None") -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("engine.flush_group")
         self._pending = max(1, nkeys)
         #: bucket -> (ship_fn, [items]); insertion-ordered
         self._deferred: dict = {}
@@ -393,7 +394,7 @@ class DeviceEncodeEngine:
         #: bulk-ingest batching lever).
         import collections
         self._inflight: collections.deque = collections.deque()
-        self._ifcv = threading.Condition()
+        self._ifcv = make_condition("engine.inflight")
         self._retiring = False        # retire thread mid-harvest
         self._retire_stop = False
         self._thread = threading.Thread(
@@ -1133,7 +1134,7 @@ class EngineHandle:
         _detach(self.engine, self._token)
 
 
-_shared_lock = threading.Lock()
+_shared_lock = make_lock("engine.shared_service")
 _shared_engine: DeviceEncodeEngine | None = None
 _attach_seq = 0
 

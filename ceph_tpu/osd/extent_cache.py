@@ -26,6 +26,8 @@ entry would make covers() feed stale content to every later RMW).
 from __future__ import annotations
 
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_lock
 from dataclasses import dataclass
 
 
@@ -101,7 +103,7 @@ class ExtentSnapshot:
 
 class ExtentCache:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("extent_cache.state")
         self._by_oid: dict[str, list[_Entry]] = {}
 
     def snapshot(self, oid: str) -> ExtentSnapshot:

@@ -28,6 +28,8 @@ import json
 import threading
 import time
 
+from ceph_tpu.analysis.lock_witness import (
+    make_condition, make_lock, make_rlock)
 from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.ec_backend import ECBackend
 from ceph_tpu.osd.pg import (
@@ -136,7 +138,7 @@ class _WQShard:
     __slots__ = ("cv", "queues", "credits")
 
     def __init__(self, weights: dict[str, int]) -> None:
-        self.cv = threading.Condition()
+        self.cv = make_condition("osd.wq_shard")
         self.queues = {cls: collections.deque() for cls in weights}
         self.credits = dict(weights)
 
@@ -161,7 +163,7 @@ class _MClockShard:
     __slots__ = ("cv", "queues", "clocks", "profile")
 
     def __init__(self, profile: dict[str, tuple]) -> None:
-        self.cv = threading.Condition()
+        self.cv = make_condition("osd.wq_shard")
         self.profile = dict(profile)
         #: cls -> deque of (r_tag, p_tag, l_tag, fn)
         self.queues = {cls: collections.deque() for cls in profile}
@@ -380,31 +382,31 @@ class OSD:
         self.monc.add_map_callback(self._on_map)
         self.addr = ""
         self.osdmap: OSDMap | None = None
-        self._map_lock = threading.RLock()
+        self._map_lock = make_rlock("osd.map")
         self.pgs: dict[tuple[int, int], PG] = {}
-        self._pgs_lock = threading.RLock()
-        self._pgscan_lock = threading.Lock()
+        self._pgs_lock = make_rlock("osd.pgs")
+        self._pgscan_lock = make_lock("osd.pgscan")
         self._pgscan_pending = False
         self._pgscan_running = False
         # recovery reservation (recovery_reservation.rst role): bound
         # concurrent recovery rounds per OSD so a mass failure does
         # not fan out unbounded push traffic; throttled PGs are
         # requeued by the heartbeat tick's _kick_recovery
-        self._recovery_res_lock = threading.Lock()
+        self._recovery_res_lock = make_lock("osd.recovery_res")
         self._recovery_active = 0
         self._backends: dict[int, PGBackend] = {}
         # device stripe-batch engine (SURVEY.md §7.5): created lazily
         # by the first EC pool whose profile selects a device backend
         self._device_engine = None
-        self._device_engine_lock = threading.Lock()
+        self._device_engine_lock = make_lock("osd.device_engine")
         self._tid = 0
-        self._tid_lock = threading.Lock()
+        self._tid_lock = make_lock("osd.tid")
         self._inflight: dict[int, InflightWrite] = {}
         self._waits: dict[int, SubOpWait] = {}
-        self._sub_lock = threading.Lock()
+        self._sub_lock = make_lock("osd.sub")
         # watch/notify state (Watch.h role; in-memory, see
         # _handle_watch): (pool, oid) -> {(peer, cookie): conn}
-        self._watch_lock = threading.Lock()
+        self._watch_lock = make_lock("osd.watch")
         self._watchers: dict[tuple, dict] = {}
         self._notifies: dict[int, dict] = {}
         self.op_wq = ShardedOpWQ(f"osd.{osd_id}",
@@ -427,7 +429,7 @@ class OSD:
         # pg log reqids). Bounded LRU.
         self._op_cache: dict[tuple[str, int], M.MOSDOpReply] = {}
         self._op_cache_order: list[tuple[str, int]] = []
-        self._op_cache_lock = threading.Lock()
+        self._op_cache_lock = make_lock("osd.op_cache")
         # messages carrying a newer map epoch than ours park here
         # until the mon's push catches us up
         # (require_same_or_newer_map role, src/osd/OSD.cc): executing
@@ -435,7 +437,7 @@ class OSD:
         # client's epoch already carries. Entries are
         # (epoch, wq_key, redispatch_fn).
         self._map_waiters: list[tuple[int, tuple, object]] = []
-        self._map_waiters_lock = threading.Lock()
+        self._map_waiters_lock = make_lock("osd.map_waiters")
         self._hb_last_rx: dict[int, float] = {}
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -586,7 +588,8 @@ class OSD:
                     f"osd.{self.whoami} failed to boot (no mon "
                     "acknowledged)")
             time.sleep(0.2)
-        self.osdmap = self.monc.osdmap
+        with self._map_lock:
+            self.osdmap = self.monc.osdmap
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name=f"osd.{self.whoami}-hb",
             daemon=True)
@@ -1136,7 +1139,7 @@ class OSD:
         for i in range(n):
             groups.setdefault((msg.pools[i], int(msg.pss[i])),
                               []).append(i)
-        state = {"left": len(groups), "lock": threading.Lock(),
+        state = {"left": len(groups), "lock": make_lock("osd.logsync_group"),
                  "stages": [""] * n}
         rx_t = getattr(msg, "_rx_t", None)
         for pgid, idxs in groups.items():

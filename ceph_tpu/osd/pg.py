@@ -24,6 +24,8 @@ a replicated PG uses ``pg_{pool}.{ps}`` on every replica.
 from __future__ import annotations
 
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_rlock
 from dataclasses import dataclass
 
 from ceph_tpu.store.object_store import (
@@ -160,7 +162,7 @@ class PG:
     def __init__(self, pool: int, ps: int) -> None:
         self.pool = pool
         self.ps = ps
-        self.lock = threading.RLock()
+        self.lock = make_rlock("pg.lock")
         self.state = self.CREATED
         self.acting: list[int] = []
         self.epoch = 0

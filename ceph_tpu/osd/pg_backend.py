@@ -23,6 +23,8 @@ by recovery — the reference's on-peering-change accounting).
 from __future__ import annotations
 
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_condition, make_lock
 import time
 from typing import Callable, Protocol
 
@@ -66,8 +68,8 @@ class SubOpWait:
     """Blocking rendezvous for a read fan-out."""
 
     def __init__(self, expected: set[int]) -> None:
-        self.lock = threading.Lock()
-        self.cond = threading.Condition(self.lock)
+        self.lock = make_lock("pg_backend.subop_wait")
+        self.cond = make_condition("pg_backend.subop_wait", self.lock)
         self.pending: set[int] = set(expected)
         self.results: dict[int, object] = {}
 
@@ -111,7 +113,7 @@ class InflightWrite:
         #: MECSubWriteReply merge under the op (None = untimed)
         self.clock = None
         self.created_at = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = make_lock("pg_backend.inflight_write")
         self._done = False
 
     def complete(self, pos: int) -> bool:

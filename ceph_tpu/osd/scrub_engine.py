@@ -48,6 +48,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_lock
 import time
 
 import numpy as np
@@ -160,7 +162,7 @@ class DeepScrubEngine:
 
     def __init__(self, osd) -> None:
         self.osd = osd
-        self._lock = threading.Lock()
+        self._lock = make_lock("scrub.state")
         self.stats = {
             "pgs": 0, "objects": 0, "batches": 0,
             "bytes_verified": 0, "mismatch_stripes": 0,

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_lock
 import time
 from ceph_tpu.utils.workerpool import DaemonPool
 
@@ -67,11 +69,11 @@ class TierService:
     def __init__(self, osd) -> None:
         self.osd = osd
         self._objecter = None
-        self._obj_lock = threading.Lock()
+        self._obj_lock = make_lock("tiering.objects")
         self._wq = DaemonPool(
             max_workers=2, thread_name_prefix=f"osd{osd.whoami}-tier")
         self._agent_running = False
-        self._agent_lock = threading.Lock()
+        self._agent_lock = make_lock("tiering.agent")
 
     def shutdown(self) -> None:
         self._wq.shutdown(wait=False)

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable
 
+from ceph_tpu.analysis.lock_witness import make_lock
 from ceph_tpu.parallel.messages import Message, decode_message
 from ceph_tpu.utils import checksum
 from ceph_tpu.utils import faults as _faults
@@ -57,7 +58,7 @@ _PREAUTH_TYPES = (38, 39, 63, 64)
 #: framing, no receiver read-loop pass — one cross-thread handoff
 #: per message leg instead of three.
 _local_peers: dict[str, "Messenger"] = {}
-_local_lock = threading.Lock()
+_local_lock = make_lock("msgr.local_peers")
 
 
 def _loopback_enabled() -> bool:

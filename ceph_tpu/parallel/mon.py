@@ -49,6 +49,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_rlock
 import time
 
 from ceph_tpu.models import registry as ec_registry
@@ -98,7 +100,7 @@ class Monitor:
         self.monmap: dict[int, str] = {}      # rank -> addr (peers+self)
         self._peer_seen: dict[int, tuple[float, int]] = {}
         self._leader_rank = 0
-        self._lock = threading.RLock()
+        self._lock = make_rlock("mon.state")
         self._subscribers: dict[str, Connection] = {}  # peer entity -> conn
         self._last_beacon: dict[int, float] = {}
         # osd -> (monotonic ts, [pg stat dicts]) — pgmap soft state
@@ -185,15 +187,20 @@ class Monitor:
         """Bind the messenger before the monmap is known (multi-mon
         bootstrap: all mons bind, then everyone learns every addr)."""
         if not self.addr:
-            self.addr = self.msgr.bind(host, port)
+            addr = self.msgr.bind(host, port)
+            with self._lock:
+                self.addr = addr
         return self.addr
 
     def set_monmap(self, monmap: dict[int, str], rank: int) -> None:
-        self.monmap = dict(monmap)
-        self.rank = rank
-        # multi-mon: leadership is EARNED through an election round
-        # (propose/defer/victory), never assumed at boot
-        self._leader_rank = rank if len(self.monmap) <= 1 else -1
+        # under the lock: the messenger is already dispatching once
+        # prebind bound it, so a peer's HB can race the map install
+        with self._lock:
+            self.monmap = dict(monmap)
+            self.rank = rank
+            # multi-mon: leadership is EARNED through an election
+            # round (propose/defer/victory), never assumed at boot
+            self._leader_rank = rank if len(self.monmap) <= 1 else -1
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         # the grace countdown for every replayed-up osd starts now: a
@@ -228,8 +235,9 @@ class Monitor:
             "election/quorum state (Elector role)")
         self.asok.start()
         self.prebind(host, port)
-        if not self.monmap:
-            self.monmap = {self.rank: self.addr}
+        with self._lock:
+            if not self.monmap:
+                self.monmap = {self.rank: self.addr}
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name=f"mon.{self.name}-tick",
             daemon=True)

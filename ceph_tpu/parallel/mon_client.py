@@ -9,6 +9,8 @@ here first:  ``if self.monc.handle_message(msg, conn): return``.
 from __future__ import annotations
 
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_condition, make_lock
 import time
 from typing import Callable
 
@@ -29,11 +31,11 @@ class MonClient:
         self.mon_addrs = [a for a in mon_addr.split(",") if a]
         self._target = 0
         self.osdmap: OSDMap | None = None
-        self._map_cond = threading.Condition()
+        self._map_cond = make_condition("monc.map")
         self._map_callbacks: list[Callable[[OSDMap], None]] = []
         self._next_tid = 1
         self._pending: dict[int, list] = {}   # tid -> [event, reply]
-        self._lock = threading.Lock()
+        self._lock = make_lock("monc.state")
         self._last_rx = time.monotonic()
         self._last_probe = 0.0
 

@@ -181,7 +181,8 @@ class CephFS:
             # trailing hole positions (alloc'd, never appended) have
             # nothing to replay: the floor may cover them
             applied = max(applied, end)
-        self._mds_pos = applied
+        with self._mds_lock:
+            self._mds_pos = applied
         self.journal.commit(self.client_id, applied)
 
     def _mds_event(self, op: str, req: tuple[str, int] | None = None,

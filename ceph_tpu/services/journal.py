@@ -68,8 +68,8 @@ class Journaler:
         self._seq_seeded = False
         #: legacy-format probe runs at most once per instance
         self._legacy_checked = False
-        import threading
-        self._append_lock = threading.Lock()
+        from ceph_tpu.analysis.lock_witness import make_lock
+        self._append_lock = make_lock("journal.append")
 
     # -- header --------------------------------------------------------
     def _load(self) -> dict:

@@ -277,7 +277,7 @@ class MDSDaemon:
                         fs.replayed_requests.items():
                     self._completed[(client, tid)] = \
                         self._replay_reply(fs, rec)
-            self.fs = fs
+                self.fs = fs
             log(1, f"mds.{self.name}: active, epoch {self.epoch}")
         except Exception as exc:
             log(0, f"mds.{self.name}: activation failed: {exc!r}")

@@ -275,7 +275,8 @@ class CephFSMount:
                         self._acquiring.pop(ino, None)
                         revoked = ino in self._revoked_midair
                         self._revoked_midair.discard(ino)
-            self._cap_ttl = float(out.get("ttl", self._cap_ttl))
+            with self._lock:
+                self._cap_ttl = float(out.get("ttl", self._cap_ttl))
             if revoked:
                 # grant crossed a recall on the wire: give it back and
                 # re-acquire (the conflicting holder goes first)

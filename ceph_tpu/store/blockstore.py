@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_lock
 from typing import Callable
 
 from ceph_tpu.store import object_store as osr
@@ -197,7 +199,7 @@ class BlockStore(ObjectStore):
         # queue_transaction calls (different PGs on different op-shard
         # threads) must not interleave size-probe and write — they
         # would record the same offset for different blobs
-        self._append_lock = threading.Lock()
+        self._append_lock = make_lock("blockstore.append")
 
     # -- lifecycle ----------------------------------------------------
     def mount(self) -> None:
@@ -207,16 +209,21 @@ class BlockStore(ObjectStore):
         # append+crc32c, lock-free pread) with a pure-python fallback;
         # both write the same raw-blob format
         from ceph_tpu.store.native_io import NativeDataFile
-        self._data = NativeDataFile.open(data_path) \
-            or _PyDataFile(data_path)
+        data = NativeDataFile.open(data_path) or _PyDataFile(data_path)
+        with self._append_lock:
+            self._data = data
 
     def umount(self) -> None:
         if self._db:
             self._db.close()
             self._db = None
-        if self._data:
-            self._data.close()
-            self._data = None
+        # serialize against in-flight appends (the engine-shutdown
+        # race class): an appender either finishes before the close
+        # or sees _data already gone
+        with self._append_lock:
+            data, self._data = self._data, None
+        if data:
+            data.close()
 
     # -- metadata helpers ---------------------------------------------
     @staticmethod

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from ceph_tpu.analysis.lock_witness import make_rlock
 from typing import Callable
 
 from ceph_tpu.store import object_store as osr
@@ -43,17 +45,19 @@ class KStore(ObjectStore):
     def __init__(self, path: str | None = None) -> None:
         self._path = path
         self._db = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("kstore.db")
         self._eio: set[tuple[str, str]] = set()
 
     # -- lifecycle ----------------------------------------------------
     def mount(self) -> None:
-        self._db = FileDB(self._path) if self._path else MemDB()
+        with self._lock:
+            self._db = FileDB(self._path) if self._path else MemDB()
 
     def umount(self) -> None:
-        if self._db is not None:
-            self._db.close()
-            self._db = None
+        with self._lock:
+            if self._db is not None:
+                self._db.close()
+                self._db = None
 
     # -- key helpers --------------------------------------------------
     @staticmethod

@@ -104,8 +104,15 @@ class Checksummer:
     of the first bad block, or -1 if all match.
     """
 
-    def __init__(self, algorithm: str = "crc32c",
-                 csum_block_size: int = 4096) -> None:
+    def __init__(self, algorithm: str | None = None,
+                 csum_block_size: int | None = None) -> None:
+        if algorithm is None or csum_block_size is None:
+            # defaults come from the bluestore_csum_* options
+            from ceph_tpu.utils.config import g_conf
+            if algorithm is None:
+                algorithm = g_conf()["bluestore_csum_type"]
+            if csum_block_size is None:
+                csum_block_size = g_conf()["bluestore_csum_block_size"]
         if algorithm not in ALGORITHMS:
             raise ValueError(f"unknown checksum algorithm {algorithm!r}")
         self.algorithm = algorithm

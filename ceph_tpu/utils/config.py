@@ -213,8 +213,6 @@ for _o in [
     Option("erasure_code_backend", str, "auto", "advanced",
            "kernel backend: auto|pallas|jax|native|numpy",
            enum_allowed=("auto", "pallas", "jax", "native", "numpy")),
-    Option("ec_stripe_batch_flush_bytes", int, 8 << 20, "advanced",
-           "device stripe-batch accumulator flush threshold"),
     Option("bluestore_csum_type", str, "crc32c", "advanced",
            "checksum algorithm (BlueStore.h:1925)",
            enum_allowed=("none", "crc32c", "crc32c_16", "crc32c_8",

@@ -219,9 +219,10 @@ class StackProfiler:
         if thread is not None:
             thread.join(timeout=2)
         self.perf.set_gauge("profile_running", 0)
-        if self._t_start:
-            self._elapsed += time.monotonic() - self._t_start
-            self._t_start = 0.0
+        with self._lock:
+            if self._t_start:
+                self._elapsed += time.monotonic() - self._t_start
+                self._t_start = 0.0
         return thread is not None
 
     def reset(self) -> None:
@@ -347,14 +348,15 @@ class StackProfiler:
     _published = (0, 0, 0)
 
     def _publish(self, samples: int, cpu: int, dropped: int) -> None:
-        ps, pc, pd = self._published
+        with self._lock:
+            ps, pc, pd = self._published
+            self._published = (samples, cpu, dropped)
         if samples > ps:
             self.perf.inc("profile_samples", samples - ps)
         if cpu > pc:
             self.perf.inc("profile_cpu_samples", cpu - pc)
         if dropped > pd:
             self.perf.inc("profile_dropped_stacks", dropped - pd)
-        self._published = (samples, cpu, dropped)
 
     # -- views --------------------------------------------------------
     def elapsed(self) -> float:

@@ -23,6 +23,8 @@ from __future__ import annotations
 import queue
 import threading
 
+from ceph_tpu.analysis.lock_witness import make_lock
+
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("pool")
@@ -34,7 +36,7 @@ class DaemonPool:
         self._max = max_workers
         self._prefix = thread_name_prefix
         self._q: queue.Queue = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = make_lock("workerpool.state")
         self._threads: list[threading.Thread] = []
         self._idle = 0
         self._stop = False

@@ -23,3 +23,27 @@ if not os.environ.get("CEPH_TPU_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Lock-order witness (ISSUE 11): CEPH_TPU_LOCK_WITNESS=1 arms the
+# pylockdep for the WHOLE session — every make_lock/make_rlock/
+# make_condition site constructs a named, tracked proxy and the
+# acquisition-order graph + blocking-under-lock findings serialize to
+# a JSON report at teardown (CEPH_TPU_LOCK_WITNESS_REPORT, default
+# lock_witness_report.json in the cwd). Off (the default) the seams
+# return bare threading primitives — zero wrappers, zero cost; the
+# tier-1 gate tests in test_lock_witness.py enable it per-test
+# instead.
+from ceph_tpu.analysis import lock_witness as _lock_witness
+
+if _lock_witness.env_enabled():
+    _lock_witness.enable()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _lock_witness.env_enabled() and _lock_witness.enabled():
+        path = os.environ.get("CEPH_TPU_LOCK_WITNESS_REPORT",
+                              "lock_witness_report.json")
+        try:
+            _lock_witness.save_report(path)
+        except OSError:
+            pass

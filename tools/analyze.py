@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo-root shim for the static-analysis driver:
+
+    python tools/analyze.py [--json] [--no-baseline] [--update-baseline]
+
+Real implementation: ceph_tpu/tools/analyze.py (also runnable as
+``python -m ceph_tpu.analysis``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.tools.analyze import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
