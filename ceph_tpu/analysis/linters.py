@@ -438,18 +438,47 @@ _ARRAYISH_CALLS = ("jnp.asarray", "jnp.array", "jnp.zeros", "jnp.ones",
                    "jax.device_put", "jnp.arange")
 
 
+def _wrapped_callee_names(tree: ast.AST) -> set[str]:
+    """Function names handed to a mesh compile wrapper — traced
+    exactly like a decorated jit body, so the hygiene rules apply the
+    same (ISSUE 12): positional args of ``*shard_map(...)`` calls,
+    first args of ``jit(...)`` calls carrying in_/out_shardings, and
+    the ``global_fn=``/``shard_fn=`` kwargs of the
+    ``mesh_compile.compile_step`` seam."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _unparse(node.func)
+        if fname == "shard_map" or fname.endswith("shard_map"):
+            if node.args and isinstance(node.args[0], ast.Name):
+                out.add(node.args[0].id)
+        elif fname == "jit" or fname.endswith(".jit"):
+            if any(kw.arg in ("in_shardings", "out_shardings")
+                   for kw in node.keywords) and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                out.add(node.args[0].id)
+        elif fname.endswith("compile_step"):
+            for kw in node.keywords:
+                if kw.arg in ("global_fn", "shard_fn") and \
+                        isinstance(kw.value, ast.Name):
+                    out.add(kw.value.id)
+    return out
+
+
 def check_jit_hygiene(src: SourceFile) -> list[Finding]:
     if not any(src.rel.startswith(d + "/") or src.rel.startswith(d)
                for d in JIT_DIRS):
         return []
     findings: list[Finding] = []
+    wrapped = _wrapped_callee_names(src.tree)
 
     def visit(node: ast.AST, arrayish: dict[str, int]):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef,
                                   ast.AsyncFunctionDef)):
                 statics: set[str] = set()
-                is_jit = False
+                is_jit = child.name in wrapped
                 for dec in child.decorator_list:
                     j, s = _jit_static_argnames(dec)
                     if j:

@@ -65,6 +65,12 @@ _PAGE = """<!doctype html>
 <h3>deep scrub</h3>
 <table><tr><th>batches</th><th>bytes verified</th><th>mismatches</th>
 <th>repaired shards</th><th>host fallbacks</th></tr>{scrub_row}</table>
+<h3>pod-scale sharded serving</h3>
+<p>{mesh_summary}</p>
+<table><tr><th>mesh encode flushes</th><th>mesh decode flushes</th>
+<th>mesh scrub batches</th><th>placement flushes</th>
+<th>placement slots</th><th>pjit steps</th><th>shard_map steps</th>
+</tr>{mesh_row}</table>
 <h3>data plane</h3>
 <p>ops {dp_ops} · p50 {dp_p50} ms · p99 {dp_p99} ms · coverage
 {dp_coverage}% · msgr send errors {dp_send_errors} · dropped
@@ -118,6 +124,10 @@ class Module(MgrModule):
             from ceph_tpu.utils.device_telemetry import telemetry
             return 200, "application/json", json.dumps(
                 self._scrub_counters(telemetry())).encode()
+        if path == "/api/mesh":
+            from ceph_tpu.utils.device_telemetry import telemetry
+            return 200, "application/json", json.dumps(
+                self._mesh_payload(telemetry())).encode()
         if path == "/api/profile":
             from ceph_tpu.utils.profiler import profiler
             prof = profiler()
@@ -177,6 +187,33 @@ class Module(MgrModule):
                                 "device.compile_cache_misses")}
             except Exception:
                 pass
+        return out
+
+    @staticmethod
+    def _mesh_payload(tel) -> dict:
+        """The pod-scale serving panel (ISSUE 12): how much of the
+        data path rode the mesh, which compile seam built the steps,
+        and the active placement map's slot->devices contract."""
+        counters = tel.snapshot()["counters"]
+        out = {key: counters.get(key, 0)
+               for key in ("mesh_flushes", "mesh_decode_flushes",
+                           "mesh_scrub_batches", "placement_flushes",
+                           "placement_slots", "mesh_compile_pjit",
+                           "mesh_compile_shard_map",
+                           "mesh_dispatches")}
+        try:
+            from ceph_tpu.parallel import mesh as mesh_mod
+            from ceph_tpu.parallel import placement
+            mesh = mesh_mod.get_default_mesh()
+            out["mesh"] = {k: int(v) for k, v in
+                           dict(mesh.shape).items()} if mesh else None
+            pmap = placement.active_map()
+            out["placement"] = {
+                "slots": pmap.n_slots,
+                "devices_per_slot": int(pmap.mesh.shape["shard"]),
+            } if pmap else None
+        except Exception:
+            out["mesh"] = out["placement"] = None
         return out
 
     @staticmethod
@@ -252,6 +289,17 @@ class Module(MgrModule):
             f"<td>{sum(overlap[7:])}</td>"
             f"<td>{counters.get('mesh_dispatches', 0)}</td>"
             f"<td>{counters.get('compile_cache_hits', 0)}</td></tr>")
+        mp = self._mesh_payload(tel)
+        mesh_row = (
+            f"<tr><td>{mp['mesh_flushes']}</td>"
+            f"<td>{mp['mesh_decode_flushes']}</td>"
+            f"<td>{mp['mesh_scrub_batches']}</td>"
+            f"<td>{mp['placement_flushes']}</td>"
+            f"<td>{mp['placement_slots']}</td>"
+            f"<td>{mp['mesh_compile_pjit']}</td>"
+            f"<td>{mp['mesh_compile_shard_map']}</td></tr>")
+        mesh_summary = html.escape(
+            f"mesh {mp.get('mesh')} · placement {mp.get('placement')}")
         return _PAGE.format(
             health=html.escape(health),
             check_rows=check_rows,
@@ -269,6 +317,8 @@ class Module(MgrModule):
             device_rows=device_rows,
             scrub_row=scrub_row,
             pipeline_row=pipeline_row,
+            mesh_row=mesh_row,
+            mesh_summary=mesh_summary,
             dp_ops=bd.get("ops", 0),
             dp_p50=bd.get("p50_ms", 0),
             dp_p99=bd.get("p99_ms", 0),

@@ -129,6 +129,43 @@ def bulk_ingest_enabled() -> bool:
     return os.environ.get("CEPH_TPU_BULK_INGEST", "1") != "0"
 
 
+def mesh_flush_threshold() -> int:
+    """The dense->mesh crossover in bytes: flushes at least this big
+    route through the default mesh's sharded steps. A real g_conf
+    Option since ISSUE 12 (registry-drift-lint covered; the future
+    ROADMAP-item-5 tuner adjusts it), env override preserved for A/B
+    runs."""
+    import os
+    env = os.environ.get("CEPH_TPU_MESH_FLUSH_BYTES")
+    if env is not None:
+        return int(env)
+    try:
+        from ceph_tpu.utils.config import g_conf
+        return int(g_conf()["mesh_flush_bytes"])
+    except Exception:
+        return 1 << 20
+
+
+def _placement_slot(key) -> int:
+    """The PG-placement slot for one staged op's dispatch key (the
+    pgid, possibly wrapped by a shared-engine attachment): stripe-row
+    coordinate of the default mesh, 0 when no multi-slot map is
+    active. Computed at STAGE time so the staging buffers key by
+    (signature, slot) and each slot's bytes stay contiguous. Runs on
+    every staged op's producer thread: the no-mesh common case must
+    stay one attribute read, no map machinery."""
+    from ceph_tpu.parallel import mesh as mesh_mod
+    if mesh_mod.get_default_mesh() is None:
+        return 0
+    from ceph_tpu.parallel import placement as _placement
+    pmap = _placement.active_map()
+    if pmap is None or pmap.n_slots <= 1:
+        return 0
+    if isinstance(key, AttachedKey):
+        key = key[1]
+    return pmap.slot(key)
+
+
 class _ConcatStager:
     """Per-signature preallocated concat buffers, written at staging
     time (the zero-copy leg of ISSUE 9). ``append`` copies the op's
@@ -143,23 +180,27 @@ class _ConcatStager:
 
     def __init__(self) -> None:
         self.lock = make_lock("engine.stager")
-        #: id(codec) -> {"buf", "used", "slots": [[off, len], ...]}
-        self._by_codec: dict[int, dict] = {}
+        #: (id(codec), placement slot) -> {"buf", "used",
+        #: "slots": [[off, len], ...]} — keyed by signature AND slot
+        #: (ISSUE 12) so each placement slot's flush hands its owning
+        #: submesh one contiguous view
+        self._by_codec: dict[tuple, dict] = {}
         self.stats = {"staged_bytes": 0, "relocated_bytes": 0}
 
-    def _state(self, codec) -> dict:
-        st = self._by_codec.get(id(codec))
+    def _state(self, codec, pslot: int) -> dict:
+        st = self._by_codec.get((id(codec), pslot))
         if st is None:
-            st = self._by_codec[id(codec)] = {
+            st = self._by_codec[(id(codec), pslot)] = {
                 "buf": np.empty(self._MIN_CAP, dtype=np.uint8),
                 "used": 0, "slots": []}
         return st
 
-    def append_locked(self, codec, data: np.ndarray) -> None:
+    def append_locked(self, codec, pslot: int,
+                      data: np.ndarray) -> None:
         """Caller holds ``self.lock`` (the engine queue put rides the
-        same critical section so per-codec slot order == queue
+        same critical section so per-(codec, slot) order == queue
         order)."""
-        st = self._state(codec)
+        st = self._state(codec, pslot)
         need = st["used"] + data.nbytes
         if need > len(st["buf"]):
             cap = max(len(st["buf"]), self._MIN_CAP)
@@ -173,14 +214,15 @@ class _ConcatStager:
         st["used"] = need
         self.stats["staged_bytes"] += data.nbytes
 
-    def take(self, codec, count: int
+    def take(self, codec, pslot: int, count: int
              ) -> tuple[np.ndarray, list[np.ndarray]]:
-        """Detach the first ``count`` staged ops of this signature:
-        returns (contiguous batch view, per-op views). The tail (ops
-        staged after the engine decided to flush) moves to a fresh
-        buffer so its queued tokens stay valid."""
+        """Detach the first ``count`` staged ops of this
+        (signature, slot): returns (contiguous batch view, per-op
+        views). The tail (ops staged after the engine decided to
+        flush) moves to a fresh buffer so its queued tokens stay
+        valid."""
         with self.lock:
-            st = self._state(codec)
+            st = self._state(codec, pslot)
             slots = st["slots"][:count]
             tail = st["slots"][count:]
             buf = st["buf"]
@@ -342,8 +384,7 @@ class DeviceEncodeEngine:
         #: sharded encode step (when one is configured); smaller ones
         #: stay single-chip
         if mesh_flush_bytes is None:
-            mesh_flush_bytes = int(os.environ.get(
-                "CEPH_TPU_MESH_FLUSH_BYTES", 1 << 20))
+            mesh_flush_bytes = mesh_flush_threshold()
         self._mesh_flush_bytes = mesh_flush_bytes
         #: flushes SMALLER than this take the host matvec instead of
         #: a device launch (the fixed dispatch cost dominates tiny
@@ -371,6 +412,16 @@ class DeviceEncodeEngine:
                       # upload/compute/download overlapped) and how
                       # many flushes routed through the mesh
                       "max_inflight_depth": 0, "mesh_flushes": 0,
+                      # pod-scale sharded serving (ISSUE 12): decode
+                      # flushes that rode the mesh twin, and flushes
+                      # launched on a PG-placement slot submesh
+                      "mesh_decode_flushes": 0,
+                      "placement_flushes": 0,
+                      # slot -> flushes launched on that slot's
+                      # submesh: the observable placement decisions
+                      # (the loopback-vs-TCP fidelity check compares
+                      # these across wire paths)
+                      "per_slot_flushes": {},
                       # small flushes routed to the host matvec (the
                       # bulk-ingest bottom rung of the routing ladder)
                       "host_flushes": 0,
@@ -488,6 +539,10 @@ class DeviceEncodeEngine:
         # HBM ledger: bytes enter the staged bucket here and leave it
         # at launch (-> in-window) or on a launch fault (-> retired)
         _telemetry().note_hbm(staged_delta=data.nbytes)
+        # PG placement (ISSUE 12): the slot is part of the staging
+        # key, so each stripe row's bytes accumulate contiguously and
+        # flush onto their owning chips
+        pslot = _placement_slot(key)
         if self._stager is not None:
             # zero-copy staging: the payload lands in the signature's
             # concat buffer NOW, on this producer thread; the engine
@@ -495,12 +550,12 @@ class DeviceEncodeEngine:
             # stager lock so per-signature slot order == queue order.
             ref = _StagedRef(data.nbytes)
             with self._stager.lock:
-                self._stager.append_locked(codec, data)
+                self._stager.append_locked(codec, pslot, data)
                 self._q.put(("enc", key, codec, sinfo, ref, cont,
-                             span, clock, _time.monotonic()))
+                             span, clock, _time.monotonic(), pslot))
             return
         self._q.put(("enc", key, codec, sinfo, data, cont, span,
-                     clock, _time.monotonic()))
+                     clock, _time.monotonic(), pslot))
 
     def stage_barrier(self, key, fn: Callable[[], None]) -> None:
         """Queue an ordering barrier: ``fn`` dispatches on ``key``
@@ -520,7 +575,8 @@ class DeviceEncodeEngine:
         import time as _time
         _telemetry().note_hbm(staged_delta=_shards_nbytes(shards))
         self._q.put(("dec", key, codec, sinfo, shards, want, cont,
-                     span, clock, _time.monotonic()))
+                     span, clock, _time.monotonic(),
+                     _placement_slot(key)))
 
     def decode_sync(self, key, codec, sinfo: ec_util.StripeInfo,
                     shards: dict[int, np.ndarray], want: list[int],
@@ -621,8 +677,11 @@ class DeviceEncodeEngine:
             if item is None:
                 self._drain_inflight()
                 return
-            pending: dict[int, tuple] = {}   # id(codec) -> state
-            # (id(codec), present, want) -> (codec, sinfo, items)
+            # (id(codec), placement slot) -> (codec, sinfo, slot,
+            # items) — slot-keyed (ISSUE 12) so each stripe row's
+            # flush launches on its owning submesh
+            pending: dict[tuple, tuple] = {}
+            # (id(codec), present, want, slot) -> state
             dec_pending: dict[tuple, tuple] = {}
             nbytes = 0
             while True:
@@ -633,9 +692,9 @@ class DeviceEncodeEngine:
                     return
                 if item[0] == "enc":
                     (_, key, codec, sinfo, data, cont, span, clock,
-                     ts) = item
-                    _, _, items = pending.setdefault(
-                        id(codec), (codec, sinfo, []))
+                     ts, pslot) = item
+                    _, _, _, items = pending.setdefault(
+                        (id(codec), pslot), (codec, sinfo, pslot, []))
                     items.append((key, data, cont, span, clock, ts))
                     nbytes += data.nbytes
                     if nbytes >= self._flush_bytes:
@@ -648,11 +707,12 @@ class DeviceEncodeEngine:
                         pending, dec_pending, nbytes = {}, {}, 0
                 elif item[0] == "dec":
                     (_, key, codec, sinfo, shards, want, cont, span,
-                     clock, ts) = item
+                     clock, ts, pslot) = item
                     sig = (id(codec),
-                           tuple(sorted(shards)), tuple(sorted(want)))
-                    _, _, items = dec_pending.setdefault(
-                        sig, (codec, sinfo, []))
+                           tuple(sorted(shards)), tuple(sorted(want)),
+                           pslot)
+                    _, _, _, items = dec_pending.setdefault(
+                        sig, (codec, sinfo, pslot, []))
                     items.append((key, shards, want, cont, span,
                                   clock, ts))
                     nbytes += sum(np.asarray(v).nbytes
@@ -728,15 +788,17 @@ class DeviceEncodeEngine:
     def _flush_inner(self, pending: dict) -> None:
         import time as _time
         from ceph_tpu.parallel import mesh as mesh_mod
+        from ceph_tpu.parallel import placement as _placement
         t0 = _time.perf_counter()
         drained = 0.0                 # retirement self-accounts
-        for codec, sinfo, items in pending.values():
+        for codec, sinfo, pslot, items in pending.values():
             if self._stager is not None:
                 # zero-copy staging: the payloads are already
                 # contiguous in the signature's concat buffer —
                 # detach the consumed prefix as one view (no
                 # flush-time np.concatenate on this thread)
-                batch, views = self._stager.take(codec, len(items))
+                batch, views = self._stager.take(codec, pslot,
+                                                 len(items))
                 nbytes = batch.nbytes
             else:
                 batch = None
@@ -751,6 +813,16 @@ class DeviceEncodeEngine:
             mesh = mesh_mod.get_default_mesh()
             if mesh is not None and nbytes < self._mesh_flush_bytes:
                 mesh = None
+            placed = False
+            if mesh is not None:
+                # PG placement (ISSUE 12): this slot's flush launches
+                # on its owning stripe row — a (1, shard) submesh —
+                # so flushes of different slots occupy DISJOINT chips
+                # and genuinely overlap inside the in-flight window
+                pmap = _placement.active_map()
+                if pmap is not None and pmap.n_slots > 1:
+                    mesh = pmap.submesh(pslot)
+                    placed = True
             # SMALL flushes route to the HOST matvec (bulk ingest):
             # below host_flush_bytes the fixed device dispatch cost
             # (jit call + transfer round trip, ~5 ms measured on the
@@ -775,6 +847,12 @@ class DeviceEncodeEngine:
                     batcher.set_preconcat(batch)
             if mesh is not None:
                 self.stats["mesh_flushes"] += 1
+                _telemetry().note_mesh_flush("encode")
+                if placed:
+                    self.stats["placement_flushes"] += 1
+                    per_slot = self.stats["per_slot_flushes"]
+                    per_slot[pslot] = per_slot.get(pslot, 0) + 1
+                    _telemetry().note_placement_flush()
             # window backpressure BEFORE the launch: with window=1
             # batch N+1 launches only after N fully retired (the old
             # serial engine); deeper windows overlap N+1's staging/
@@ -990,8 +1068,10 @@ class DeviceEncodeEngine:
 
     def _flush_decodes_inner(self, dec_pending: dict) -> None:
         import time as _time
-        for (_cid, present, want), (codec, sinfo, items) in \
-                dec_pending.items():
+        from ceph_tpu.parallel import mesh as mesh_mod
+        from ceph_tpu.parallel import placement as _placement
+        for (_cid, present, want, pslot), \
+                (codec, sinfo, _slot, items) in dec_pending.items():
             launched = _time.monotonic()
             t0 = _time.perf_counter()
             tel = _telemetry()
@@ -1017,7 +1097,34 @@ class DeviceEncodeEngine:
                     for c in present}
                 lens = [len(np.asarray(shards[present[0]]))
                         for _k, shards, _w, _c, _s, _cl, _t in items]
-                out = ec_util.decode(sinfo, codec, merged, list(want))
+                # multi-chip decode (ISSUE 12): a big-enough
+                # signature batch rides the mesh twin of the decode
+                # matmul on this PG slot's submesh — the same
+                # dense->mesh crossover as encode; any mesh fault
+                # falls back to the single-chip/host route below
+                out = None
+                mesh = mesh_mod.get_default_mesh()
+                if mesh is not None and \
+                        staged >= self._mesh_flush_bytes and \
+                        ec_util.device_decodable(codec):
+                    placed = False
+                    pmap = _placement.active_map()
+                    if pmap is not None and pmap.n_slots > 1:
+                        mesh = pmap.submesh(pslot)
+                        placed = True
+                    try:
+                        out = ec_util.flush_decode_mesh(
+                            mesh, sinfo, codec, merged, list(want))
+                        self.stats["mesh_decode_flushes"] += 1
+                        tel.note_mesh_flush("decode")
+                        if placed:
+                            self.stats["placement_flushes"] += 1
+                            tel.note_placement_flush()
+                    except Exception as exc:
+                        self._note_fused_fallback("mesh_decode", exc)
+                if out is None:
+                    out = ec_util.decode(sinfo, codec, merged,
+                                         list(want))
             except Exception as exc:
                 log(0, f"device decode batch of {len(items)} ops "
                     f"(sig {present}->{want}) failed: {exc!r}")

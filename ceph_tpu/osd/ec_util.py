@@ -297,10 +297,14 @@ class StripeBatcher:
         self._preconcat = None
         if self.mesh is not None and _device_fusable(self.codec):
             try:
-                results = _flush_mesh(self.mesh, self.sinfo,
-                                      self.codec, ops, bufs,
-                                      batch=preconcat)
-                return lambda: results
+                # ASYNC since ISSUE 12: the mesh step launches here
+                # (jax async dispatch) and the returned finalize
+                # downloads — mesh flushes ride the engine's in-flight
+                # window like fused single-chip flushes, so flushes on
+                # DIFFERENT placement slots (disjoint devices) overlap
+                return _flush_mesh(self.mesh, self.sinfo,
+                                   self.codec, ops, bufs,
+                                   batch=preconcat)
             except Exception as exc:
                 self._note_fallback("mesh", exc)
                 # single-device fallback below
@@ -467,14 +471,48 @@ _mesh_step_cache: dict = {}
 _MESH_STEP_CACHE_MAX = 8
 
 
+def _mesh_step(mesh, key, build):
+    """One slot of the bounded per-mesh step cache: each compiled
+    step pins its mesh + executables, so growth across mesh
+    reconfigurations (or placement submeshes) stays bounded."""
+    if id(mesh) not in _mesh_step_cache and \
+            len(_mesh_step_cache) >= _MESH_STEP_CACHE_MAX:
+        _mesh_step_cache.clear()
+    per_mesh = _mesh_step_cache.setdefault(id(mesh), {})
+    step = per_mesh.get(key)
+    if step is None:
+        step = per_mesh[key] = build()
+    return step
+
+
+def _round_stripes(data: np.ndarray, n_stripe: int) -> np.ndarray:
+    """pow2-bucket the stripe count (bounds compiles) and round to
+    the stripe axis; zero stripes encode/decode to zero and slice
+    off."""
+    s = data.shape[0]
+    s_pad = _pow2_bucket(max(s, n_stripe), n_stripe)
+    if s_pad % n_stripe:
+        s_pad = -(-s_pad // n_stripe) * n_stripe
+    if s_pad != s:
+        pad = np.zeros((s_pad - s,) + data.shape[1:], dtype=np.uint8)
+        data = np.concatenate([data, pad])
+    return data
+
+
 def _flush_mesh(mesh, sinfo: StripeInfo, codec, ops, bufs,
                 batch=None):
     """Flush the batch through the MULTI-CHIP encode step: stripes
     shard over the mesh's ('stripe' x 'shard') axes, parity computes
     locally on every chip (position-wise math — zero communication),
-    and the integrity stat psums over ICI. Parity bytes are bit-exact
-    vs the host codec (place=False keeps them home; the TCP messenger
-    owns shard placement in this architecture)."""
+    and the integrity stat reduces over ICI. Parity bytes are
+    bit-exact vs the host codec (place=False keeps them home; the TCP
+    messenger owns shard placement in this architecture).
+
+    Returns ``finalize() -> results`` (ISSUE 12): the step call here
+    only LAUNCHES the sharded program (jax async dispatch); finalize
+    downloads — the engine parks mesh flushes on its in-flight window
+    so different placement slots' flushes overlap on their disjoint
+    devices."""
     from ceph_tpu.parallel import sharded_codec
     cs, sw = sinfo.chunk_size, sinfo.stripe_width
     k = codec.get_data_chunk_count()
@@ -483,38 +521,79 @@ def _flush_mesh(mesh, sinfo: StripeInfo, codec, ops, bufs,
     if batch is None:
         batch = np.concatenate(bufs)
     s = len(batch) // sw
-    data = batch.reshape(s, k, cs)
-    n_stripe = mesh.shape["stripe"]
-    # pow2-bucket the stripe count (bounds compiles) and round to the
-    # stripe axis; zero stripes encode to zero parity and slice off
-    s_pad = _pow2_bucket(max(s, n_stripe), n_stripe)
-    if s_pad % n_stripe:
-        s_pad = -(-s_pad // n_stripe) * n_stripe
-    if s_pad != s:
-        data = np.concatenate(
-            [data, np.zeros((s_pad - s, k, cs), dtype=np.uint8)])
-    if id(mesh) not in _mesh_step_cache and \
-            len(_mesh_step_cache) >= _MESH_STEP_CACHE_MAX:
-        _mesh_step_cache.clear()
-    per_mesh = _mesh_step_cache.setdefault(id(mesh), {})
-    key = codec.coding_matrix.tobytes()
-    step = per_mesh.get(key)
-    if step is None:
-        step = per_mesh[key] = sharded_codec.make_encode_step(
+    data = _round_stripes(batch.reshape(s, k, cs),
+                          mesh.shape["stripe"])
+    step = _mesh_step(
+        mesh, codec.coding_matrix.tobytes(),
+        lambda: sharded_codec.make_encode_step(
             mesh, np.asarray(codec.coding_matrix, dtype=np.uint8),
-            place=False)
-    chunks, _csum = step(sharded_codec.shard_stripe_batch(mesh, data))
-    chunks = np.asarray(chunks)[:s]            # [s, k+m, cs]
-    streams = {i: np.ascontiguousarray(
-        chunks[:, i, :]).reshape(-1) for i in range(n_chunks)}
-    results = []
-    off = 0
-    for op_id, ln in zip(ops, lens):
-        results.append((op_id,
-                        {i: streams[i][off:off + ln]
-                         for i in range(n_chunks)}, None))
-        off += ln
-    return results
+            place=False))
+    chunks_dev, _csum = step(
+        sharded_codec.shard_stripe_batch(mesh, data))
+
+    def finalize():
+        chunks = np.asarray(chunks_dev)[:s]    # [s, k+m, cs]
+        streams = {i: np.ascontiguousarray(
+            chunks[:, i, :]).reshape(-1) for i in range(n_chunks)}
+        results = []
+        off = 0
+        for op_id, ln in zip(ops, lens):
+            results.append((op_id,
+                            {i: streams[i][off:off + ln]
+                             for i in range(n_chunks)}, None))
+            off += ln
+        return results
+
+    return finalize
+
+
+def flush_decode_mesh(mesh, sinfo: StripeInfo, codec,
+                      shards: dict[int, np.ndarray],
+                      want: list[int]) -> dict[int, np.ndarray]:
+    """Mesh twin of :func:`decode` (ISSUE 12): the engine's
+    signature-batched reconstruct as ONE sharded matmul — stripes
+    over the ``stripe`` axis, chunk bytes over ``shard``, the decode
+    matrix keyed by the erasure signature exactly like the single-chip
+    route. Present rows return verbatim; bit-exactness vs the host
+    corpus is gated in tier-1. Raises on shapes the mesh cannot take
+    (callers fall back to the single-chip/host path)."""
+    from ceph_tpu.ops import gf256
+    from ceph_tpu.parallel import sharded_codec
+    cs = sinfo.chunk_size
+    present = sorted(shards)
+    missing = [i for i in want if i not in shards]
+    out = {i: np.asarray(shards[i], dtype=np.uint8)
+           for i in want if i in shards}
+    if not missing:
+        return out
+    n_shard = mesh.shape["shard"]
+    if cs % n_shard:
+        raise ErasureCodeError(
+            f"chunk size {cs} does not shard over {n_shard} devices")
+    k = codec.get_data_chunk_count()
+    if len(present) < k:
+        raise ErasureCodeError(
+            f"{len(present)} survivors < k={k}")
+    # any k survivors reconstruct the same bytes (MDS); take the
+    # first k deterministically so the decode matrix signature is
+    # stable per erasure signature
+    present = present[:k]
+    some = np.asarray(next(iter(shards.values())))
+    s = len(some) // cs
+    x = np.stack([np.asarray(shards[i], dtype=np.uint8).reshape(s, cs)
+                  for i in present], axis=1)       # [s, k, cs]
+    x = _round_stripes(x, mesh.shape["stripe"])
+    mat = np.asarray(codec.coding_matrix, dtype=np.uint8)
+    step = _mesh_step(
+        mesh, ("dec", mat.tobytes(), tuple(present), tuple(missing)),
+        lambda: sharded_codec.make_degraded_read_step(
+            mesh, gf256.systematic_generator(mat),
+            list(present), list(missing), gather=False))
+    rec = step(sharded_codec.shard_stripe_batch(mesh, x))
+    rec = np.asarray(rec)[:s]                      # [s, w, cs]
+    for j, c in enumerate(missing):
+        out[c] = np.ascontiguousarray(rec[:, j, :]).reshape(-1)
+    return out
 
 
 def _flush_device_fused_async(sinfo: StripeInfo, codec, ops, bufs,

@@ -118,23 +118,62 @@ def verify_fn(mat: np.ndarray, k: int, l_b: int, nobj_b: int):
     return fn
 
 
-def verify_batch(mat: np.ndarray, k: int, batch: np.ndarray
-                 ) -> tuple[np.ndarray, np.ndarray]:
+#: id(mesh) -> {(matrix bytes, k): verify step} — mesh twins of the
+#: fused verify program (bounded like ec_util's step cache)
+_mesh_verify_cache: dict = {}
+
+
+def _mesh_verify_step(mesh, mat: np.ndarray, k: int):
+    from ceph_tpu.parallel import sharded_codec
+    if id(mesh) not in _mesh_verify_cache and \
+            len(_mesh_verify_cache) >= _VERIFY_CACHE_MAX:
+        _mesh_verify_cache.clear()
+    per_mesh = _mesh_verify_cache.setdefault(id(mesh), {})
+    key = (mat.tobytes(), k)
+    step = per_mesh.get(key)
+    if step is None:
+        step = per_mesh[key] = sharded_codec.make_verify_step(
+            mesh, mat, k)
+    return step
+
+
+def verify_batch(mat: np.ndarray, k: int, batch: np.ndarray,
+                 mesh=None) -> tuple[np.ndarray, np.ndarray]:
     """Host entry: verify a [nobj, k+m, L] uint8 batch (L already a
     pow2 bucket, shards FRONT-padded — free under both GF and crc
     linearity). Pads the object axis to its pow2 bucket, runs the
     fused program through the telemetry compile accountant, and
-    returns (mismatch [nobj, m] bool, crc_lin [nobj, k+m] uint32)."""
+    returns (mismatch [nobj, m] bool, crc_lin [nobj, k+m] uint32).
+
+    With ``mesh`` (ISSUE 12), the batch spreads over every mesh chip
+    through the sharded verify twin (parallel/sharded_codec.
+    make_verify_step) — objects partition over the device axis, each
+    chip re-encodes + crcs its objects locally, and only the verdict
+    rows come home. Bit-exact vs the single-chip program (zero-padded
+    objects verify clean on both). Raises on a mesh fault — callers
+    fall back to the single-chip path."""
     mat = np.asarray(mat, dtype=np.uint8)
     nobj, n, l_b = batch.shape
     m = mat.shape[0]
     assert n == k + m, (n, k, m)
     nobj_b = _pow2(max(nobj, 1), 1)
+    if mesh is not None:
+        # the object axis shards over EVERY chip: round the pow2
+        # bucket up to a device-count multiple
+        n_dev = int(np.prod(list(mesh.shape.values())))
+        if nobj_b % n_dev:
+            nobj_b = -(-nobj_b // n_dev) * n_dev
     if nobj_b != nobj:
         # zero objects: zero parity re-encodes to zero (no mismatch)
         padded = np.zeros((nobj_b, n, l_b), dtype=np.uint8)
         padded[:nobj] = batch
         batch = padded
+    if mesh is not None:
+        from ceph_tpu.parallel import sharded_codec
+        step = _mesh_verify_step(mesh, mat, k)
+        mism, lin = step(sharded_codec.shard_object_batch(mesh, batch))
+        _telemetry().note_mesh_scrub_batch()
+        return (np.asarray(mism)[:nobj], np.asarray(lin)[:nobj])
     fn = verify_fn(mat, k, l_b, nobj_b)
     sig = f"scrub_verify[{m}x{k}]L{l_b}n{nobj_b}"
     mism, lin = _telemetry().timed_call(sig, fn, batch)
@@ -325,9 +364,25 @@ class DeepScrubEngine:
         t0 = time.perf_counter()
         mism = lin = None
         engine = self.osd.device_engine()
+        # multi-chip deep scrub (ISSUE 12): a big-enough batch
+        # spreads over the PG's placement-slot submesh (or the whole
+        # default mesh) through the sharded verify twin; a mesh fault
+        # falls back to the single-chip program, never to a skipped
+        # verification
+        mesh = self._pick_mesh(pg, batch.nbytes)
         try:
-            mism, lin = engine.run_sync(
-                lambda: verify_batch(mat, k, batch))
+            if mesh is not None:
+                try:
+                    mism, lin = engine.run_sync(
+                        lambda: verify_batch(mat, k, batch,
+                                             mesh=mesh))
+                except Exception as exc:
+                    log(1, f"{pg}: mesh scrub verify fell back to "
+                        f"single-chip ({exc!r})")
+                    _telemetry().note_fused_fallback()
+            if mism is None:
+                mism, lin = engine.run_sync(
+                    lambda: verify_batch(mat, k, batch))
         except Exception as exc:
             log(0, f"{pg}: deep-scrub device verify failed ({exc!r});"
                 " host oracle fallback for this batch")
@@ -380,6 +435,24 @@ class DeepScrubEngine:
                 continue
             self._host_verdict(pg, oid, obs, victims)
         return nbytes
+
+    @staticmethod
+    def _pick_mesh(pg, nbytes: int):
+        """The mesh this PG's verify batch should ride: None below
+        the dense->mesh crossover or with no default mesh; the PG's
+        placement-slot submesh when a multi-slot map is active (scrub
+        lands on the same chips that own the PG's encode/decode
+        work); else the whole default mesh."""
+        from ceph_tpu.osd import device_engine as de
+        from ceph_tpu.parallel import mesh as mesh_mod
+        from ceph_tpu.parallel import placement as _placement
+        mesh = mesh_mod.get_default_mesh()
+        if mesh is None or nbytes < de.mesh_flush_threshold():
+            return None
+        pmap = _placement.active_map()
+        if pmap is not None and pmap.n_slots > 1:
+            return pmap.submesh(pmap.slot(pg.pgid))
+        return mesh
 
     def _exclusion_test(self, be, obs: dict) -> int | None:
         """Single-corruption localization with no crc evidence: the

@@ -17,11 +17,16 @@ from jax.sharding import Mesh
 
 
 def make_mesh(n_devices: int | None = None, stripe: int | None = None,
-              shard: int | None = None, devices=None) -> Mesh:
+              shard: int | None = None, devices=None,
+              chunk_count: int | None = None) -> Mesh:
     """Build a 2D ('stripe', 'shard') mesh over the first n devices.
 
-    Default factorization: shard axis as large as possible up to 4 (matching
-    small EC groups), remainder to stripe.
+    Default factorization: shard axis as large as possible up to the
+    codec profile's chunk count when one is known (``chunk_count`` =
+    k+m — the flagship k=8,m=3 profile wants all 8+ chips on the
+    byte/shard axis, which a hardcoded cap of 4 denied it), else up
+    to 4 (the historical small-EC-group default), remainder to
+    stripe. The factorization choice is pinned in test_parallel.
     """
     if devices is None:
         devices = jax.devices()
@@ -29,7 +34,8 @@ def make_mesh(n_devices: int | None = None, stripe: int | None = None,
         n_devices = len(devices)
     devices = devices[:n_devices]
     if stripe is None or shard is None:
-        shard = shard or _largest_factor_leq(n_devices, 4)
+        cap = chunk_count if chunk_count else 4
+        shard = shard or _largest_factor_leq(n_devices, cap)
         stripe = stripe or n_devices // shard
     assert stripe * shard == n_devices, (stripe, shard, n_devices)
     arr = np.array(devices).reshape(stripe, shard)

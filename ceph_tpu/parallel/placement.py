@@ -1,0 +1,131 @@
+"""PG→chip placement (ISSUE 12): each stripe row of the pod owns a
+shard of the PG space.
+
+The reference maps PGs to OSDs with CRUSH — a deterministic,
+stable-under-remap hash of the pgid (crush/CrushWrapper mapping rules).
+This module is the same idea one level down: a pod's mesh has
+``stripe`` rows of chips, and a CRUSH-stable hash of the pgid picks
+the row (the *placement slot*) whose chips own that PG's device work.
+The device engine keys its staging buffers by (signature, slot) and
+launches each slot's flushes onto the slot's submesh, so
+
+- a PG's encode/decode/scrub work always lands on the same chips
+  (cache/HBM locality, deterministic across daemon restarts — the
+  stability contract the MiniCluster scenario pins);
+- different slots' flushes ride DISJOINT devices, so the engine's
+  in-flight window genuinely overlaps them (engine-window × mesh
+  interplay) instead of serializing on one device queue.
+
+The map is a pure function of (pgid, mesh shape): nothing is stored,
+nothing rebalances — exactly as stable as the hash. ``all-flash-array``
+cluster studies (PAPERS.md, arxiv 1906.08602) are the motivation:
+EC clusters live or die on how coding work spreads over the array.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+
+from jax.sharding import Mesh
+
+from ceph_tpu.analysis.lock_witness import make_lock
+
+
+def stable_hash(key) -> int:
+    """CRUSH-stable 32-bit hash of ``str(key)``: a pure function,
+    identical across processes, restarts, and python hash seeds (the
+    rjenkins role — crc32 here; the point is stability, not
+    avalanche quality)."""
+    return zlib.crc32(str(key).encode("utf-8")) & 0xFFFFFFFF
+
+
+class PlacementMap:
+    """pgid -> stripe-row placement over one mesh. Slots are the
+    mesh's ``stripe`` coordinates; a slot's submesh is that row of
+    chips as a (1, shard) mesh (reusing the parent's axis names so
+    every sharded-codec step runs on it unchanged)."""
+
+    def __init__(self, mesh: Mesh) -> None:
+        self.mesh = mesh
+        self.n_slots = int(mesh.shape["stripe"])
+        self._lock = make_lock("placement.submesh")
+        self._submeshes: dict[int, Mesh] = {}
+
+    def slot(self, pgid) -> int:
+        return stable_hash(pgid) % self.n_slots
+
+    def submesh(self, slot: int) -> Mesh:
+        """The slot's stripe row as a standalone (1, shard) mesh.
+        Cached: step caches key by mesh identity, so the same slot
+        must always hand back the same Mesh object."""
+        slot %= self.n_slots
+        with self._lock:
+            sm = self._submeshes.get(slot)
+            if sm is None:
+                arr = self.mesh.devices[slot:slot + 1, :]
+                sm = self._submeshes[slot] = Mesh(
+                    arr, axis_names=self.mesh.axis_names)
+            return sm
+
+    def owners(self, pgid) -> list:
+        """The devices owning this PG's device work."""
+        return list(self.mesh.devices[self.slot(pgid), :])
+
+    def table(self, pgids) -> dict:
+        """The placement-map contract, dumpable: pgid -> slot +
+        owning device ids (the dashboard panel / asok view)."""
+        return {str(p): {"slot": self.slot(p),
+                         "devices": [str(d) for d in self.owners(p)]}
+                for p in pgids}
+
+
+def enabled() -> bool:
+    """The placement on/off switch: env override beats the declared
+    Option (registry-covered, tunable by the ROADMAP-item-5 tuner)."""
+    env = os.environ.get("CEPH_TPU_MESH_PLACEMENT")
+    if env is not None:
+        return env != "0"
+    try:
+        from ceph_tpu.utils.config import g_conf
+        return bool(g_conf()["mesh_placement"])
+    except Exception:
+        return True
+
+
+_lock = make_lock("placement.active")
+_active: tuple[int, PlacementMap] | None = None
+_noted_slots: int | None = None
+
+
+def active_map() -> PlacementMap | None:
+    """The placement map over the process default mesh
+    (parallel/mesh.py), or None when no mesh is configured or
+    placement is switched off. Rebuilt when the default mesh changes;
+    the ``placement_slots`` gauge tracks the active slot count.
+    Called per staged op, so the steady state is two dict reads."""
+    global _active
+    from ceph_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.get_default_mesh()
+    if mesh is None or not enabled():
+        _note_slots(0)
+        return None
+    with _lock:
+        if _active is None or _active[0] != id(mesh):
+            _active = (id(mesh), PlacementMap(mesh))
+        pmap = _active[1]
+    _note_slots(pmap.n_slots)
+    return pmap
+
+
+def _note_slots(n: int) -> None:
+    global _noted_slots
+    if n == _noted_slots:
+        return
+    try:
+        from ceph_tpu.utils.device_telemetry import telemetry
+        telemetry().note_placement_slots(n)
+        _noted_slots = n
+    except Exception:
+        pass

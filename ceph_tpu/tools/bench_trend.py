@@ -31,10 +31,25 @@ import os
 import sys
 
 
+#: explicit per-metric direction pins: rows that must gate with a
+#: known direction the moment numbers exist, independent of the name
+#: heuristic below (ISSUE 12: the two multichip mesh rows — on a
+#: single-chip driver they land from the host-platform subprocess,
+#: and a silent direction flip would let a mesh regression pass)
+DIRECTIONS = {
+    "multichip_encode_GBps": "higher",
+    "multichip_decode_GBps": "higher",
+    "multichip_scaling": "higher",
+}
+
+
 def lower_is_better(metric: str) -> bool:
     """Latency-flavored metrics regress UP; everything this bench
     family emits otherwise (GBps / MBps / ops counts) regresses
-    DOWN."""
+    DOWN. Explicit DIRECTIONS pins win over the name heuristic."""
+    pin = DIRECTIONS.get(metric)
+    if pin is not None:
+        return pin == "lower"
     return metric.endswith("_ms") or "_p99" in metric \
         or "_p50" in metric or "latency" in metric
 

@@ -95,6 +95,41 @@ def _profile_section(prof, top_n: int = 10) -> dict:
     }
 
 
+#: stages whose device work can route through the mesh — the rows the
+#: table's ``mesh`` column annotates with the measured mesh share, so
+#: multi-chip runs attribute the same stages as single-chip ones
+_MESH_STAGES = ("engine_stage_wait", "device_window_wait",
+                "device_finalize")
+
+
+def _mesh_section() -> dict:
+    """The multi-chip share of this run's device work (ISSUE 12):
+    how many engine flushes rode the mesh / a placement slot, read
+    from the device telemetry counters. ``encode_share`` /
+    ``decode_share`` are the fractions the ``mesh`` column prints."""
+    try:
+        import jax
+        from ceph_tpu.utils.device_telemetry import telemetry
+        c = telemetry().perf.dump()
+        enc = c.get("mesh_flushes", 0)
+        dec = c.get("mesh_decode_flushes", 0)
+        # total encode flushes = the occupancy histogram's
+        # observation count (one hinc per retired flush)
+        occ = c.get("encode_batch_ops") or []
+        flushes = sum(occ) if isinstance(occ, list) else 0
+        return {
+            "n_devices": len(jax.devices()),
+            "mesh_flushes": enc,
+            "mesh_decode_flushes": dec,
+            "mesh_scrub_batches": c.get("mesh_scrub_batches", 0),
+            "placement_flushes": c.get("placement_flushes", 0),
+            "placement_slots": c.get("placement_slots", 0),
+            "encode_share": round(enc / flushes, 3) if flushes else 0.0,
+        }
+    except Exception:
+        return {}
+
+
 def run_report(seconds: float, n_osds: int, obj_size: int,
                threads: int, k: int, m: int, backend: str,
                args) -> dict:
@@ -135,6 +170,10 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
         "subops": breakdown.get("subops", {}),
         "profile": cluster.get("profile"),
         "backend": cluster.get("backend"),
+        # ISSUE 12: the multi-chip share of this run's device work —
+        # a mesh run attributes the SAME stages; this section (and
+        # the table's mesh column) says how much of them rode it
+        "mesh": _mesh_section(),
     }
     if prof is not None:
         report["profiler"] = _profile_section(prof)
@@ -155,11 +194,20 @@ def print_table(report: dict) -> None:
     print()
     prof = report.get("profiler") or {}
     hot = prof.get("hot_frames", {})
-    print(f"{'stage':<22}{'label':<26}{'mean_ms':>9}{'share':>8}")
-    print("-" * 65)
+    mesh = report.get("mesh") or {}
+    # the mesh column: device stages annotate the fraction of encode
+    # flushes that rode the mesh route ("-" for host-side stages) —
+    # a multi-chip run attributes the same stages, visibly
+    mesh_share = mesh.get("encode_share", 0.0)
+    mesh_mark = f"{100 * mesh_share:.0f}%" if mesh_share else "-"
+    print(f"{'stage':<22}{'label':<26}{'mean_ms':>9}{'share':>8}"
+          f"{'mesh':>7}")
+    print("-" * 72)
     for stage, ent in report["stages"].items():
+        col = mesh_mark if stage in _MESH_STAGES else "-"
         print(f"{stage:<22}{_LABELS.get(stage, ''):<26}"
-              f"{ent['mean_ms']:>9.3f}{ent['share_pct']:>7.1f}%")
+              f"{ent['mean_ms']:>9.3f}{ent['share_pct']:>7.1f}%"
+              f"{col:>7}")
         # --profile: the hot frames sampled while THIS stage owned
         # the thread, so each row bottoms out in function names
         for f in hot.get(stage, []):

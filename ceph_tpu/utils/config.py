@@ -413,6 +413,24 @@ for _o in [
            "HBM_PRESSURE raises when the device engine's live buffer "
            "bytes (staged + in-window) reach this level (0 disables)",
            min=0),
+    Option("mesh_flush_bytes", int, 1 << 20, "advanced",
+           "engine flushes at least this big route through the "
+           "default mesh's sharded encode/decode steps (the "
+           "dense->mesh crossover, BASELINE.md 'Pod-scale sharded "
+           "serving'; env CEPH_TPU_MESH_FLUSH_BYTES overrides — a "
+           "registry-covered knob the ROADMAP-item-5 tuner can "
+           "adjust)", min=0),
+    Option("mesh_placement", bool, True, "advanced",
+           "PG->chip placement: key engine staging by (signature, "
+           "placement slot) and land each slot's flushes on its "
+           "owning stripe row of the mesh (parallel/placement.py; "
+           "env CEPH_TPU_MESH_PLACEMENT overrides)"),
+    Option("mesh_compile_mode", str, "auto", "advanced",
+           "mesh-step compile seam: auto prefers jax.jit with "
+           "in_shardings/out_shardings (pjit) and falls back to the "
+           "shard_map shim; pjit/shard_map force one route for A/B "
+           "runs (env CEPH_TPU_MESH_COMPILE_MODE overrides)",
+           enum_allowed=("auto", "pjit", "shard_map")),
     Option("profiler_hz", float, 50.0, "advanced",
            "stack-sampling profiler rate while running "
            "(profile start)", min=0.1, max=1000.0),

@@ -120,6 +120,31 @@ class DeviceTelemetry:
                              "clay linearized-transform LRU builds")
         perf.add_u64_counter("mesh_dispatches",
                              "multi-chip sharded-codec step calls")
+        # pod-scale sharded serving (ISSUE 12): how much of the data
+        # path actually rode the mesh, and through which compile seam
+        perf.add_u64_counter("mesh_flushes",
+                             "engine encode flushes routed through "
+                             "the sharded mesh step")
+        perf.add_u64_counter("mesh_decode_flushes",
+                             "signature-batched decode flushes "
+                             "(degraded reads / recovery) routed "
+                             "through the mesh twin")
+        perf.add_u64_counter("mesh_scrub_batches",
+                             "deep-scrub verify launches routed "
+                             "through the mesh twin")
+        perf.add_u64_counter("placement_flushes",
+                             "flushes launched on a PG-placement "
+                             "slot's submesh (disjoint chips per "
+                             "slot; overlapped in the engine window)")
+        perf.add_gauge("placement_slots",
+                       "slots in the active PG->chip placement map "
+                       "(0 = no map: single-chip or placement off)")
+        perf.add_u64_counter("mesh_compile_pjit",
+                             "mesh steps compiled through the "
+                             "jit+in_shardings (pjit) seam")
+        perf.add_u64_counter("mesh_compile_shard_map",
+                             "mesh steps compiled through the "
+                             "shard_map fallback shim")
         # pipelined engine (osd/device_engine.py): launch-window
         # accounting — depth proves batches overlap, overlap-pct is
         # the share of a batch's device lifetime hidden behind other
@@ -349,6 +374,27 @@ class DeviceTelemetry:
     def note_mesh_dispatch(self) -> None:
         self.perf.inc("mesh_dispatches")
 
+    # -- pod-scale sharded serving (ISSUE 12) -------------------------
+    def note_mesh_flush(self, kind: str) -> None:
+        """One engine flush routed through the mesh: ``kind`` is
+        "encode" or "decode" (the two data-path twins)."""
+        self.perf.inc("mesh_flushes" if kind == "encode"
+                      else "mesh_decode_flushes")
+
+    def note_mesh_scrub_batch(self) -> None:
+        self.perf.inc("mesh_scrub_batches")
+
+    def note_placement_flush(self) -> None:
+        self.perf.inc("placement_flushes")
+
+    def note_placement_slots(self, n: int) -> None:
+        self.perf.set_gauge("placement_slots", n)
+
+    def note_mesh_compile(self, path: str) -> None:
+        """One mesh step built: which compile seam produced it."""
+        self.perf.inc("mesh_compile_pjit" if path == "pjit"
+                      else "mesh_compile_shard_map")
+
     def note_cost(self, signature: str, cost: dict) -> None:
         """One compiled cost analysis (ops/cost_model.analyze): the
         per-signature FLOPs/bytes table the dashboard and ``device
@@ -430,6 +476,9 @@ class DeviceTelemetry:
                     "bytes_decoded", "fused_fallbacks", "calibrations",
                     "calibrations_sparse_won", "lin_matvec_hits",
                     "lin_matvec_misses", "mesh_dispatches",
+                    "mesh_flushes", "mesh_decode_flushes",
+                    "mesh_scrub_batches", "placement_flushes",
+                    "mesh_compile_pjit", "mesh_compile_shard_map",
                     "scrub_batches",
                     "scrub_bytes_verified", "scrub_mismatch_stripes",
                     "scrub_repaired_shards", "scrub_host_fallbacks"):

@@ -109,3 +109,26 @@ def test_missing_rounds_tolerated(tmp_path):
     assert report["metrics"]["a_GBps"]["regressed"] is False
     assert "regressed" not in report["metrics"]["b_GBps"]
     assert report["rounds"][1]["metrics"] == 0
+
+
+def test_multichip_direction_pins(tmp_path):
+    """ISSUE 12: the two multichip mesh rows carry explicit DIRECTION
+    entries (higher is better) — a drop gates as a regression the
+    moment numbers exist, and the name heuristic cannot silently
+    reclassify them."""
+    for row in ("multichip_encode_GBps", "multichip_decode_GBps",
+                "multichip_scaling"):
+        assert bench_trend.DIRECTIONS[row] == "higher"
+        assert not bench_trend.lower_is_better(row)
+    files = [
+        _round_file(tmp_path, "BENCH_r01.json",
+                    {"multichip_encode_GBps": 10.0,
+                     "multichip_decode_GBps": 8.0}),
+        _round_file(tmp_path, "BENCH_r02.json",
+                    {"multichip_encode_GBps": 4.0,
+                     "multichip_decode_GBps": 8.1}),
+    ]
+    report = bench_trend.trend(files)
+    assert report["metrics"]["multichip_encode_GBps"]["regressed"]
+    assert "multichip_encode_GBps" in report["regressions"]
+    assert not report["metrics"]["multichip_decode_GBps"]["regressed"]

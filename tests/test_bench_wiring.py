@@ -245,9 +245,10 @@ def test_degraded_rows_emit_parseable_lines(capsys, monkeypatch):
 
 
 def test_multichip_metric_emits_parseable_line(capsys, monkeypatch):
-    """The round-9 acceptance gate: on >= 2 devices (the conftest's 8
-    virtual CPU devices here) bench's multichip row measures the real
-    sharded encode step and the emitted line parses with a positive
+    """The round-9 acceptance gate, ISSUE 12 edition: on >= 2
+    devices (the conftest's 8 virtual CPU devices here) bench's
+    multichip family measures the real sharded encode step AND its
+    decode sibling, and BOTH emitted lines parse with a positive
     GB/s value, n_devices, and a telemetry snapshot."""
     import time
 
@@ -257,6 +258,7 @@ def test_multichip_metric_emits_parseable_line(capsys, monkeypatch):
     # driver-scale budget; the deadline is re-anchored to NOW (the
     # module-level _T0 is the import time of the whole test session)
     monkeypatch.setitem(bench.BUDGETS, "multichip_encode", (2.0, 0.0))
+    monkeypatch.setitem(bench.BUDGETS, "multichip_decode", (2.0, 0.0))
     monkeypatch.setattr(bench, "_T0", time.perf_counter())
     monkeypatch.setattr(bench, "TOTAL_BUDGET", 60.0)
 
@@ -264,16 +266,22 @@ def test_multichip_metric_emits_parseable_line(capsys, monkeypatch):
     assert isinstance(contended, bool)
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.strip()]
-    rec = json.loads(lines[-1])
-    assert rec["metric"] == "multichip_encode_GBps"
-    assert "skipped" not in rec, rec
-    assert rec["n_devices"] >= 2
-    assert rec["value"] > 0
-    assert rec["unit"] == "GB/s"
-    assert isinstance(rec["telemetry"], dict)
-    # the mesh step dispatched through the accounted entry
-    assert rec["telemetry"].get("mesh_dispatches", 0) >= 1
-    # the warmup compile is ledger-accounted under the bench label
+    recs = {json.loads(ln)["metric"]: json.loads(ln)
+            for ln in lines}
+    for row in ("multichip_encode_GBps", "multichip_decode_GBps"):
+        rec = recs[row]
+        assert "skipped" not in rec and "error" not in rec, rec
+        assert rec["n_devices"] >= 2
+        assert rec["value"] > 0
+        assert rec["unit"] == "GB/s"
+        assert rec["compile_path"] in ("pjit", "shard_map")
+        assert isinstance(rec["telemetry"], dict)
+    # the mesh steps dispatched through the accounted entry
+    assert recs["multichip_decode_GBps"]["telemetry"].get(
+        "mesh_dispatches", 0) >= 2
+    # the warmup compiles are ledger-accounted under the bench labels
     from ceph_tpu.utils.device_telemetry import telemetry
     assert telemetry().compile_count("bench[multichip_encode]") >= 1
+    assert telemetry().compile_count("bench[multichip_decode]") >= 1
     bench._RESULTS.pop("multichip_encode_GBps", None)
+    bench._RESULTS.pop("multichip_decode_GBps", None)

@@ -188,6 +188,46 @@ def test_fault_and_degraded_counters_covered_by_lint():
         collection().remove("osd.schema_lint")
 
 
+def test_mesh_and_placement_counters_covered_by_lint():
+    """ISSUE 12: the pod-scale serving counters — mesh route shares,
+    placement flushes/slots, and the compile-seam split — are
+    registered on the device logger (so the generic exporter lints
+    above cover them) and reach both exporters."""
+    _ensure_registries()
+    from ceph_tpu.utils import device_telemetry
+    from ceph_tpu.utils.device_telemetry import telemetry
+    keys = {"mesh_flushes", "mesh_decode_flushes",
+            "mesh_scrub_batches", "placement_flushes",
+            "placement_slots", "mesh_compile_pjit",
+            "mesh_compile_shard_map"}
+    assert keys <= set(telemetry().perf.dump())
+    text = prometheus.render_text()
+    for key in sorted(keys):
+        assert f"ceph_tpu_{key}" in text, key
+    # asok side: the device dump carries them
+
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    device_telemetry.register_asok(asok)
+    payload = asok.commands["device perf dump"]({})
+    assert keys <= set(payload["counters"])
+    # ...and the bench metric-line brief surfaces the mesh shares
+    # once they fire (snapshot_brief drops zero counters)
+    telemetry().note_mesh_flush("encode")
+    telemetry().note_mesh_flush("decode")
+    telemetry().note_mesh_scrub_batch()
+    telemetry().note_placement_flush()
+    brief = telemetry().snapshot_brief()
+    assert {"mesh_flushes", "mesh_decode_flushes",
+            "mesh_scrub_batches", "placement_flushes"} <= set(brief)
+
+
 def test_trace_and_autopsy_counters_covered_by_lint():
     """ISSUE 10: the tail sampler's trace_* counters and the autopsy
     registry are registered (so the generic lints above cover them)

@@ -126,10 +126,15 @@ def test_bench_budget_sum_bounded():
     assert "scrub_verify" in bench.BUDGETS
     tb, eb = bench.BUDGETS["scrub_verify"]
     assert 0 < tb and tb + eb <= 100, (tb, eb)
-    # the round-9 mesh row is budgeted like every other metric
-    assert "multichip_encode" in bench.BUDGETS
-    tb, eb = bench.BUDGETS["multichip_encode"]
-    assert 0 < tb and tb + eb <= 100, (tb, eb)
+    # the round-9 mesh row is budgeted like every other metric, and
+    # ISSUE 12's decode sibling rides the same identity: TOTAL_BUDGET
+    # came down 425 -> 390 to absorb the extra warmup reservation its
+    # BUDGETS entry adds (the single-chip subprocess that lands both
+    # rows is bounded by these same budgets, so no structural term)
+    for key in ("multichip_encode", "multichip_decode"):
+        assert key in bench.BUDGETS, key
+        tb, eb = bench.BUDGETS[key]
+        assert 0 < tb and tb + eb <= 100, (key, tb, eb)
     # ISSUE 8: the two degraded-mode rows have their own budgets and
     # the global deadline identity absorbed them (TOTAL_BUDGET came
     # DOWN so the fully-cold worst case still clears 870s with the

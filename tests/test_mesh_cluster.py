@@ -1,0 +1,177 @@
+"""ISSUE 12 acceptance: engine-on-mesh serving as the DEFAULT data
+path on a multi-device host.
+
+The conftest forces 8 host-platform CPU devices, so these scenarios
+run the real pod topology in tier-1: a process default mesh, the
+dense->mesh crossover forced low, and a MiniCluster whose EC pool
+runs the device engine. Pinned:
+
+- write burst THROUGH the mesh route (mesh_flushes > 0) with
+  PG->chip placement engaged — the slots observed at the engine are
+  exactly the slots of the PGs written, and every acked write reads
+  back bit-exact;
+- batched decode-on-read THROUGH the mesh twin while an OSD is down
+  (mesh_decode_flushes > 0), bit-exact;
+- deep scrub THROUGH the mesh verify twin (mesh_scrub_batches > 0),
+  clean verdicts on a clean PG;
+- the placement map is STABLE across an OSD kill/revive (the
+  restart-stability contract), and zero acked writes are lost across
+  the whole fault cycle;
+- loopback vs TCP wire paths make IDENTICAL placement decisions and
+  produce identical per-op stage shapes (the fidelity bar every
+  in-process shortcut must clear).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.parallel import mesh as mesh_mod
+from ceph_tpu.parallel import placement
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.utils.device_telemetry import telemetry
+
+OBJ = 64 * 1024
+
+
+@pytest.fixture
+def mesh_env(monkeypatch):
+    import jax
+    assert len(jax.devices()) >= 8, "conftest provides 8 devices"
+    # every engine flush (and scrub batch) is mesh-eligible
+    monkeypatch.setenv("CEPH_TPU_MESH_FLUSH_BYTES", "1")
+    mesh = mesh_mod.make_mesh(8)          # (stripe=2, shard=4)
+    mesh_mod.set_default_mesh(mesh)
+    yield mesh
+    mesh_mod.set_default_mesh(None)
+
+
+def _engine_stats(cluster) -> dict:
+    """Union of the (shared) engine stats across live OSDs."""
+    stats: dict = {}
+    for osd in cluster.osds.values():
+        if osd._device_engine is not None:
+            s = osd._device_engine.stats
+            stats[id(s)] = s
+    out = {"mesh_flushes": 0, "mesh_decode_flushes": 0,
+           "placement_flushes": 0, "slots": set()}
+    for s in stats.values():
+        out["mesh_flushes"] += s["mesh_flushes"]
+        out["mesh_decode_flushes"] += s["mesh_decode_flushes"]
+        out["placement_flushes"] += s["placement_flushes"]
+        out["slots"] |= set(s["per_slot_flushes"])
+    return out
+
+
+def _pool_pgids(cluster, pool_name: str, oids) -> dict:
+    """oid -> pgid for the written objects."""
+    osdmap = cluster.mon.osdmap
+    pool_id = osdmap.pool_by_name[pool_name]
+    return {oid: (pool_id, osdmap.object_to_pg(pool_id, oid))
+            for oid in oids}
+
+
+def test_engine_on_mesh_cluster_scenario(mesh_env):
+    """The headline tier-1 scenario: write burst + degraded read +
+    deep scrub, ALL through the mesh route, zero lost acked writes,
+    placement stable across an OSD restart."""
+    rng = np.random.default_rng(31)
+    payloads = {f"pod{i}": rng.integers(0, 256, OBJ,
+                                        dtype=np.uint8).tobytes()
+                for i in range(16)}
+    with MiniCluster(n_osds=4) as cluster:
+        rados = cluster.client()
+        cluster.create_ec_pool("pod", k=2, m=1, pg_num=16,
+                               backend="jax")
+        io = rados.open_ioctx("pod")
+        io.op_timeout = 120.0
+        for oid, data in payloads.items():
+            io.write_full(oid, data)
+
+        # the mesh route IS the data path: flushes rode the sharded
+        # step, placement-keyed, and the slots observed at the engine
+        # are exactly the slots of the PGs written
+        stats = _engine_stats(cluster)
+        assert stats["mesh_flushes"] > 0, stats
+        assert stats["placement_flushes"] > 0, stats
+        pgids = _pool_pgids(cluster, "pod", payloads)
+        pmap = placement.active_map()
+        assert pmap is not None and pmap.n_slots == 2
+        want_slots = {pmap.slot(p) for p in pgids.values()}
+        assert stats["slots"] == want_slots, (stats, want_slots)
+
+        # healthy read-back: bit-exact
+        for oid, data in payloads.items():
+            assert io.read(oid) == data, oid
+
+        # deep scrub through the mesh verify twin: clean PG
+        before = telemetry().perf.dump().get("mesh_scrub_batches", 0)
+        res = cluster.scrub_pool("pod", deep=True)
+        assert res.get("deep") and res["inconsistent"] == {}, res
+        assert telemetry().perf.dump().get(
+            "mesh_scrub_batches", 0) > before, \
+            "deep scrub never rode the mesh twin"
+
+        # degraded serving: one OSD down, every read reconstructs
+        # bit-exactly through the batched mesh decode route
+        victim = max(cluster.osds)
+        slots_before = {str(p): pmap.slot(p) for p in pgids.values()}
+        cluster.kill_osd(victim)
+        for oid, data in payloads.items():
+            assert io.read(oid) == data, f"degraded read {oid}"
+        stats = _engine_stats(cluster)
+        assert stats["mesh_decode_flushes"] > 0, stats
+
+        # placement decisions survive the restart (the stability
+        # contract: a pure function of pgid and mesh shape) and no
+        # acked write was lost across the whole fault cycle
+        cluster.revive_osd(victim)
+        cluster.wait_for_clean(timeout=60)
+        pmap2 = placement.active_map()
+        assert {str(p): pmap2.slot(p)
+                for p in pgids.values()} == slots_before
+        for oid, data in payloads.items():
+            assert io.read(oid) == data, f"post-revive read {oid}"
+
+
+def _fidelity_run(loopback: bool):
+    """One fixed 8-write burst; returns (placement decisions, engine
+    slot set, per-op stage shapes) for one wire path."""
+    from ceph_tpu.utils.dataplane import dataplane
+
+    os.environ["CEPH_TPU_MSGR_LOOPBACK"] = "1" if loopback else "0"
+    dataplane().reset()
+    try:
+        with MiniCluster(n_osds=3) as cluster:
+            rados = cluster.client()
+            cluster.create_ec_pool("fid", k=2, m=1, pg_num=8,
+                                   backend="jax")
+            io = rados.open_ioctx("fid")
+            io.op_timeout = 120.0
+            oids = [f"fid{i}" for i in range(8)]
+            for oid in oids:
+                io.write_full(oid, oid.encode() * 4096)
+            pgids = _pool_pgids(cluster, "fid", oids)
+            pmap = placement.active_map()
+            decisions = {oid: pmap.slot(p)
+                         for oid, p in pgids.items()}
+            slots = _engine_stats(cluster)["slots"]
+            shapes = sorted({
+                tuple(s["stage"] for s in tl["stages"])
+                for tl in dataplane().recent()})
+        return decisions, slots, shapes
+    finally:
+        os.environ.pop("CEPH_TPU_MSGR_LOOPBACK", None)
+
+
+def test_placement_fidelity_loopback_vs_tcp(mesh_env):
+    """The wire path must not leak into placement or observability:
+    the same burst over the in-process loopback and over real TCP
+    lands identical PG->slot decisions, exercises the same engine
+    slots, and produces the same per-op stage shapes."""
+    dec_lo, slots_lo, shapes_lo = _fidelity_run(loopback=True)
+    dec_tcp, slots_tcp, shapes_tcp = _fidelity_run(loopback=False)
+    assert dec_lo == dec_tcp
+    assert slots_lo == slots_tcp
+    assert shapes_lo == shapes_tcp, (shapes_lo, shapes_tcp)

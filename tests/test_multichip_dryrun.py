@@ -10,11 +10,55 @@ subprocess on 8 host-platform devices — a mesh/engine regression fails
 here in tier-1 instead of burning a TPU round.
 """
 
+import json
 import os
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multichip_bench_rows_and_scaling_smoke():
+    """ISSUE 12: ``bench.py --multichip-sub`` — the exact subprocess
+    a single-chip driver spawns — lands BOTH multichip rows plus the
+    scaling record on 8 host-platform devices. The near-linear bar
+    (>= 6x at 8 devices) is asserted when the host has >= 8 real
+    cores to express it; below that the weak-scaled mesh must still
+    hold per-core efficiency (no partition overhead the cores can't
+    hide — the axis-preserving global spelling measures ~1.0x on one
+    core, vs ~0.14x for a resharding spelling)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CEPH_TPU_MC_BUDGET"] = "25"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--multichip-sub"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    recs = {}
+    for line in proc.stdout.splitlines():
+        at = line.find('{"metric"')
+        if at >= 0:
+            rec = json.loads(line[at:])
+            recs[rec["metric"]] = rec
+    for row in ("multichip_encode_GBps", "multichip_decode_GBps"):
+        assert row in recs, (sorted(recs), proc.stderr[-500:])
+        assert recs[row].get("value", 0) > 0, recs[row]
+        assert recs[row]["n_devices"] == 8
+        assert "error" not in recs[row]
+    sc = recs.get("multichip_scaling")
+    assert sc and sc.get("value"), sc
+    cores = sc["cores"]
+    if cores >= 8:
+        assert sc["value"] >= 6.0, \
+            f"near-linear bar missed at {cores} cores: {sc}"
+    else:
+        floor = 0.5 * min(cores, 8)
+        assert sc["value"] >= floor, \
+            f"weak-scaling efficiency below {floor}: {sc}"
 
 
 def test_dryrun_multichip_8_host_devices():

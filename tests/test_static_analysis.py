@@ -250,6 +250,61 @@ def f(x):
     assert any(k.startswith("f:host-pull") for k in keys)
 
 
+def test_shard_map_wrapped_callee_walked():
+    """ISSUE 12: a function handed to shard_map is traced exactly
+    like a decorated jit body — the hygiene rules walk it."""
+    keys = _jit_keys('''
+import jax
+from jax.experimental.shard_map import shard_map
+def build(mesh):
+    def step(x):
+        if x.sum() > 0:
+            return x
+        return -x
+    return jax.jit(shard_map(step, mesh=mesh,
+                             in_specs=None, out_specs=None))
+''')
+    assert any(k.startswith("step:traced-branch") for k in keys), keys
+
+
+def test_in_shardings_wrapped_callee_walked():
+    """...and so is the first arg of a jit call carrying
+    in_shardings/out_shardings (the pjit seam), and the global_fn/
+    shard_fn kwargs of mesh_compile.compile_step."""
+    keys = _jit_keys('''
+import jax
+def build(mesh):
+    def gstep(x):
+        return x + int(x[0])
+    return jax.jit(gstep, in_shardings=None, out_shardings=None)
+
+def build2(mesh, mesh_compile, specs):
+    def body(x):
+        return np.asarray(x)
+    return mesh_compile.compile_step(
+        mesh, global_fn=body, shard_fn=body,
+        in_specs=specs, out_specs=specs)
+''')
+    assert any(k.startswith("gstep:traced-coercion:int")
+               for k in keys), keys
+    assert any(k.startswith("body:host-pull") for k in keys), keys
+
+
+def test_plain_jit_call_without_shardings_not_walked():
+    """A bare ``jax.jit(fn)`` call (no shardings) keeps its historical
+    treatment: only decorator sites and wrapper seams are walked, so
+    the rule adds no blanket findings to the existing call-style
+    entry points."""
+    keys = _jit_keys('''
+import jax
+def build():
+    def fn(x):
+        return x + int(x[0])
+    return jax.jit(fn)
+''')
+    assert not keys, keys
+
+
 def test_jit_closure_device_array_caught():
     keys = _jit_keys('''
 import jax, jax.numpy as jnp
