@@ -515,7 +515,11 @@ def check_jit_hygiene(src: SourceFile) -> list[Finding]:
 _COUNTER_REG = {"add_u64_counter": "u64", "add_gauge": "gauge",
                 "add_time_avg": "time_avg", "add_histogram": "hist"}
 #: update methods that are distinctive enough to always count
-_COUNTER_USE_STRONG = ("ginc", "tinc", "hinc")
+_COUNTER_USE_STRONG = ("ginc", "tinc", "hinc",
+                       # the tuner's guarded-update seams (ISSUE 13:
+                       # publish_perf=False engines skip counters,
+                       # so every update routes through these)
+                       "_count", "_count_gauge")
 #: generic names counted only on perf-ish receivers ("logger" is the
 #: reference's name for a PerfCounters instance)
 _COUNTER_USE_WEAK = ("inc", "set_gauge", "time")
@@ -555,6 +559,9 @@ class RegistryDrift:
         self.options_read: dict[str, tuple[str, int]] = {}
         self.asok_registered: dict[str, tuple[str, int]] = {}
         self.asok_invoked: dict[str, tuple[str, int]] = {}
+        #: options consumed through a config observer (ISSUE 13: the
+        #: cached-read discipline tuner-managed knobs must follow)
+        self.options_observed: dict[str, tuple[str, int]] = {}
         #: (prefix, suffix) families touched via f-string keys
         self.counter_affixes: list[tuple[str, str]] = []
         self.option_affixes: list[tuple[str, str]] = []
@@ -570,6 +577,21 @@ class RegistryDrift:
                     if isinstance(tgt, ast.Name):
                         conf_aliases.add(tgt.id)
         for node in ast.walk(src.tree):
+            # the loop-over-keys observer idiom (utils/tracing):
+            # `_CFG_KEYS = ("a", "b", ...)` + `for key in _CFG_KEYS:
+            # conf.add_observer(key, ...)` — the tuple constant IS
+            # the observation declaration
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Tuple):
+                names = [t.id if isinstance(t, ast.Name) else
+                         getattr(t, "attr", "")
+                         for t in node.targets]
+                if any("CFG_KEYS" in (n or "") for n in names):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and \
+                                isinstance(elt.value, str):
+                            self.options_observed.setdefault(
+                                elt.value, (src.rel, node.lineno))
             if not isinstance(node, ast.Call):
                 if isinstance(node, ast.Subscript) and \
                         self._is_conf(node.value, conf_aliases):
@@ -618,6 +640,13 @@ class RegistryDrift:
                             lit0, (src.rel, node.lineno))
                     elif dyn0:
                         self.option_affixes.append(dyn0)
+                elif fn.attr in ("add_observer",
+                                 "_observe_knob") and lit0:
+                    # direct observer registration, or the device
+                    # engine's _observe_knob seam (same contract:
+                    # first arg is the option, consumer caches)
+                    self.options_observed.setdefault(
+                        lit0, (src.rel, node.lineno))
                 elif fn.attr == "register_command" and lit0:
                     self.asok_registered.setdefault(
                         lit0, (src.rel, node.lineno))
@@ -681,7 +710,30 @@ class RegistryDrift:
                 add("asok-unregistered", key, where,
                     f"asok command {key!r} invoked but no daemon "
                     "registers it")
+        # ISSUE 13: every tuner-managed knob must be consumed through
+        # a config OBSERVER somewhere — the tuner mutates these at
+        # runtime, so a consumer re-reading g_conf per-op/per-flush
+        # pays the RLock the tracing PR measured, and a consumer that
+        # caches WITHOUT an observer silently ignores the tuner
+        for key in self._tuner_knob_names():
+            if key in self.options_declared and \
+                    key not in self.options_observed:
+                add("tuner-knob-unobserved", key,
+                    self.options_declared[key],
+                    f"tuner-managed knob {key!r} has no add_observer "
+                    "consumer: runtime pushes either cost a hot-path "
+                    "config read or never reach the daemon")
         return out
+
+    @staticmethod
+    def _tuner_knob_names() -> list[str]:
+        """The actuator registry (utils/knobs.TUNER_KNOBS) — imported
+        live rather than re-parsed: the registry IS the contract."""
+        try:
+            from ceph_tpu.utils.knobs import tuner_managed_names
+            return tuner_managed_names()
+        except Exception:
+            return []
 
 
 # ---------------------------------------------------------------------------

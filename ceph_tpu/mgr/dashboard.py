@@ -19,6 +19,9 @@ from the mgr's cluster view:
                       timelines)
     GET /api/profile  continuous-profiler aggregate (status, per-stage
                       sample shares, top-N hot frames, folded stacks)
+    GET /api/tuner    closed-loop tuner: enabled flag, knob vector
+                      with sources/pins, pending step, decision
+                      history (ISSUE 13)
 
 Commands: ``dashboard status|on|off`` over the mgr asok; ``on`` binds
 an ephemeral port (reported by status) on 127.0.0.1.
@@ -71,6 +74,10 @@ _PAGE = """<!doctype html>
 <th>mesh scrub batches</th><th>placement flushes</th>
 <th>placement slots</th><th>pjit steps</th><th>shard_map steps</th>
 </tr>{mesh_row}</table>
+<h3>closed-loop tuning</h3>
+<p>{tuner_summary}</p>
+<table><tr><th>knob</th><th>value</th><th>source</th></tr>
+{tuner_rows}</table>
 <h3>data plane</h3>
 <p>ops {dp_ops} · p50 {dp_p50} ms · p99 {dp_p99} ms · coverage
 {dp_coverage}% · msgr send errors {dp_send_errors} · dropped
@@ -136,6 +143,9 @@ class Module(MgrModule):
                  "dump": prof.dump(),
                  "top_frames": prof.top_frames(10),
                  "folded": prof.folded()}).encode()
+        if path == "/api/tuner":
+            return 200, "application/json", json.dumps(
+                self._tuner_payload(), default=str).encode()
         if path == "/api/dataplane":
             from ceph_tpu.utils.dataplane import dataplane
             from ceph_tpu.utils.msgr_telemetry import telemetry as mt
@@ -187,6 +197,25 @@ class Module(MgrModule):
                                 "device.compile_cache_misses")}
             except Exception:
                 pass
+        return out
+
+    def _tuner_payload(self) -> dict:
+        """The closed-loop tuning panel (ISSUE 13): the knob vector
+        (with winning sources and operator pins) always renders —
+        gap attribution without the knob vector is half a story —
+        plus the control loop's state when a tuner is live."""
+        from ceph_tpu.utils.knobs import TUNER_KNOBS
+        out = {"enabled": False,
+               "knobs": TUNER_KNOBS.vector_detail()}
+        tuner_mod = self.mgr.modules.get("tuner")
+        engine = getattr(tuner_mod, "engine", None)
+        if engine is not None:
+            status = engine.status()
+            out.update({"enabled": True,
+                        "pending": status["pending"],
+                        "weights": status["weights"],
+                        "counters": status["counters"],
+                        "history": engine.history_dump(limit=32)})
         return out
 
     @staticmethod
@@ -300,6 +329,20 @@ class Module(MgrModule):
             f"<td>{mp['mesh_compile_shard_map']}</td></tr>")
         mesh_summary = html.escape(
             f"mesh {mp.get('mesh')} · placement {mp.get('placement')}")
+        tp = self._tuner_payload()
+        steps = (tp.get("counters") or {}).get("tuner_steps", 0)
+        reverts = (tp.get("counters") or {}).get("tuner_reverts", 0)
+        tuner_summary = html.escape(
+            ("ACTIVE · %s steps · %s reverts" % (steps, reverts))
+            if tp["enabled"] else
+            "off (tuner_enabled=false) — knob vector below is the "
+            "hand-set state")
+        tuner_rows = "".join(
+            f"<tr><td>{html.escape(name)}</td>"
+            f"<td>{ent['value']}</td>"
+            f"<td>{html.escape(ent['source'])}"
+            f"{' (pinned)' if ent.get('pinned') else ''}</td></tr>"
+            for name, ent in tp["knobs"].items())
         return _PAGE.format(
             health=html.escape(health),
             check_rows=check_rows,
@@ -319,6 +362,8 @@ class Module(MgrModule):
             pipeline_row=pipeline_row,
             mesh_row=mesh_row,
             mesh_summary=mesh_summary,
+            tuner_summary=tuner_summary,
+            tuner_rows=tuner_rows,
             dp_ops=bd.get("ops", 0),
             dp_p50=bd.get("p50_ms", 0),
             dp_p99=bd.get("p99_ms", 0),

@@ -443,6 +443,17 @@ class HealthEngine:
         section("compile_cache", lambda: {
             "dir": compile_cache.enabled_dir(),
             "ledger": compile_cache.ledger()})
+        # closed-loop tuner (ISSUE 13): the knob vector and recent
+        # step/revert decisions ride the bundle ONLY when a tuner is
+        # live — probing must not instantiate one (the literal-NOOP
+        # contract when the tuner is off)
+        try:
+            from ceph_tpu.mgr import tuner as _tuner
+            tuner_state = _tuner.status_if_active()
+        except Exception as exc:
+            tuner_state = {"error": repr(exc)}
+        if tuner_state is not None:
+            bundle["tuner"] = tuner_state
         return bundle
 
     def _emit_bundle(self, reason: str) -> None:

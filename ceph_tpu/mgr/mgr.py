@@ -25,9 +25,12 @@ from ceph_tpu.utils.perf_counters import PerfCounters
 
 log = Dout("mgr")
 
-#: default module set (the reference's always-on + default-on modules)
+#: default module set (the reference's always-on + default-on
+#: modules). ``tuner`` loads LAST so it can wire itself to the
+#: health engine; it is a literal NOOP unless tuner_enabled /
+#: CEPH_TPU_TUNER turns it on (ISSUE 13).
 DEFAULT_MODULES = ("balancer", "progress", "telemetry",
-                   "dashboard", "health", "trace")
+                   "dashboard", "health", "trace", "tuner")
 
 
 class Mgr:

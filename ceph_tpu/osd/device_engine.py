@@ -132,9 +132,10 @@ def bulk_ingest_enabled() -> bool:
 def mesh_flush_threshold() -> int:
     """The dense->mesh crossover in bytes: flushes at least this big
     route through the default mesh's sharded steps. A real g_conf
-    Option since ISSUE 12 (registry-drift-lint covered; the future
-    ROADMAP-item-5 tuner adjusts it), env override preserved for A/B
-    runs."""
+    Option since ISSUE 12 (registry-drift-lint covered; the ISSUE-13
+    tuner adjusts it at runtime through the engine's cached
+    observer), env override preserved for A/B runs — and an env pin
+    freezes the knob against tuner pushes."""
     import os
     env = os.environ.get("CEPH_TPU_MESH_FLUSH_BYTES")
     if env is not None:
@@ -144,6 +145,23 @@ def mesh_flush_threshold() -> int:
         return int(g_conf()["mesh_flush_bytes"])
     except Exception:
         return 1 << 20
+
+
+def _conf_knob(env_name: str, read_conf, fallback: int
+               ) -> tuple[int, bool]:
+    """Resolve one engine knob at construction: env beats the
+    declared Option (the A/B convention), Option beats the compiled
+    fallback. Returns (value, pinned) — a pinned knob (env) must NOT
+    track runtime config pushes, an unpinned one must (the tuner's
+    actuation path is exactly a runtime ``config set``)."""
+    import os
+    env = os.environ.get(env_name)
+    if env is not None:
+        return int(env), True
+    try:
+        return int(read_conf()), False
+    except Exception:
+        return fallback, True
 
 
 def _placement_slot(key) -> int:
@@ -354,7 +372,7 @@ class DeviceEncodeEngine:
     thread."""
 
     def __init__(self, dispatch: Callable[[object, Callable], None],
-                 flush_bytes: int = 64 << 20,
+                 flush_bytes: int | None = None,
                  counters=None, window: int | None = None,
                  mesh_flush_bytes: int | None = None) -> None:
         import os
@@ -363,7 +381,6 @@ class DeviceEncodeEngine:
         #: shared engine service, where every key is an AttachedKey
         #: routed through the per-OSD dispatcher table below.
         self._dispatch_default = dispatch
-        self._flush_bytes = flush_bytes
         #: attach token -> that OSD's dispatch fn (shared engine)
         self._dispatchers: dict[int, Callable] = {}
         #: ISSUE 9 bulk-ingest legs, captured at construction so
@@ -375,24 +392,53 @@ class DeviceEncodeEngine:
         self._last_group: FlushGroup | None = None
         self._last_group_event: threading.Event | None = None
         self._counters = counters
+        # ISSUE 13: the four engine knobs resolve explicit-arg > env
+        # > g_conf Option, and every UNPINNED one registers a config
+        # observer so the mgr tuner's runtime pushes land here as one
+        # cached attribute write — never a per-flush g_conf read (the
+        # hot-path audit: the same RLock fix the tracing PR measured)
+        self._cfg_observers: list[tuple[str, Callable]] = []
+        #: staged payload bytes that force a launch (the batch-size
+        #: cap bounding the device working set)
+        from ceph_tpu.utils.config import g_conf
+        if flush_bytes is None:
+            flush_bytes, fb_pinned = _conf_knob(
+                "CEPH_TPU_ENGINE_FLUSH_BYTES",
+                lambda: g_conf()["engine_flush_bytes"], 64 << 20)
+        else:
+            fb_pinned = True
+        self._flush_bytes = flush_bytes
         #: max launched-not-retired encode batches (the pipeline
         #: depth); 1 = the old serial engine
         if window is None:
-            window = int(os.environ.get("CEPH_TPU_ENGINE_WINDOW", 3))
+            window, w_pinned = _conf_knob(
+                "CEPH_TPU_ENGINE_WINDOW",
+                lambda: g_conf()["engine_window"], 3)
+        else:
+            w_pinned = True
         self._window = max(1, window)
         #: batches at least this big route through the default mesh's
         #: sharded encode step (when one is configured); smaller ones
         #: stay single-chip
         if mesh_flush_bytes is None:
             mesh_flush_bytes = mesh_flush_threshold()
+            mfb_pinned = "CEPH_TPU_MESH_FLUSH_BYTES" in os.environ
+        else:
+            mfb_pinned = True
         self._mesh_flush_bytes = mesh_flush_bytes
         #: flushes SMALLER than this take the host matvec instead of
         #: a device launch (the fixed dispatch cost dominates tiny
         #: batches — the bottom end of the routing ladder: host <
         #: host_flush_bytes <= single-chip device < mesh_flush_bytes
         #: <= mesh). 0 disables; bulk-ingest only.
-        self._host_flush_bytes = int(os.environ.get(
-            "CEPH_TPU_HOST_FLUSH_BYTES", 512 << 10))
+        self._host_flush_bytes, hfb_pinned = _conf_knob(
+            "CEPH_TPU_HOST_FLUSH_BYTES",
+            lambda: g_conf()["host_flush_bytes"], 512 << 10)
+        #: which knobs track runtime config pushes (env pins do not)
+        self._knob_unpinned = {"engine_flush_bytes": not fb_pinned,
+                               "engine_window": not w_pinned,
+                               "mesh_flush_bytes": not mfb_pinned,
+                               "host_flush_bytes": not hfb_pinned}
         # warmup-kill: per-signature device programs persist across
         # processes (best-effort; a disabled/failed cache only costs
         # recompiles, never correctness)
@@ -455,6 +501,48 @@ class DeviceEncodeEngine:
             target=self._retire_run, name="ec-device-retire",
             daemon=True)
         self._retire_thread.start()
+        # runtime knob observers attach LAST (fully-built engine: the
+        # window observer touches the inflight CV) — literal names so
+        # the registry-drift lint can hold every tuner-managed knob
+        # to the cached-observer bar
+        self._observe_knob("engine_flush_bytes",
+                           self._set_flush_bytes)
+        self._observe_knob("engine_window", self._set_window)
+        self._observe_knob("mesh_flush_bytes",
+                           self._set_mesh_flush_bytes)
+        self._observe_knob("host_flush_bytes",
+                           self._set_host_flush_bytes)
+
+    # -- runtime knob observers (ISSUE 13) ----------------------------
+    def _observe_knob(self, option: str, fn) -> None:
+        if not self._knob_unpinned.get(option, False):
+            return              # env/arg pins win for this engine
+        try:
+            from ceph_tpu.utils.config import g_conf
+            g_conf().add_observer(option, fn)
+            self._cfg_observers.append((option, fn))
+        except Exception:
+            pass            # a schema-less embedder keeps the pins
+
+    def _set_window(self, _name: str, value) -> None:
+        """Runtime window change: widen wakes launchers blocked in
+        _wait_window; shrink takes effect on their next wait check
+        (in-flight batches above the new bound drain naturally — the
+        window is a launch gate, not a hard cap on what is already
+        out)."""
+        with self._ifcv:
+            self._window = max(1, int(value))
+            self._ifcv.notify_all()
+        _telemetry().note_engine_window(self._window)
+
+    def _set_flush_bytes(self, _name: str, value) -> None:
+        self._flush_bytes = max(1, int(value))
+
+    def _set_mesh_flush_bytes(self, _name: str, value) -> None:
+        self._mesh_flush_bytes = max(0, int(value))
+
+    def _set_host_flush_bytes(self, _name: str, value) -> None:
+        self._host_flush_bytes = max(0, int(value))
 
     # -- dispatch routing (per-OSD when shared) -----------------------
     def _dispatch(self, key, fn) -> None:
@@ -541,8 +629,11 @@ class DeviceEncodeEngine:
         _telemetry().note_hbm(staged_delta=data.nbytes)
         # PG placement (ISSUE 12): the slot is part of the staging
         # key, so each stripe row's bytes accumulate contiguously and
-        # flush onto their owning chips
+        # flush onto their owning chips. The per-slot staged ledger
+        # (ISSUE 13) is the tuner's chip-load signal for load-aware
+        # placement weighting.
         pslot = _placement_slot(key)
+        _telemetry().note_slot_staged(pslot, data.nbytes)
         if self._stager is not None:
             # zero-copy staging: the payload lands in the signature's
             # concat buffer NOW, on this producer thread; the engine
@@ -574,9 +665,10 @@ class DeviceEncodeEngine:
         blocked decode_sync caller)."""
         import time as _time
         _telemetry().note_hbm(staged_delta=_shards_nbytes(shards))
+        pslot = _placement_slot(key)
+        _telemetry().note_slot_staged(pslot, _shards_nbytes(shards))
         self._q.put(("dec", key, codec, sinfo, shards, want, cont,
-                     span, clock, _time.monotonic(),
-                     _placement_slot(key)))
+                     span, clock, _time.monotonic(), pslot))
 
     def decode_sync(self, key, codec, sinfo: ec_util.StripeInfo,
                     shards: dict[int, np.ndarray], want: list[int],
@@ -624,6 +716,16 @@ class DeviceEncodeEngine:
         return box[0]
 
     def stop(self) -> None:
+        # detach the knob observers first: a tuner push must not land
+        # an attribute write on an engine that is tearing down
+        if self._cfg_observers:
+            try:
+                from ceph_tpu.utils.config import g_conf
+                for option, fn in self._cfg_observers:
+                    g_conf().remove_observer(option, fn)
+            except Exception:
+                pass
+            self._cfg_observers = []
         self._running = False
         self._q.put(None)
         self._thread.join(timeout=10)
@@ -804,6 +906,7 @@ class DeviceEncodeEngine:
                 batch = None
                 views = [d for _k, d, _c, _s, _cl, _t in items]
                 nbytes = sum(d.nbytes for d in views)
+            _telemetry().note_slot_staged(pslot, -nbytes)
             # a configured default mesh takes the flush through the
             # multi-chip encode step (pod deployments; dryrun/tests)
             # — but only once the batch is big enough to amortize the
@@ -1080,6 +1183,7 @@ class DeviceEncodeEngine:
             staged = sum(_shards_nbytes(shards)
                          for _k, shards, _w, _c, _s, _cl, _t in items)
             tel.note_hbm(staged_delta=-staged, retired=staged)
+            tel.note_slot_staged(pslot, -staged)
             for _key, _shards, _want, _cont, span, clock, ts in items:
                 tel.note_queue_wait("decode", launched - ts)
                 clock.mark("engine_stage_wait", t=launched)
@@ -1246,7 +1350,7 @@ _shared_engine: DeviceEncodeEngine | None = None
 _attach_seq = 0
 
 
-def shared_engine_attach(dispatch, flush_bytes: int = 64 << 20
+def shared_engine_attach(dispatch, flush_bytes: int | None = None
                          ) -> EngineHandle:
     """Attach one OSD to the process-wide shared engine (the ISSUE-9
     shared engine service): co-located OSDs feed ONE device pipeline,

@@ -24,6 +24,7 @@ EC clusters live or die on how coding work spreads over the array.
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import zlib
@@ -41,6 +42,42 @@ def stable_hash(key) -> int:
     return zlib.crc32(str(key).encode("utf-8")) & 0xFFFFFFFF
 
 
+# -- load-aware slot weighting (ISSUE 13) ------------------------------
+#
+# Hash-uniform placement is the default AND the fallback: weights only
+# exist while the mgr tuner is active and publishing its chip-load
+# signal (per-slot live staged bytes + HBM share). A weight vector
+# biases the pgid->slot map via weighted rendezvous hashing — still a
+# pure function of (pgid, weights), so every daemon that sees the same
+# weights places identically, and clearing the weights restores the
+# exact historical modulo map.
+
+_weights_lock = make_lock("placement.weights")
+_slot_weights: dict[int, float] | None = None
+
+
+def set_slot_weights(weights: dict[int, float] | None) -> None:
+    """Publish (or clear, with None/empty) the tuner's slot-weight
+    vector. Non-positive weights are floored to a small epsilon —
+    a loaded slot is de-preferred, never excluded (excluding a slot
+    would strand its staged state)."""
+    global _slot_weights
+    if not weights:
+        with _weights_lock:
+            _slot_weights = None
+        return
+    cleaned = {int(s): max(1e-6, float(w))
+               for s, w in weights.items()}
+    with _weights_lock:
+        _slot_weights = cleaned
+
+
+def slot_weights() -> dict[int, float] | None:
+    """The active weight vector (None = hash-uniform)."""
+    with _weights_lock:
+        return dict(_slot_weights) if _slot_weights else None
+
+
 class PlacementMap:
     """pgid -> stripe-row placement over one mesh. Slots are the
     mesh's ``stripe`` coordinates; a slot's submesh is that row of
@@ -54,7 +91,27 @@ class PlacementMap:
         self._submeshes: dict[int, Mesh] = {}
 
     def slot(self, pgid) -> int:
+        """pgid -> stripe row. Hash-uniform modulo by default; when
+        the tuner published slot weights, weighted rendezvous
+        hashing (highest-random-weight with -ln(u)/w scores) biases
+        new assignments toward lightly loaded rows while staying a
+        pure, process-independent function of (pgid, weights).
+        Works for ANY slot count — non-pow2 stripe rows included."""
+        weights = _slot_weights
+        if weights:
+            return self._weighted_slot(pgid, weights)
         return stable_hash(pgid) % self.n_slots
+
+    def _weighted_slot(self, pgid, weights: dict[int, float]) -> int:
+        best, best_score = 0, math.inf
+        for s in range(self.n_slots):
+            w = weights.get(s, 1.0)
+            # u in (0, 1): never 0 (log) and never exactly 1
+            u = (stable_hash(f"{pgid}|slot{s}") + 1.0) / 4294967298.0
+            score = -math.log(u) / w
+            if score < best_score:
+                best, best_score = s, score
+        return best
 
     def submesh(self, slot: int) -> Mesh:
         """The slot's stripe row as a standalone (1, shard) mesh.

@@ -16,10 +16,16 @@ machine-readable ``{"bench_trend": ...}`` JSON line, the bench-gate
 convention. Runnable in tier-1 on the checked-in files
 (tests/test_bench_trend.py).
 
+``--tuned-vs-fixed`` (ISSUE 13) runs the deterministic tuner
+comparison instead: the closed-loop controller against every fixed
+knob vector on the phase-shift plant (bench/tuner_sim), printing the
+per-phase table plus one ``{"tuner_sim": ...}`` JSON line; with
+``--strict`` a tuned loss exits 2 exactly like a metric regression.
+
 CLI (also via the repo-root shim ``tools/bench_trend.py``)::
 
     python -m ceph_tpu.tools.bench_trend [files...] \
-        [--threshold 10] [--strict]
+        [--threshold 10] [--strict] [--tuned-vs-fixed [--seed N]]
 """
 
 from __future__ import annotations
@@ -178,7 +184,25 @@ def main(argv=None) -> int:
                          "(default 10)")
     ap.add_argument("--strict", action="store_true",
                     help="exit 2 when any metric regressed")
+    ap.add_argument("--tuned-vs-fixed", action="store_true",
+                    help="run the deterministic tuned-vs-fixed "
+                         "comparison (bench/tuner_sim) instead of "
+                         "the round diff")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="plant seed for --tuned-vs-fixed")
     args = ap.parse_args(argv)
+    if args.tuned_vs_fixed:
+        from ceph_tpu.bench import tuner_sim
+        report = tuner_sim.comparison(args.seed)
+        print(tuner_sim.render(report))
+        print(json.dumps({"tuner_sim": {
+            "seed": report["seed"],
+            "verdicts": report["verdicts"],
+            "tuned_beats_all": report["tuned_beats_all"]}},
+            sort_keys=True))
+        if args.strict and not report["tuned_beats_all"]:
+            return 2
+        return 0
     files = args.files or default_files()
     if len(files) < 1:
         print("no BENCH_r*.json files found", file=sys.stderr)

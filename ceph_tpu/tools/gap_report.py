@@ -102,6 +102,28 @@ _MESH_STAGES = ("engine_stage_wait", "device_window_wait",
                 "device_finalize")
 
 
+def _knob_section() -> dict:
+    """The active actuator vector (ISSUE 13): every tuner-managed
+    knob's effective value and winning config source, so an
+    attribution table is never read without knowing which knob
+    vector produced it. ``tuner_active`` says whether a live tuner
+    is driving them."""
+    try:
+        from ceph_tpu.mgr import tuner as tuner_mod
+        from ceph_tpu.utils.knobs import TUNER_KNOBS
+        out = {"vector": TUNER_KNOBS.vector_detail(),
+               "tuner_active": tuner_mod.active_tuner() is not None}
+        tail = tuner_mod.decisions_tail_if_active(limit=5)
+        if tail:
+            out["recent_decisions"] = [
+                {k: d.get(k) for k in ("kind", "knob", "from", "to",
+                                       "rule")}
+                for d in tail]
+        return out
+    except Exception:
+        return {}
+
+
 def _mesh_section() -> dict:
     """The multi-chip share of this run's device work (ISSUE 12):
     how many engine flushes rode the mesh / a placement slot, read
@@ -174,6 +196,8 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
         # a mesh run attributes the SAME stages; this section (and
         # the table's mesh column) says how much of them rode it
         "mesh": _mesh_section(),
+        # ISSUE 13: the knob vector this attribution ran under
+        "knobs": _knob_section(),
     }
     if prof is not None:
         report["profiler"] = _profile_section(prof)
@@ -191,6 +215,15 @@ def print_table(report: dict) -> None:
           f"(source: {report['engine_source']})")
     if report["gap_x"]:
         print(f"gap: {report['gap_x']}x")
+    knobs = (report.get("knobs") or {}).get("vector") or {}
+    if knobs:
+        active = "tuner ACTIVE" if report["knobs"].get(
+            "tuner_active") else "tuner off"
+        vec = "  ".join(
+            f"{name}={ent['value']}"
+            + ("*" if ent.get("pinned") else "")
+            for name, ent in knobs.items())
+        print(f"knobs ({active}, * = pinned): {vec}")
     print()
     prof = report.get("profiler") or {}
     hot = prof.get("hot_frames", {})

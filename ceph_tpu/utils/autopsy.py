@@ -84,6 +84,9 @@ class AutopsyStore:
         frames = self._hot_frames()
         if frames is not None:
             entry["hot_frames"] = frames
+        decisions = self._tuner_tail()
+        if decisions is not None:
+            entry["tuner_decisions"] = decisions
         with self._lock:
             evicted = len(self._ring) == self._ring.maxlen
             self._ring.append(entry)
@@ -117,6 +120,18 @@ class AutopsyStore:
             return reg.fired()[-_FAULT_TAIL:]
         except Exception:
             return []
+
+    @staticmethod
+    def _tuner_tail():
+        """Recent closed-loop tuner decisions, only when a tuner is
+        live (ISSUE 13): a slow op autopsied mid-adjustment should
+        say so — a knob step is exactly the kind of context that
+        explains an outlier. Never instantiates a tuner."""
+        try:
+            from ceph_tpu.mgr import tuner as _tuner
+            return _tuner.decisions_tail_if_active()
+        except Exception:
+            return None
 
     @staticmethod
     def _hot_frames():

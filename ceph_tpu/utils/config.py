@@ -187,6 +187,28 @@ class ConfigProxy:
         with self._lock:
             self._observers.setdefault(name, []).append(fn)
 
+    def remove_observer(self, name: str,
+                        fn: Callable[[str, Any], None]) -> None:
+        """Detach an observer (daemons that stop must not leave dead
+        callbacks firing into freed engines — the tuner pushes knob
+        writes for the process lifetime)."""
+        with self._lock:
+            obs = self._observers.get(name)
+            if obs and fn in obs:
+                obs.remove(fn)
+
+    def source_of(self, name: str) -> str:
+        """The layer whose value wins for ``name`` ("default" when no
+        layer holds it). The tuner uses this to recognize operator
+        pins: an 'env' or 'override' value outranks its 'mon'-layer
+        pushes, so stepping that knob would be a silent no-op."""
+        self.schema.get(name)
+        with self._lock:
+            for source in reversed(SOURCES):
+                if name in self._values[source]:
+                    return source
+        return "default"
+
     def dump(self) -> dict[str, Any]:
         return {name: self.get(name) for name in self.schema.names()}
 
@@ -434,6 +456,54 @@ for _o in [
     Option("profiler_hz", float, 50.0, "advanced",
            "stack-sampling profiler rate while running "
            "(profile start)", min=0.1, max=1000.0),
+    Option("engine_window", int, 3, "advanced",
+           "device engine launch-window depth: launched-not-retired "
+           "encode batches kept in flight (1 = the serial engine; "
+           "env CEPH_TPU_ENGINE_WINDOW pins it — a tuner-managed "
+           "knob, adjusted at runtime through a config observer)",
+           min=1, max=64),
+    Option("engine_flush_bytes", int, 64 << 20, "advanced",
+           "device engine flush threshold: staged payload bytes that "
+           "force a launch (the batch-size cap bounding the device "
+           "working set; env CEPH_TPU_ENGINE_FLUSH_BYTES pins it — "
+           "tuner-managed)", min=64 << 10),
+    Option("host_flush_bytes", int, 512 << 10, "advanced",
+           "bulk-ingest bottom rung: flushes smaller than this take "
+           "the host matvec instead of a device launch (0 disables; "
+           "env CEPH_TPU_HOST_FLUSH_BYTES pins it — tuner-managed)",
+           min=0),
+    Option("tuner_enabled", bool, False, "advanced",
+           "mgr closed-loop tuner: adjust the declared actuator "
+           "knobs from the live dataplane (default OFF — a literal "
+           "NOOP: zero threads, zero knob writes, zero counters; "
+           "env CEPH_TPU_TUNER=1 enables)"),
+    Option("tuner_tick_period", float, 0.5, "advanced",
+           "seconds between tuner control-loop evaluations (the "
+           "slow outer loop's cadence)", min=0.05),
+    Option("tuner_cooldown_s", float, 3.0, "advanced",
+           "seconds a stepped knob is held before its step is "
+           "judged (confirm or revert) and before the next step "
+           "anywhere — one actuation in flight at a time keeps "
+           "regression attribution sound", min=0.1),
+    Option("tuner_threshold_pct", float, 10.0, "advanced",
+           "direction-aware regression threshold for "
+           "revert-on-regression, percent (the bench_trend "
+           "convention: latency regresses up, throughput down)",
+           min=0.5),
+    Option("tuner_hysteresis_ticks", int, 2, "advanced",
+           "consecutive control ticks a rule must fire before its "
+           "step is taken (a one-sample blip must not move a knob)",
+           min=1),
+    Option("tuner_baseline_window", int, 8, "advanced",
+           "sensor samples in the rolling objective baseline a step "
+           "is judged against", min=2),
+    Option("tuner_history_size", int, 128, "advanced",
+           "tuner decisions retained for 'tuner history' and the "
+           "health diagnostics bundle", min=8),
+    Option("tuner_placement_weighting", bool, True, "advanced",
+           "when the tuner is active, weight PG->slot placement by "
+           "the live per-slot staged-byte load (hash-uniform "
+           "remains the default and the fallback)"),
     Option("profiler_max_stacks", int, 2048, "advanced",
            "distinct folded stacks the profiler holds (fixed "
            "memory; overflow aggregates under one sentinel key)",

@@ -68,6 +68,11 @@ class DeviceTelemetry:
         self._hbm_staged = 0
         self._hbm_inflight = 0
         self._hbm_peak = 0
+        #: placement slot -> live staged bytes (ISSUE 13: the tuner's
+        #: chip-load signal for load-aware PG->slot weighting); bytes
+        #: enter at stage time and leave at flush take, so idle reads
+        #: all-zero like the hbm gauges
+        self._slot_staged: dict[int, int] = {}
 
     @staticmethod
     def _declare(perf: PerfCounters) -> None:
@@ -432,6 +437,21 @@ class DeviceTelemetry:
         with self._lock:
             return self._hbm_staged + self._hbm_inflight
 
+    def note_slot_staged(self, slot: int, delta: int) -> None:
+        """Move live staged bytes on one placement slot's ledger
+        (floored at zero per slot — the same self-healing the hbm
+        gauges use, so an accounting slip decays instead of
+        compounding)."""
+        with self._lock:
+            self._slot_staged[slot] = max(
+                0, self._slot_staged.get(slot, 0) + delta)
+
+    def slot_staged_bytes(self) -> dict[int, int]:
+        """Per-slot live staged bytes — the queue-depth half of the
+        tuner's chip-load signal (HBM pressure is the other half)."""
+        with self._lock:
+            return dict(self._slot_staged)
+
     # -- deep-scrub accounting ----------------------------------------
     def note_scrub_flush(self, objs: int, nbytes: int,
                          device_s: float) -> None:
@@ -460,10 +480,13 @@ class DeviceTelemetry:
             calibrations = {s: dict(v)
                             for s, v in self._calibrations.items()}
             costs = {s: dict(v) for s, v in self._costs.items()}
+        with self._lock:
+            slot_staged = dict(self._slot_staged)
         return {"counters": self.perf.dump(),
                 "compiles_by_signature": compiles,
                 "calibrations": calibrations,
-                "costs_by_signature": costs}
+                "costs_by_signature": costs,
+                "slot_staged_bytes": slot_staged}
 
     def snapshot_brief(self) -> dict:
         """Compact view for bench metric lines: scalar counters plus

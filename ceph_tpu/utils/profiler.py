@@ -149,6 +149,15 @@ class StackProfiler:
         self._lock = threading.Lock()
         self.hz = float(hz if hz is not None
                         else g_conf()["profiler_hz"])
+        if hz is None:
+            # tuner-managed knob (ISSUE 13): a runtime profiler_hz
+            # push retunes a RUNNING sampler — the loop re-derives
+            # its interval from self.hz every sweep. An explicit hz
+            # argument pins the rate for this profiler's lifetime.
+            try:
+                g_conf().add_observer("profiler_hz", self._on_hz)
+            except Exception:
+                pass
         self.max_stacks = int(max_stacks if max_stacks is not None
                               else g_conf()["profiler_max_stacks"])
         perf = collection().get("profiler")
@@ -187,6 +196,12 @@ class StackProfiler:
         perf.add_time_avg("profile_sweep_time",
                           "seconds per sampler sweep (the overhead "
                           "numerator: sweep_time.sum / elapsed)")
+
+    def _on_hz(self, _name: str, value) -> None:
+        with self._lock:
+            self.hz = float(value)
+        if self.running:
+            self.perf.set_gauge("profile_hz", self.hz)
 
     # -- lifecycle ----------------------------------------------------
     @property
@@ -242,9 +257,11 @@ class StackProfiler:
 
     # -- the sampler thread -------------------------------------------
     def _run(self) -> None:
-        interval = 1.0 / max(self.hz, 0.1)
         my_ident = threading.get_ident()
-        while not self._stop_ev.wait(interval):
+        # interval re-derives from self.hz each sweep so a runtime
+        # profiler_hz push (the tuner's observability lever) retunes
+        # a live sampler without a restart
+        while not self._stop_ev.wait(1.0 / max(self.hz, 0.1)):
             t0 = time.perf_counter()
             try:
                 self._sweep(my_ident)

@@ -57,7 +57,9 @@ from collections import OrderedDict
 _seq = itertools.count(1)
 
 #: keep reasons, also the trace_kept_<reason> counter suffixes
-KEEP_REASONS = ("error", "fault", "slow", "sample", "all")
+#: ("forced": an owner declared the trace load-bearing — tuner
+#: decisions ride this so every actuation survives the sampler)
+KEEP_REASONS = ("error", "fault", "slow", "sample", "all", "forced")
 
 #: EWMA smoothing for the per-op-type slowness baseline
 _EWMA_ALPHA = 0.2
@@ -82,7 +84,7 @@ class Span:
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "service",
                  "op_type", "start", "end", "events",
                  "error", "_fault_mark", "_clock", "_tracer",
-                 "__weakref__")
+                 "_forced", "__weakref__")
 
     def __init__(self, tracer: "Tracer", trace_id: str, span_id: int,
                  parent_id: int, name: str, service: str,
@@ -107,6 +109,8 @@ class Span:
         #: the op's StageClock, attached by the owner so a slow/error
         #: keep can autopsy the stage timeline alongside the spans
         self._clock = None
+        #: owner-declared keep (ISSUE 13: control-plane decisions)
+        self._forced = False
 
     @property
     def start_wall(self) -> float:
@@ -122,6 +126,13 @@ class Span:
     def set_error(self, detail: str = "error") -> None:
         """Mark the op failed — the trace survives the tail decision."""
         self.error = detail or "error"
+
+    def force_keep(self) -> None:
+        """Declare this (root) trace load-bearing: the tail decision
+        keeps it with reason "forced" regardless of outcome. For
+        rare, operator-facing events (tuner steps/reverts) — NOT a
+        sampling bypass for data-path ops."""
+        self._forced = True
 
     def attach_clock(self, clock) -> None:
         """Hang the op's (merged) StageClock on the root span so the
@@ -171,6 +182,7 @@ class _NoopSpan:
 
     def event(self, name: str) -> None: ...
     def set_error(self, detail: str = "error") -> None: ...
+    def force_keep(self) -> None: ...
     def attach_clock(self, clock) -> None: ...
     def finish(self) -> None: ...
     def wire(self) -> str:
@@ -374,6 +386,8 @@ class Tracer:
         self._root_seq += 1
         if conf["trace_all"]:
             return True, "all"
+        if span._forced:
+            return True, "forced"
         if span.error:
             return True, "error"
         if span._fault_mark is not None and \

@@ -132,3 +132,33 @@ def test_multichip_direction_pins(tmp_path):
     assert report["metrics"]["multichip_encode_GBps"]["regressed"]
     assert "multichip_encode_GBps" in report["regressions"]
     assert not report["metrics"]["multichip_decode_GBps"]["regressed"]
+
+
+def test_tuned_vs_fixed_mode(capsys):
+    """ISSUE 13: --tuned-vs-fixed runs the deterministic controller
+    comparison (bench/tuner_sim) — human table + one machine line —
+    and the tuned loop beats every fixed vector (the acceptance
+    verdict test_tuner_scenario pins in depth). --strict turns a
+    tuned loss into exit 2, same convention as a metric regression."""
+    import json
+
+    rc = bench_trend.main(["--tuned-vs-fixed", "--seed", "7",
+                           "--strict"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tuned control loop vs fixed knob vectors" in out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith('{"tuner_sim"')][-1]
+    doc = json.loads(line)["tuner_sim"]
+    assert doc["tuned_beats_all"] is True
+    assert set(doc["verdicts"]) == {"default", "read_opt",
+                                    "burst_opt", "degraded_opt"}
+    for v in doc["verdicts"].values():
+        assert v["tuned_wins"]
+
+
+def test_tuner_objective_uses_benchtrend_directions():
+    """The tuner's revert judgment reuses THIS module's direction
+    logic: p99 regresses up, throughput down."""
+    assert bench_trend.lower_is_better("tuner_p99_ms")
+    assert not bench_trend.lower_is_better("tuner_MBps")

@@ -270,6 +270,48 @@ def test_trace_and_autopsy_counters_covered_by_lint():
     assert set(status["counters"]) >= trace_keys
 
 
+def test_tuner_counters_covered_by_lint():
+    """ISSUE 13: the closed-loop tuner's registry — created only
+    when an engine exists (the off = zero-counters contract) — is
+    registered like every other, reaches the prometheus exposition,
+    and the per-knob gauges ride along once published."""
+    _ensure_registries()
+    from ceph_tpu.mgr.tuner import ScriptedSensors, TunerEngine
+    from ceph_tpu.utils.config import SCHEMA, ConfigProxy
+    from ceph_tpu.utils.knobs import TUNER_KNOBS
+    snap = {"p99_ms": 1.0, "mbps": 1.0, "hbm_live": 0,
+            "hbm_limit": 0, "inflight": 0, "window": 3,
+            "occupancy": 0, "flush_bytes_mean": 0, "health_rank": 0,
+            "fault_events": 0, "mesh_slots": 0, "slot_staged": {}}
+    eng = TunerEngine(ScriptedSensors([snap]),
+                      conf=ConfigProxy(SCHEMA))
+    eng.tick()
+    keys = set(eng.perf.dump())
+    assert {"tuner_ticks", "tuner_steps", "tuner_reverts",
+            "tuner_confirms", "tuner_clamped",
+            "tuner_pinned_skips", "tuner_weight_updates",
+            "tuner_active"} <= keys
+    # one gauge per declared knob published on the same registry
+    for name in TUNER_KNOBS.names():
+        assert f"knob_{name}" in keys, name
+    text = prometheus.render_text()
+    for key in ("tuner_ticks", "tuner_reverts", "tuner_active",
+                "knob_engine_window"):
+        assert f"ceph_tpu_{key}" in text, key
+    assert 'daemon="tuner"' in text
+    eng.shutdown()
+
+
+def test_trace_forced_keep_reason_covered():
+    """The 'forced' keep reason (tuner decision traces) has its
+    counter registered with the other trace_kept_* reasons."""
+    _ensure_registries()
+    from ceph_tpu.utils.tracing import KEEP_REASONS, tracer
+    assert "forced" in KEEP_REASONS
+    assert "trace_kept_forced" in set(tracer().perf.dump())
+    assert "ceph_tpu_trace_kept_forced" in prometheus.render_text()
+
+
 def test_exemplars_do_not_break_prometheus_parsing():
     """ISSUE 10 satellite: exemplar-bearing histogram exposition.
     A bucket line with an OpenMetrics exemplar clause still parses as

@@ -111,21 +111,38 @@ def _burst(window: int, monkeypatch, n_ops: int = 8):
 def test_pipelined_burst_overlaps_and_beats_serial(monkeypatch):
     """The acceptance gate: an 8-flush burst through the pipelined
     engine reports in-flight depth >= 2 and strictly lower wall clock
-    than the same burst with window=1 (the serial engine)."""
+    than the same burst with window=1 (the serial engine).
+
+    ISSUE 13 de-flake: the depth/overlap assertions are the core
+    overlap proof (sleep-based fake device, core-count independent);
+    the wall-clock bar stays DIRECTIONAL everywhere, but on a <= 2
+    core box a single scheduler preemption inside the ~0.33 s piped
+    window can eat the 0.8 s margin, so the paired measurement gets
+    one retry there before failing (a genuinely serial pipeline
+    fails both attempts at ~1.0x)."""
+    import os
     from ceph_tpu.utils.device_telemetry import telemetry
     telemetry().reset()
-    wall_serial, order_serial, stats_serial = _burst(1, monkeypatch)
-    wall_piped, order_piped, stats_piped = _burst(3, monkeypatch)
-    # continuation order is submission order under BOTH windows
-    assert order_serial == list(range(8))
-    assert order_piped == list(range(8))
-    # the window filled: batches genuinely overlapped on the device
-    assert stats_piped["max_inflight_depth"] >= 2, stats_piped
-    assert stats_serial["max_inflight_depth"] == 1, stats_serial
-    assert stats_piped["flushes"] == 8 and \
-        stats_serial["flushes"] == 8
-    # serial pays ~8x DEVICE_S; the pipeline hides most of it
-    assert wall_piped < wall_serial, (wall_piped, wall_serial)
+    attempts = 1 if len(os.sched_getaffinity(0)) > 2 else 2
+    for attempt in range(attempts):
+        wall_serial, order_serial, stats_serial = \
+            _burst(1, monkeypatch)
+        wall_piped, order_piped, stats_piped = _burst(3, monkeypatch)
+        # continuation order is submission order under BOTH windows
+        assert order_serial == list(range(8))
+        assert order_piped == list(range(8))
+        # the window filled: batches genuinely overlapped
+        assert stats_piped["max_inflight_depth"] >= 2, stats_piped
+        assert stats_serial["max_inflight_depth"] == 1, stats_serial
+        assert stats_piped["flushes"] == 8 and \
+            stats_serial["flushes"] == 8
+        # serial pays ~8x DEVICE_S; the pipeline hides most of it
+        if wall_piped < wall_serial:
+            break
+        if attempt == attempts - 1:
+            raise AssertionError(
+                f"pipelined burst never beat serial: "
+                f"{wall_piped:.3f}s vs {wall_serial:.3f}s")
     # telemetry saw the depth histogram and per-batch overlap ratios
     # (histograms dump as pow2-bucket lists; bucket b holds
     # [2^(b-1), 2^b), so depth >= 2 lands in buckets[2:])

@@ -163,3 +163,28 @@ def test_bulk_ingest_before_after_regression_gate(monkeypatch,
     assert last[1] < last[0] + 4.0, (
         f"combined commit/stage share grew past noise: "
         f"{last[0]:.1f}% -> {last[1]:.1f}%")
+
+
+def test_knob_section_rides_report_and_table(capsys):
+    """ISSUE 13: gap_report carries the active knob vector (value +
+    winning source + pin marker) next to its attribution table, and
+    the table prints it — an attribution is never read without
+    knowing which knob vector produced it."""
+    from ceph_tpu.tools.gap_report import _knob_section, print_table
+    from ceph_tpu.utils.knobs import TUNER_KNOBS
+
+    section = _knob_section()
+    assert set(section["vector"]) == set(TUNER_KNOBS.names())
+    for name, ent in section["vector"].items():
+        assert {"value", "source", "pinned"} <= set(ent), name
+    assert section["tuner_active"] is False
+    report = {"cluster_MBps": 1.0, "cluster_p50_ms": 1,
+              "cluster_p99_ms": 2, "engine_GBps": 80.0,
+              "engine_source": "baseline", "gap_x": 10.0,
+              "backend": "jax", "profile": "k2m1",
+              "stages": {}, "subops": {}, "coverage_pct": 0.0,
+              "knobs": section}
+    print_table(report)
+    out = capsys.readouterr().out
+    assert "knobs (tuner off" in out
+    assert "engine_window=" in out

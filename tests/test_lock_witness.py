@@ -300,14 +300,33 @@ def test_witness_overhead_bounded(witness):
     """Proxy cost must stay linear and small: 100k witnessed
     acquire/release pairs in well under the tier-1 noise floor (the
     <10%-of-tier-1-wall bound holds because ONLY the gate tests
-    enable the witness at all)."""
-    lock = lw.make_lock("bench.lock")
-    t0 = time.perf_counter()
-    for _ in range(100_000):
-        with lock:
-            pass
-    elapsed = time.perf_counter() - t0
-    assert elapsed < 5.0, f"witnessed acquire too slow: {elapsed:.2f}s"
+    enable the witness at all).
+
+    ISSUE 13 de-flake: the old absolute <5 s wall bound flaked on
+    the 1-core CI box whenever the suite's other threads stole the
+    core mid-loop. The measured quantity is the witness's RELATIVE
+    overhead, so assert it as a paired ratio against a bare
+    threading.Lock driven through the identical loop in the same
+    scheduling weather (directional: witnessed slower, but bounded),
+    with a widened absolute ceiling kept as the runaway backstop."""
+    import threading
+
+    def drive(lock) -> float:
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            with lock:
+                pass
+        return time.perf_counter() - t0
+
+    bare_s = drive(threading.Lock())
+    witnessed_s = drive(lw.make_lock("bench.lock"))
+    # measured ~8-12x on the CI box; 60x flags a superlinear proxy
+    # while staying far from scheduler noise
+    assert witnessed_s < 60.0 * max(bare_s, 1e-4), \
+        f"witness overhead ratio blown: {witnessed_s:.3f}s vs " \
+        f"bare {bare_s:.3f}s"
+    assert witnessed_s < 20.0, \
+        f"witnessed acquire runaway: {witnessed_s:.2f}s"
 
 
 # -- the cluster gate ----------------------------------------------------

@@ -175,3 +175,83 @@ def test_placement_fidelity_loopback_vs_tcp(mesh_env):
     assert dec_lo == dec_tcp
     assert slots_lo == slots_tcp
     assert shapes_lo == shapes_tcp, (shapes_lo, shapes_tcp)
+
+
+# -- ISSUE 13 satellites: non-pow2 stripe rows + load-aware weights ----
+
+def test_non_pow2_stripe_rows_encode_decode_bit_exact():
+    """ROADMAP item 2b leftover: a mesh whose stripe axis is NOT a
+    power of two (6 devices as 3x2) runs the sharded encode step and
+    the decode twin bit-exactly — _round_stripes pads the batch to a
+    multiple of ANY row count, and the placement map's slots/
+    submeshes work for any n_slots."""
+    import jax
+    from ceph_tpu.models import registry as ec_registry
+    from ceph_tpu.osd import ec_util
+
+    assert len(jax.devices()) >= 6
+    mesh = mesh_mod.make_mesh(6, stripe=3, shard=2)
+    assert dict(mesh.shape) == {"stripe": 3, "shard": 2}
+    codec = ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "2", "m": "1",
+                     "backend": "jax"})
+    sinfo = ec_util.StripeInfo(stripe_width=2 * 4096,
+                               chunk_size=4096)
+    rng = np.random.default_rng(5)
+    bufs = [rng.integers(0, 256, 2 * 4096, dtype=np.uint8)
+            for _ in range(5)]           # 5 stripes: not % 3 either
+    results = ec_util._flush_mesh(mesh, sinfo, codec,
+                                  list(range(5)), bufs)()
+    host = ec_registry.instance().factory(
+        "jerasure", {"plugin": "jerasure", "k": "2", "m": "1",
+                     "backend": "numpy"})
+    for (op, shards, err), buf in zip(results, bufs):
+        assert err is None
+        want = ec_util.encode(sinfo, host, buf, [2])
+        assert np.array_equal(np.asarray(shards[2]).ravel(),
+                              np.asarray(want[2]).ravel()), op
+    # decode twin on the same non-pow2 mesh reconstructs chunk 1
+    present = {0: np.concatenate([r[1][0] for r in results]),
+               2: np.concatenate([r[1][2] for r in results])}
+    out = ec_util.flush_decode_mesh(mesh, sinfo, codec, present, [1])
+    want = np.concatenate([b[4096:] for b in bufs])
+    assert np.array_equal(
+        np.asarray(out[1]).ravel()[:len(want)], want)
+    # the placement map over 3 rows: stable slots, (1, 2) submeshes
+    pmap = placement.PlacementMap(mesh)
+    assert pmap.n_slots == 3
+    slots = {pmap.slot((1, i)) for i in range(32)}
+    assert slots <= {0, 1, 2} and len(slots) == 3
+    for s in range(3):
+        assert dict(pmap.submesh(s).shape) == {"stripe": 1,
+                                               "shard": 2}
+
+
+def test_weighted_placement_biases_and_falls_back():
+    """Load-aware weighting (the tuner's chip-load actuator): a
+    de-weighted slot receives measurably fewer NEW pgids, the map
+    stays a pure function (same pgid -> same slot, process-wide),
+    and clearing the weights restores the EXACT historical modulo
+    map — hash-uniform is the default and the fallback."""
+    import jax
+    mesh = mesh_mod.make_mesh(8)
+    pmap = placement.PlacementMap(mesh)
+    pgids = [(1, i) for i in range(512)]
+    placement.set_slot_weights(None)
+    uniform = [pmap.slot(p) for p in pgids]
+    assert uniform == [placement.stable_hash(p) % pmap.n_slots
+                       for p in pgids]
+    try:
+        # slot 0 overloaded: 5x de-weighted
+        placement.set_slot_weights({0: 0.2, 1: 1.0})
+        weighted = [pmap.slot(p) for p in pgids]
+        assert weighted == [pmap.slot(p) for p in pgids]  # pure fn
+        n0_uniform = uniform.count(0)
+        n0_weighted = weighted.count(0)
+        assert n0_weighted < 0.6 * n0_uniform, \
+            (n0_uniform, n0_weighted)
+        assert set(weighted) == set(range(pmap.n_slots))  # no slot
+        #                                         is ever excluded
+    finally:
+        placement.set_slot_weights(None)
+    assert [pmap.slot(p) for p in pgids] == uniform

@@ -370,6 +370,45 @@ def test_drift_asok_unregistered_invoke_caught():
     assert "registry_drift:asok-unregistered:real cmd" not in keys
 
 
+def test_drift_tuner_knob_unobserved_caught():
+    """ISSUE 13: a tuner-managed knob (the live utils/knobs registry
+    names them) whose Option is declared with NO observer consumer
+    anywhere is flagged — runtime pushes would either pay a hot-path
+    config read or never land."""
+    bad = _drift_keys(
+        "Option('engine_window', int, 3)\n"
+        "x = g_conf()['engine_window']\n")
+    assert "registry_drift:tuner-knob-unobserved:engine_window" \
+        in bad
+    # a direct add_observer consumer clears it
+    good = _drift_keys(
+        "Option('engine_window', int, 3)\n"
+        "x = g_conf()['engine_window']\n"
+        "g_conf().add_observer('engine_window', fn)\n")
+    assert not any("tuner-knob-unobserved:engine_window" in k
+                   for k in good)
+    # ...as does the engine's _observe_knob seam
+    seam = _drift_keys(
+        "Option('mesh_flush_bytes', int, 1)\n"
+        "x = g_conf()['mesh_flush_bytes']\n"
+        "self._observe_knob('mesh_flush_bytes', fn)\n")
+    assert not any("tuner-knob-unobserved:mesh_flush_bytes" in k
+                   for k in seam)
+    # ...as does the tracer's _CFG_KEYS loop-over-keys idiom
+    keys_idiom = _drift_keys(
+        "Option('trace_sample_every', int, 64)\n"
+        "x = g_conf()['trace_sample_every']\n"
+        "_CFG_KEYS = ('trace_sample_every',)\n")
+    assert not any(
+        "tuner-knob-unobserved:trace_sample_every" in k
+        for k in keys_idiom)
+    # a non-tuner option never triggers this finding
+    other = _drift_keys(
+        "Option('mon_lease', float, 5.0)\n"
+        "x = g_conf()['mon_lease']\n")
+    assert not any("tuner-knob-unobserved" in k for k in other)
+
+
 # ---------------------------------------------------------------------------
 # family 4: lock discipline — seeded violations
 # ---------------------------------------------------------------------------

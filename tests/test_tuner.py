@@ -1,0 +1,401 @@
+"""ISSUE 13: the closed-loop tuner's control discipline and safety.
+
+Everything here runs the REAL TunerEngine headless: scripted sensors,
+scripted clock, a private ConfigProxy — deterministic by
+construction. Pinned:
+
+- Knob mechanics: bounded steps, type quantization, the operator-pin
+  precedence (env/override outrank the tuner's mon-layer pushes);
+- control discipline: hysteresis (a one-tick blip moves nothing),
+  cool-down pacing (one actuation in flight, judged before the
+  next), revert-on-regression with the bench_trend direction
+  convention, escalating quarantine on repeated reverts;
+- safety: a mgr killed mid-adjustment leaves every knob in bounds;
+  tuner off is a literal NOOP (no engine, no counters registry, no
+  knob writes, no threads);
+- the actuator seam: a runtime knob push lands in a live
+  DeviceEncodeEngine through its cached observer, and detaches at
+  engine stop;
+- load-aware placement weighting: imbalance publishes a weight
+  vector, balance clears it back to hash-uniform.
+"""
+
+import threading
+
+import pytest
+
+from ceph_tpu.mgr.tuner import (
+    DEFAULT_RULES,
+    LiveSensors,
+    Module as TunerModule,
+    ScriptedSensors,
+    TunerEngine,
+    _set_active,
+    status_if_active,
+)
+from ceph_tpu.utils.config import SCHEMA, ConfigProxy, g_conf
+from ceph_tpu.utils.knobs import TUNER_KNOBS, Knob, KnobRegistry
+
+BASE = {"p99_ms": 10.0, "mbps": 100.0, "hbm_live": 0,
+        "hbm_limit": 1 << 30, "inflight": 3, "window": 3,
+        "occupancy": 1, "flush_bytes_mean": 0, "health_rank": 0,
+        "fault_events": 0, "mesh_slots": 0, "slot_staged": {}}
+
+SATURATED = dict(BASE, inflight=3, window=3)          # window_grow
+QUIET = dict(BASE, inflight=1)                        # nothing fires
+
+
+def _engine(trace, conf=None, **kw):
+    conf = conf or ConfigProxy(SCHEMA)
+    clock = [0.0]
+
+    def advance():
+        clock[0] += 1.0
+        return clock[0]
+
+    eng = TunerEngine(ScriptedSensors(trace), conf=conf,
+                      clock=lambda: clock[0], wall=lambda: clock[0],
+                      publish_perf=False, **kw)
+    return eng, conf, clock
+
+
+def _run(eng, clock, ticks):
+    out = []
+    for _ in range(ticks):
+        clock[0] += 1.0
+        out.extend(eng.tick())
+    return out
+
+
+# -- knob mechanics ----------------------------------------------------
+
+def test_knob_steps_clamp_and_quantize():
+    conf = ConfigProxy(SCHEMA)
+    w = TUNER_KNOBS.get("engine_window")
+    assert w.up(3, conf) == 4 and w.down(3, conf) == 2
+    assert w.down(1, conf) == 1 and w.up(16, conf) == 16   # clamped
+    fb = TUNER_KNOBS.get("engine_flush_bytes")
+    assert fb.up(1 << 20, conf) == 2 << 20
+    assert fb.down(1 << 20, conf) == 1 << 20               # at lo
+    assert isinstance(fb.up(1 << 20, conf), int)           # quantized
+    hz = TUNER_KNOBS.get("profiler_hz")
+    assert hz.up(50.0, conf) == 100.0                      # float knob
+
+
+def test_knob_envelope_within_option_bounds():
+    """Every declared knob's envelope must sit inside its Option's
+    hard min/max — a tuner value an Option would reject could strand
+    a daemon mid-push."""
+    for knob in TUNER_KNOBS:
+        opt = SCHEMA.get(knob.name)
+        opt.coerce(knob.lo if opt.type is not int else int(knob.lo))
+        opt.coerce(knob.hi if opt.type is not int else int(knob.hi))
+
+
+def test_push_lands_on_mon_layer_and_pins_win():
+    conf = ConfigProxy(SCHEMA)
+    val, landed = TUNER_KNOBS.push("engine_window", 7, conf)
+    assert (val, landed) == (7, True)
+    assert conf.source_of("engine_window") == "mon"
+    # an env-layer pin outranks the push: the tuner must SEE that
+    conf.set("engine_window", 2, source="env")
+    val, landed = TUNER_KNOBS.push("engine_window", 9, conf)
+    assert not landed and conf["engine_window"] == 2
+    detail = TUNER_KNOBS.vector_detail(conf)
+    assert detail["engine_window"]["pinned"]
+    assert detail["engine_flush_bytes"]["pinned"] is False
+
+
+def test_duplicate_knob_rejected():
+    reg = KnobRegistry([Knob("engine_window", 1, 8, 1, kind="add")])
+    with pytest.raises(ValueError):
+        reg.add(Knob("engine_window", 1, 8, 1, kind="add"))
+
+
+# -- control discipline ------------------------------------------------
+
+def test_hysteresis_one_tick_blip_moves_nothing():
+    trace = [QUIET, SATURATED, QUIET, QUIET, QUIET, QUIET]
+    eng, conf, clock = _engine(trace)
+    _run(eng, clock, 6)
+    assert conf["engine_window"] == SCHEMA.get(
+        "engine_window").default
+    assert eng.history_dump() == []
+
+
+def test_step_then_cooldown_then_judgment():
+    eng, conf, clock = _engine([SATURATED] * 20)
+    decisions = _run(eng, clock, 8)
+    kinds = [(d["kind"], d["t"]) for d in decisions]
+    # hysteresis=2 -> step at t=2; cooldown 3 -> judged (confirmed,
+    # flat objective) at t=5; next step waits a full cooldown more
+    assert kinds[0] == ("step", 2.0)
+    assert kinds[1] == ("confirm", 5.0)
+    steps = [d for d in decisions if d["kind"] == "step"]
+    assert all(b["t"] - a["t"] >= eng.cooldown_s
+               for a, b in zip(steps, steps[1:]))
+    # while a step is pending, nothing else actuates
+    for a, b in zip(decisions, decisions[1:]):
+        if a["kind"] == "step":
+            assert b["knob"] == a["knob"]
+
+
+def test_revert_on_regression_within_one_cooldown():
+    bad = dict(SATURATED, p99_ms=40.0)     # 4x p99, flat throughput
+    eng, conf, clock = _engine([SATURATED] * 2 + [bad] * 20)
+    decisions = _run(eng, clock, 12)
+    step = next(d for d in decisions if d["kind"] == "step")
+    revert = next(d for d in decisions if d["kind"] == "revert")
+    assert revert["t"] - step["t"] <= eng.cooldown_s
+    assert revert["knob"] == "engine_window"
+    assert revert["from"] == step["to"]
+    assert revert["to"] == step["from"]
+    assert conf["engine_window"] == step["from"]
+    # the judgment is the bench_trend direction convention
+    assert revert["judge"]["d_p99_pct"] < -eng.threshold_pct
+    # the reverted knob is quarantined: no further window steps
+    # inside the burn window
+    later_steps = [d for d in decisions
+                   if d["kind"] == "step" and d["t"] > revert["t"]
+                   and d["knob"] == "engine_window"]
+    assert all(d["t"] >= revert["t"] + 4 * eng.cooldown_s
+               for d in later_steps)
+
+
+def test_escalating_backoff_on_repeated_reverts():
+    """Every consecutive revert of the same probe doubles the
+    quarantine — the flap damper. Needs a RESPONSIVE plant (p99
+    follows the knob): against a static trace the controller rightly
+    concludes its step changed nothing and confirms it."""
+    conf = ConfigProxy(SCHEMA)
+
+    class Responsive:
+        def sample(self):
+            w = conf["engine_window"]
+            return dict(SATURATED,
+                        p99_ms=10.0 if w <= 3 else 40.0)
+
+    clock = [0.0]
+    eng = TunerEngine(Responsive(), conf=conf,
+                      clock=lambda: clock[0], wall=lambda: clock[0],
+                      publish_perf=False)
+    decisions = _run(eng, clock, 150)
+    reverts = [d["t"] for d in decisions
+               if d["kind"] == "revert"
+               and d["knob"] == "engine_window"]
+    assert len(reverts) >= 3
+    assert conf["engine_window"] == 3         # always rolled back
+    gaps = [b - a for a, b in zip(reverts, reverts[1:])]
+    assert all(b > a for a, b in zip(gaps, gaps[1:])), gaps
+
+
+def test_pinned_knob_never_stepped():
+    conf = ConfigProxy(SCHEMA)
+    conf.set("engine_window", 3, source="env")     # operator pin
+    eng, conf, clock = _engine([SATURATED] * 10, conf=conf)
+    _run(eng, clock, 10)
+    assert conf.source_of("engine_window") == "env"
+    assert conf["engine_window"] == 3
+    assert not any(d["kind"] == "step"
+                   and d["knob"] == "engine_window"
+                   for d in eng.history_dump())
+
+
+def test_clamped_at_bound_counts_not_steps():
+    conf = ConfigProxy(SCHEMA)
+    conf.set("engine_window", 16)                  # knob hi
+    # hbm pressure wants window DOWN; saturation wants UP — at the
+    # hi bound the up-rule must clamp, not spin
+    eng, conf2, clock = _engine([SATURATED] * 8, conf=conf)
+    _run(eng, clock, 8)
+    assert conf["engine_window"] == 16 or \
+        conf.source_of("engine_window") == "override"
+    assert all(d["to"] != d["from"] for d in eng.history_dump()
+               if d["kind"] == "step")
+
+
+def test_determinism_same_trace_same_history():
+    bad = dict(SATURATED, p99_ms=40.0, mbps=60.0)
+    trace = [SATURATED] * 3 + [bad] * 10 + [QUIET] * 10
+    eng1, _, c1 = _engine(trace)
+    eng2, _, c2 = _engine(trace)
+    _run(eng1, c1, 23)
+    _run(eng2, c2, 23)
+
+    def strip(hist):
+        return [{k: v for k, v in d.items() if k != "trace_id"}
+                for d in hist]
+
+    assert strip(eng1.history_dump()) == strip(eng2.history_dump())
+    assert eng1.history_dump() != []
+
+
+def test_mid_adjustment_kill_leaves_knobs_in_bounds():
+    """A mgr that dies between step and judgment (shutdown without
+    revert, or no shutdown at all) leaves every knob inside its
+    declared envelope — pushes are clamped at the only write path."""
+    chaos = []
+    for i in range(40):
+        chaos.append(dict(SATURATED,
+                          p99_ms=10.0 * (1 + (i * 7) % 5),
+                          hbm_live=(i % 3) * (1 << 29),
+                          occupancy=(i * 3) % 8,
+                          health_rank=i % 2))
+    eng, conf, clock = _engine(chaos)
+    _run(eng, clock, 17)      # stop mid-run: pending may be open
+    del eng                   # the "kill": nobody judges or reverts
+    for knob in TUNER_KNOBS:
+        val = conf[knob.name]
+        assert knob.lo <= val <= knob.hi, (knob.name, val)
+        SCHEMA.get(knob.name).coerce(val)
+
+
+# -- off = literal NOOP ------------------------------------------------
+
+class _StubMgr:
+    def __init__(self):
+        self.modules = {}
+
+
+def test_tuner_off_is_literal_noop(monkeypatch):
+    from ceph_tpu.utils.perf_counters import collection
+    monkeypatch.delenv("CEPH_TPU_TUNER", raising=False)
+    assert g_conf()["tuner_enabled"] is False      # default OFF
+    collection().remove("tuner")                   # fresh view
+    before_threads = {t.name for t in threading.enumerate()}
+    before_diff = dict(g_conf().diff())
+    mod = TunerModule(_StubMgr())
+    mod.tick()
+    assert mod.engine is None
+    assert mod.TICK_PERIOD == 0.0                  # never ticked
+    assert collection().get("tuner") is None       # zero counters
+    assert dict(g_conf().diff()) == before_diff    # zero knob writes
+    assert {t.name for t in threading.enumerate()} == before_threads
+    code, msg, data = mod.handle_command({"prefix": "status"})
+    assert code == 0 and b'"enabled": false' in data
+    mod.shutdown()
+
+
+def test_env_switch_enables(monkeypatch):
+    from ceph_tpu.mgr.tuner import tuner_on
+    monkeypatch.delenv("CEPH_TPU_TUNER", raising=False)
+    assert tuner_on() is False
+    monkeypatch.setenv("CEPH_TPU_TUNER", "1")
+    assert tuner_on() is True
+    monkeypatch.setenv("CEPH_TPU_TUNER", "0")
+    assert tuner_on() is False
+
+
+# -- sensors -----------------------------------------------------------
+
+def test_live_sensors_sample_shape():
+    snap = LiveSensors().sample()
+    assert isinstance(snap, dict)
+    for key in ("p99_ms", "hbm_limit"):
+        assert isinstance(snap.get(key, 0), (int, float))
+    # never raises, even with no health source and a cold stack
+
+
+def test_rules_cover_every_knob_family():
+    """Every declared actuator has at least one rule that can move
+    it — a knob no rule touches is dead weight in the registry."""
+    ruled = {r.knob for r in DEFAULT_RULES}
+    for name in TUNER_KNOBS.names():
+        assert name in ruled or name == "host_flush_bytes", name
+    # host_flush_bytes is registry-managed (bounds/pins/reporting)
+    # but deliberately not auto-stepped yet: its crossover is
+    # calibrated (BASELINE.md), not load-dependent
+
+
+# -- the actuator seam (runtime observers) -----------------------------
+
+def test_engine_window_push_lands_via_observer(monkeypatch):
+    monkeypatch.delenv("CEPH_TPU_ENGINE_WINDOW", raising=False)
+    monkeypatch.delenv("CEPH_TPU_ENGINE_FLUSH_BYTES", raising=False)
+    from ceph_tpu.osd.device_engine import DeviceEncodeEngine
+    eng = DeviceEncodeEngine(lambda k, f: f())
+    try:
+        assert eng._window == g_conf()["engine_window"]
+        g_conf().set("engine_window", 5, source="mon")
+        assert eng._window == 5
+        g_conf().set("engine_flush_bytes", 128 << 20, source="mon")
+        assert eng._flush_bytes == 128 << 20
+        g_conf().set("mesh_flush_bytes", 2 << 20, source="mon")
+        assert eng._mesh_flush_bytes == 2 << 20
+        g_conf().set("host_flush_bytes", 256 << 10, source="mon")
+        assert eng._host_flush_bytes == 256 << 10
+    finally:
+        eng.stop()
+        g_conf().set_mon_layer({})
+    # after stop the observers are detached: pushes no longer land
+    g_conf().set("engine_window", 9, source="mon")
+    try:
+        assert eng._window == 5
+    finally:
+        g_conf().set_mon_layer({})
+
+
+def test_engine_env_pin_freezes_knob(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_ENGINE_WINDOW", "2")
+    from ceph_tpu.osd.device_engine import DeviceEncodeEngine
+    eng = DeviceEncodeEngine(lambda k, f: f())
+    try:
+        assert eng._window == 2
+        g_conf().set("engine_window", 8, source="mon")
+        assert eng._window == 2                    # pinned
+    finally:
+        eng.stop()
+        g_conf().set_mon_layer({})
+
+
+# -- placement weighting ----------------------------------------------
+
+def test_weights_rule_publishes_and_clears():
+    from ceph_tpu.parallel import placement
+    placement.set_slot_weights(None)
+    hot = dict(BASE, mesh_slots=4,
+               slot_staged={0: 900, 1: 30, 2: 40, 3: 30})
+    balanced = dict(BASE, mesh_slots=4,
+                    slot_staged={0: 25, 1: 25, 2: 25, 3: 25})
+    eng, conf, clock = _engine([hot] * 4 + [balanced] * 4)
+    try:
+        _run(eng, clock, 4)
+        weights = placement.slot_weights()
+        assert weights is not None
+        assert weights[0] < min(weights[s] for s in (1, 2, 3))
+        kinds = [d["kind"] for d in eng.history_dump()]
+        assert "weights" in kinds
+        _run(eng, clock, 4)
+        assert placement.slot_weights() is None    # back to uniform
+    finally:
+        eng.shutdown()
+        placement.set_slot_weights(None)
+
+
+def test_shutdown_clears_weights():
+    from ceph_tpu.parallel import placement
+    hot = dict(BASE, mesh_slots=2, slot_staged={0: 1000, 1: 10})
+    eng, conf, clock = _engine([hot] * 4)
+    _run(eng, clock, 3)
+    assert placement.slot_weights() is not None
+    eng.shutdown()
+    assert placement.slot_weights() is None
+
+
+# -- the bundle / status surface ---------------------------------------
+
+def test_status_and_bundle_surface():
+    bad = dict(SATURATED, p99_ms=40.0)
+    eng, conf, clock = _engine([SATURATED] * 2 + [bad] * 10)
+    _run(eng, clock, 8)
+    st = eng.status()
+    assert st["enabled"] and st["decisions"] >= 2
+    assert set(st["knobs"]) == set(TUNER_KNOBS.names())
+    _set_active(eng)
+    try:
+        brief = status_if_active()
+        assert brief is not None
+        assert any(d["kind"] == "revert" for d in brief["history"])
+    finally:
+        _set_active(None)
+    assert status_if_active() is None
