@@ -1056,6 +1056,40 @@ def _bench_load_gen() -> None:
         "qos_within_bar": bool(out["qos"]["within_bar"]),
         "wall_s": round(time.perf_counter() - t0, 1),
     })
+    _emit_commit_path_rows(healthy.get("MBps", 0.0))
+
+
+def _emit_commit_path_rows(measured_mbps: float) -> None:
+    """Derived commit-path rows (ISSUE 14, zero bench budget — pure
+    reads of what the load_gen run already recorded): the fsync cost
+    per store txn and the what-if projection, so the next perf PR's
+    before/after gates on them through bench_trend DIRECTIONS."""
+    try:
+        from ceph_tpu.tools.gap_report import _what_if
+        from ceph_tpu.utils.dataplane import dataplane
+        from ceph_tpu.utils.store_telemetry import telemetry
+        brief = telemetry().snapshot_brief()
+        emit("store_fsyncs_per_op", {
+            "value": brief.get("fsyncs_per_txn", 0.0),
+            "unit": "fsyncs/txn", "txns": brief.get("txns", 0),
+            "fsyncs": brief.get("fsyncs", 0)})
+        bd = dataplane().stage_breakdown()
+        wi = _what_if({"ops": bd.get("ops"),
+                       "mean_ms": bd.get("mean_ms"),
+                       "cluster_MBps": measured_mbps,
+                       "stages": bd.get("stages", {})})
+        emit("whatif_group_commit_MBps", {
+            "value": wi.get("projected_MBps", 0.0),
+            "unit": "MB/s",
+            "window_ms": wi.get("window_ms"),
+            "fsyncs_saved": wi.get("fsyncs_saved"),
+            "fsync_model": wi.get("fsync_model"),
+            "objecter_mean_batch":
+                (wi.get("objecter_stream") or {}).get("mean_batch"),
+        })
+    except Exception as exc:
+        emit("store_fsyncs_per_op", {"error": repr(exc)})
+        emit("whatif_group_commit_MBps", {"error": repr(exc)})
 
 
 def _cpu_baseline_gbps(mat) -> float:

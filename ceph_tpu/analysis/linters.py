@@ -30,6 +30,14 @@ the *static twin* of a runtime contract this repo already gates:
    mutating attributes that are elsewhere accessed under that lock
    must themselves hold it.
 
+5. **fsync seam** (ISSUE 14) — every durability barrier under
+   ``ceph_tpu/store/`` must go through the named timed-fsync seam
+   (``utils/store_telemetry.timed_fsync``/``timed_fdatasync``/
+   ``timed_sync``): a direct ``os.fsync``/``os.fdatasync`` call is an
+   unmeasured commit stall the commit-path X-ray cannot see — the
+   exact blind spot this PR closed; future stores don't get to
+   reopen it.
+
 Findings diff against the justified allowlist in
 ``analysis/baseline.json``; any NEW finding (or a stale baseline
 entry) fails ``tests/test_static_analysis.py`` in tier-1. Keys carry
@@ -890,6 +898,52 @@ def check_lock_discipline(src: SourceFile) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 5. fsync seam (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+#: the directory whose durability barriers must be timed (repo-
+#: relative prefix)
+FSYNC_SEAM_DIR = "ceph_tpu/store"
+
+#: call spellings that ARE a raw durability barrier
+_RAW_SYNC_CALLS = frozenset((
+    "os.fsync", "os.fdatasync", "fsync", "fdatasync"))
+
+
+def check_fsync_seam(src: SourceFile) -> list[Finding]:
+    """Direct ``os.fsync``/``os.fdatasync`` calls under
+    ``ceph_tpu/store/`` — untimed commit stalls. The store layer must
+    route every barrier through ``utils/store_telemetry``'s named
+    seam so fsync count/bytes/wall land per call site; a store that
+    syncs directly reopens the pre-ISSUE-14 blind spot under
+    ``commit_wait``."""
+    rel = src.rel.replace(os.sep, "/")
+    if not rel.startswith(FSYNC_SEAM_DIR + "/"):
+        return []
+    findings: list[Finding] = []
+
+    def visit(node: ast.AST, func: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            name = func
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                name = child.name
+            if isinstance(child, ast.Call) and \
+                    _unparse(child.func) in _RAW_SYNC_CALLS:
+                findings.append(Finding(
+                    "fsync_seam", src.rel, child.lineno,
+                    f"untimed-fsync:{rel}:{func}",
+                    f"{_unparse(child.func)} in {func}(): durability "
+                    "barrier bypasses the timed-fsync seam "
+                    "(store_telemetry.timed_fsync/timed_fdatasync/"
+                    "timed_sync) — an unmeasured commit stall"))
+            visit(child, name)
+
+    visit(src.tree, "<module>")
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver + baseline
 # ---------------------------------------------------------------------------
 
@@ -903,6 +957,7 @@ def run_all(root: str = PKG_ROOT,
         findings.extend(check_wire_symmetry(src))
         findings.extend(check_jit_hygiene(src))
         findings.extend(check_lock_discipline(src))
+        findings.extend(check_fsync_seam(src))
         drift.collect(src)
     findings.extend(drift.findings())
     findings.sort(key=lambda f: (f.path, f.line, f.key))

@@ -37,6 +37,14 @@ def attach_stage_breakdown(out: dict) -> dict:
         out["stage_breakdown"] = dataplane().stage_breakdown()
     except Exception:
         out["stage_breakdown"] = {}
+    # the commit-path brief (ISSUE 14): how many store txns/fsyncs
+    # the run cost, so a metric line is one dump_store away from the
+    # full X-ray; degrades to {} like the others
+    try:
+        from ceph_tpu.utils.store_telemetry import telemetry
+        out["store"] = telemetry().snapshot_brief()
+    except Exception:
+        out["store"] = {}
     return attach_trace_brief(out)
 
 

@@ -35,6 +35,7 @@ from ceph_tpu.utils import stage_clock
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.dout import Dout
+from ceph_tpu.utils.store_telemetry import telemetry as _store_tel
 
 log = Dout("objecter")
 
@@ -172,6 +173,16 @@ class Objecter:
             self._send(rec)
         finally:
             _profiler.pop_stage(_pstage)
+        # the submission-stream ledger (ISSUE 14, ROADMAP 1b's
+        # measurement): this op's (pool, PG) arrival + live in-flight
+        # depth feed the streaming-objecter what-if — how many of
+        # these per-op submits a streaming seam would have coalesced.
+        # _send resolved msg.ps; telemetry faults never cost an op.
+        try:
+            _store_tel().note_objecter_submit(msg.pool, msg.ps)
+            _stream_noted = True
+        except Exception:
+            _stream_noted = False
         try:
             # blocked on the cluster: a sample of this thread here is
             # client wait, not encode work (the classifier would
@@ -228,6 +239,11 @@ class Objecter:
                     pass   # telemetry faults never cost an op
             return reply
         finally:
+            if _stream_noted:
+                try:
+                    _store_tel().note_objecter_done(msg.pool, msg.ps)
+                except Exception:
+                    pass
             span.finish()
 
     def _send(self, op: _Op) -> None:

@@ -14,6 +14,9 @@ from the mgr's cluster view:
                       flushes, occupancy, calibration outcomes)
     GET /api/traces   tail-sampled tracing: keep/drop stats, kept
                       traces (reason, services), autopsy index
+    GET /api/store    commit-path X-ray: store txn sub-stage
+                      decomposition, fsync call sites, group-commit +
+                      streaming-objecter what-if ledgers
     GET /api/dataplane  per-op stage-latency decomposition (stage
                       breakdown + messenger counters + recent merged
                       timelines)
@@ -84,6 +87,12 @@ _PAGE = """<!doctype html>
 {dp_dropped}</p>
 <table><tr><th>stage</th><th>mean ms</th><th>share</th></tr>
 {dp_rows}</table>
+<h3>commit path</h3>
+<p>{store_summary}</p>
+<table><tr><th>commit sub-stage</th><th>mean ms</th>
+<th>share of commit_wait</th></tr>{commit_rows}</table>
+<table><tr><th>store txn sub-stage</th><th>mean us</th>
+<th>share</th></tr>{store_rows}</table>
 <h3>profiler</h3>
 <p>{prof_status}</p>
 <table><tr><th>stage</th><th>hot frame</th><th>samples</th>
@@ -146,6 +155,9 @@ class Module(MgrModule):
         if path == "/api/tuner":
             return 200, "application/json", json.dumps(
                 self._tuner_payload(), default=str).encode()
+        if path == "/api/store":
+            return 200, "application/json", json.dumps(
+                self._store_payload()).encode()
         if path == "/api/dataplane":
             from ceph_tpu.utils.dataplane import dataplane
             from ceph_tpu.utils.msgr_telemetry import telemetry as mt
@@ -246,6 +258,18 @@ class Module(MgrModule):
         return out
 
     @staticmethod
+    def _store_payload() -> dict:
+        """The commit-path panel (ISSUE 14): the store registry's txn
+        sub-stage decomposition, fsync call sites, and the two
+        batching what-if ledgers, plus the dataplane's commit-wait
+        envelope coverage."""
+        from ceph_tpu.utils.dataplane import dataplane
+        from ceph_tpu.utils.store_telemetry import telemetry
+        out = telemetry().snapshot()
+        out["commit_path"] = dataplane().commit_path()
+        return out
+
+    @staticmethod
     def _scrub_counters(tel) -> dict:
         counters = tel.snapshot()["counters"]
         return {key: counters.get(key, 0)
@@ -343,6 +367,32 @@ class Module(MgrModule):
             f"<td>{html.escape(ent['source'])}"
             f"{' (pinned)' if ent.get('pinned') else ''}</td></tr>"
             for name, ent in tp["knobs"].items())
+        sp = self._store_payload()
+        commit_rows = "".join(
+            f"<tr><td>{html.escape(stage)}</td>"
+            f"<td>{ent['mean_ms']}</td>"
+            f"<td>{ent['share_of_commit_pct']}%</td></tr>"
+            for stage, ent in
+            sp.get("commit_path", {}).get("stages", {}).items()) \
+            or "<tr><td colspan=3>no commit envelopes yet</td></tr>"
+        store_rows = "".join(
+            f"<tr><td>{html.escape(stage)}</td>"
+            f"<td>{ent['mean_us']}</td>"
+            f"<td>{ent['share_pct']}%</td></tr>"
+            for stage, ent in
+            sp.get("txn_breakdown", {}).get("stages", {}).items()) \
+            or "<tr><td colspan=3>no store txns yet</td></tr>"
+        wi_obj = sp.get("objecter_stream", {})
+        gc = sp.get("group_commit") or [{}]
+        pick = gc[len(gc) // 2]
+        store_summary = html.escape(
+            f"txns {sp.get('txn_breakdown', {}).get('txns', 0)} · "
+            f"commit coverage "
+            f"{sp.get('commit_path', {}).get('coverage_pct', 0)}% · "
+            f"what-if @{pick.get('window_ms')}ms: "
+            f"{pick.get('fsyncs_saved', 0)} fsyncs saved "
+            f"({pick.get('fsync_model', '-')}) · objecter coalesce "
+            f"{wi_obj.get('mean_batch', 0)} ops/batch")
         return _PAGE.format(
             health=html.escape(health),
             check_rows=check_rows,
@@ -373,6 +423,9 @@ class Module(MgrModule):
             dp_rows=dp_rows,
             prof_status=html.escape(json.dumps(prof.status())),
             prof_rows=prof_rows,
+            store_summary=store_summary,
+            commit_rows=commit_rows,
+            store_rows=store_rows,
         ).encode()
 
     # -- server --------------------------------------------------------

@@ -66,6 +66,7 @@ from ceph_tpu.store.object_store import (
 )
 from ceph_tpu.utils import stage_clock, tracing
 from ceph_tpu.utils.config import g_conf
+from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils.dout import Dout
 
@@ -187,8 +188,36 @@ class ECBackend(PGBackend):
         kv, drop = pg.log.stage(entry)
         positions = self.up_positions(pg)
         tid = self.parent.new_tid()
+        # the commit-wait envelope (ISSUE 14): a child timeline
+        # anchored where commit_wait starts measuring (the op clock's
+        # newest mark — device_finalize on the engine path, pg_process
+        # on the host path) whose consecutive intervals partition the
+        # primary's commit_wait: dispatch/txn-build -> flush-group
+        # ship -> shard-ack wait. Merged under the op at completion so
+        # dump_op_timeline and the dataplane histograms say WHY commit
+        # waited.
+        op_clock0 = stage_clock.current()
+        cclock = None
+        if op_clock0 is not stage_clock.NOOP:
+            cclock = stage_clock.StageClock(
+                name="commit_start", t=op_clock0.last_mark_t())
+
+        def all_committed() -> None:
+            if cclock is not None:
+                # ship may not have marked yet (all-local completions
+                # can finish inside the group ship itself): close the
+                # ship interval at the ack instant, once
+                cclock.mark_once("commit_ship_wait")
+                cclock.mark("commit_ack_wait")
+                op_clock0.merge_child("commit", cclock)
+                try:
+                    dataplane().record_stages(cclock.durations())
+                except Exception:
+                    pass   # telemetry faults never cost an op
+            on_commit(0)
+
         iw = InflightWrite(tid, pg, oid, version, set(positions),
-                           lambda: on_commit(0))
+                           all_committed)
         # an abandoned write must still drop its extent-cache pin:
         # a leaked entry would make covers()/overlay() feed stale
         # content to every later RMW on the object
@@ -201,7 +230,7 @@ class ECBackend(PGBackend):
         # timelines returning in MECSubWriteReply merge under it
         op_span = tracing.current()
         op_span.event(f"start {span_label}")
-        op_clock = stage_clock.current()
+        op_clock = op_clock0
         if op_clock is not stage_clock.NOOP:
             iw.clock = op_clock
         # bulk ingest (ISSUE 9): inside a flush-group continuation the
@@ -250,6 +279,17 @@ class ECBackend(PGBackend):
                             name="subop_send")
                     self.parent.send_osd(osd, sub)
                 child.finish()
+        if cclock is not None:
+            # the dispatch interval (continuation queue wait + PG
+            # lock + txn build) ends here; the ship interval closes
+            # when the flush group actually ships (immediately on the
+            # ungrouped path: its sends just happened inline)
+            cclock.mark("commit_dispatch")
+            if group is not None:
+                group.after_flush(
+                    lambda: cclock.mark_once("commit_ship_wait"))
+            else:
+                cclock.mark_once("commit_ship_wait")
         if supersedes_recovery:
             # a write of every shard supersedes pending recovery for it
             for missing in pg.peer_missing.values():

@@ -562,6 +562,8 @@ class OSD:
         _dp.register_asok(self.asok)
         from ceph_tpu.utils import msgr_telemetry as _mt
         _mt.register_asok(self.asok)
+        from ceph_tpu.utils import store_telemetry as _st
+        _st.register_asok(self.asok)
         from ceph_tpu.utils import faults as _faults
         _faults.register_asok(self.asok)
         self.asok.start()

@@ -32,7 +32,8 @@ import time
 from typing import Callable
 
 from ceph_tpu.analysis.lock_witness import make_lock
-from ceph_tpu.parallel.messages import Message, decode_message
+from ceph_tpu.parallel.messages import (MECSubWriteBatch, Message,
+                                        decode_message)
 from ceph_tpu.utils import checksum
 from ceph_tpu.utils import faults as _faults
 from ceph_tpu.utils import profiler as _prof
@@ -47,6 +48,10 @@ _HDR = struct.Struct("<IQH")   # magic, seq, msg type
 
 #: message types allowed before authentication (the MAuth exchange)
 _PREAUTH_TYPES = (38, 39, 63, 64)
+
+#: the bulk-ingest sub-write batch (one frame per peer per engine
+#: flush) — the type the wire-framing ledger accounts per-flush
+_BATCH_TYPE = MECSubWriteBatch.MSG_TYPE
 
 #: in-process peer registry (bulk ingest, ISSUE 9): listening addr ->
 #: Messenger for every bound endpoint in THIS process. Co-located
@@ -443,6 +448,11 @@ class Messenger:
         mtype = msg.MSG_TYPE
         tel.note_send(mtype, len(payload) + _HDR.size,
                       time.monotonic() - t_pick, 0.0)
+        # wire framing ledger (ISSUE 14): the loopback pays no frame
+        # header/meta/crc — overhead here is the header-equivalent
+        tel.note_framing(len(payload), len(payload) + _HDR.size,
+                         loopback=True,
+                         is_batch=mtype == _BATCH_TYPE)
         try:
             m2 = decode_message(mtype, payload)
         except Exception as exc:
@@ -599,6 +609,8 @@ class Messenger:
         tel.note_send(msg.MSG_TYPE, len(frame),
                       time.monotonic() - t_pick,
                       0.0 if t_submit is None else t_pick - t_submit)
+        tel.note_framing(len(payload), len(frame), loopback=False,
+                         is_batch=msg.MSG_TYPE == _BATCH_TYPE)
         try:
             async with conn.lock:
                 conn.writer.write(frame)

@@ -181,7 +181,9 @@ class _PyDataFile:
         return os.pread(self._f.fileno(), length, off), None
 
     def sync(self) -> None:
-        os.fdatasync(self._f.fileno())
+        from ceph_tpu.utils import store_telemetry
+        store_telemetry.timed_fdatasync(self._f.fileno(),
+                                        site="blockstore.data")
 
     def close(self) -> None:
         self._f.close()
@@ -249,6 +251,15 @@ class BlockStore(ObjectStore):
     def queue_transaction(self, txn: Transaction,
                           on_commit: Callable[[], None] | None = None) -> None:
         assert self._db is not None, "not mounted"
+        from ceph_tpu.utils import store_telemetry
+        tmr = store_telemetry.telemetry().txn_timer(
+            "blockstore", id(self))
+        tmr.n_ops = len(txn)
+        with tmr:
+            self._queue_transaction_timed(txn, tmr)
+            tmr.run_on_commit(on_commit)
+
+    def _queue_transaction_timed(self, txn: Transaction, tmr) -> None:
         _TP_QUEUE_TXN(len(txn))
         # stage 1: data-file appends for every WRITE op; blobs compress
         # when the configured algorithm saves enough
@@ -269,27 +280,36 @@ class BlockStore(ObjectStore):
         # engine need the explicit hash, done here.
         native = not isinstance(self._data, _PyDataFile)
         staged: list[tuple[int, bytes, bytes, int, int | None]] = []
-        for i, op in enumerate(txn.ops):
-            if op[0] == osr.OP_WRITE:
-                payload = op[4]
-                stored, comp_id = payload, COMP_NONE
-                if comp_alg is not None and len(payload) >= comp_min:
-                    packed = comp_alg.compress(payload)
-                    if len(packed) <= len(payload) * comp_ratio:
-                        stored = packed
-                        comp_id = _COMP_IDS[comp_alg.name]
-                pre = None if (csum_id == 0 and native) \
-                    else csum_fn(stored)
-                staged.append((i, payload, bytes(stored), comp_id, pre))
+        with tmr.stage("apply"):
+            for i, op in enumerate(txn.ops):
+                if op[0] == osr.OP_WRITE:
+                    payload = op[4]
+                    stored, comp_id = payload, COMP_NONE
+                    if comp_alg is not None and \
+                            len(payload) >= comp_min:
+                        packed = comp_alg.compress(payload)
+                        if len(packed) <= len(payload) * comp_ratio:
+                            stored = packed
+                            comp_id = _COMP_IDS[comp_alg.name]
+                    pre = None if (csum_id == 0 and native) \
+                        else csum_fn(stored)
+                    staged.append((i, payload, bytes(stored), comp_id,
+                                   pre))
         if staged:
+            t0 = tmr.now()
             with self._append_lock:
-                for i, payload, stored, comp_id, pre in staged:
-                    file_off, ncrc = self._data.append(stored)
-                    csum = pre if pre is not None else ncrc
-                    blob_at[i] = (file_off, len(payload), len(stored),
-                                  csum, comp_id, csum_id)
+                tmr.mark_wait("queue_wait", t0)
+                with tmr.stage("apply"):
+                    for i, payload, stored, comp_id, pre in staged:
+                        file_off, ncrc = self._data.append(stored)
+                        csum = pre if pre is not None else ncrc
+                        blob_at[i] = (file_off, len(payload),
+                                      len(stored), csum, comp_id,
+                                      csum_id)
             data_dirty = True
         if data_dirty:
+            # the data-file barrier: both engines route their
+            # fdatasync through the timed seam (site blockstore.data)
             self._data.sync()
 
         # stage 2: one kv batch for all metadata effects
@@ -319,6 +339,7 @@ class BlockStore(ObjectStore):
                     raise NoSuchObject(f"{cid}/{oid}")
             return metas[key]
 
+        t_kv = tmr.now()
         for i, op in enumerate(txn.ops):
             code = op[0]
             if code == osr.OP_MKCOLL:
@@ -378,9 +399,10 @@ class BlockStore(ObjectStore):
         for (cid, oid), m in metas.items():
             if m is not None:
                 batch.put(self._okey(cid, oid), m.encode())
+        tmr.add("kv_build", tmr.now() - t_kv)
+        # FileDB.submit lands wal_append + the kv.wal fsync on this
+        # txn's timer — the atomicity point's own decomposition
         self._db.submit(batch, sync=True)
-        if on_commit:
-            on_commit()
 
     # -- reads --------------------------------------------------------
     @staticmethod

@@ -145,14 +145,23 @@ class KStore(ObjectStore):
                           on_commit: Callable[[], None] | None = None
                           ) -> None:
         assert self._db is not None, "not mounted"
-        with self._lock:
-            self._validate(txn)
-            batch = WriteBatch()
-            for op in txn.ops:
-                self._apply_op(batch, op)
-            self._db.submit(batch, sync=True)
-        if on_commit:
-            on_commit()
+        from ceph_tpu.utils import store_telemetry
+        tmr = store_telemetry.telemetry().txn_timer("kstore", id(self))
+        tmr.n_ops = len(txn)
+        with tmr:
+            t0 = tmr.now()
+            with self._lock:
+                tmr.mark_wait("queue_wait", t0)
+                with tmr.stage("apply"):
+                    self._validate(txn)
+                with tmr.stage("kv_build"):
+                    batch = WriteBatch()
+                    for op in txn.ops:
+                        self._apply_op(batch, op)
+                # FileDB.submit lands wal_append + the kv.wal fsync
+                # on this txn's timer (MemDB commits in RAM: free)
+                self._db.submit(batch, sync=True)
+            tmr.run_on_commit(on_commit)
 
     def _apply_op(self, batch: WriteBatch, op: tuple) -> None:
         code = op[0]

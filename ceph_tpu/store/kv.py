@@ -14,8 +14,9 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 
-from ceph_tpu.utils import checksum
+from ceph_tpu.utils import checksum, store_telemetry
 from ceph_tpu.utils.encoding import DecodeError, Decoder, Encoder
 
 
@@ -140,13 +141,22 @@ class FileDB(KeyValueDB):
 
     # -- commits ------------------------------------------------------
     def submit(self, batch: WriteBatch, sync: bool = True) -> None:
+        # commit-path decomposition (ISSUE 14): the record build +
+        # write + flush is the wal_append sub-stage, the fsync its
+        # own — both attributed to the enclosing store txn when one
+        # is active (store_telemetry.current_timer)
+        t0 = time.perf_counter()
         payload = batch.encode()
         rec = self._REC_HDR.pack(len(payload),
                                  checksum.crc32c(payload)) + payload
         self._wal.write(rec)
         self._wal.flush()
+        store_telemetry.note_wal_append(time.perf_counter() - t0,
+                                        nbytes=len(rec))
         if sync:
-            os.fsync(self._wal.fileno())
+            store_telemetry.timed_fsync(self._wal.fileno(),
+                                        site="kv.wal",
+                                        nbytes=len(rec))
         self._apply(batch)
         self._wal_records += 1
         if self._wal_records >= 10000:
@@ -167,11 +177,13 @@ class FileDB(KeyValueDB):
         with open(tmp, "wb") as f:
             f.write(e.getvalue())
             f.flush()
-            os.fsync(f.fileno())
+            store_telemetry.timed_fsync(f.fileno(),
+                                        site="kv.compact.snapshot")
         os.replace(tmp, self._snap)
         self._wal.close()
         self._wal = open(self._walp, "wb")
-        os.fsync(self._wal.fileno())
+        store_telemetry.timed_fsync(self._wal.fileno(),
+                                    site="kv.compact.wal")
         self._wal_records = 0
 
     def close(self) -> None:

@@ -80,6 +80,16 @@ class MemStore(ObjectStore):
 
     def queue_transaction(self, txn: Transaction,
                           on_commit: Callable[[], None] | None = None) -> None:
+        from ceph_tpu.utils import store_telemetry
+        tmr = store_telemetry.telemetry().txn_timer(
+            "memstore", id(self))
+        tmr.n_ops = len(txn)
+        with tmr:
+            with tmr.stage("apply"):
+                self._apply(txn)
+            tmr.run_on_commit(on_commit)
+
+    def _apply(self, txn: Transaction) -> None:
         self._validate(txn)
         for op in txn.ops:
             code = op[0]
@@ -127,8 +137,6 @@ class MemStore(ObjectStore):
                 o = self._get_or_create(op[1], op[2])
                 for k in [k for k in o.omap if k.startswith(op[3])]:
                     del o.omap[k]
-        if on_commit:
-            on_commit()
 
     # -- reads --------------------------------------------------------
     def read(self, cid: str, oid: str, off: int = 0,

@@ -69,6 +69,10 @@ class NativeDataFile:
         return out[:n].tobytes(), int(crc.value)
 
     def sync(self) -> None:
+        from ceph_tpu.utils import store_telemetry
+        store_telemetry.timed_sync("blockstore.data", self._sync_raw)
+
+    def _sync_raw(self) -> None:
         rc = self._lib.ioeng_sync(self._fd)
         if rc < 0:
             raise OSError(-rc, "ioeng_sync")

@@ -46,6 +46,11 @@ DIRECTIONS = {
     "multichip_encode_GBps": "higher",
     "multichip_decode_GBps": "higher",
     "multichip_scaling": "higher",
+    # ISSUE 14: commit-path rows derived from the load_gen run —
+    # the name heuristic would misread both (no _ms/_GBps suffix on
+    # the first; the second must gate UP when store batching lands)
+    "store_fsyncs_per_op": "lower",
+    "whatif_group_commit_MBps": "higher",
 }
 
 

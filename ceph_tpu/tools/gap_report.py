@@ -152,6 +152,68 @@ def _mesh_section() -> dict:
         return {}
 
 
+def _store_section() -> dict:
+    """The commit-path store table (ISSUE 14): the txn sub-stage
+    decomposition + per-site fsync accounting the new store registry
+    measured during THIS run."""
+    try:
+        from ceph_tpu.utils.store_telemetry import telemetry
+        tel = telemetry()
+        return {"txn_breakdown": tel.txn_breakdown(),
+                "fsync_sites": tel.fsync_sites(),
+                "brief": tel.snapshot_brief()}
+    except Exception:
+        return {}
+
+
+def _what_if(report: dict) -> dict:
+    """The batching-opportunity ledger (ISSUE 14): what the measured
+    txn/submit adjacency projects for ROADMAP item 1's three fixes.
+    First-order latency-scaling model: per-op savings subtract from
+    the measured mean, throughput scales inversely — the honest
+    'if the batching landed at THIS adjacency' number, not a promise."""
+    try:
+        from ceph_tpu.utils.msgr_telemetry import telemetry as msgr_tel
+        from ceph_tpu.utils.store_telemetry import telemetry
+        tel = telemetry()
+        gc_windows = tel.group_commit_projection()
+        obj = tel.objecter_adjacency()
+        framing = msgr_tel().framing_brief()
+        ops = report.get("ops") or 0
+        mean_ms = report.get("mean_ms") or 0.0
+        mbps = report.get("cluster_MBps") or 0.0
+        # the middle window is THE projection (default 2 ms — inside
+        # one commit round trip); the full sweep rides along
+        pick = gc_windows[len(gc_windows) // 2] if gc_windows else {}
+        saved_commit_ms = (pick.get("wall_saved_s", 0.0) * 1e3 / ops) \
+            if ops else 0.0
+        client_ms = sum(
+            report.get("stages", {}).get(s, {}).get("mean_ms", 0.0)
+            for s in ("objecter_encode", "send_queue_wait",
+                      "commit_reply"))
+        mean_batch = obj.get("mean_batch") or 1.0
+        saved_stream_ms = client_ms * (1.0 - 1.0 / mean_batch) \
+            if mean_batch > 1.0 else 0.0
+        proj_mean = max(mean_ms - saved_commit_ms - saved_stream_ms,
+                        mean_ms * 0.05, 1e-6)
+        out = {
+            "group_commit": gc_windows,
+            "objecter_stream": obj,
+            "wire_framing": framing,
+            "window_ms": pick.get("window_ms"),
+            "fsyncs_saved": pick.get("fsyncs_saved", 0.0),
+            "fsync_model": pick.get("fsync_model", ""),
+            "saved_commit_ms_per_op": round(saved_commit_ms, 4),
+            "saved_stream_ms_per_op": round(saved_stream_ms, 4),
+            "projected_MBps": round(mbps * mean_ms / proj_mean, 1)
+            if mean_ms and mbps else 0.0,
+            "model": "first-order latency scaling",
+        }
+        return out
+    except Exception:
+        return {}
+
+
 def run_report(seconds: float, n_osds: int, obj_size: int,
                threads: int, k: int, m: int, backend: str,
                args) -> dict:
@@ -159,8 +221,14 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
     from ceph_tpu.utils.dataplane import dataplane
 
     # fresh stage registry: the table attributes THIS run, not
-    # whatever the process did before
+    # whatever the process did before (same for the store/commit-path
+    # registry the what-if ledgers live in)
     dataplane().reset()
+    try:
+        from ceph_tpu.utils.store_telemetry import telemetry as _st
+        _st().reset()
+    except Exception:
+        pass
     prof = None
     if getattr(args, "profile", False):
         from ceph_tpu.utils.profiler import profiler
@@ -198,7 +266,14 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
         "mesh": _mesh_section(),
         # ISSUE 13: the knob vector this attribution ran under
         "knobs": _knob_section(),
+        # ISSUE 14: why commit waited (the sub-stage decomposition
+        # under commit_wait) + what the store measured
+        "commit_path": breakdown.get("commit_path", {}),
+        "store": _store_section(),
     }
+    # ISSUE 14: the batching-opportunity projection (needs the
+    # report's own mean/stages, so assembled last)
+    report["what_if"] = _what_if(report)
     if prof is not None:
         report["profiler"] = _profile_section(prof)
     return report
@@ -251,6 +326,7 @@ def print_table(report: dict) -> None:
           f"{report['coverage_pct']:>16.1f}%")
     for stage, ent in report.get("subops", {}).items():
         print(f"  (subop) {stage:<20}{ent['mean_ms']:>9.3f} ms")
+    _print_commit_path(report)
     if prof:
         print(f"profiler: {prof['samples']} samples @ {prof['hz']} Hz"
               f", {prof['attributed_pct']}% stage-attributed, "
@@ -263,6 +339,43 @@ def print_table(report: dict) -> None:
             print(f"  (off-table) {stage:<22}{extra[stage]:>6} "
                   f"samples  {lead}")
     print()
+
+
+def _print_commit_path(report: dict) -> None:
+    """The commit-path X-ray block (ISSUE 14): sub-stage shares under
+    commit_wait, the store txn decomposition + fsync sites, and the
+    what-if projection line."""
+    commit = report.get("commit_path") or {}
+    if commit.get("stages"):
+        print()
+        print(f"commit path (under commit_wait "
+              f"{commit['commit_wait_ms']:.3f} ms, sub-stage "
+              f"coverage {commit['coverage_pct']:.1f}%):")
+        for stage, ent in commit["stages"].items():
+            print(f"  {stage:<20}{ent['mean_ms']:>9.3f} ms"
+                  f"{ent['share_of_commit_pct']:>7.1f}%")
+    store = report.get("store") or {}
+    txn = store.get("txn_breakdown") or {}
+    if txn.get("stages"):
+        parts = "  ".join(
+            f"{s}={e['mean_us']:.0f}us({e['share_pct']:.0f}%)"
+            for s, e in txn["stages"].items())
+        print(f"store txns ({txn['txns']}): {parts}")
+    sites = store.get("fsync_sites") or {}
+    if sites:
+        parts = "  ".join(
+            f"{site}: n={e['count']} {e['seconds'] * 1e3:.1f}ms"
+            for site, e in sorted(sites.items()))
+        print(f"fsync sites: {parts}")
+    wi = report.get("what_if") or {}
+    if wi:
+        obj = wi.get("objecter_stream") or {}
+        print(f"what-if @{wi.get('window_ms')}ms: group-commit saves "
+              f"{wi.get('fsyncs_saved')} fsyncs "
+              f"({wi.get('fsync_model')}), streaming objecter "
+              f"coalesces {obj.get('mean_batch')} ops/batch "
+              f"(max {obj.get('max_batch')}) -> projected "
+              f"{wi.get('projected_MBps')} MB/s")
 
 
 def main(argv=None) -> int:

@@ -84,6 +84,9 @@ class AutopsyStore:
         frames = self._hot_frames()
         if frames is not None:
             entry["hot_frames"] = frames
+        store_brief = self._store_brief()
+        if store_brief is not None:
+            entry["store"] = store_brief
         decisions = self._tuner_tail()
         if decisions is not None:
             entry["tuner_decisions"] = decisions
@@ -130,6 +133,24 @@ class AutopsyStore:
         try:
             from ceph_tpu.mgr import tuner as _tuner
             return _tuner.decisions_tail_if_active()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _store_brief():
+        """The commit-path state at the keep moment (ISSUE 14): txn /
+        fsync counts plus the sub-stage means — a slow op whose
+        commit waited on fsyncs should say so in its autopsy. Only
+        when the store registry already exists (diagnosing must not
+        allocate one)."""
+        try:
+            from ceph_tpu.utils import store_telemetry
+            tel = store_telemetry.telemetry_if_exists()
+            if tel is None:
+                return None
+            brief = tel.snapshot_brief()
+            brief["txn_breakdown"] = tel.txn_breakdown()
+            return brief
         except Exception:
             return None
 

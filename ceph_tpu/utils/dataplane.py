@@ -28,11 +28,18 @@ from collections import deque
 from ceph_tpu.utils import stage_clock
 from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
-#: every stage a timeline can carry (op stages + sub-op child stages),
-#: anchor marks excluded (they have no duration)
+#: every stage a timeline can carry (op stages + sub-op child stages
+#: + the commit-wait envelope children), anchor marks excluded (they
+#: have no duration)
 STAGE_KEYS = tuple(
     s for s in stage_clock.EC_WRITE_STAGES + stage_clock.SUBOP_STAGES
-    if s not in ("client_submit", "subop_send"))
+    + stage_clock.COMMIT_STAGES
+    if s not in ("client_submit", "subop_send", "commit_start"))
+
+#: child-vocabulary stages: they nest INSIDE commit_wait, so the main
+#: breakdown (whose stage sums partition the op end-to-end) skips
+#: them — they get their own commit-path view instead
+_CHILD_STAGES = stage_clock.SUBOP_STAGES + stage_clock.COMMIT_STAGES
 
 #: the client-owned stages (recorded by the Objecter; everything else
 #: is recorded by the daemon that marked it)
@@ -145,7 +152,7 @@ class DataplaneTelemetry:
         total_sum = tot["sum"]
         covered = 0.0
         for stage in STAGE_KEYS:
-            if stage in stage_clock.SUBOP_STAGES:
+            if stage in _CHILD_STAGES:
                 continue          # children nest inside commit_wait
             ent = snap[f"stage_{stage}"]
             if not ent["avgcount"]:
@@ -170,6 +177,44 @@ class DataplaneTelemetry:
                 subops[stage] = {"mean_ms": round(ent["avg"] * 1e3, 4)}
         if subops:
             out["subops"] = subops
+        commit = self.commit_path(snap)
+        if commit:
+            out["commit_path"] = commit
+        return out
+
+    def commit_path(self, snap: dict | None = None) -> dict:
+        """The commit-wait X-ray (ISSUE 14): each commit-envelope
+        child stage's mean and share OF commit_wait, plus the
+        coverage those children reach — the >= 90% acceptance bar
+        that says the decomposition explains why commit waited.
+        Empty when nothing recorded commit children (read-only runs,
+        old peers)."""
+        if snap is None:
+            snap = self.perf.dump()
+        cw = snap.get("stage_commit_wait") or {}
+        if not cw.get("avgcount"):
+            return {}
+        cw_sum = cw["sum"]
+        out = {"commit_wait_ms": round(cw["avg"] * 1e3, 4),
+               "stages": {}}
+        covered = 0.0
+        for stage in stage_clock.COMMIT_STAGES:
+            ent = snap.get(f"stage_{stage}") or {}
+            if not ent.get("avgcount"):
+                continue
+            covered += ent["sum"]
+            out["stages"][stage] = {
+                "mean_ms": round(ent["avg"] * 1e3, 4),
+                "share_of_commit_pct":
+                    round(100.0 * ent["sum"] / cw_sum, 1)
+                    if cw_sum else 0.0,
+                "p99_ms": self.percentile_ms(f"stage_{stage}_us",
+                                             0.99),
+            }
+        if not out["stages"]:
+            return {}
+        out["coverage_pct"] = round(
+            100.0 * covered / cw_sum, 1) if cw_sum else 0.0
         return out
 
     def exemplar_links(self) -> dict:

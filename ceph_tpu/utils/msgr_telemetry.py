@@ -76,6 +76,32 @@ class MessengerTelemetry:
                        "(all sharded queues in the process)")
         perf.add_histogram("send_frame_bytes",
                            "frame size per send (wire mix)")
+        # wire framing accounting (ISSUE 14): what bulk framing
+        # actually costs and where it runs — the measurement under
+        # ROADMAP 1(c)'s "make MECSubWriteBatch win on real TCP too"
+        perf.add_u64_counter("loopback_msgs",
+                             "messages delivered over the in-process "
+                             "loopback (no socket, no frame header)")
+        perf.add_u64_counter("tcp_msgs",
+                             "messages framed onto a real socket")
+        perf.add_u64_counter("batch_frames",
+                             "MECSubWriteBatch frames sent (one per "
+                             "peer per engine flush)")
+        perf.add_histogram("batch_frame_bytes",
+                           "serialized MECSubWriteBatch size per "
+                           "flush send")
+        perf.add_u64_counter("batch_payload_bytes",
+                             "MECSubWriteBatch payload bytes (pre-"
+                             "framing)")
+        perf.add_u64_counter("batch_framing_overhead_bytes",
+                             "frame bytes minus payload bytes on "
+                             "batch sends (header + meta + crc cost)")
+        perf.add_u64_counter("loopback_batch_frames",
+                             "batch frames that took the loopback "
+                             "(bulk framing pays off only here until "
+                             "ROADMAP 1c lands)")
+        perf.add_u64_counter("tcp_batch_frames",
+                             "batch frames that paid the real wire")
 
     # -- per-type side table ------------------------------------------
     def _type_ent(self, mtype: int) -> dict:
@@ -103,6 +129,43 @@ class MessengerTelemetry:
             ent["sent_bytes"] += frame_bytes
             ent["serialize_s"] = round(
                 ent["serialize_s"] + serialize_s, 9)
+
+    def note_framing(self, payload_bytes: int, frame_bytes: int,
+                     loopback: bool, is_batch: bool) -> None:
+        """Per-send framing accounting (both send paths call this
+        right after note_send): the loopback-vs-TCP split for every
+        message, plus per-flush serialized size + framing overhead
+        for MECSubWriteBatch frames."""
+        self.perf.inc("loopback_msgs" if loopback else "tcp_msgs")
+        if not is_batch:
+            return
+        self.perf.inc("batch_frames")
+        self.perf.hinc("batch_frame_bytes", frame_bytes)
+        self.perf.inc("batch_payload_bytes", payload_bytes)
+        self.perf.inc("batch_framing_overhead_bytes",
+                      max(0, frame_bytes - payload_bytes))
+        self.perf.inc("loopback_batch_frames" if loopback
+                      else "tcp_batch_frames")
+
+    def framing_brief(self) -> dict:
+        """The wire-framing slice of the what-if report: batch frame
+        count/size split by transport, mean framing overhead."""
+        c = self.perf.dump()
+        frames = c["batch_frames"]
+        return {
+            "loopback_msgs": c["loopback_msgs"],
+            "tcp_msgs": c["tcp_msgs"],
+            "batch_frames": frames,
+            "loopback_batch_frames": c["loopback_batch_frames"],
+            "tcp_batch_frames": c["tcp_batch_frames"],
+            "batch_payload_bytes": c["batch_payload_bytes"],
+            "mean_batch_frame_bytes":
+                round(c["batch_payload_bytes"] / frames
+                      + c["batch_framing_overhead_bytes"] / frames)
+                if frames else 0,
+            "framing_overhead_bytes":
+                c["batch_framing_overhead_bytes"],
+        }
 
     def note_send_error(self, mtype: int) -> None:
         self.perf.inc("send_errors")

@@ -57,6 +57,15 @@ EC_WRITE_STAGES = (
 SUBOP_STAGES = ("subop_send", "subop_wire", "subop_dispatch_wait",
                 "subop_commit")
 
+#: the commit-wait envelope (ISSUE 14): a ``commit`` child timeline
+#: the EC fan-out hangs under the op, partitioning the primary's
+#: ``commit_wait`` interval — anchor ``commit_start`` sits at the
+#: mark commit_wait measures from (device_finalize on the engine
+#: path, pg_process on the host path), so the child's intervals sum
+#: to the op's commit_wait (the >= 90% commit-path coverage bar)
+COMMIT_STAGES = ("commit_dispatch", "commit_ship_wait",
+                 "commit_ack_wait")
+
 #: one-line glossary served by ``dump_op_timeline`` and BASELINE.md
 GLOSSARY = {
     "client_submit": "anchor: op_submit entry on the client",
@@ -75,6 +84,13 @@ GLOSSARY = {
     "subop_wire": "sub-op frame serialize + socket + shard read loop",
     "subop_dispatch_wait": "shard fast dispatch -> op-wq dequeue",
     "subop_commit": "shard store transaction commit",
+    "commit_start": "anchor: where commit_wait starts measuring",
+    "commit_dispatch": "continuation queue wait + PG lock + fan-out "
+                       "txn build",
+    "commit_ship_wait": "flush-group ship: local store txn group + "
+                        "per-peer sub-write batch serialize/send",
+    "commit_ack_wait": "last local/remote shard commit ack + "
+                       "completion sweep",
 }
 
 
@@ -185,6 +201,12 @@ class StageClock:
         return [(marks[i][0], marks[i][1] - marks[i - 1][1])
                 for i in range(max(1, start), len(marks))]
 
+    def last_mark_t(self) -> float:
+        """Timestamp of the newest mark (the commit envelope anchors
+        its child clock here: commit_wait measures from this point)."""
+        with self._lock:
+            return self.marks[-1][1]
+
     def total(self) -> float:
         with self._lock:
             return self.marks[-1][1] - self.marks[0][1]
@@ -231,6 +253,9 @@ class _NoopClock:
 
     def own_durations(self) -> list:
         return []
+
+    def last_mark_t(self) -> float:
+        return 0.0
 
     def total(self) -> float:
         return 0.0

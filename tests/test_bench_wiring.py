@@ -114,6 +114,9 @@ def test_cluster_bench_line_carries_p50_p99_and_stage_breakdown():
     assert "wire" in bd["stages"]
     assert bd["stages"]["wire"]["share_pct"] >= 0
     assert "coverage_pct" in bd
+    # ISSUE 14: the commit-path store brief rides the same line
+    assert "store" in rec
+    assert "txns" in rec["store"] and "fsyncs" in rec["store"]
 
 
 def test_stage_breakdown_degrades_to_empty(monkeypatch):

@@ -21,6 +21,7 @@ def _ensure_registries():
     from ceph_tpu.utils.faults import registry as fault_registry
     from ceph_tpu.utils.msgr_telemetry import telemetry as msgr
     from ceph_tpu.utils.profiler import profiler
+    from ceph_tpu.utils.store_telemetry import telemetry as store_tel
     from ceph_tpu.utils.tracing import tracer
     telemetry()
     dataplane()
@@ -29,6 +30,7 @@ def _ensure_registries():
     fault_registry()
     tracer()
     autopsy_store()
+    store_tel()
 
 
 def test_every_counter_reaches_prometheus():
@@ -310,6 +312,52 @@ def test_trace_forced_keep_reason_covered():
     assert "forced" in KEEP_REASONS
     assert "trace_kept_forced" in set(tracer().perf.dump())
     assert "ceph_tpu_trace_kept_forced" in prometheus.render_text()
+
+
+def test_store_counters_covered_by_lint():
+    """ISSUE 14: the commit-path registry — txn sub-stage decomposition,
+    fsync seam accounting, the objecter stream ledger — is registered
+    (so the generic exporter lints above cover it) and reaches
+    prometheus AND the ``dump_store`` asok payload."""
+    _ensure_registries()
+    from ceph_tpu.utils import store_telemetry
+    from ceph_tpu.utils.store_telemetry import SUB_STAGES, telemetry
+    keys = set(telemetry().perf.dump())
+    expect = {"txns", "txn_ops", "fsyncs", "fsync_bytes",
+              "fsync_time", "objecter_ops", "objecter_pg_inflight",
+              "objecter_batch_ops"}
+    for stage in SUB_STAGES:
+        expect.add(f"txn_{stage}")
+        expect.add(f"txn_{stage}_us")
+    assert expect <= keys, expect - keys
+    text = prometheus.render_text()
+    for key in ("txns", "fsyncs", "txn_fsync_sum",
+                "objecter_ops"):
+        assert f"ceph_tpu_{key}" in text, key
+    assert 'daemon="store"' in text
+    # the new msgr framing counters ride the existing msgr registry
+    from ceph_tpu.utils.msgr_telemetry import telemetry as msgr
+    msgr_keys = set(msgr().perf.dump())
+    assert {"loopback_msgs", "tcp_msgs", "batch_frames",
+            "batch_frame_bytes", "batch_payload_bytes",
+            "batch_framing_overhead_bytes", "loopback_batch_frames",
+            "tcp_batch_frames"} <= msgr_keys
+    # asok side: dump_store carries every registered counter + the
+    # what-if ledgers
+
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    store_telemetry.register_asok(asok)
+    payload = asok.commands["dump_store"]({})
+    assert set(payload["counters"]) >= expect
+    assert "group_commit" in payload and "objecter_stream" in payload
+    assert "fsync_sites" in payload and "txn_breakdown" in payload
 
 
 def test_exemplars_do_not_break_prometheus_parsing():

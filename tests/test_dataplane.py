@@ -103,7 +103,8 @@ def test_ec_write_timeline_complete_and_monotonic(dp_run):
 
 def test_timeline_spans_shard_osds(dp_run):
     """Cross-daemon merge: at least one op carries shard children
-    whose sub-op stages are monotonic with durations >= 0."""
+    whose sub-op stages are monotonic with durations >= 0, and
+    (ISSUE 14) the commit-wait envelope child rides next to them."""
     with_children = [t for t in dp_run["timelines"]
                      if t.get("children")]
     assert with_children, "no timeline merged a shard sub-op child"
@@ -112,11 +113,21 @@ def test_timeline_spans_shard_osds(dp_run):
                for label in tl["children"]), tl["children"]
     for label, rows in tl["children"].items():
         names = [r["stage"] for r in rows]
-        assert names[0] == "subop_send", names
-        assert "subop_commit" in names, names
+        if label.startswith("shard"):
+            assert names[0] == "subop_send", names
+            assert "subop_commit" in names, names
         ts = [r["t_us"] for r in rows]
         assert ts == sorted(ts), rows
         assert all(r["dur_us"] >= 0 for r in rows), rows
+    # the commit-wait envelope: anchored where commit_wait starts,
+    # dispatch -> ship -> ack in order (the commit-path X-ray)
+    commit = tl["children"].get("commit")
+    assert commit is not None, tl["children"]
+    names = [r["stage"] for r in commit]
+    assert names[0] == "commit_start", names
+    assert names[-1] == "commit_ack_wait", names
+    assert "commit_dispatch" in names and \
+        "commit_ship_wait" in names, names
 
 
 def test_messenger_per_type_counters_advance(dp_run):

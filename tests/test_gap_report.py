@@ -47,6 +47,42 @@ def test_gap_report_quick_run_attributes_latency(capsys):
     assert rep["cluster_p50_ms"] > 0
     assert rep["cluster_p99_ms"] >= rep["cluster_p50_ms"]
 
+    # -- ISSUE 14: the commit-path X-ray on the same quick run --
+    # (a) the commit-wait envelope decomposes commit_wait: sub-stage
+    # sums cover >= 90% of the measured commit_wait
+    commit = rep["commit_path"]
+    assert commit["coverage_pct"] >= 90.0, commit
+    for stage in ("commit_dispatch", "commit_ship_wait",
+                  "commit_ack_wait"):
+        assert stage in commit["stages"], commit
+        assert commit["stages"][stage]["mean_ms"] >= 0.0
+    # (b) the what_if object parses and projects fsyncs-saved > 0
+    # under the bulk-ingest burst (memstore run: durable profile)
+    wi = rep["what_if"]
+    assert wi["fsyncs_saved"] > 0, wi
+    assert wi["fsync_model"] in ("measured", "durable_profile")
+    assert wi["projected_MBps"] >= rep["cluster_MBps"], wi
+    for row in wi["group_commit"]:
+        assert row["txns"] >= row["groups"] > 0
+    # (c) the objecter adjacency ledger shows coalescable ops > 1
+    # per (pool, PG) window under the concurrent burst
+    obj = wi["objecter_stream"]
+    assert obj["max_batch"] > 1, obj
+    assert obj["coalescable_ops"] > 0, obj
+    # (d) wire framing accounted: batch frames counted with their
+    # serialized sizes and a loopback/TCP split
+    framing = wi["wire_framing"]
+    assert framing["batch_frames"] > 0, framing
+    assert framing["loopback_msgs"] + framing["tcp_msgs"] > 0
+    assert framing["mean_batch_frame_bytes"] > 0
+    # (e) the store table rode the report: txn decomposition + brief
+    store = rep["store"]
+    assert store["txn_breakdown"]["txns"] > 0
+    assert store["brief"]["txns"] > 0
+    # the human table printed the commit-path block + what-if line
+    assert "commit path (under commit_wait" in out
+    assert "what-if @" in out
+
     # -- ISSUE 7: --profile joins hot frames under the stage rows --
     prof = rep["profiler"]
     assert prof["hz"] == 50.0

@@ -3,6 +3,7 @@ namespace ops over the wire, server-driven cap recall, journaled
 failover with completed-request dedup."""
 
 import errno
+import os
 import threading
 import time
 
@@ -86,8 +87,14 @@ def test_server_driven_cap_revoke(cluster, mds):
         f2 = m2.open("/capfile")
         assert f2.read() == b"v1"       # forced a revoke of m1's cap
         elapsed = time.monotonic() - t0
-        # revoke round-trip, NOT the 2 s lease expiry backstop
-        assert elapsed < 1.5, f"revoke took {elapsed:.2f}s (lease-" \
+        # revoke round-trip, NOT the 2 s lease expiry backstop. The
+        # measured quantity stays directional everywhere: the bar is
+        # core-gated (ISSUE 14 1-core de-flake) — on a loaded 1-core
+        # CI box the round-trip legitimately stretches, but the
+        # lease-expiry path costs >= 2.0 s by construction, so 1.9
+        # still discriminates.
+        bar = 1.5 if (os.cpu_count() or 1) >= 4 else 1.9
+        assert elapsed < bar, f"revoke took {elapsed:.2f}s (lease-" \
             "expiry path?)"
         holders = mds.cap_holders(ino)
         assert holders.get(m2.client_id) == "shared"

@@ -3,6 +3,7 @@ recovery work shares each wq shard by weighted round-robin with
 client ops — client latency stays bounded during recovery, recovery
 never fully starves."""
 
+import os
 import threading
 import time
 
@@ -116,8 +117,12 @@ def test_client_latency_bounded_during_recovery():
                 else lat[0]
             # bounded: far below SUBOP_TIMEOUT (5s); an unchunked,
             # unweighted queue parks client ops behind whole-PG
-            # recovery rounds
-            assert p99 < 3.0, (p99, len(lat))
+            # recovery rounds (those approach the 5 s timeout). Bar
+            # core-gated (ISSUE 14 1-core de-flake): full-suite GIL
+            # pressure on a 1-core box stretches honest tails, and
+            # 4.0 still discriminates against the 5 s parked class.
+            bar = 3.0 if (os.cpu_count() or 1) >= 4 else 4.0
+            assert p99 < bar, (p99, len(lat))
     finally:
         for k, v in old.items():
             conf.set(k, v)

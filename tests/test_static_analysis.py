@@ -19,6 +19,7 @@ runtime contract from drifting apart.
 """
 
 import json
+import os
 import subprocess
 import sys
 
@@ -550,3 +551,72 @@ def test_registry_covers_every_declared_class():
                 if issubclass(obj, M.Message) and obj is not M.Message
                 and obj.MSG_TYPE]
     assert {c.MSG_TYPE for c in declared} == set(M._REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# family 5: fsync seam (ISSUE 14) — seeded violations
+# ---------------------------------------------------------------------------
+
+def _fsync_keys(text: str, rel: str = "ceph_tpu/store/synthstore.py"
+                ) -> set[str]:
+    fs = linters.check_fsync_seam(_src(text, rel=rel))
+    return {f.key for f in fs}
+
+
+def test_untimed_fsync_in_store_caught():
+    keys = _fsync_keys('''
+import os
+
+class SynthStore:
+    def commit(self):
+        self._wal.flush()
+        os.fsync(self._wal.fileno())
+''')
+    assert "untimed-fsync:ceph_tpu/store/synthstore.py:commit" in keys
+
+
+def test_untimed_fdatasync_in_store_caught():
+    keys = _fsync_keys('''
+import os
+
+def barrier(fd):
+    os.fdatasync(fd)
+''')
+    assert ("untimed-fsync:ceph_tpu/store/synthstore.py:barrier"
+            in keys)
+
+
+def test_fsync_outside_store_dir_not_flagged():
+    """The seam contract scopes to ceph_tpu/store/ — the seam's own
+    os.fsync (utils/store_telemetry) and unrelated callers are not
+    findings."""
+    assert _fsync_keys('''
+import os
+
+def anywhere(fd):
+    os.fsync(fd)
+''', rel="ceph_tpu/utils/synth.py") == set()
+
+
+def test_timed_seam_calls_are_clean():
+    """A store that routes through the seam produces zero findings."""
+    assert _fsync_keys('''
+from ceph_tpu.utils import store_telemetry
+
+class SynthStore:
+    def commit(self):
+        store_telemetry.timed_fsync(self._wal.fileno(), site="synth")
+        store_telemetry.timed_sync("synth.data", self._data.sync)
+''') == set()
+
+
+def test_real_store_files_have_no_untimed_fsyncs():
+    """The live contract: every durability barrier in the shipped
+    stores goes through the seam TODAY (kv.py's WAL/compact fsyncs,
+    the blockstore data-file fdatasync — both engines)."""
+    store_srcs = [s for s in linters.iter_sources()
+                  if s.rel.replace(os.sep, "/").startswith(
+                      "ceph_tpu/store/")]
+    assert store_srcs
+    for src in store_srcs:
+        assert linters.check_fsync_seam(src) == [], src.rel

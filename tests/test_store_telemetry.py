@@ -1,0 +1,296 @@
+"""Commit-path X-ray acceptance (ISSUE 14): the store txn lifecycle
+decomposition, the timed-fsync seam, and the two batching what-if
+ledgers.
+
+- a scripted txn schedule under an injectable clock pins sub-stage
+  sums == the txn's commit span (the decomposition is a partition,
+  not a sample);
+- every real store's fsyncs land counted/timed per call site through
+  the named seam (blockstore: data fdatasync + kv WAL fsync; kstore
+  on FileDB: WAL fsync; memstore: zero);
+- the group-commit analyzer's projection matches a hand-computable
+  arrival sequence, in both fsync-cost models;
+- the objecter adjacency histogram under a scripted burst shows the
+  coalescable batches a streaming seam would have formed.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from ceph_tpu.store.object_store import Transaction, create_store
+from ceph_tpu.utils import store_telemetry
+from ceph_tpu.utils.store_telemetry import SUB_STAGES, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    tel = telemetry()
+    tel.reset()
+    yield
+    telemetry().reset()
+
+
+class FakeClock:
+    """Injectable perf_counter: advances only when told to."""
+
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- txn lifecycle decomposition --------------------------------------
+
+def test_scripted_schedule_substage_sums_equal_commit_span():
+    """Every instant of a scripted commit is attributed to exactly
+    one sub-stage: the sums equal the span, to the clock tick."""
+    tel = telemetry()
+    clock = FakeClock()
+    tmr = tel.txn_timer("synth", 7, now=clock)
+    tmr.n_ops = 3
+    span0 = clock.t
+    with tmr:
+        with tmr.stage("queue_wait"):
+            clock.advance(0.002)
+        with tmr.stage("apply"):
+            clock.advance(0.003)
+        with tmr.stage("kv_build"):
+            clock.advance(0.0015)
+        tmr.add("wal_append", 0.001)
+        clock.advance(0.001)                    # the wal time itself
+        tmr.add_fsync("synth.wal", 0.004, nbytes=4096)
+        clock.advance(0.004)                    # the fsync time
+        tmr.run_on_commit(lambda: clock.advance(0.0005))
+    span = clock.t - span0
+    assert tmr.total() == pytest.approx(span, abs=1e-12)
+    assert tmr.durations == pytest.approx({
+        "queue_wait": 0.002, "apply": 0.003, "kv_build": 0.0015,
+        "wal_append": 0.001, "fsync": 0.004, "on_commit": 0.0005})
+    # the registry saw exactly one txn with those sums
+    snap = tel.perf.dump()
+    assert snap["txns"] == 1
+    for stage, want in tmr.durations.items():
+        assert snap[f"txn_{stage}"]["sum"] == pytest.approx(want)
+    bd = tel.txn_breakdown()
+    assert bd["txns"] == 1
+    assert bd["span_s"] == pytest.approx(span, abs=1e-9)
+    shares = sum(e["share_pct"] for e in bd["stages"].values())
+    assert shares == pytest.approx(100.0, abs=1.0)
+    # the seam's per-site table recorded the barrier
+    sites = tel.fsync_sites()
+    assert sites["synth.wal"]["count"] == 1
+    assert sites["synth.wal"]["bytes"] == 4096
+
+
+def test_every_substage_key_is_registered():
+    keys = set(telemetry().perf.dump())
+    for stage in SUB_STAGES:
+        assert f"txn_{stage}" in keys
+        assert f"txn_{stage}_us" in keys
+
+
+# -- fsync accounting per store ---------------------------------------
+
+def _commit_one_write(store) -> None:
+    txn = Transaction()
+    txn.create_collection("c")
+    txn.write("c", "o", 0, b"payload" * 64)
+    fired = []
+    store.queue_transaction(txn, lambda: fired.append(1))
+    assert fired == [1]
+
+
+def test_memstore_commits_with_zero_fsyncs():
+    store = create_store("memstore")
+    store.mount()
+    _commit_one_write(store)
+    snap = telemetry().perf.dump()
+    assert snap["txns"] >= 1
+    assert snap["fsyncs"] == 0
+    assert snap["txn_apply"]["avgcount"] >= 1
+    assert snap["txn_on_commit"]["avgcount"] >= 1
+
+
+def test_blockstore_fsyncs_timed_per_site(tmp_path):
+    store = create_store("blockstore", str(tmp_path / "bs"))
+    store.mount()
+    _commit_one_write(store)
+    tel = telemetry()
+    snap = tel.perf.dump()
+    # one data-file barrier + one WAL fsync, both through the seam
+    sites = tel.fsync_sites()
+    assert sites["blockstore.data"]["count"] >= 1
+    assert sites["kv.wal"]["count"] >= 1
+    assert sites["kv.wal"]["bytes"] > 0
+    assert snap["fsyncs"] >= 2
+    assert snap["fsync_time"]["avgcount"] == snap["fsyncs"]
+    # the txn's own decomposition carried the barrier + wal time
+    assert snap["txn_fsync"]["sum"] > 0
+    assert snap["txn_wal_append"]["sum"] > 0
+    assert snap["txn_apply"]["avgcount"] >= 1
+    assert snap["txn_kv_build"]["avgcount"] >= 1
+    store.umount()
+
+
+def test_kstore_filedb_fsyncs_land_on_txn(tmp_path):
+    store = create_store("kstore", str(tmp_path / "ks"))
+    store.mount()
+    _commit_one_write(store)
+    tel = telemetry()
+    snap = tel.perf.dump()
+    assert tel.fsync_sites()["kv.wal"]["count"] >= 1
+    assert snap["txn_fsync"]["sum"] > 0
+    assert snap["txn_queue_wait"]["avgcount"] >= 1
+    assert snap["txn_kv_build"]["avgcount"] >= 1
+    store.umount()
+
+
+def test_timed_fsync_outside_txn_still_counts(tmp_path):
+    """The seam records straight into the registry when no txn timer
+    is active (mon-store compactions, bit-flip injection)."""
+    path = tmp_path / "f"
+    with open(path, "wb") as f:
+        f.write(b"x")
+        store_telemetry.timed_fsync(f.fileno(), site="synth.loose",
+                                    nbytes=1)
+    tel = telemetry()
+    assert tel.fsync_sites()["synth.loose"]["count"] == 1
+    assert tel.perf.dump()["fsyncs"] == 1
+
+
+# -- group-commit what-if ledger --------------------------------------
+
+def test_group_commit_projection_hand_computed():
+    """Arrivals [0, 0.4ms, 0.8ms, 10ms] in one store: under a 1 ms
+    window the first three share a leader -> 2 groups, 2 barriers
+    saved; fsync cost model is MEASURED (2 fsyncs x 1 ms each per
+    txn)."""
+    tel = telemetry()
+    for t in (0.0, 0.0004, 0.0008, 0.010):
+        tel.note_txn("synth", 1, t, 2, {"apply": 0.0001},
+                     fsyncs=2, fsync_s=0.002)
+    out = tel.group_commit_projection(windows_s=(0.001,))
+    assert len(out) == 1
+    row = out[0]
+    assert row["window_ms"] == 1.0
+    assert row["txns"] == 4
+    assert row["groups"] == 2
+    assert row["max_group"] == 3
+    # 4 txns - 2 groups = 2 txn-barriers saved x 2 fsyncs/txn
+    assert row["fsyncs_saved"] == pytest.approx(4.0)
+    # measured cost: 8 fsyncs took 8 ms -> 1 ms each
+    assert row["wall_saved_s"] == pytest.approx(0.004)
+    assert row["fsync_model"] == "measured"
+
+
+def test_group_commit_projection_profile_model_when_no_fsyncs():
+    """A memstore run records zero fsyncs; the projection prices
+    barriers with the durable-store profile and SAYS so."""
+    tel = telemetry()
+    for t in (0.0, 0.0001, 0.0002):
+        tel.note_txn("memstore", 1, t, 1, {"apply": 0.0001},
+                     fsyncs=0, fsync_s=0.0)
+    row = tel.group_commit_projection(windows_s=(0.001,))[0]
+    assert row["fsync_model"] == "durable_profile"
+    assert row["groups"] == 1
+    assert row["fsyncs_saved"] > 0
+
+
+def test_group_commit_adjacency_is_per_store():
+    """Two stores' interleaved arrivals never group together —
+    adjacency only means anything within one store's commit queue."""
+    tel = telemetry()
+    tel.note_txn("synth", 1, 0.0, 1, {}, 0, 0.0)
+    tel.note_txn("synth", 2, 0.0001, 1, {}, 0, 0.0)
+    row = tel.group_commit_projection(windows_s=(0.001,))[0]
+    assert row["txns"] == 2
+    assert row["groups"] == 2            # one per store: no sharing
+    assert row["fsyncs_saved"] == 0.0
+
+
+# -- objecter submission-stream ledger --------------------------------
+
+def test_objecter_adjacency_under_scripted_burst():
+    """A burst of 4 submits inside the window on pg (1, 3) + a
+    straggler + an unrelated pg: the analyzer forms the batches a
+    streaming objecter would have framed."""
+    tel = telemetry()
+    for t in (0.0, 0.001, 0.002, 0.003):
+        tel.note_objecter_submit(1, 3, t=t)
+    tel.note_objecter_submit(1, 3, t=5.0)      # outside any window
+    tel.note_objecter_submit(1, 4, t=0.0)      # different PG
+    out = tel.objecter_adjacency(window_s=0.010)
+    assert out["pgs"] == 2
+    assert out["ops"] == 6
+    assert out["batches"] == 3                 # [4-burst], [1], [1]
+    assert out["max_batch"] == 4
+    assert out["coalescable_ops"] == 3
+    assert out["mean_batch"] == pytest.approx(2.0)
+    # the size histogram recorded each batch
+    hist = telemetry().perf.get("objecter_batch_ops")
+    assert sum(hist) == 3
+
+
+def test_objecter_inflight_depth_histogram():
+    tel = telemetry()
+    tel.note_objecter_submit(2, 0, t=0.0)
+    tel.note_objecter_submit(2, 0, t=0.001)    # depth 2 while first
+    tel.note_objecter_done(2, 0)
+    tel.note_objecter_done(2, 0)
+    tel.note_objecter_submit(2, 0, t=0.002)    # back to depth 1
+    hist = tel.perf.get("objecter_pg_inflight")
+    # pow2 buckets: depth 1 -> bucket 1, depth 2 -> bucket 2
+    assert hist[1] == 2 and hist[2] == 1
+    assert tel.perf.dump()["objecter_ops"] == 3
+
+
+# -- export surfaces ---------------------------------------------------
+
+def test_snapshot_and_brief_shapes():
+    tel = telemetry()
+    tel.note_txn("synth", 1, 0.0, 2, {"apply": 0.001}, 1, 0.0005)
+    tel.note_fsync("synth.site", 0.0005, 64)
+    snap = tel.snapshot()
+    assert {"glossary", "counters", "txn_breakdown", "fsync_sites",
+            "group_commit", "objecter_stream"} <= set(snap)
+    brief = tel.snapshot_brief()
+    assert brief["txns"] == 1
+    assert brief["fsyncs"] == 1
+    assert brief["fsyncs_per_txn"] == 1.0
+
+
+def test_windows_env_override(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_WHATIF_WINDOWS_MS", "1,4")
+    assert store_telemetry.whatif_windows_s() == (0.001, 0.004)
+    monkeypatch.setenv("CEPH_TPU_WHATIF_WINDOWS_MS", "garbage")
+    assert store_telemetry.whatif_windows_s() == \
+        store_telemetry._DEFAULT_WINDOWS_S
+
+
+def test_native_and_python_data_engines_share_the_seam(tmp_path):
+    """Both blockstore data engines route their barrier through
+    site blockstore.data (the format-compatibility twin of the
+    engines themselves)."""
+    from ceph_tpu.store.blockstore import _PyDataFile
+    from ceph_tpu.store.native_io import NativeDataFile
+    py = _PyDataFile(str(tmp_path / "py"))
+    py.append(b"blob")
+    py.sync()
+    py.close()
+    tel = telemetry()
+    count = tel.fsync_sites()["blockstore.data"]["count"]
+    assert count >= 1
+    native = NativeDataFile.open(str(tmp_path / "nat"))
+    if native is not None:
+        native.append(b"blob")
+        native.sync()
+        native.close()
+        assert tel.fsync_sites()["blockstore.data"]["count"] \
+            == count + 1
