@@ -71,6 +71,11 @@ BUDGETS = {
     # fault ladder, not a kernel loop; wall-clock-budgeted, not
     # slope-sampled
     "load_gen": (40.0, 0.0),
+    # ISSUE 15: the commit path CLOSED — a durable-store (blockstore)
+    # A/B burst measuring store_fsyncs_per_op pre/post group commit,
+    # the streaming-objecter batch row, and the real-TCP (multi-
+    # process, loopback off) bulk-framing win. Wall-clock-budgeted.
+    "commit_path": (45.0, 0.0),
 }
 
 #: global sampling deadline (seconds from process start). Sampling
@@ -87,7 +92,10 @@ BUDGETS = {
 #: (BUDGETS grew by one more; the subprocess the single-chip path
 #: spawns for the two multichip rows is bounded by those rows' own
 #: budgets, so it adds no structural term)
-TOTAL_BUDGET = 390.0
+#: r20: 390 -> 355 absorbs the commit_path row's reservation (ISSUE
+#: 15; its wire-probe subprocesses are bounded by the row's own
+#: budget, adding no structural term)
+TOTAL_BUDGET = 355.0
 
 #: tunnel worst-case seconds for ONE cold per-signature compile
 COLD_COMPILE_S = 35.0
@@ -329,6 +337,15 @@ def main() -> None:
         _bench_load_gen()
     except Exception as exc:  # the cluster row must still land
         emit("load_gen_MBps", {"error": repr(exc)})
+
+    try:
+        _bench_commit_path()
+    except Exception as exc:  # all three ISSUE-15 rows must land
+        for row in ("store_fsyncs_per_op",
+                    "objecter_stream_mean_batch",
+                    "wire_framing_tcp_MBps"):
+            if row not in _RESULTS:
+                emit(row, {"error": repr(exc)})
 
     if any_contended:
         # independent chip-health probe (different program, same
@@ -1061,18 +1078,14 @@ def _bench_load_gen() -> None:
 
 def _emit_commit_path_rows(measured_mbps: float) -> None:
     """Derived commit-path rows (ISSUE 14, zero bench budget — pure
-    reads of what the load_gen run already recorded): the fsync cost
-    per store txn and the what-if projection, so the next perf PR's
-    before/after gates on them through bench_trend DIRECTIONS."""
+    reads of what the load_gen run already recorded): the what-if
+    projection (its direction pin gates UP now that the batching
+    landed). The measured ``store_fsyncs_per_op`` row moved to the
+    durable-store A/B in ``_bench_commit_path`` (ISSUE 15) — on the
+    memstore load_gen cluster the fsync count is degenerate."""
     try:
         from ceph_tpu.tools.gap_report import _what_if
         from ceph_tpu.utils.dataplane import dataplane
-        from ceph_tpu.utils.store_telemetry import telemetry
-        brief = telemetry().snapshot_brief()
-        emit("store_fsyncs_per_op", {
-            "value": brief.get("fsyncs_per_txn", 0.0),
-            "unit": "fsyncs/txn", "txns": brief.get("txns", 0),
-            "fsyncs": brief.get("fsyncs", 0)})
         bd = dataplane().stage_breakdown()
         wi = _what_if({"ops": bd.get("ops"),
                        "mean_ms": bd.get("mean_ms"),
@@ -1088,8 +1101,149 @@ def _emit_commit_path_rows(measured_mbps: float) -> None:
                 (wi.get("objecter_stream") or {}).get("mean_batch"),
         })
     except Exception as exc:
-        emit("store_fsyncs_per_op", {"error": repr(exc)})
         emit("whatif_group_commit_MBps", {"error": repr(exc)})
+
+
+def _commit_path_burst(n_objs: int, obj_kb: int, conc: int,
+                       store: str, data_dir: str | None) -> dict:
+    """One MiniCluster write burst; returns MB/s + the store brief
+    (the telemetry registry is reset per burst so each arm measures
+    only itself)."""
+    import concurrent.futures
+    import tempfile
+
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.utils.store_telemetry import telemetry
+    telemetry().reset()
+    if store != "memstore" and data_dir is None:
+        data_dir = tempfile.mkdtemp(prefix="bench_cp_")
+    payload = b"\xa5" * (obj_kb * 1024)
+    with MiniCluster(n_osds=3, store=store, data_dir=data_dir) as c:
+        c.create_ec_pool("cp", k=2, m=1, pg_num=4, backend="jax")
+        io = c.client().open_ioctx("cp")
+        io.write_full("warm", payload)
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(conc) as pool:
+            list(pool.map(
+                lambda i: io.write_full(f"o{i}", payload),
+                range(n_objs)))
+        dt = time.perf_counter() - t0
+    brief = telemetry().snapshot_brief()
+    brief["MBps"] = round(n_objs * len(payload) / dt / 1e6, 2)
+    return brief
+
+
+def _bench_commit_path() -> None:
+    """ISSUE 15: the measured commit-path rows. (1) A durable-store
+    (blockstore) A/B burst: ``store_fsyncs_per_op`` with group
+    commit on (value) vs off (the pre-fix machinery) — the >= 2x
+    drop gate, counted not timed. (2) The streaming-objecter row:
+    mean ops per SHIPPED MOSDOpBatch frame. (3) The real-wire
+    framing row from two fresh subprocesses with the in-process
+    loopback DISABLED (every frame crosses a kernel TCP socket):
+    bulk batch framing vs singleton sends, off-loopback."""
+    import os
+    budget, _ = BUDGETS["commit_path"]
+    deadline = min(_deadline(), time.perf_counter() + budget)
+    n, kb, conc = 96, 8, 16
+    try:
+        os.environ["CEPH_TPU_GROUP_COMMIT"] = "0"
+        pre = _commit_path_burst(n, kb, conc, "blockstore", None)
+    finally:
+        os.environ.pop("CEPH_TPU_GROUP_COMMIT", None)
+    post = _commit_path_burst(n, kb, conc, "blockstore", None)
+    pre_rate = pre.get("fsyncs", 0) / max(pre.get("txns", 1), 1)
+    post_rate = post.get("fsyncs", 0) / max(post.get("txns", 1), 1)
+    emit("store_fsyncs_per_op", {
+        "value": round(post_rate, 3), "unit": "fsyncs/txn",
+        "store": "blockstore", "pre_fix": round(pre_rate, 3),
+        "drop_x": round(pre_rate / post_rate, 2) if post_rate else None,
+        "fsyncs": post.get("fsyncs"), "txns": post.get("txns"),
+        "group_commits": post.get("group_commits", 0),
+        "mean_group_size": post.get("mean_group_size", 0.0),
+        "durable_MBps": post.get("MBps"),
+        "durable_MBps_pre": pre.get("MBps")})
+    emit("objecter_stream_mean_batch", {
+        "value": post.get("mean_stream_batch", 0.0),
+        "unit": "ops/frame",
+        "batches": post.get("stream_batches", 0),
+        "pre_fix_batches": pre.get("stream_batches", 0)})
+    remaining = deadline - time.perf_counter()
+    _bench_wire_framing_tcp(max(remaining, 12.0))
+
+
+def _bench_wire_framing_tcp(budget_s: float) -> None:
+    """The multi-process real-TCP arm: one subprocess per framing
+    mode (CEPH_TPU_MSGR_LOOPBACK=0 forces every frame onto kernel
+    TCP; CEPH_TPU_BULK_INGEST toggles MECSubWriteBatch framing vs
+    singleton sends). Each lands its own MB/s + the loopback-vs-TCP
+    framing split from the PR-14 ``note_framing`` ledger."""
+    import os
+    import subprocess
+    import sys
+
+    out = {}
+    for label, bulk in (("batch", "1"), ("singleton", "0")):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["CEPH_TPU_MSGR_LOOPBACK"] = "0"
+        env["CEPH_TPU_BULK_INGEST"] = bulk
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--wire-sub"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=env, capture_output=True, text=True,
+                timeout=max(budget_s / 2, 10.0))
+        except subprocess.TimeoutExpired:
+            out[label] = {"error": "wire probe timed out"}
+            continue
+        rec = None
+        for line in proc.stdout.splitlines():
+            at = line.find('{"wire_probe"')
+            if at >= 0:
+                try:
+                    rec = json.loads(line[at:])["wire_probe"]
+                except ValueError:
+                    pass
+        out[label] = rec or {"error": "no probe record "
+                                      f"(rc={proc.returncode}): "
+                                      f"{proc.stderr[-300:]}"}
+    batch = out.get("batch") or {}
+    single = out.get("singleton") or {}
+    b_mbps = batch.get("MBps") or 0.0
+    s_mbps = single.get("MBps") or 0.0
+    emit("wire_framing_tcp_MBps", {
+        "value": b_mbps, "unit": "MB/s",
+        "singleton_MBps": s_mbps,
+        "win_x": round(b_mbps / s_mbps, 2) if s_mbps else None,
+        "transport": "tcp (loopback disabled, subprocess per arm)",
+        "batch": batch, "singleton": single})
+
+
+def wire_sub_main() -> None:
+    """``bench.py --wire-sub``: one framing arm — a small write burst
+    over real TCP sockets, printing MB/s + the msgr framing brief."""
+    import concurrent.futures
+    import tempfile
+
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.utils.msgr_telemetry import telemetry as msgr_tel
+    payload = b"\x5a" * 8192
+    n, conc = 64, 8
+    with MiniCluster(n_osds=3) as c:
+        c.create_ec_pool("wp", k=2, m=1, pg_num=4, backend="jax")
+        io = c.client().open_ioctx("wp")
+        io.write_full("warm", payload)
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(conc) as pool:
+            list(pool.map(
+                lambda i: io.write_full(f"w{i}", payload),
+                range(n)))
+        dt = time.perf_counter() - t0
+    rec = {"MBps": round(n * len(payload) / dt / 1e6, 2),
+           "framing": msgr_tel().framing_brief()}
+    print(json.dumps({"wire_probe": rec}, sort_keys=True), flush=True)
 
 
 def _cpu_baseline_gbps(mat) -> float:
@@ -1119,5 +1273,7 @@ if __name__ == "__main__":
     import sys as _sys
     if "--multichip-sub" in _sys.argv:
         multichip_sub_main()
+    elif "--wire-sub" in _sys.argv:
+        wire_sub_main()
     else:
         main()

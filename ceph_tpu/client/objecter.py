@@ -70,6 +70,11 @@ EBLOCKLISTED = -108
 TRACE_ERRNOS = (-5, -110)
 
 
+#: op codes the streaming seam may coalesce (plain data writes; the
+#: guarded / snap-context / cls / read families keep singleton frames)
+_STREAM_OPS = (1, 5, 6)          # WRITE_FULL, WRITE, APPEND
+
+
 class Objecter:
     def __init__(self, msgr: Messenger, monc: MonClient,
                  client_id: str | None = None) -> None:
@@ -89,20 +94,65 @@ class Objecter:
         self._lock = make_lock("objecter.state")
         self._next_tid = 1
         self._pending: dict[int, _Op] = {}
+        # the streaming submission seam (ROADMAP 1b): per-(pool, PG)
+        # coalescing state — ops arriving while that PG has a frame
+        # in flight accumulate and ship as ONE MOSDOpBatch the moment
+        # the in-flight frame drains (no hold timer: solo traffic
+        # ships immediately; batching emerges under concurrency,
+        # exactly the adjacency the PR-14 ledger measured)
+        self._streams: dict[tuple[int, int], dict] = {}
+        self._stream_enabled = bool(g_conf()["objecter_stream"])
+        # the batch window is a tuner-managed Knob: cache it through
+        # the config-observer seam, never a hot-path config read
+        self._stream_max = int(g_conf()["objecter_stream_max_ops"])
+        g_conf().add_observer("objecter_stream_max_ops",
+                              self._on_stream_window)
         self._stop = threading.Event()
         self._tick = threading.Thread(
             target=self._tick_loop, name="objecter-tick", daemon=True)
         self._tick.start()
         monc.add_map_callback(self._on_map)
 
+    def _on_stream_window(self, _name: str, value) -> None:
+        try:
+            value = max(int(value), 1)
+        except (TypeError, ValueError):
+            return
+        with self._lock:       # read under _lock on the submit path
+            self._stream_max = value
+
     def shutdown(self) -> None:
         self._stop.set()
+        try:
+            g_conf().remove_observer("objecter_stream_max_ops",
+                                     self._on_stream_window)
+        except Exception:
+            pass
         self._tick.join(timeout=5)
 
     # -- inbound ------------------------------------------------------
     def handle_message(self, msg: M.Message, conn: Connection) -> bool:
+        if isinstance(msg, M.MOSDOpReplyBatch):
+            # one frame = one reply sweep: every contained tid wakes
+            # exactly as if its singleton MOSDOpReply arrived
+            for i, tid in enumerate(msg.tids):
+                self._handle_reply(M.MOSDOpReply(
+                    tid=tid,
+                    code=msg.codes[i] if i < len(msg.codes) else 0,
+                    epoch=int(msg.epochs[i])
+                    if i < len(msg.epochs) else 0,
+                    data=msg.datas[i] if i < len(msg.datas) else b"",
+                    version=msg.versions[i]
+                    if i < len(msg.versions) else 0,
+                    stages=msg.stages[i]
+                    if i < len(msg.stages) else ""))
+            return True
         if not isinstance(msg, M.MOSDOpReply):
             return False
+        self._handle_reply(msg)
+        return True
+
+    def _handle_reply(self, msg: M.MOSDOpReply) -> None:
         if msg.code == EBLOCKLISTED:
             # sticky even when the op already timed out locally (a
             # parked op's late rejection must still fence us)
@@ -110,17 +160,17 @@ class Objecter:
         with self._lock:
             op = self._pending.get(msg.tid)
         if op is None:
-            return True        # dup reply after resend: drop
+            return             # dup reply after resend: drop
         if msg.code == ESTALE:
             # reached a non-primary; our map is behind. Leave the op
             # pending: the mon's map push retargets it (and the tick
             # loop backstops a lost push).
-            return True
+            return
         with self._lock:
             self._pending.pop(msg.tid, None)
+        self._stream_note_done(op)
         op.reply = msg
         op.event.set()
-        return True
 
     # -- submit -------------------------------------------------------
     def op_submit(self, pool: int, oid: str, op: int, *, offset: int = 0,
@@ -170,7 +220,10 @@ class Objecter:
             self._pending[tid] = rec
         span.event("submitted")
         try:
-            self._send(rec)
+            if self._streamable(msg):
+                self._stream_submit(rec)
+            else:
+                self._send(rec)
         finally:
             _profiler.pop_stage(_pstage)
         # the submission-stream ledger (ISSUE 14, ROADMAP 1b's
@@ -195,6 +248,7 @@ class Objecter:
             if not committed:
                 with self._lock:
                     self._pending.pop(tid, None)
+                self._stream_note_done(rec)
                 span.event("timeout")
                 # the tail sampler keeps errored traces: a timed-out
                 # op is exactly the outlier worth an autopsy
@@ -245,6 +299,137 @@ class Objecter:
                 except Exception:
                     pass
             span.finish()
+
+    # -- streaming submission seam (ROADMAP 1b) ------------------------
+    def _streamable(self, msg: M.MOSDOp) -> bool:
+        """Plain data writes only: guarded, snap-context, xattr/omap,
+        cls and read ops keep their singleton frames (their reply
+        shapes and admission paths are op-specific)."""
+        return (self._stream_enabled and self._stream_max > 1
+                and msg.op in _STREAM_OPS and not msg.cls
+                and not msg.gname and not msg.xname
+                and not msg.snap_seq and not msg.snaps
+                and not msg.snapid)
+
+    def _stream_submit(self, rec: _Op) -> None:
+        """First-transmission vehicle selection: ship immediately
+        while the op's (pool, PG) stream is idle; while a frame is in
+        flight, accumulate — the accumulated run ships as ONE
+        MOSDOpBatch the moment the in-flight frame drains (or sooner,
+        when it reaches the batch window). The op itself stays a
+        fully-formed singleton MOSDOp in ``_pending``: map pushes and
+        the resend tick retransmit it individually, so reliability is
+        exactly the singleton machinery."""
+        osdmap = self.monc.osdmap
+        msg = rec.msg
+        if osdmap is None or osdmap.pools.get(msg.pool) is None:
+            return              # wait for a map that has the pool
+        ps, _, _ = osdmap.object_locator(msg.pool, msg.oid)
+        msg.ps = ps
+        key = (msg.pool, ps)
+        ship = None
+        with self._lock:
+            st = self._streams.get(key)
+            if st is None:
+                st = self._streams[key] = {"inflight": set(),
+                                           "pending": []}
+            if not st["inflight"]:
+                # idle stream: this op leads (zero added latency)
+                st["inflight"].add(rec.tid)
+            else:
+                st["pending"].append(rec)
+                rec.sent_at = time.monotonic()
+                if len(st["pending"]) >= self._stream_max:
+                    ship = self._stream_take_locked(st)
+                else:
+                    return
+        if ship is None:
+            self._send(rec)
+        else:
+            self._ship_stream(key, ship)
+
+    @staticmethod
+    def _stream_take_locked(st: dict) -> list:
+        """Take the pending run to ship — EXCLUDING any op the tick
+        loop already singleton-sent while it waited (shipping it
+        again would race the in-flight execution of a non-idempotent
+        op like append; an already-sent op is the resend machinery's
+        to finish)."""
+        batch = [r for r in st["pending"] if r.attempts == 0]
+        st["pending"] = []
+        st["inflight"].update(r.tid for r in batch)
+        return batch
+
+    def _stream_note_done(self, rec: _Op) -> None:
+        """An op left ``_pending`` (reply or timeout): drain its
+        stream bookkeeping, and when the in-flight frame is done,
+        ship the accumulated run."""
+        key = (rec.msg.pool, rec.msg.ps)
+        ship = None
+        with self._lock:
+            st = self._streams.get(key)
+            if st is None:
+                return
+            st["inflight"].discard(rec.tid)
+            if st["pending"] and not st["inflight"]:
+                ship = self._stream_take_locked(st)
+            elif not st["pending"] and not st["inflight"]:
+                del self._streams[key]
+        if ship:
+            self._ship_stream(key, ship)
+
+    def _ship_stream(self, key: tuple[int, int], recs: list) -> None:
+        """Frame the accumulated run: one MOSDOpBatch per (pool, PG)
+        — one serialize, one wire traversal, one reply sweep. A run
+        of one keeps the singleton frame (no batch overhead for solo
+        traffic)."""
+        if not recs:
+            return
+        if len(recs) == 1:
+            self._send(recs[0])
+            return
+        osdmap = self.monc.osdmap
+        if osdmap is None:
+            return              # tick/map-push resend singletons
+        pool, ps = key
+        _, _, primary = osdmap.pg_to_up_acting(pool, ps)
+        info = osdmap.osds.get(primary) if primary >= 0 else None
+        if info is None or not info.addr:
+            return              # PG unserviceable; tick retries
+        now = time.monotonic()
+        stages = []
+        for r in recs:
+            r.msg.epoch = osdmap.epoch
+            r.sent_at = now
+            r.attempts += 1
+            clock = getattr(r.msg, "_stage_clock", None)
+            if clock is not None:
+                # the batch is the send hand-off: each entry keeps
+                # its OWN timeline (unlike MECSubWriteBatch entries,
+                # which are born sharing the frame clock)
+                clock.mark_once("send_queue_wait", t=now)
+                stages.append(clock.to_wire())
+            else:
+                stages.append("")
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+        batch = M.MOSDOpBatch(
+            tid=tid, client=self.client_id, epoch=osdmap.epoch,
+            pool=pool, ps=ps,
+            tids=[r.tid for r in recs],
+            oids=[r.msg.oid for r in recs],
+            ops=[r.msg.op for r in recs],
+            offsets=[r.msg.offset for r in recs],
+            lengths=[r.msg.length for r in recs],
+            datas=[r.msg.data for r in recs],
+            traces=[r.msg.trace for r in recs],
+            stages=stages)
+        try:
+            _store_tel().note_stream_batch(len(recs))
+        except Exception:
+            pass                # telemetry faults never cost an op
+        self.msgr.send_message(batch, info.addr)
 
     def _send(self, op: _Op) -> None:
         osdmap = self.monc.osdmap

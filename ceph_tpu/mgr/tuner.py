@@ -93,7 +93,7 @@ def tuner_on() -> bool:
 SENSOR_KEYS = ("p99_ms", "mbps", "hbm_live", "hbm_limit", "inflight",
                "window", "occupancy", "flush_bytes_mean",
                "health_rank", "fault_events", "mesh_slots",
-               "slot_staged")
+               "slot_staged", "stream_batch_mean")
 
 
 class LiveSensors:
@@ -144,6 +144,18 @@ class LiveSensors:
                     snap["flush_bytes_mean"] = db / df
                 if dops is not None:
                     snap["occupancy"] = max(0.0, dops / df)
+        except Exception:
+            pass
+        try:
+            # the streaming objecter's measured batch size (ISSUE 15:
+            # the objecter_stream_max_ops actuator's sensor); the
+            # if_exists form never allocates the registry from here
+            from ceph_tpu.utils.store_telemetry import \
+                telemetry_if_exists
+            st = telemetry_if_exists()
+            if st is not None:
+                snap["stream_batch_mean"] = \
+                    st.snapshot_brief().get("mean_stream_batch", 0.0)
         except Exception:
             pass
         try:
@@ -245,6 +257,23 @@ DEFAULT_RULES = (
          lambda s, e: s["mesh_slots"] > 1 and
          s["flush_bytes_mean"] >=
          float(e.conf.get("mesh_flush_bytes"))),
+    # the streaming objecter's batch window (ROADMAP 1b/5d): widen
+    # while shipped batches clip at the cap with healthy latency;
+    # narrow when p99 moves off baseline with batches running far
+    # under it (head-of-line batching latency nothing amortizes)
+    Rule("stream_window_grow", "objecter_stream_max_ops", "up",
+         "streaming batches clip at the window with healthy "
+         "latency: widen the client coalescing window",
+         lambda s, e: s["stream_batch_mean"] >= 0.75 *
+         float(e.conf.get("objecter_stream_max_ops")) and
+         (s["p99_ref"] <= 0 or s["p99_ms"] <= 1.2 * s["p99_ref"])),
+    Rule("stream_window_shrink", "objecter_stream_max_ops", "down",
+         "p99 off baseline with streaming batches far under the "
+         "window: cut the head-of-line coalescing wait",
+         lambda s, e: s["p99_ref"] > 0 and
+         s["p99_ms"] > 1.5 * s["p99_ref"] and
+         0 < s["stream_batch_mean"] <= 0.25 *
+         float(e.conf.get("objecter_stream_max_ops"))),
     # observability levers: keep more evidence while degraded, give
     # the overhead back when healthy
     Rule("trace_keep_more", "trace_sample_every", "down",

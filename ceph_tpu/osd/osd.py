@@ -60,6 +60,7 @@ from ceph_tpu.store.object_store import (
     ObjectStore,
     StoreError,
     Transaction,
+    group_commit_enabled,
 )
 from ceph_tpu.utils.admin_socket import (
     AdminSocket,
@@ -71,6 +72,7 @@ from ceph_tpu.utils import stage_clock, tracing
 from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.msgr_telemetry import telemetry as _msgr_telemetry
+from ceph_tpu.utils import store_telemetry as _store_telemetry
 from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
@@ -107,6 +109,16 @@ EBLOCKLISTED = -108
 #: objects next to the head; the separator is outside the client
 #: namespace and PGLS filters it)
 SNAP_SEP = "\x1e"
+
+#: wq-worker marker (group commit, ROADMAP 1a): local store commits
+#: issued FROM a wq item may defer their barrier to the worker's
+#: end-of-item drain (prompt, lock-free); commits from other threads
+#: (scrub, asok, tests) keep the inline barrier
+_wq_tls = threading.local()
+
+
+def _on_wq_thread() -> bool:
+    return getattr(_wq_tls, "active", False)
 
 
 def snap_clone_oid(oid: str, snapid: int) -> str:
@@ -229,9 +241,15 @@ class ShardedOpWQ:
 
     def __init__(self, name: str, num_shards: int,
                  weights: dict[str, int] | None = None,
-                 mode: str | None = None) -> None:
+                 mode: str | None = None,
+                 after_item=None) -> None:
         conf = g_conf()
         self.mode = mode or conf["osd_op_queue"]
+        #: end-of-item hook (group commit, ROADMAP 1a): runs after
+        #: every work item, OUTSIDE every lock the item took — the
+        #: drain point where barriers deferred during the item (store
+        #: commits queued under pg.lock) fsync and ack
+        self._after_item = after_item
         self._weights = weights or {
             QOS_CLIENT: max(1, conf["osd_client_op_priority"]),
             QOS_RECOVERY: max(1, conf["osd_recovery_op_priority"]),
@@ -302,6 +320,7 @@ class ShardedOpWQ:
 
     def _worker(self, sh) -> None:
         mclock = isinstance(sh, _MClockShard)
+        _wq_tls.active = True      # marks this thread as a wq worker
         while True:
             # profiler join: a worker parked on its cv is idle, not
             # pg_process work (the classifier would otherwise charge
@@ -343,6 +362,11 @@ class ShardedOpWQ:
                 log(0, f"op worker exception: {exc!r}")
             finally:
                 _prof.pop_stage(_pstage)
+                if self._after_item is not None:
+                    try:
+                        self._after_item()
+                    except Exception as exc:
+                        log(0, f"wq after-item hook failed: {exc!r}")
 
     def drain_stop(self) -> None:
         self._running = False
@@ -410,7 +434,8 @@ class OSD:
         self._watchers: dict[tuple, dict] = {}
         self._notifies: dict[int, dict] = {}
         self.op_wq = ShardedOpWQ(f"osd.{osd_id}",
-                                 g_conf()["osd_op_num_shards"])
+                                 g_conf()["osd_op_num_shards"],
+                                 after_item=self._drain_store_barrier)
         from ceph_tpu.osd.tiering import TierService
         self.tier = TierService(self)
         # replica-side service ops (shard reads, peering queries) are
@@ -422,7 +447,8 @@ class OSD:
         # client limit must throttle clients, not the fan-outs
         # serving them
         self.reader_wq = ShardedOpWQ(f"osd.{osd_id}-svc", 2,
-                                     mode="wpq")
+                                     mode="wpq",
+                                     after_item=self._drain_store_barrier)
         # completed-mutation replies by (client, tid): a client resend
         # of an already-applied write/remove gets the cached reply
         # instead of re-executing (the reference's dup-op detection via
@@ -430,6 +456,15 @@ class OSD:
         self._op_cache: dict[tuple[str, int], M.MOSDOpReply] = {}
         self._op_cache_order: list[tuple[str, int]] = []
         self._op_cache_lock = make_lock("osd.op_cache")
+        # APPENDs currently executing, by (client, tid) -> admit time:
+        # the dup cache only covers COMPLETED ops and re-execution of
+        # an incomplete write is the documented lost-subop recovery
+        # path — safe for offset writes (idempotent), but a resend
+        # racing a still-running APPEND would double-apply it. Racing
+        # append dups are dropped while the entry is FRESH (under
+        # 2x SUBOP_TIMEOUT); a stale entry means the original is
+        # stuck and re-execution is the liveness path again.
+        self._op_inflight: dict[tuple[str, int], float] = {}
         # messages carrying a newer map epoch than ours park here
         # until the mon's push catches us up
         # (require_same_or_newer_map role, src/osd/OSD.cc): executing
@@ -667,33 +702,54 @@ class OSD:
         with self._sub_lock:
             self._waits.pop(tid, None)
 
+    def _drain_store_barrier(self) -> None:
+        """The wq end-of-item drain: flush barriers deferred during
+        the item (commits issued under pg.lock park their fsync +
+        ack here, where no lock is held — the witness contract)."""
+        if self.store.barrier_pending():
+            self.store.barrier()
+
     def queue_local_txn(self, txn: Transaction, on_commit) -> None:
-        self.store.queue_transaction(txn, on_commit)
+        """One local shard txn. From a wq item (the op/sub-op paths —
+        which may hold pg.lock) the barrier + ack defer to the
+        worker's end-of-item drain, where the shared leader-follower
+        rounds coalesce them with everything else the item (and its
+        shard neighbors) committed; other threads commit inline."""
+        if group_commit_enabled() and _on_wq_thread():
+            self.store.queue_transaction_group([(txn, on_commit)],
+                                               defer=True)
+        else:
+            self.store.queue_transaction(txn, on_commit)
 
     def queue_local_txn_group(self, pairs: list) -> None:
-        """Apply many (txn, on_commit) pairs as ONE queued store txn
-        (the bulk-ingest local-shard leg: a flush's local sub-writes
-        commit together instead of one store round trip per op).
-        Op order within the merged txn is list order."""
-        if len(pairs) == 1:
+        """Apply many (txn, on_commit) pairs as ONE store group
+        commit (the bulk-ingest local-shard leg: a flush's local
+        sub-writes share one apply pass, one WAL append, one barrier
+        set — ``ObjectStore.queue_transaction_group``, ROADMAP 1a —
+        with completions swept in list order by the store)."""
+        if len(pairs) == 1 or not group_commit_enabled():
+            if len(pairs) > 1:
+                # A/B fallback (CEPH_TPU_GROUP_COMMIT=0): the pre-15
+                # merged-txn path — one store txn, wrapper callback
+                merged = Transaction()
+                cbs = []
+                for txn, cb in pairs:
+                    merged.ops.extend(txn.ops)
+                    cbs.append(cb)
+                self.store.queue_transaction(
+                    merged, lambda: _store_telemetry.sweep_completions(
+                        cbs))
+                return
             txn, cb = pairs[0]
-            self.store.queue_transaction(txn, cb)
+            self.queue_local_txn(txn, cb)
             return
-        merged = Transaction()
-        cbs = []
-        for txn, cb in pairs:
-            merged.ops.extend(txn.ops)
-            cbs.append(cb)
-
-        def committed() -> None:
-            for cb in cbs:
-                try:
-                    cb()
-                except Exception as exc:
-                    log(0, f"local txn-group commit cb failed: "
-                        f"{exc!r}")
-
-        self.store.queue_transaction(merged, committed)
+        if _on_wq_thread():
+            # flush continuations run as wq items: defer to the
+            # end-of-item drain so the frame's other legs share the
+            # barrier round
+            self.store.queue_transaction_group(pairs, defer=True)
+        else:
+            self.store.queue_transaction_group(pairs)
 
     # -- asok backends -------------------------------------------------
     def _asok_status(self) -> dict:
@@ -938,6 +994,12 @@ class OSD:
             pgid = (msg.pool, msg.ps)
             self.op_wq.enqueue(pgid,
                                lambda: self._handle_osd_op(msg, conn))
+        elif isinstance(msg, M.MOSDOpBatch):
+            # the streaming client leg (ROADMAP 1b): one frame of
+            # same-PG writes — one wq traversal on the PG's key, so
+            # FIFO against singleton MOSDOps is preserved
+            self.op_wq.enqueue(
+                pgid, lambda: self._handle_osd_op_batch(msg, conn))
         elif isinstance(msg, M.MECSubWrite):
             self.op_wq.enqueue(pgid,
                                lambda: self._handle_sub_write(msg, conn))
@@ -1126,22 +1188,28 @@ class OSD:
                 committed=True, version=msg.version,
                 stages=sclock.to_wire()))
 
-        self.store.queue_transaction(txn, committed)
+        self.queue_local_txn(txn, committed)
 
     def _handle_sub_write_batch(self, msg: M.MECSubWriteBatch,
                                 conn: Connection) -> None:
         """One frame = every sub-write of one engine flush aimed at
         this OSD (ISSUE 9). Entries group by contained PG; each group
         enqueues ONE handler on its own pgid key (per-PG FIFO against
-        singleton MECSubWrites is preserved) and applies its txns as
-        ONE queued store txn. The LAST group to commit sends ONE
-        MECSubWriteBatchReply acking every contained tid."""
+        singleton MECSubWrites is preserved) and queues its txns as
+        ONE store txn group. Under group commit (ROADMAP 1a, default)
+        the groups DEFER their durability barrier to the worker's
+        end-of-item drain, where the store's shared leader-follower
+        rounds coalesce the whole frame's PG groups (and any
+        neighbors) onto one barrier set — one data fdatasync + one
+        WAL fsync instead of a set per PG — after which the store
+        sweeps every entry's completion and the last entry acks all
+        contained tids in ONE MECSubWriteBatchReply."""
         n = len(msg.tids)
         groups: dict[tuple, list[int]] = {}
         for i in range(n):
             groups.setdefault((msg.pools[i], int(msg.pss[i])),
                               []).append(i)
-        state = {"left": len(groups), "lock": make_lock("osd.logsync_group"),
+        state = {"left": n, "lock": make_lock("osd.logsync_group"),
                  "stages": [""] * n}
         rx_t = getattr(msg, "_rx_t", None)
         for pgid, idxs in groups.items():
@@ -1152,10 +1220,10 @@ class OSD:
     def _apply_sub_write_group(self, msg: M.MECSubWriteBatch,
                                conn: Connection, idxs: list[int],
                                state: dict, rx_t) -> None:
-        merged = Transaction()
-        entries = []
+        grouped = group_commit_enabled()
+        pairs = []
         for i in idxs:
-            merged.ops.extend(Transaction.decode(msg.txns[i]).ops)
+            txn = Transaction.decode(msg.txns[i])
             self.logger.inc("subop_w")
             span = tracing.tracer().from_wire(
                 msg.traces[i] if i < len(msg.traces) else "",
@@ -1163,15 +1231,14 @@ class OSD:
                 f"osd.{self.whoami}")
             # per-entry child timeline forked from the batch's shared
             # clock: every entry rode the same frame, so the send/
-            # wire marks ARE shared; commit is per group
+            # wire marks ARE shared; the commit mark lands when the
+            # shared barrier releases this entry's completion
             sclock = stage_clock.StageClock.from_wire(msg.stages)
             if rx_t is not None:
                 sclock.mark("subop_wire", t=rx_t)
             sclock.mark("subop_dispatch_wait")
-            entries.append((i, span, sclock))
 
-        def committed() -> None:
-            for i, span, sclock in entries:
+            def entry_committed(i=i, span=span, sclock=sclock) -> None:
                 span.event("committed")
                 span.finish()
                 sclock.mark("subop_commit")
@@ -1183,18 +1250,34 @@ class OSD:
                 except Exception:
                     pass
                 state["stages"][i] = sclock.to_wire()
-            with state["lock"]:
-                state["left"] -= 1
-                last = state["left"] == 0
-            if last:
-                conn.send_message(M.MECSubWriteBatchReply(
-                    tid=msg.tid, committed=True,
-                    tids=list(msg.tids), pools=list(msg.pools),
-                    pss=list(msg.pss), shards=list(msg.shards),
-                    versions=list(msg.versions),
-                    stages=list(state["stages"])))
+                with state["lock"]:
+                    state["left"] -= 1
+                    last = state["left"] == 0
+                if last:
+                    conn.send_message(M.MECSubWriteBatchReply(
+                        tid=msg.tid, committed=True,
+                        tids=list(msg.tids), pools=list(msg.pools),
+                        pss=list(msg.pss), shards=list(msg.shards),
+                        versions=list(msg.versions),
+                        stages=list(state["stages"])))
 
-        self.store.queue_transaction(merged, committed)
+            pairs.append((txn, entry_committed))
+        if not grouped:
+            # A/B fallback (CEPH_TPU_GROUP_COMMIT=0): the pre-15
+            # per-PG machinery — one merged sync store txn per group
+            merged = Transaction()
+            cbs = []
+            for txn, cb in pairs:
+                merged.ops.extend(txn.ops)
+                cbs.append(cb)
+            self.store.queue_transaction(
+                merged,
+                lambda: _store_telemetry.sweep_completions(cbs))
+            return
+        # barrier + acks defer to the wq end-of-item drain (this
+        # handler IS a wq item), where the shared rounds merge every
+        # PG group of the frame onto one barrier set
+        self.store.queue_transaction_group(pairs, defer=True)
 
     def _handle_sub_write_batch_reply(
             self, msg: M.MECSubWriteBatchReply) -> None:
@@ -1324,6 +1407,33 @@ class OSD:
                      M.OSD_OP_WRITESAME, M.OSD_OP_OMAPSETHEADER)
     _OP_CACHE_MAX = 10000
 
+    def _handle_osd_op_batch(self, msg: M.MOSDOpBatch,
+                             conn: Connection) -> None:
+        """One MOSDOpBatch = N client writes for one PG (the
+        streaming objecter's frame). Each contained op runs the FULL
+        singleton admission path — map fence, blocklist, dup-op
+        cache, PG state, QoS — as its own MOSDOp through a collecting
+        connection shim; when every op has replied, ONE
+        MOSDOpReplyBatch sweeps all of them home."""
+        n = len(msg.tids)
+        if not n:
+            return
+        rx_t = getattr(msg, "_rx_t", None)
+        state = {"left": n, "replies": [None] * n,
+                 "lock": make_lock("osd.op_batch")}
+        for i in range(n):
+            sub = M.MOSDOp(
+                tid=msg.tids[i], client=msg.client, epoch=msg.epoch,
+                pool=msg.pool, ps=msg.ps, oid=msg.oids[i],
+                op=msg.ops[i], offset=msg.offsets[i],
+                length=msg.lengths[i], data=msg.datas[i],
+                trace=msg.traces[i] if i < len(msg.traces) else "",
+                stages=msg.stages[i] if i < len(msg.stages) else "")
+            if rx_t is not None:
+                sub._rx_t = rx_t
+            self._handle_osd_op(
+                sub, _BatchOpConn(conn, msg, i, state))
+
     def _handle_osd_op(self, msg: M.MOSDOp, conn: Connection) -> None:
         osdmap = self.get_osdmap()
         t0 = time.perf_counter()
@@ -1376,14 +1486,42 @@ class OSD:
             return
         cache_key = (msg.client, msg.tid)
         if msg.op in self._MUTATING_OPS:
+            racing = False
             with self._op_cache_lock:
                 cached = self._op_cache.get(cache_key)
+                if cached is None and msg.op == M.OSD_OP_APPEND:
+                    t0_adm = self._op_inflight.get(cache_key)
+                    racing = (t0_adm is not None
+                              and time.monotonic() - t0_adm
+                              < 2 * SUBOP_TIMEOUT
+                              and not getattr(msg, "_admitted",
+                                              False))
+                    if not racing:
+                        # committing to execute: marked BEFORE any
+                        # park/async leg so a wire dup cannot double-
+                        # apply; ``_admitted`` tags THIS message
+                        # object so its own re-runs (map park,
+                        # waiting_for_active, tier requeue) pass
+                        # back through
+                        self._op_inflight[cache_key] = \
+                            time.monotonic()
+                        msg._admitted = True
             if cached is not None:     # client resend of an applied op
                 track.mark_event("dup_op_cached_reply")
                 track.finish()
                 span.event("dup_op_cached_reply")
                 span.finish()
                 conn.send_message(cached)
+                return
+            if racing:
+                # a resend raced the ORIGINAL append's still-running
+                # execution (the double-apply class): drop it — the
+                # original's reply answers this tid, and a later
+                # resend hits the dup cache
+                track.mark_event("dup_op_in_flight_dropped")
+                track.finish()
+                span.event("dup_op_in_flight_dropped")
+                span.finish()
                 return
 
         def reply(code: int, data: bytes = b"", version: int = 0) -> None:
@@ -1414,14 +1552,19 @@ class OSD:
             out = M.MOSDOpReply(
                 tid=msg.tid, code=code, epoch=osdmap.epoch, data=data,
                 version=version, stages=clock.to_wire())
-            if msg.op in self._MUTATING_OPS and code == 0:
+            if msg.op in self._MUTATING_OPS:
                 with self._op_cache_lock:
-                    if cache_key not in self._op_cache:
-                        self._op_cache_order.append(cache_key)
-                    self._op_cache[cache_key] = out
-                    while len(self._op_cache_order) > self._OP_CACHE_MAX:
-                        old = self._op_cache_order.pop(0)
-                        self._op_cache.pop(old, None)
+                    # execution obligation settled either way: a
+                    # failed op may be re-executed by a resend
+                    self._op_inflight.pop(cache_key, None)
+                    if code == 0:
+                        if cache_key not in self._op_cache:
+                            self._op_cache_order.append(cache_key)
+                        self._op_cache[cache_key] = out
+                        while len(self._op_cache_order) > \
+                                self._OP_CACHE_MAX:
+                            old = self._op_cache_order.pop(0)
+                            self._op_cache.pop(old, None)
             conn.send_message(out)
 
         pool = osdmap.pools.get(msg.pool)
@@ -2837,6 +2980,13 @@ class OSD:
         client resends, and the dup-op cache only answers for writes
         that DID fully commit."""
         stale_after = 6 * SUBOP_TIMEOUT
+        # prune abandoned append admissions (their suppression window
+        # closed long ago; entries whose op never replied must not
+        # accumulate for the process lifetime)
+        with self._op_cache_lock:
+            for key in [k for k, t in self._op_inflight.items()
+                        if now - t > stale_after]:
+                del self._op_inflight[key]
         with self._sub_lock:
             stale = [iw for iw in self._inflight.values()
                      if now - iw.created_at > stale_after]
@@ -2937,6 +3087,13 @@ class OSD:
             self.monc.beacon(self.whoami, osdmap.epoch)
             now = time.monotonic()
             self._expire_inflight(now)
+            # stranded-barrier backstop (group commit, ROADMAP 1a): a
+            # deferred txn group whose last-group barrier died (wq
+            # handler exception, shutdown race) must not strand acked
+            # writes — flush it on the tick (cheap attribute check
+            # when nothing is parked)
+            if self.store.barrier_pending():
+                self.store.barrier()
             self._sweep_notifies()
             self._kick_recovery()
             self.op_tracker.check_slow()
@@ -2958,6 +3115,50 @@ class OSD:
                 self.msgr.send_message(
                     M.MPing(osd_id=self.whoami, epoch=osdmap.epoch,
                             stamp=now), info.addr)
+
+
+class _BatchOpConn:
+    """Connection shim for one entry of an MOSDOpBatch: collects the
+    entry's MOSDOpReply and, once every entry of the frame has
+    replied, ships ONE MOSDOpReplyBatch on the real connection.
+    Everything else (peer identity, tier intercepts, parking in
+    ``waiting_for_active``) delegates to the inbound connection, so
+    the singleton op path runs unchanged underneath."""
+
+    __slots__ = ("_conn", "_msg", "_i", "_state")
+
+    def __init__(self, conn: Connection, msg: "M.MOSDOpBatch",
+                 i: int, state: dict) -> None:
+        self._conn = conn
+        self._msg = msg
+        self._i = i
+        self._state = state
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+    def send_message(self, reply: M.Message) -> None:
+        if not isinstance(reply, M.MOSDOpReply):
+            self._conn.send_message(reply)
+            return
+        state = self._state
+        with state["lock"]:
+            if state["replies"][self._i] is not None:
+                return          # dup reply for this entry: drop
+            state["replies"][self._i] = reply
+            state["left"] -= 1
+            if state["left"]:
+                return
+            replies = state["replies"]
+        m = self._msg
+        self._conn.send_message(M.MOSDOpReplyBatch(
+            tid=m.tid,
+            tids=[r.tid for r in replies],
+            codes=[r.code for r in replies],
+            epochs=[r.epoch for r in replies],
+            versions=[r.version for r in replies],
+            datas=[r.data for r in replies],
+            stages=[r.stages for r in replies]))
 
 
 class _SelfConn:

@@ -50,6 +50,11 @@ _REGISTRY: dict[int, type] = {}
 class Message:
     MSG_TYPE = 0
     FIELDS: list[tuple[str, str]] = []
+    #: name of a ``bytes_list`` field whose payloads dominate the
+    #: frame (bulk batch messages): ``encode_payload_parts`` passes
+    #: them through by reference instead of re-copying into one blob
+    #: (scatter-gather serialize, ROADMAP 1c)
+    BULK_FIELD: str | None = None
 
     def __init__(self, **kw) -> None:
         self.seq = 0
@@ -81,6 +86,35 @@ class Message:
         e = Encoder()
         e.section(1, body)
         return e.getvalue()
+
+    def encode_payload_parts(self) -> list[bytes]:
+        """Scatter-gather serialization: the payload as a buffer
+        list whose concatenation == ``encode_payload()`` byte for
+        byte (pinned in tests/test_messenger.py), with the
+        ``BULK_FIELD`` payloads passed through by reference — no
+        re-copy of chunk data into one contiguous blob. The
+        messenger writes the parts and crc-chains across them; only
+        messages that declare a bulk field pay the parts machinery."""
+        bulk = self.BULK_FIELD
+        if not bulk:
+            return [self.encode_payload()]
+        body = Encoder()
+        for name, kind in self.FIELDS:
+            if name == bulk:
+                vals = getattr(self, name)
+                body.u32(len(vals))
+                for v in vals:
+                    body.u32(len(v))
+                    body.raw(v)
+            else:
+                _ENC[kind](body, getattr(self, name))
+        # ENCODE_START framing over the uncopied body (the byte-
+        # identical twin of Encoder.section)
+        hdr = Encoder()
+        hdr.u8(1)
+        hdr.u8(1)
+        hdr.u32(body.nbytes())
+        return hdr.getparts() + body.getparts()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "Message":
@@ -253,6 +287,44 @@ class MOSDOpReply(Message):
               ("stages", "str")]
 
 
+class MOSDOpBatch(Message):
+    """Client -> primary: every in-flight plain write the streaming
+    objecter coalesced for ONE (pool, PG), in one frame (ROADMAP 1b:
+    one client saturates a primary the way peers saturate each other
+    since the bulk-ingest fan-out). Entries are parallel lists —
+    entry i is the write (tids[i], oids[i], ops[i], offsets[i],
+    lengths[i], datas[i], traces[i], stages[i]); ``stages`` stays
+    per-entry because each op owns its client-side timeline (unlike
+    MECSubWriteBatch, whose entries are born on one shared clock).
+    Restricted by the sender to plain data writes — guarded, snap-
+    context, cls and read ops ride singleton MOSDOps. Each entry is
+    individually resendable as a singleton (the OSD's (client, tid)
+    dup-op cache dedups), so the reliability machinery is unchanged."""
+    MSG_TYPE = 69
+    FIELDS = [("tid", "u64"), ("client", "str"), ("epoch", "u32"),
+              ("pool", "i32"), ("ps", "u32"),
+              ("tids", "u64_list"), ("oids", "str_list"),
+              ("ops", "i32_list"), ("offsets", "u64_list"),
+              ("lengths", "u64_list"), ("datas", "bytes_list"),
+              ("traces", "str_list"), ("stages", "str_list")]
+
+    #: scatter-gather framing (ROADMAP 1c): ship ``datas`` payloads
+    #: as their own frame parts instead of re-copying into one blob
+    BULK_FIELD = "datas"
+
+
+class MOSDOpReplyBatch(Message):
+    """One ack for every op an MOSDOpBatch carried: entry i answers
+    tids[i] with codes[i]/versions[i]/datas[i] and its merged stage
+    timeline — exactly a singleton MOSDOpReply per entry, in one
+    frame with one client-side wakeup sweep."""
+    MSG_TYPE = 70
+    FIELDS = [("tid", "u64"), ("tids", "u64_list"),
+              ("codes", "i32_list"), ("epochs", "u64_list"),
+              ("versions", "u64_list"), ("datas", "bytes_list"),
+              ("stages", "str_list")]
+
+
 class MPGStats(Message):
     """OSD -> mon: periodic per-PG stat report (the MgrClient report
     protocol's role, mgr collapsed into the mon). ``stats`` is a json
@@ -423,6 +495,10 @@ class MECSubWriteBatch(Message):
               ("oids", "str_list"), ("versions", "u64_list"),
               ("txns", "bytes_list"), ("traces", "str_list"),
               ("stages", "str")]
+
+    #: scatter-gather framing (ROADMAP 1c): the shard txns ship as
+    #: their own frame parts — no re-copy into one contiguous payload
+    BULK_FIELD = "txns"
 
 
 class MECSubWriteBatchReply(Message):

@@ -33,7 +33,7 @@ from typing import Callable
 
 from ceph_tpu.analysis.lock_witness import make_lock
 from ceph_tpu.parallel.messages import (MECSubWriteBatch, Message,
-                                        decode_message)
+                                        MOSDOpBatch, decode_message)
 from ceph_tpu.utils import checksum
 from ceph_tpu.utils import faults as _faults
 from ceph_tpu.utils import profiler as _prof
@@ -49,9 +49,12 @@ _HDR = struct.Struct("<IQH")   # magic, seq, msg type
 #: message types allowed before authentication (the MAuth exchange)
 _PREAUTH_TYPES = (38, 39, 63, 64)
 
-#: the bulk-ingest sub-write batch (one frame per peer per engine
-#: flush) — the type the wire-framing ledger accounts per-flush
-_BATCH_TYPE = MECSubWriteBatch.MSG_TYPE
+#: the bulk batch frames — the peer sub-write batch (one per peer per
+#: engine flush, ISSUE 9) and the streaming client batch (one per
+#: (pool, PG) coalescing run, ROADMAP 1b) — the types the
+#: wire-framing ledger accounts per-flush
+_BATCH_TYPES = frozenset((MECSubWriteBatch.MSG_TYPE,
+                          MOSDOpBatch.MSG_TYPE))
 
 #: in-process peer registry (bulk ingest, ISSUE 9): listening addr ->
 #: Messenger for every bound endpoint in THIS process. Co-located
@@ -443,7 +446,9 @@ class Messenger:
             # the moment of hand-off (its interval reads ~0)
             clock.mark_once("send_queue_wait", t=t_pick)
             msg.stages = clock.to_wire()
-        payload = msg.encode_payload()
+        # one join: the loopback decode needs a contiguous buffer
+        # anyway (scatter-gather pays off on the real wire below)
+        payload = b"".join(msg.encode_payload_parts())
         self._seq += 1
         mtype = msg.MSG_TYPE
         tel.note_send(mtype, len(payload) + _HDR.size,
@@ -452,7 +457,7 @@ class Messenger:
         # header/meta/crc — overhead here is the header-equivalent
         tel.note_framing(len(payload), len(payload) + _HDR.size,
                          loopback=True,
-                         is_batch=mtype == _BATCH_TYPE)
+                         is_batch=mtype in _BATCH_TYPES)
         try:
             m2 = decode_message(mtype, payload)
         except Exception as exc:
@@ -597,23 +602,39 @@ class Messenger:
         if clock is not None:
             clock.mark_once("send_queue_wait", t=t_pick)
             msg.stages = clock.to_wire()
-        payload = msg.encode_payload()
+        # scatter-gather serialize (ROADMAP 1c): bulk batch payloads
+        # stay in their own buffers — the crc chains across parts and
+        # the socket takes the part list; no re-copy into one blob
+        parts = msg.encode_payload_parts()
+        payload_len = sum(len(p) for p in parts)
         self._seq += 1
-        auth = self.signer.sign(payload) if self.signer else ""
+        if self.signer is not None:
+            # auth signs the contiguous payload: the signed path pays
+            # the one join (auth'd clusters already skip loopback too)
+            payload = b"".join(parts)
+            parts = [payload]
+            auth = self.signer.sign(payload)
+        else:
+            auth = ""
         meta = f"{self.entity_name}|{self.addr}|{auth}".encode()
-        crc = checksum.crc32c(payload) if self._crc_data else 0
-        frame = (_HDR.pack(_MAGIC, self._seq, msg.MSG_TYPE)
-                 + struct.pack("<H", len(meta)) + meta
-                 + struct.pack("<II", len(payload), crc)
-                 + payload)
-        tel.note_send(msg.MSG_TYPE, len(frame),
+        crc = 0
+        if self._crc_data:
+            for p in parts:
+                crc = checksum.crc32c(p, crc)
+        head = (_HDR.pack(_MAGIC, self._seq, msg.MSG_TYPE)
+                + struct.pack("<H", len(meta)) + meta
+                + struct.pack("<II", payload_len, crc))
+        frame_len = len(head) + payload_len
+        tel.note_send(msg.MSG_TYPE, frame_len,
                       time.monotonic() - t_pick,
                       0.0 if t_submit is None else t_pick - t_submit)
-        tel.note_framing(len(payload), len(frame), loopback=False,
-                         is_batch=msg.MSG_TYPE == _BATCH_TYPE)
+        tel.note_framing(payload_len, frame_len, loopback=False,
+                         is_batch=msg.MSG_TYPE in _BATCH_TYPES)
         try:
             async with conn.lock:
-                conn.writer.write(frame)
+                conn.writer.write(head)
+                for p in parts:
+                    conn.writer.write(p)
                 await conn.writer.drain()
             return True
         except (ConnectionError, OSError) as exc:

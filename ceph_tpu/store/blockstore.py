@@ -202,9 +202,18 @@ class BlockStore(ObjectStore):
         # threads) must not interleave size-probe and write — they
         # would record the same offset for different blobs
         self._append_lock = make_lock("blockstore.append")
+        self._parked = osr._ParkedCompletions("blockstore.parked")
+        # leader-follower barrier coalescing (ROADMAP 1a): concurrent
+        # commits share fsync rounds instead of each paying its own;
+        # the hot-leader dwell window is cached at mount
+        self._shared = osr._SharedBarrier("blockstore.barrier")
+        self._barrier_window_s = 0.0
 
     # -- lifecycle ----------------------------------------------------
     def mount(self) -> None:
+        from ceph_tpu.utils.config import g_conf
+        self._barrier_window_s = \
+            g_conf()["store_barrier_window_ms"] / 1e3
         self._db = FileDB(os.path.join(self.path, "db"))
         data_path = os.path.join(self.path, "data")
         # native data-plane engine (KernelDevice/aio role: one-pass
@@ -256,10 +265,82 @@ class BlockStore(ObjectStore):
             "blockstore", id(self))
         tmr.n_ops = len(txn)
         with tmr:
-            self._queue_transaction_timed(txn, tmr)
+            if osr.group_commit_enabled():
+                # barriers ride the shared leader-follower rounds:
+                # an idle store syncs immediately; concurrent commits
+                # coalesce onto one fsync set (the page-cache WAL
+                # write precedes the data barrier inside a round —
+                # the same OS-crash-only ordering note as the
+                # deferred group path)
+                self._queue_transaction_timed(txn, tmr, sync=False)
+                self._shared.sync(self._sync_all,
+                                  self._barrier_window_s)
+            else:
+                self._queue_transaction_timed(txn, tmr)
             tmr.run_on_commit(on_commit)
 
-    def _queue_transaction_timed(self, txn: Transaction, tmr) -> None:
+    def queue_transaction_group(self, pairs: list,
+                                defer: bool = False) -> None:
+        """Group commit (ROADMAP 1a): the flush group's writes append
+        in one pass under one append-lock hold, pay ONE data-file
+        fdatasync, build ONE metadata kv batch = ONE WAL append + ONE
+        kv.wal fsync — instead of a barrier set per txn. ``defer``
+        parks both barriers and the completion sweep for
+        :meth:`barrier` (the cross-thread leg: the deferred WAL
+        record is page-cache-written before the data barrier, so the
+        data-before-wal *barrier* order still holds at the shared
+        :meth:`barrier`; the exposure window narrows the crash
+        contract to OS-crash page reordering, same class as the
+        reference's deferred writes)."""
+        assert self._db is not None, "not mounted"
+        if not pairs:
+            return
+        from ceph_tpu.utils import store_telemetry
+        tmr = store_telemetry.telemetry().txn_timer(
+            "blockstore", id(self))
+        merged = Transaction()
+        for txn, _ in pairs:
+            merged.ops.extend(txn.ops)
+        tmr.n_ops = len(merged)
+        tmr.n_txns = len(pairs)
+        with tmr:
+            data_dirty = self._queue_transaction_timed(
+                merged, tmr, sync=False)
+            if defer:
+                self._parked.park([cb for _, cb in pairs],
+                                  dirty=data_dirty)
+            else:
+                self._shared.sync(self._sync_all,
+                                  self._barrier_window_s)
+                tmr.run_on_commit_sweep([cb for _, cb in pairs])
+
+    def _sync_all(self) -> None:
+        """One barrier round: the data-file fdatasync then the WAL
+        fsync — the same data-before-wal barrier order as the inline
+        path, paid once per leader-follower round."""
+        data = self._data
+        if data is not None:
+            data.sync()
+        if self._db is not None:
+            self._db.sync()
+
+    def barrier(self) -> None:
+        """The shared deferred barrier: one barrier round covering
+        every ``defer=True`` group parked so far, then the completion
+        sweep in submission order. Runs lock-free (the fsyncs must
+        never sit under the append lock or a PG lock)."""
+        from ceph_tpu.utils import store_telemetry
+        cbs, dirty = self._parked.take()
+        if not cbs and not dirty:
+            return
+        self._shared.sync(self._sync_all, self._barrier_window_s)
+        store_telemetry.sweep_completions(cbs)
+
+    def barrier_pending(self) -> bool:
+        return bool(self._parked)
+
+    def _queue_transaction_timed(self, txn: Transaction, tmr,
+                                 sync: bool = True) -> bool:
         _TP_QUEUE_TXN(len(txn))
         # stage 1: data-file appends for every WRITE op; blobs compress
         # when the configured algorithm saves enough
@@ -307,7 +388,7 @@ class BlockStore(ObjectStore):
                                       len(stored), csum, comp_id,
                                       csum_id)
             data_dirty = True
-        if data_dirty:
+        if data_dirty and sync:
             # the data-file barrier: both engines route their
             # fdatasync through the timed seam (site blockstore.data)
             self._data.sync()
@@ -402,7 +483,9 @@ class BlockStore(ObjectStore):
         tmr.add("kv_build", tmr.now() - t_kv)
         # FileDB.submit lands wal_append + the kv.wal fsync on this
         # txn's timer — the atomicity point's own decomposition
-        self._db.submit(batch, sync=True)
+        # (sync=False defers the fsync to the group's shared barrier)
+        self._db.submit(batch, sync=sync)
+        return data_dirty
 
     # -- reads --------------------------------------------------------
     @staticmethod

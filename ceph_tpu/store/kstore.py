@@ -47,9 +47,15 @@ class KStore(ObjectStore):
         self._db = None
         self._lock = make_rlock("kstore.db")
         self._eio: set[tuple[str, str]] = set()
+        self._parked = osr._ParkedCompletions("kstore.parked")
+        self._shared = osr._SharedBarrier("kstore.barrier")
+        self._barrier_window_s = 0.0
 
     # -- lifecycle ----------------------------------------------------
     def mount(self) -> None:
+        from ceph_tpu.utils.config import g_conf
+        self._barrier_window_s = \
+            g_conf()["store_barrier_window_ms"] / 1e3
         with self._lock:
             self._db = FileDB(self._path) if self._path else MemDB()
 
@@ -158,10 +164,65 @@ class KStore(ObjectStore):
                     batch = WriteBatch()
                     for op in txn.ops:
                         self._apply_op(batch, op)
-                # FileDB.submit lands wal_append + the kv.wal fsync
-                # on this txn's timer (MemDB commits in RAM: free)
-                self._db.submit(batch, sync=True)
+                # FileDB.submit lands the wal_append on this txn's
+                # timer (MemDB commits in RAM: free); the kv.wal
+                # fsync is paid OUTSIDE the store lock below —
+                # readers must not queue behind a durability barrier
+                self._db.submit(batch, sync=False)
+            if osr.group_commit_enabled():
+                self._shared.sync(self._db.sync,
+                                  self._barrier_window_s)
+            else:
+                self._db.sync()
             tmr.run_on_commit(on_commit)
+
+    def queue_transaction_group(self, pairs: list,
+                                defer: bool = False) -> None:
+        """Group commit (ROADMAP 1a): the whole flush group builds
+        ONE kv batch and pays ONE WAL append; the WAL fsync is issued
+        OUTSIDE the store lock (one barrier for the group — and never
+        under a lock the read path takes). ``defer`` parks barrier +
+        completion sweep for :meth:`barrier`."""
+        assert self._db is not None, "not mounted"
+        if not pairs:
+            return
+        from ceph_tpu.utils import store_telemetry
+        tmr = store_telemetry.telemetry().txn_timer("kstore",
+                                                    id(self))
+        merged = Transaction()
+        for txn, _ in pairs:
+            merged.ops.extend(txn.ops)
+        tmr.n_ops = len(merged)
+        tmr.n_txns = len(pairs)
+        with tmr:
+            t0 = tmr.now()
+            with self._lock:
+                tmr.mark_wait("queue_wait", t0)
+                with tmr.stage("apply"):
+                    self._validate(merged)
+                with tmr.stage("kv_build"):
+                    batch = WriteBatch()
+                    for op in merged.ops:
+                        self._apply_op(batch, op)
+                self._db.submit(batch, sync=False)
+            if defer:
+                self._parked.park([cb for _, cb in pairs],
+                                  dirty=True)
+            else:
+                self._shared.sync(self._db.sync,
+                                  self._barrier_window_s)
+                tmr.run_on_commit_sweep([cb for _, cb in pairs])
+
+    def barrier(self) -> None:
+        from ceph_tpu.utils import store_telemetry
+        cbs, dirty = self._parked.take()
+        if dirty and self._db is not None:
+            self._shared.sync(self._db.sync,
+                              self._barrier_window_s)
+        store_telemetry.sweep_completions(cbs)
+
+    def barrier_pending(self) -> bool:
+        return bool(self._parked)
 
     def _apply_op(self, batch: WriteBatch, op: tuple) -> None:
         code = op[0]

@@ -51,6 +51,11 @@ class KeyValueDB:
     def submit(self, batch: WriteBatch, sync: bool = True) -> None:
         raise NotImplementedError
 
+    def sync(self) -> None:
+        """Deferred-barrier seam (group commit, ROADMAP 1a): make
+        every ``submit(sync=False)`` so far durable with ONE barrier.
+        No-op for stores with no durability (MemDB)."""
+
     def get(self, key: str) -> bytes | None:
         raise NotImplementedError
 
@@ -104,6 +109,7 @@ class FileDB(KeyValueDB):
                 f.truncate(valid_end)
         self._wal = open(self._walp, "ab")
         self._wal_records = 0
+        self._unsynced = 0     # bytes appended since the last barrier
 
     # -- recovery -----------------------------------------------------
     def _load(self) -> int:
@@ -157,10 +163,26 @@ class FileDB(KeyValueDB):
             store_telemetry.timed_fsync(self._wal.fileno(),
                                         site="kv.wal",
                                         nbytes=len(rec))
+        else:
+            self._unsynced += len(rec)
         self._apply(batch)
         self._wal_records += 1
         if self._wal_records >= 10000:
             self.compact()
+
+    def sync(self) -> None:
+        """One WAL fsync covering every unsynced append so far (the
+        shared barrier a txn group pays once). A compaction racing in
+        from another txn swaps the WAL file object; its own fsyncs
+        already made everything durable, so a stale-fd error here is
+        a satisfied barrier, not a failure."""
+        nbytes, self._unsynced = self._unsynced, 0
+        try:
+            store_telemetry.timed_fsync(self._wal.fileno(),
+                                        site="kv.wal",
+                                        nbytes=nbytes)
+        except (OSError, ValueError):
+            pass
 
     def get(self, key: str) -> bytes | None:
         return self._data.get(key)
@@ -185,6 +207,7 @@ class FileDB(KeyValueDB):
         store_telemetry.timed_fsync(self._wal.fileno(),
                                     site="kv.compact.wal")
         self._wal_records = 0
+        self._unsynced = 0     # the snapshot made everything durable
 
     def close(self) -> None:
         self.compact()

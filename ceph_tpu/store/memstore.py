@@ -89,6 +89,30 @@ class MemStore(ObjectStore):
                 self._apply(txn)
             tmr.run_on_commit(on_commit)
 
+    def queue_transaction_group(self, pairs: list,
+                                defer: bool = False) -> None:
+        """Group commit (ROADMAP 1a): one apply pass for the whole
+        flush group, completions as one sweep in submission order.
+        There is NO barrier to share in RAM — a commit here is
+        already "durable" — so ``defer`` is a no-op and the sweep
+        runs inline (parking acks for a barrier that will never add
+        durability is pure latency; measured ~10% off the memstore
+        loopback quick run)."""
+        if not pairs:
+            return
+        from ceph_tpu.utils import store_telemetry
+        tmr = store_telemetry.telemetry().txn_timer(
+            "memstore", id(self))
+        tmr.n_ops = sum(len(txn) for txn, _ in pairs)
+        tmr.n_txns = len(pairs)
+        with tmr:
+            with tmr.stage("apply"):
+                merged = Transaction()
+                for txn, _ in pairs:
+                    merged.ops.extend(txn.ops)
+                self._apply(merged)
+            tmr.run_on_commit_sweep([cb for _, cb in pairs])
+
     def _apply(self, txn: Transaction) -> None:
         self._validate(txn)
         for op in txn.ops:

@@ -16,11 +16,136 @@ from __future__ import annotations
 
 from typing import Callable, Iterator
 
+from ceph_tpu.analysis.lock_witness import make_condition, make_lock
 from ceph_tpu.utils.encoding import Decoder, Encoder
+
+
+def group_commit_enabled() -> bool:
+    """The ROADMAP-1a store batching switch (shared with the OSD's
+    ``CEPH_TPU_GROUP_COMMIT`` A/B convention): when off, every txn
+    pays its own inline barrier set exactly like the pre-15 stores."""
+    import os
+    return os.environ.get("CEPH_TPU_GROUP_COMMIT", "1") != "0"
 
 
 class StoreError(Exception):
     pass
+
+
+class _SharedBarrier:
+    """Leader-follower barrier coalescing — THE group-commit
+    mechanism the adjacency-window ledger priced (the classic WAL
+    group commit): a caller whose appends need durability either
+    leads a barrier round immediately (idle path: zero added
+    latency) or, when a round is already in flight, waits and shares
+    a later round with every other caller that arrived meanwhile.
+    One fsync set then covers them all — under load the barrier rate
+    converges on 1/fsync-duration instead of 1/txn.
+
+    Rounds have two phases. While a round is COLLECTING, new callers
+    join it (their appends precede the fsync, which has not started);
+    once it is SYNCING, arrivals wait for the next round. A hot
+    leader — one whose previous round was shared — DWELLS for the
+    adjacency window before syncing, sweeping in the near-adjacent
+    commits the what-if ledger measured; a cold (idle-stream) leader
+    syncs immediately, so light traffic never pays the window.
+
+    The leader runs ``do_sync`` with no locks held (waiters park on
+    this barrier's own condition, never on a store or PG lock)."""
+
+    __slots__ = ("_cond", "_gen", "_phase", "_members",
+                 "_last_shared", "_last_end")
+
+    _IDLE, _COLLECTING, _SYNCING = 0, 1, 2
+
+    #: hotness horizon: a leader dwells when the previous round ended
+    #: within this many windows ago (the stream is adjacent even if
+    #: commits never overlap — the exact population the what-if
+    #: ledger's window replay grouped)
+    _HOT_WINDOWS = 5.0
+
+    def __init__(self, name: str) -> None:
+        self._cond = make_condition(name)
+        self._gen = 0
+        self._phase = self._IDLE
+        self._members = 0
+        self._last_shared = False
+        self._last_end = -1e18
+
+    def sync(self, do_sync: Callable[[], None],
+             window_s: float = 0.0) -> None:
+        import time as _time
+        with self._cond:
+            while True:
+                if self._phase == self._IDLE:
+                    self._phase = self._COLLECTING   # lead new round
+                    break
+                # either way we are concurrent demand: the NEXT
+                # leader's dwell decision keys on having had waiters
+                self._members += 1
+                if self._phase == self._COLLECTING:
+                    # join the open round (its fsync has not started,
+                    # so it covers our appends) and wait it out
+                    my_round = self._gen + 1
+                    while self._gen < my_round:
+                        self._cond.wait()
+                    return
+                # SYNCING: that fsync may predate our appends — wait
+                # for the round to finish, then join/lead the next
+                cur = self._gen
+                while self._gen == cur and \
+                        self._phase == self._SYNCING:
+                    self._cond.wait()
+            hot = self._last_shared or (
+                _time.monotonic() - self._last_end
+                < self._HOT_WINDOWS * window_s)
+            dwell = window_s if hot else 0.0
+        if dwell > 0:
+            _time.sleep(dwell)  # collect the adjacency window
+        with self._cond:
+            self._phase = self._SYNCING
+        try:
+            do_sync()
+        finally:
+            with self._cond:
+                self._gen += 1
+                self._phase = self._IDLE
+                self._last_shared = self._members > 0
+                self._members = 0
+                self._last_end = _time.monotonic()
+                self._cond.notify_all()
+
+
+class _ParkedCompletions:
+    """Thread-safe holder for the deferred leg of group commit: the
+    completion callbacks (and, for stores with a separate data file,
+    the needs-a-data-barrier flag) parked between a ``defer=True``
+    :meth:`ObjectStore.queue_transaction_group` and the shared
+    :meth:`ObjectStore.barrier`. Only list/flag handoff happens under
+    its lock — the barrier's fsyncs and the completion sweep run
+    outside it."""
+
+    __slots__ = ("_lock", "_cbs", "_dirty")
+
+    def __init__(self, name: str) -> None:
+        self._lock = make_lock(name)
+        self._cbs: list = []
+        self._dirty = False
+
+    def park(self, cbs, dirty: bool = False) -> None:
+        with self._lock:
+            self._cbs.extend(cbs)
+            self._dirty = self._dirty or dirty
+
+    def take(self) -> tuple[list, bool]:
+        with self._lock:
+            cbs, self._cbs = self._cbs, []
+            dirty, self._dirty = self._dirty, False
+        return cbs, dirty
+
+    def __bool__(self) -> bool:
+        with self._lock:
+            return bool(self._cbs) or self._dirty
 
 
 class EIOError(StoreError):
@@ -182,6 +307,44 @@ class ObjectStore:
     def queue_transaction(self, txn: Transaction,
                           on_commit: Callable[[], None] | None = None) -> None:
         raise NotImplementedError
+
+    # -- group commit (ROADMAP item 1a) -------------------------------
+    def queue_transaction_group(self, pairs: list,
+                                defer: bool = False) -> None:
+        """Commit many ``(txn, on_commit)`` pairs as ONE store commit:
+        one apply pass, one metadata batch, one WAL append, one
+        durability-barrier set — instead of per-txn completion
+        machinery — with the completions delivered as one batched
+        sweep in submission order (the group-commit path the
+        adjacency-window ledger in utils/store_telemetry projected).
+        The group is atomic as a whole (it is a flush group: the same
+        all-or-nothing envelope the merged-transaction path had).
+
+        ``defer=True`` additionally parks the barrier AND the
+        completion sweep until :meth:`barrier` — the cross-thread leg:
+        several groups queued from different op-shard threads (one
+        per PG of a batched sub-write frame) share ONE barrier issued
+        by whoever calls :meth:`barrier` last. Callers own liveness:
+        every ``defer=True`` queue MUST be followed by a
+        :meth:`barrier` on some thread, or the acks never fire.
+        """
+        for txn, cb in pairs:
+            self.queue_transaction(txn, cb)
+        if defer:
+            # base fallback committed synchronously: nothing parked
+            return
+
+    def barrier(self) -> None:
+        """Flush every deferred durability barrier and sweep the
+        parked completions in submission order. Must never be called
+        (and is never needed) under a per-PG or store lock the op
+        path also takes — the fsync runs lock-free."""
+
+    def barrier_pending(self) -> bool:
+        """True when deferred completions are parked (tick backstop
+        hook: a stranded ``defer=True`` group must not strand its
+        acks forever)."""
+        return False
 
     # -- reads (never require a transaction) --------------------------
     def read(self, cid: str, oid: str, off: int = 0,

@@ -286,6 +286,22 @@ for _o in [
            "objecter_resend_interval up to this (jittered) — a dead "
            "primary must not be hammered at RTT rate by every parked "
            "client (ISSUE 8)"),
+    Option("objecter_stream", bool, True, "advanced",
+           "streaming submission seam (ROADMAP 1b): coalesce "
+           "concurrent in-flight plain writes per (pool, PG) into "
+           "batched MOSDOp frames with one reply sweep; off = every "
+           "op frames its own MOSDOp (the pre-15 client leg)"),
+    Option("objecter_stream_max_ops", int, 32, "advanced",
+           "the streaming batch window: max writes coalesced into "
+           "one MOSDOpBatch frame per (pool, PG); 1 disables "
+           "coalescing. Tuner-managed (ISSUE 13 registry)",
+           min=1, max=1024),
+    Option("store_barrier_window_ms", float, 2.0, "advanced",
+           "group-commit adjacency window: a HOT barrier leader "
+           "(previous fsync round was shared) dwells this long "
+           "collecting adjacent commits before syncing — the window "
+           "the PR-14 what-if ledger priced; idle commits never pay "
+           "it. 0 disables the dwell", min=0.0, max=50.0),
     Option("osd_ec_read_backoff_base", float, 0.02, "advanced",
            "EC shard-read retry ladder: first-retry backoff seconds "
            "(doubles per attempt, full jitter)"),

@@ -78,6 +78,22 @@ class Encoder:
         self.bytes(payload)
         return self
 
+    # -- scatter-gather surface (ROADMAP 1c) --------------------------
+    def raw(self, v: bytes) -> "Encoder":
+        """Append pre-encoded bytes as their own part, by reference:
+        ``getparts`` hands it through uncopied (length prefixes are
+        the caller's job — pair with an explicit ``u32``)."""
+        self._parts.append(v)
+        return self
+
+    def getparts(self) -> list[bytes]:
+        """The encoded buffers WITHOUT the final join — the sendmsg-
+        style scatter list whose concatenation == ``getvalue()``."""
+        return list(self._parts)
+
+    def nbytes(self) -> int:
+        return sum(len(p) for p in self._parts)
+
     def getvalue(self) -> bytes:
         return b"".join(self._parts)
 

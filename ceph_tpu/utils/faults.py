@@ -78,6 +78,13 @@ _EVENT_LOG_MAX = 4096
 _MSG_TYPE_FAMILY = {
     30: (30, 67),     # MECSubWrite -> + MECSubWriteBatch
     31: (31, 68),     # MECSubWriteReply -> + MECSubWriteBatchReply
+    # ISSUE 15: the streaming objecter's batched client frames — a
+    # rule on MOSDOp/MOSDOpReply keeps biting when the client leg
+    # coalesces the same writes into one MOSDOpBatch per (pool, PG),
+    # so a dropped batched submit degrades exactly like N singleton
+    # drops (and recovers the same way: per-op singleton resends)
+    20: (20, 69),     # MOSDOp -> + MOSDOpBatch
+    21: (21, 70),     # MOSDOpReply -> + MOSDOpReplyBatch
 }
 
 

@@ -155,6 +155,11 @@ TUNER_KNOBS = KnobRegistry([
     Knob("mesh_flush_bytes", lo=128 << 10, hi=64 << 20, step=2.0,
          kind="mul", cooldown_s=3.0, subsystem="osd/device_engine",
          desc="dense->mesh crossover: single-chip vs sharded step"),
+    Knob("objecter_stream_max_ops", lo=1, hi=256, step=2.0,
+         kind="mul", cooldown_s=3.0, subsystem="client/objecter",
+         desc="streaming-objecter batch window: writes coalesced "
+              "per (pool, PG) frame — batching amortization vs "
+              "head-of-line latency (ROADMAP 1b/5d)"),
     Knob("trace_sample_every", lo=8, hi=1024, step=2.0, kind="mul",
          cooldown_s=6.0, subsystem="utils/tracing",
          desc="head-sample keep rate: observability vs overhead"),

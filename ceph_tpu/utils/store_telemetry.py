@@ -164,6 +164,20 @@ class StoreTelemetry:
         perf.add_histogram("objecter_batch_ops",
                            "ops per would-be streaming batch under "
                            "the default adjacency window")
+        # ROADMAP item 1 landed (ISSUE 15): the measured twins of the
+        # two what-if ledgers above — group commits the stores
+        # actually formed, and MOSDOpBatch frames the streaming
+        # objecter actually shipped
+        perf.add_u64_counter("store_group_commits",
+                             "txn groups committed under one shared "
+                             "barrier set (queue_transaction_group)")
+        perf.add_histogram("store_group_size",
+                           "txns per committed group")
+        perf.add_u64_counter("objecter_stream_batches",
+                             "batched MOSDOp frames the streaming "
+                             "objecter shipped")
+        perf.add_histogram("objecter_stream_batch_ops",
+                           "ops per shipped streaming batch")
 
     # -- txn lifecycle -------------------------------------------------
     def txn_timer(self, kind: str, store_id: int = 0,
@@ -176,11 +190,18 @@ class StoreTelemetry:
 
     def note_txn(self, kind: str, store_id: int, arrival_t: float,
                  n_ops: int, durations: dict[str, float],
-                 fsyncs: int, fsync_s: float) -> None:
+                 fsyncs: int, fsync_s: float,
+                 n_txns: int = 1) -> None:
         """One committed txn's decomposition lands in the registry
-        and its arrival in the group-commit ledger."""
-        self.perf.inc("txns")
+        and its arrival in the group-commit ledger. ``n_txns > 1``
+        marks a group commit: the group counts as ``n_txns`` logical
+        txns (so ``fsyncs_per_txn`` reflects the sharing) but ONE
+        arrival/commit in the adjacency ledger."""
+        self.perf.inc("txns", max(n_txns, 1))
         self.perf.hinc("txn_ops", n_ops)
+        if n_txns > 1:
+            self.perf.inc("store_group_commits")
+            self.perf.hinc("store_group_size", n_txns)
         for stage, dt in durations.items():
             if stage in SUB_STAGES and dt >= 0:
                 self.perf.tinc(f"txn_{stage}", dt)
@@ -289,6 +310,12 @@ class StoreTelemetry:
             self._pg_inflight[key] = depth
         self.perf.hinc("objecter_pg_inflight", depth)
 
+    def note_stream_batch(self, n_ops: int) -> None:
+        """One batched MOSDOp frame actually shipped by the streaming
+        objecter (the measured twin of ``objecter_batch_ops``)."""
+        self.perf.inc("objecter_stream_batches")
+        self.perf.hinc("objecter_stream_batch_ops", n_ops)
+
     def note_objecter_done(self, pool: int, ps: int) -> None:
         key = (int(pool), int(ps))
         with self._lock:
@@ -382,6 +409,22 @@ class StoreTelemetry:
             brief["fsync_time_s"] = round(ft["sum"], 4)
         if c["objecter_ops"]:
             brief["objecter_ops"] = c["objecter_ops"]
+        groups = c.get("store_group_commits", 0)
+        if groups:
+            sizes = c.get("store_group_size") or []
+            grouped = sum(n * (1 << max(i - 1, 0))
+                          for i, n in enumerate(sizes))
+            brief["group_commits"] = groups
+            # pow2 buckets: the reconstructed mean is a lower bound,
+            # good enough for the brief's at-a-glance group size
+            brief["mean_group_size"] = round(grouped / groups, 1)
+        batches = c.get("objecter_stream_batches", 0)
+        if batches:
+            sizes = c.get("objecter_stream_batch_ops") or []
+            ops = sum(n * (1 << max(i - 1, 0))
+                      for i, n in enumerate(sizes))
+            brief["stream_batches"] = batches
+            brief["mean_stream_batch"] = round(ops / batches, 1)
         return brief
 
     def reset(self) -> None:
@@ -391,6 +434,20 @@ class StoreTelemetry:
         global _telemetry
         with _module_lock:
             _telemetry = None
+
+
+def sweep_completions(cbs) -> None:
+    """Run a group's commit callbacks in submission order; one
+    failing ack must not starve the rest of the group (the OSD's old
+    merged-callback wrapper's guard, now owned by the store layer)."""
+    for cb in cbs:
+        if cb is None:
+            continue
+        try:
+            cb()
+        except Exception as exc:
+            from ceph_tpu.utils.dout import Dout
+            Dout("store")(0, f"group commit callback failed: {exc!r}")
 
 
 class TxnTimer:
@@ -413,7 +470,7 @@ class TxnTimer:
 
     __slots__ = ("_tel", "kind", "store_id", "_now", "arrival_t",
                  "start_t", "durations", "fsyncs", "fsync_s", "_prev",
-                 "n_ops")
+                 "n_ops", "n_txns")
 
     def __init__(self, tel: StoreTelemetry, kind: str, store_id: int,
                  now) -> None:
@@ -428,6 +485,7 @@ class TxnTimer:
         self.fsync_s = 0.0
         self._prev = None
         self.n_ops = 0
+        self.n_txns = 1       # >1: a queue_transaction_group commit
 
     def now(self) -> float:
         return self._now()
@@ -460,6 +518,16 @@ class TxnTimer:
         with self.stage("on_commit"):
             cb()
 
+    def run_on_commit_sweep(self, cbs) -> None:
+        """The group-commit completion sweep: every callback of the
+        group, in submission order, under ONE ``on_commit`` span. A
+        failing callback is logged and must not starve the rest of
+        the group's acks."""
+        if not cbs:
+            return
+        with self.stage("on_commit"):
+            sweep_completions(cbs)
+
     # -- thread-local current-timer protocol ---------------------------
     def __enter__(self) -> "TxnTimer":
         self._prev = getattr(_tls, "timer", None)
@@ -472,7 +540,7 @@ class TxnTimer:
             self._tel.note_txn(self.kind, self.store_id,
                                self.arrival_t, self.n_ops,
                                self.durations, self.fsyncs,
-                               self.fsync_s)
+                               self.fsync_s, n_txns=self.n_txns)
 
     def total(self) -> float:
         return sum(self.durations.values())

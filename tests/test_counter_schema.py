@@ -325,14 +325,19 @@ def test_store_counters_covered_by_lint():
     keys = set(telemetry().perf.dump())
     expect = {"txns", "txn_ops", "fsyncs", "fsync_bytes",
               "fsync_time", "objecter_ops", "objecter_pg_inflight",
-              "objecter_batch_ops"}
+              "objecter_batch_ops",
+              # ISSUE 15: the measured twins of the two what-if
+              # ledgers — groups committed and stream frames shipped
+              "store_group_commits", "store_group_size",
+              "objecter_stream_batches", "objecter_stream_batch_ops"}
     for stage in SUB_STAGES:
         expect.add(f"txn_{stage}")
         expect.add(f"txn_{stage}_us")
     assert expect <= keys, expect - keys
     text = prometheus.render_text()
     for key in ("txns", "fsyncs", "txn_fsync_sum",
-                "objecter_ops"):
+                "objecter_ops", "store_group_commits",
+                "objecter_stream_batches"):
         assert f"ceph_tpu_{key}" in text, key
     assert 'daemon="store"' in text
     # the new msgr framing counters ride the existing msgr registry

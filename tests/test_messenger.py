@@ -184,6 +184,67 @@ def test_unknown_message_type_dropped(pair):
     assert all(isinstance(m, M.MPing) for m, _ in sink.got)
 
 
+def test_scatter_gather_parts_equal_joined_payload():
+    """ISSUE 15 (real-wire bulk framing): a bulk batch message's
+    scatter-gather parts concatenate to EXACTLY encode_payload() —
+    the wire bytes are unchanged, only the copies are gone."""
+    batch = M.MECSubWriteBatch(
+        tid=3, epoch=7, tids=[1, 2], pools=[0, 0], pss=[1, 2],
+        shards=[0, 1], oids=["a", "b"], versions=[5, 6],
+        txns=[b"T" * 4096, b"U" * 9000], traces=["", "t"],
+        stages="s")
+    parts = batch.encode_payload_parts()
+    assert len(parts) > 1                  # really scatter-gathered
+    assert b"".join(parts) == batch.encode_payload()
+    # the bulk payloads ride by REFERENCE: no copy of the txn bytes
+    assert any(p is batch.txns[0] for p in parts)
+    assert any(p is batch.txns[1] for p in parts)
+    ob = M.MOSDOpBatch(
+        tid=1, client="c", epoch=2, pool=3, ps=4, tids=[9, 10],
+        oids=["o1", "o2"], ops=[5, 5], offsets=[0, 0],
+        lengths=[8, 8], datas=[b"D" * 8192, b"E" * 100],
+        traces=["", ""], stages=["", ""])
+    assert b"".join(ob.encode_payload_parts()) == ob.encode_payload()
+    # non-bulk messages keep the single-buffer fast path
+    assert len(M.MPing(osd_id=1).encode_payload_parts()) == 1
+
+
+def test_batch_frames_survive_real_tcp(monkeypatch):
+    """The off-loopback contract: scatter-gather framed batches cross
+    a real kernel TCP socket with crc intact and decode equal."""
+    monkeypatch.setenv("CEPH_TPU_MSGR_LOOPBACK", "0")
+    a, b = Messenger("osd.7"), Messenger("osd.8")
+    a.bind(); b.bind()
+    try:
+        sink = Sink()
+        b.set_dispatcher(sink)
+        batch = M.MECSubWriteBatch(
+            tid=11, epoch=2, tids=[21, 22], pools=[1, 1],
+            pss=[0, 3], shards=[0, 2], oids=["x", "y"],
+            versions=[1, 2], txns=[b"\x01" * 65536, b"\x02" * 1234],
+            traces=["", ""], stages="")
+        opb = M.MOSDOpBatch(
+            tid=12, client="client.1", epoch=2, pool=1, ps=3,
+            tids=[31], oids=["z"], ops=[1], offsets=[0],
+            lengths=[16], datas=[b"\x03" * 16], traces=[""],
+            stages=[""])
+        a.send_message(batch, b.addr)
+        a.send_message(opb, b.addr)
+        assert sink.wait(n=2)
+        got_batch = next(m for m, _ in sink.got
+                         if isinstance(m, M.MECSubWriteBatch))
+        assert got_batch.txns == batch.txns
+        assert got_batch.oids == ["x", "y"]
+        got_opb = next(m for m, _ in sink.got
+                       if isinstance(m, M.MOSDOpBatch))
+        assert got_opb.datas == [b"\x03" * 16]
+        # and the framing ledger saw them as TCP batch frames
+        from ceph_tpu.utils.msgr_telemetry import telemetry
+        assert telemetry().perf.dump()["tcp_batch_frames"] >= 2
+    finally:
+        a.shutdown(); b.shutdown()
+
+
 def test_failure_injection_drops_but_system_recovers():
     from ceph_tpu.utils.config import g_conf
     g_conf().set("ms_inject_socket_failures", 5)
