@@ -337,6 +337,9 @@ def main() -> None:
         _bench_load_gen()
     except Exception as exc:  # the cluster row must still land
         emit("load_gen_MBps", {"error": repr(exc)})
+        for row in ("dispatch_hops_per_op", "whatif_rtc_MBps"):
+            if row not in _RESULTS:   # ISSUE-17 rows ride load_gen
+                emit(row, {"error": repr(exc)})
 
     try:
         _bench_commit_path()
@@ -1082,7 +1085,12 @@ def _emit_commit_path_rows(measured_mbps: float) -> None:
     projection (its direction pin gates UP now that the batching
     landed). The measured ``store_fsyncs_per_op`` row moved to the
     durable-store A/B in ``_bench_commit_path`` (ISSUE 15) — on the
-    memstore load_gen cluster the fsync count is degenerate."""
+    memstore load_gen cluster the fsync count is degenerate.
+
+    ISSUE 17 adds the dispatch-path pair off the same run: the
+    measured cross-thread hops per completed op (gates DOWN when the
+    run-to-completion refactor lands) and the RTC projection (gates
+    UP, same first-order model as the group-commit row)."""
     try:
         from ceph_tpu.tools.gap_report import _what_if
         from ceph_tpu.utils.dataplane import dataplane
@@ -1102,6 +1110,37 @@ def _emit_commit_path_rows(measured_mbps: float) -> None:
         })
     except Exception as exc:
         emit("whatif_group_commit_MBps", {"error": repr(exc)})
+    try:
+        from ceph_tpu.utils.dataplane import dataplane
+        from ceph_tpu.utils.dispatch_telemetry import SEAMS, telemetry
+        tel = telemetry()
+        c = tel.perf.dump()
+        chains = c.get("op_chains", 0)
+        hops = sum(c.get(f"ophop_{s}", 0) for s in SEAMS)
+        emit("dispatch_hops_per_op", {
+            "value": round(hops / chains, 2) if chains else 0.0,
+            "unit": "hops",
+            "op_chains": chains,
+            "wakeups_per_frame":
+                tel.wakeup_table().get("wakeups_per_frame"),
+        })
+        bd = dataplane().stage_breakdown()
+        ch = ((bd.get("commit_path") or {}).get("stages", {})
+              .get("commit_handoff") or {}).get("mean_ms")
+        rtc = tel.rtc_projection(bd.get("ops") or 0,
+                                 bd.get("mean_ms") or 0.0,
+                                 measured_mbps,
+                                 handoff_ms_per_op=ch)
+        emit("whatif_rtc_MBps", {
+            "value": rtc.get("whatif_rtc_MBps", 0.0),
+            "unit": "MB/s",
+            "hops_saved": rtc.get("hops_saved"),
+            "wakeups_saved": rtc.get("wakeups_saved"),
+            "saved_ms_per_op": rtc.get("saved_ms_per_op"),
+        })
+    except Exception as exc:
+        emit("dispatch_hops_per_op", {"error": repr(exc)})
+        emit("whatif_rtc_MBps", {"error": repr(exc)})
 
 
 def _commit_path_burst(n_objs: int, obj_kb: int, conc: int,

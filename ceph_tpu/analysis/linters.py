@@ -897,6 +897,105 @@ def check_lock_discipline(src: SourceFile) -> list[Finding]:
     return findings
 
 
+#: call spellings that construct a condition variable (own-lock arg
+#: recorded so notifying under the cond's OWN lock never flags)
+_COND_CTORS = ("threading.Condition", "make_condition",
+               "lock_witness.make_condition")
+
+
+def _cond_attrs(cls: ast.ClassDef) -> dict[str, str | None]:
+    """``self.<attr>`` condition variables of this class ->
+    the ``self.<lock>`` attr passed as their lock (None when the
+    cond owns its lock)."""
+    out: dict[str, str | None] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            fname = _unparse(node.value.func)
+            if fname not in _COND_CTORS and \
+                    not fname.endswith(".make_condition"):
+                continue
+            own = None
+            args = list(node.value.args) + [
+                kw.value for kw in node.value.keywords
+                if kw.arg == "lock"]
+            for a in args:
+                if isinstance(a, ast.Attribute) and \
+                        isinstance(a.value, ast.Name) and \
+                        a.value.id == "self":
+                    own = a.attr
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    out[tgt.attr] = own
+    return out
+
+
+def check_notify_under_lock(src: SourceFile) -> list[Finding]:
+    """ISSUE 17: ``self.<cond>.notify()``/``notify_all()`` executed
+    lexically inside a ``with self.<lock>`` span where ``<lock>`` is a
+    DIFFERENT lock of the same class than the cond's own. The woken
+    thread's first act is usually to take that other lock — signalling
+    while still holding it turns every wakeup into an immediate block
+    (the hurry-up-and-wait shape the dispatch X-ray's wakeup-latency
+    plane measures at runtime); notify after release instead. The
+    cond's OWN lock is exempt: Python requires holding it to
+    notify."""
+    findings: list[Finding] = []
+    for cls in [n for n in ast.walk(src.tree)
+                if isinstance(n, ast.ClassDef)]:
+        locks = _lock_attrs(cls)
+        conds = _cond_attrs(cls)
+        if not locks or not conds:
+            continue
+        for m in [n for n in cls.body
+                  if isinstance(n, ast.FunctionDef)]:
+            spans: list[tuple[int, int, str]] = []
+            for node in ast.walk(m):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Attribute) and \
+                            isinstance(ctx.value, ast.Name) and \
+                            ctx.value.id == "self" and \
+                            ctx.attr in locks and \
+                            ctx.attr not in conds:
+                        spans.append((node.lineno,
+                                      node.end_lineno or node.lineno,
+                                      ctx.attr))
+            if not spans:
+                continue
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("notify",
+                                               "notify_all")):
+                    continue
+                recv = node.func.value
+                if not (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                        and recv.attr in conds):
+                    continue
+                own = conds[recv.attr]
+                held = [lk for a, b, lk in spans
+                        if a <= node.lineno <= b
+                        and lk != own and lk != recv.attr]
+                if held:
+                    findings.append(Finding(
+                        "notify_under_lock", src.rel, node.lineno,
+                        f"notify_under_lock:{src.rel}:{cls.name}."
+                        f"{m.name}:{recv.attr}",
+                        f"{cls.name}.{m.name}: notifies "
+                        f"self.{recv.attr} while holding "
+                        f"self.{held[0]} — the woken thread blocks "
+                        "right back on that lock; release before "
+                        "signalling"))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # 5. fsync seam (ISSUE 14)
 # ---------------------------------------------------------------------------
@@ -957,6 +1056,7 @@ def run_all(root: str = PKG_ROOT,
         findings.extend(check_wire_symmetry(src))
         findings.extend(check_jit_hygiene(src))
         findings.extend(check_lock_discipline(src))
+        findings.extend(check_notify_under_lock(src))
         findings.extend(check_fsync_seam(src))
         drift.collect(src)
     findings.extend(drift.findings())

@@ -29,6 +29,15 @@ runs wired through tests/conftest.py.
 
 State is fixed-memory: edges, fingerprints and violations are capped;
 past the cap new observations only bump counters.
+
+ISSUE 17 adds a second, independent opt-in mode — **lock timing** —
+riding the same construction seams: while :func:`enable_timing` is on
+(or ``CEPH_TPU_LOCK_TIMING=1``), ``make_*`` wraps the primitive in a
+:class:`_TimedLock` / :class:`_TimedCondition` that measures wait-vs-
+hold per named lock and condvar notify->wake latency, reported into
+the ``dispatch`` telemetry registry (the dispatch-path X-ray's
+lock-wait plane). Both modes compose: witness wraps the timed lock as
+its ``_inner``. Default-off still returns bare primitives.
 """
 
 from __future__ import annotations
@@ -46,6 +55,7 @@ MAX_VIOLATIONS = 512
 _STACK_DEPTH = 8
 
 _ENABLED = False
+_TIMING = False
 _state_lock = threading.Lock()     # guards the graphs below (bare by design)
 _tls = threading.local()
 
@@ -68,35 +78,66 @@ def enabled() -> bool:
     return _ENABLED
 
 
+def timing_env_enabled() -> bool:
+    return os.environ.get("CEPH_TPU_LOCK_TIMING") == "1"
+
+
+def timing_enabled() -> bool:
+    return _TIMING
+
+
 # -- construction seams (the named-lock adoption surface) ---------------
 
 def make_lock(name: str):
     """A named mutex. Off: a bare ``threading.Lock`` (zero wrappers)."""
+    inner = threading.Lock()
+    if _TIMING:
+        inner = _TimedLock(inner, name, reentrant=False)
     if not _ENABLED:
-        return threading.Lock()
-    return WitnessLock(threading.Lock(), name, _site(), reentrant=False)
+        return inner
+    return WitnessLock(inner, name, _site(), reentrant=False)
 
 
 def make_rlock(name: str):
+    inner = threading.RLock()
+    if _TIMING:
+        inner = _TimedLock(inner, name, reentrant=True)
     if not _ENABLED:
-        return threading.RLock()
-    return WitnessLock(threading.RLock(), name, _site(), reentrant=True)
+        return inner
+    return WitnessLock(inner, name, _site(), reentrant=True)
+
+
+def _is_reentrant(lock) -> bool:
+    if isinstance(lock, _TimedLock):
+        return lock._reentrant
+    return isinstance(lock, type(threading.RLock()))
 
 
 def make_condition(name: str, lock=None):
     """A condition variable; ``lock`` may be a ``make_lock``/
-    ``make_rlock`` result (witnessed or bare) or None (own RLock)."""
+    ``make_rlock`` result (witnessed, timed or bare) or None (own
+    RLock)."""
     if not _ENABLED:
         if isinstance(lock, WitnessLock):     # enabled->disabled races
             lock = lock._inner
-        return threading.Condition(lock)
+        if not _TIMING:
+            if isinstance(lock, _TimedLock):  # timing flipped off
+                lock = lock._inner
+            return threading.Condition(lock)
+        if lock is None:
+            lock = _TimedLock(threading.RLock(), name, reentrant=True)
+        elif not isinstance(lock, _TimedLock):
+            lock = _TimedLock(lock, name,
+                              reentrant=_is_reentrant(lock))
+        return _TimedCondition(lock, name)
     if lock is None:
-        lock = WitnessLock(threading.RLock(), name, _site(),
-                           reentrant=True)
+        inner = threading.RLock()
+        if _TIMING:
+            inner = _TimedLock(inner, name, reentrant=True)
+        lock = WitnessLock(inner, name, _site(), reentrant=True)
     elif not isinstance(lock, WitnessLock):
         lock = WitnessLock(lock, name, _site(),
-                           reentrant=isinstance(
-                               lock, type(threading.RLock())))
+                           reentrant=_is_reentrant(lock))
     return WitnessCondition(lock, name)
 
 
@@ -337,6 +378,215 @@ class WitnessCondition:
 
     def notify_all(self) -> None:
         self._cond.notify_all()
+
+
+# -- lock timing (ISSUE 17: the dispatch X-ray's lock-wait plane) -------
+
+def _report_timing(kind: str, name: str, value: float) -> None:
+    """Feed one timing observation into the ``dispatch`` registry.
+    Lazy import (perf_counters sits below this module) and re-entry
+    guarded: a timed lock inside the telemetry itself must not
+    recurse. Telemetry faults never cost a lock operation."""
+    if getattr(_tls, "in_report", False):
+        return
+    _tls.in_report = True
+    try:
+        from ceph_tpu.utils.dispatch_telemetry import telemetry
+        tel = telemetry()
+        if kind == "wait":
+            tel.note_lock_wait(name, value)
+        elif kind == "hold":
+            tel.note_lock_hold(name, value)
+        else:
+            tel.note_condvar_wakeup(name, value)
+    except Exception:
+        pass
+    finally:
+        _tls.in_report = False
+
+
+class _TimedLock:
+    """Wait-vs-hold timing proxy over a bare primitive. Measures the
+    blocked time of every outermost acquire and the held time of every
+    outermost release (RLock re-entries bump a depth counter like
+    WitnessLock). Composes under WitnessLock as its ``_inner``."""
+
+    __slots__ = ("_inner", "name", "_reentrant", "_depth", "_hold_t0")
+
+    def __init__(self, inner, name: str, reentrant: bool) -> None:
+        self._inner = inner
+        self.name = name
+        self._reentrant = reentrant
+        self._depth = _Tls()
+        self._hold_t0 = 0.0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        import time
+        if self._reentrant and self._depth.value > 0:
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._depth.value += 1
+            return ok
+        t0 = time.monotonic()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            now = time.monotonic()
+            if self._reentrant:
+                self._depth.value = 1
+            self._hold_t0 = now
+            _report_timing("wait", self.name, now - t0)
+        return ok
+
+    def release(self) -> None:
+        import time
+        if self._reentrant and self._depth.value > 1:
+            self._depth.value -= 1
+            self._inner.release()
+            return
+        if self._reentrant:
+            self._depth.value = 0
+        hold = time.monotonic() - self._hold_t0 \
+            if self._hold_t0 else 0.0
+        self._inner.release()
+        _report_timing("hold", self.name, hold)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    # threading.Condition protocol: a condition built directly over
+    # this proxy (WitnessCondition does that when both modes are on)
+    # must fully unwind/restore the RLock depth across wait()
+    def _release_save(self):
+        import time
+        depth = self._depth.value if self._reentrant else 0
+        self._depth.value = 0
+        hold = time.monotonic() - self._hold_t0 \
+            if self._hold_t0 else 0.0
+        if hasattr(self._inner, "_release_save"):
+            saved = self._inner._release_save()
+        else:
+            saved = None
+            self._inner.release()
+        _report_timing("hold", self.name, hold)
+        return (depth, saved)
+
+    def _acquire_restore(self, state) -> None:
+        import time
+        depth, saved = state
+        t0 = time.monotonic()
+        if saved is not None and hasattr(self._inner,
+                                         "_acquire_restore"):
+            self._inner._acquire_restore(saved)
+        else:
+            self._inner.acquire()
+        now = time.monotonic()
+        self._hold_t0 = now
+        self._depth.value = depth
+        # post-wakeup reacquire contention is genuine lock wait
+        _report_timing("wait", self.name, now - t0)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return f"<_TimedLock {self.name}>"
+
+
+class _TimedCondition:
+    """Condition proxy adding notify->wake latency measurement: every
+    ``notify``/``notify_all`` stamps the signal instant; a waiter that
+    wakes notified reports how long after the newest signal it was
+    actually running again (the wakeup cost the run-to-completion
+    ledger prices)."""
+
+    def __init__(self, lock: _TimedLock, name: str) -> None:
+        self._lock = lock
+        self.name = name
+        # built over the proxy: wait() unwinds via _release_save /
+        # _acquire_restore above, so hold intervals close at wait
+        # entry and wakeup reacquire counts as wait
+        self._cond = threading.Condition(lock)
+        self._last_notify = 0.0
+
+    # lock surface ----------------------------------------------------
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._lock.release()
+        return False
+
+    # condition surface -----------------------------------------------
+    def wait(self, timeout: float | None = None):
+        import time
+        t0 = time.monotonic()
+        notified = self._cond.wait(timeout)
+        if notified:
+            now = time.monotonic()
+            lat = now - self._last_notify \
+                if self._last_notify >= t0 else 0.0
+            _report_timing("condvar", self.name, max(lat, 0.0))
+        return notified
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        import time
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait(None)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        import time
+        self._last_notify = time.monotonic()
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        import time
+        self._last_notify = time.monotonic()
+        self._cond.notify_all()
+
+
+def enable_timing() -> None:
+    """Turn lock timing on process-wide: locks constructed through the
+    ``make_*`` seams AFTER this point are timed. Independent of the
+    witness; both may be on."""
+    global _TIMING
+    _TIMING = True
+
+
+def disable_timing() -> None:
+    global _TIMING
+    _TIMING = False
 
 
 # -- blocking hooks (installed only while enabled) ----------------------

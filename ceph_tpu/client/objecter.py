@@ -36,6 +36,7 @@ from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.store_telemetry import telemetry as _store_tel
+from ceph_tpu.utils.dispatch_telemetry import telemetry as _dsp_tel
 
 log = Dout("objecter")
 
@@ -49,7 +50,8 @@ class ObjecterError(Exception):
 
 
 class _Op:
-    __slots__ = ("tid", "msg", "event", "reply", "sent_at", "attempts")
+    __slots__ = ("tid", "msg", "event", "reply", "sent_at", "attempts",
+                 "wake_t")
 
     def __init__(self, tid: int, msg: M.MOSDOp) -> None:
         self.tid = tid
@@ -58,6 +60,9 @@ class _Op:
         self.reply: M.MOSDOpReply | None = None
         self.sent_at = 0.0
         self.attempts = 0
+        #: monotonic stamp taken just before event.set() — the waiter
+        #: side measures signal->wake latency from it (ISSUE 17)
+        self.wake_t = 0.0
 
 
 EBLOCKLISTED = -108
@@ -133,6 +138,13 @@ class Objecter:
     # -- inbound ------------------------------------------------------
     def handle_message(self, msg: M.Message, conn: Connection) -> bool:
         if isinstance(msg, M.MOSDOpReplyBatch):
+            # wakeup accounting (ISSUE 17): frames count HERE, once
+            # per sweep — _handle_reply runs once per contained tid
+            try:
+                _dsp_tel().note_reply_frame(self.client_id,
+                                            len(msg.tids))
+            except Exception:
+                pass
             # one frame = one reply sweep: every contained tid wakes
             # exactly as if its singleton MOSDOpReply arrived
             for i, tid in enumerate(msg.tids):
@@ -149,6 +161,10 @@ class Objecter:
             return True
         if not isinstance(msg, M.MOSDOpReply):
             return False
+        try:
+            _dsp_tel().note_reply_frame(self.client_id, 1)
+        except Exception:
+            pass
         self._handle_reply(msg)
         return True
 
@@ -170,6 +186,7 @@ class Objecter:
             self._pending.pop(msg.tid, None)
         self._stream_note_done(op)
         op.reply = msg
+        op.wake_t = time.monotonic()
         op.event.set()
 
     # -- submit -------------------------------------------------------
@@ -245,6 +262,15 @@ class Objecter:
                 committed = rec.event.wait(timeout)
             finally:
                 _profiler.pop_stage(_pwait)
+            if committed and rec.wake_t:
+                # signal->wake->running latency, per connection: the
+                # run-to-completion ledger's wakeup-cost input
+                try:
+                    _dsp_tel().note_wakeup(
+                        self.client_id,
+                        time.monotonic() - rec.wake_t)
+                except Exception:
+                    pass
             if not committed:
                 with self._lock:
                     self._pending.pop(tid, None)
@@ -291,6 +317,13 @@ class Objecter:
                         timeline, trace_id=span.trace_id or None)
                 except Exception:
                     pass   # telemetry faults never cost an op
+                try:
+                    # causal chain (ISSUE 17): hops this op crossed,
+                    # derived from the merged timeline — no new wire
+                    # fields
+                    _dsp_tel().note_op_chain(timeline.dump())
+                except Exception:
+                    pass
             return reply
         finally:
             if _stream_noted:

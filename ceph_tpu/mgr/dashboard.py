@@ -17,6 +17,9 @@ from the mgr's cluster view:
     GET /api/store    commit-path X-ray: store txn sub-stage
                       decomposition, fsync call sites, group-commit +
                       streaming-objecter what-if ledgers
+    GET /api/dispatch dispatch-path X-ray: per-seam handoff spans,
+                      per-connection wakeup accounting, timed-lock
+                      waits, recent per-op causal chains (ISSUE 17)
     GET /api/dataplane  per-op stage-latency decomposition (stage
                       breakdown + messenger counters + recent merged
                       timelines)
@@ -93,6 +96,10 @@ _PAGE = """<!doctype html>
 <th>share of commit_wait</th></tr>{commit_rows}</table>
 <table><tr><th>store txn sub-stage</th><th>mean us</th>
 <th>share</th></tr>{store_rows}</table>
+<h3>dispatch path</h3>
+<p>{dispatch_summary}</p>
+<table><tr><th>handoff seam</th><th>hops</th><th>mean us</th>
+<th>total ms</th></tr>{dispatch_rows}</table>
 <h3>profiler</h3>
 <p>{prof_status}</p>
 <table><tr><th>stage</th><th>hot frame</th><th>samples</th>
@@ -158,6 +165,10 @@ class Module(MgrModule):
         if path == "/api/store":
             return 200, "application/json", json.dumps(
                 self._store_payload()).encode()
+        if path == "/api/dispatch":
+            from ceph_tpu.utils.dispatch_telemetry import telemetry
+            return 200, "application/json", json.dumps(
+                telemetry().snapshot()).encode()
         if path == "/api/dataplane":
             from ceph_tpu.utils.dataplane import dataplane
             from ceph_tpu.utils.msgr_telemetry import telemetry as mt
@@ -393,6 +404,22 @@ class Module(MgrModule):
             f"{pick.get('fsyncs_saved', 0)} fsyncs saved "
             f"({pick.get('fsync_model', '-')}) · objecter coalesce "
             f"{wi_obj.get('mean_batch', 0)} ops/batch")
+        from ceph_tpu.utils.dispatch_telemetry import telemetry as _dsp
+        dtel = _dsp()
+        dispatch_rows = "".join(
+            f"<tr><td>{html.escape(seam)}</td>"
+            f"<td>{ent['hops']}</td><td>{ent['mean_us']}</td>"
+            f"<td>{ent['total_ms']}</td></tr>"
+            for seam, ent in sorted(dtel.seam_table().items())) \
+            or "<tr><td colspan=4>no handoffs observed yet</td></tr>"
+        dwk = dtel.wakeup_table()
+        dc = dtel.perf.dump()
+        dchains = dc.get("op_chains", 0)
+        dispatch_summary = html.escape(
+            f"op chains {dchains} · wakeups {dwk.get('wakeups', 0)} "
+            f"({dwk.get('wakeups_per_frame', 0)}/frame, mean wake "
+            f"{dwk.get('mean_latency_us', 0)}us) · lock waits "
+            f"{dc.get('lock_waits', 0)}")
         return _PAGE.format(
             health=html.escape(health),
             check_rows=check_rows,
@@ -426,6 +453,8 @@ class Module(MgrModule):
             store_summary=store_summary,
             commit_rows=commit_rows,
             store_rows=store_rows,
+            dispatch_summary=dispatch_summary,
+            dispatch_rows=dispatch_rows,
         ).encode()
 
     # -- server --------------------------------------------------------

@@ -96,6 +96,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Callable
 
 import numpy as np
@@ -106,6 +107,7 @@ from ceph_tpu.utils import faults as _faults
 from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils import stage_clock as _stage_clock
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
+from ceph_tpu.utils import dispatch_telemetry as _dsp
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.tracing import NOOP
 
@@ -795,6 +797,10 @@ class DeviceEncodeEngine:
                 if item[0] == "enc":
                     (_, key, codec, sinfo, data, cont, span, clock,
                      ts, pslot) = item
+                    # handoff seam (ISSUE 17): producer put -> engine
+                    # thread pickup, one cross-thread hop per stage
+                    _dsp.telemetry().note_handoff(
+                        "engine_stage", _time.monotonic() - ts)
                     _, _, _, items = pending.setdefault(
                         (id(codec), pslot), (codec, sinfo, pslot, []))
                     items.append((key, data, cont, span, clock, ts))
@@ -810,6 +816,8 @@ class DeviceEncodeEngine:
                 elif item[0] == "dec":
                     (_, key, codec, sinfo, shards, want, cont, span,
                      clock, ts, pslot) = item
+                    _dsp.telemetry().note_handoff(
+                        "engine_stage", _time.monotonic() - ts)
                     sig = (id(codec),
                            tuple(sorted(shards)), tuple(sorted(want)),
                            pslot)
@@ -827,7 +835,6 @@ class DeviceEncodeEngine:
                     # auxiliary device work (deep-scrub verify): runs
                     # after the in-flight batch drains so it never
                     # contends with an encode download on the device
-                    import time as _time
                     self._flush(pending)
                     self._flush_decodes(dec_pending)
                     self._drain_inflight()

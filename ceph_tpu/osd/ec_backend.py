@@ -67,6 +67,7 @@ from ceph_tpu.store.object_store import (
 from ceph_tpu.utils import stage_clock, tracing
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dataplane import dataplane
+from ceph_tpu.utils import dispatch_telemetry
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils.dout import Dout
 
@@ -201,6 +202,17 @@ class ECBackend(PGBackend):
         if op_clock0 is not stage_clock.NOOP:
             cclock = stage_clock.StageClock(
                 name="commit_start", t=op_clock0.last_mark_t())
+            # commit_handoff (ISSUE 17): when this fan-out runs inside
+            # an engine continuation dequeued from the op-wq, the wq
+            # worker published the hop it crossed — mark the dequeue
+            # instant so the envelope splits queue wait (handoff) from
+            # continuation run (dispatch). Ops after the first in one
+            # continuation absorb earlier fan-out run time into their
+            # handoff-to-dispatch split exactly as the wq served them.
+            hop = dispatch_telemetry.current_hop()
+            if hop is not None and hop[0] == "wq_continuation" \
+                    and hop[1] > op_clock0.last_mark_t():
+                cclock.mark("commit_handoff", t=hop[1])
 
         def all_committed() -> None:
             if cclock is not None:

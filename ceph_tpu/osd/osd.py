@@ -73,6 +73,7 @@ from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.msgr_telemetry import telemetry as _msgr_telemetry
 from ceph_tpu.utils import store_telemetry as _store_telemetry
+from ceph_tpu.utils import dispatch_telemetry as _dsp
 from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
@@ -287,6 +288,14 @@ class ShardedOpWQ:
     def enqueue(self, key, fn, qos: str = QOS_CLIENT) -> None:
         if not self._running:
             return
+        try:
+            # handoff stamp (ISSUE 17): consumed by the worker to
+            # attribute the cross-thread queue wait. Closures take
+            # attributes; bound methods may not — skip silently.
+            fn._dsp_enq = (time.monotonic(),
+                           threading.current_thread().name)
+        except AttributeError:
+            pass
         sh = self._shards[hash(key) % len(self._shards)]
         with sh.cv:
             if isinstance(sh, _MClockShard):
@@ -350,6 +359,14 @@ class ShardedOpWQ:
                         fn = self._dequeue(sh)
             _prof.pop_stage(_pidle)
             _msgr_telemetry().dispatch_queue_delta(-1)
+            # handoff attribution (ISSUE 17): the enqueue->dequeue
+            # span is one cross-thread hop; the seam (op vs engine
+            # continuation) classifies from the profiler tag, and the
+            # hop is published thread-locally so the EC fan-out can
+            # mark commit_handoff at the absolute dequeue time
+            enq = getattr(fn, "_dsp_enq", None)
+            if enq is not None:
+                _dsp.note_wq_dequeue(fn, enq)
             # profiler stage join: a worker sample belongs to the
             # stage of the work it runs — PG/op processing by default,
             # or the stage a producer tagged on the continuation
@@ -362,6 +379,8 @@ class ShardedOpWQ:
                 log(0, f"op worker exception: {exc!r}")
             finally:
                 _prof.pop_stage(_pstage)
+                if enq is not None:
+                    _dsp.clear_current_hop()
                 if self._after_item is not None:
                     try:
                         self._after_item()
@@ -599,6 +618,7 @@ class OSD:
         _mt.register_asok(self.asok)
         from ceph_tpu.utils import store_telemetry as _st
         _st.register_asok(self.asok)
+        _dsp.register_asok(self.asok)
         from ceph_tpu.utils import faults as _faults
         _faults.register_asok(self.asok)
         self.asok.start()

@@ -40,6 +40,7 @@ from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.msgr_telemetry import telemetry as _telemetry
+from ceph_tpu.utils import dispatch_telemetry as _dsp
 
 log = Dout("ms")
 
@@ -490,6 +491,13 @@ class Messenger:
         if not self._running or self._dispatcher is None:
             _telemetry().note_drop(msg.MSG_TYPE)
             return
+        # handoff seam (ISSUE 17): the sender stamped _rx_t at decode;
+        # this entry runs on the receiver's loop thread — the loopback
+        # cross-thread hop
+        rx_t = getattr(msg, "_rx_t", None)
+        if rx_t is not None:
+            _dsp.telemetry().note_handoff(
+                "msgr_dispatch", time.monotonic() - rx_t)
         try:
             self._dispatcher(msg, conn)
         except Exception as exc:
@@ -526,6 +534,9 @@ class Messenger:
 
     async def _send_to(self, msg: Message, dest_addr: str,
                        t_submit: float) -> None:
+        # handoff seam (ISSUE 17): send_message() -> loop pickup
+        _dsp.telemetry().note_handoff(
+            "msgr_send", time.monotonic() - t_submit)
         try:
             for _attempt in (0, 1):   # one transparent reconnect
                 conn = await self._get_conn(dest_addr)

@@ -51,6 +51,11 @@ DIRECTIONS = {
     # the first; the second must gate UP when store batching lands)
     "store_fsyncs_per_op": "lower",
     "whatif_group_commit_MBps": "higher",
+    # ISSUE 17: dispatch-path rows — cross-thread hops per op must
+    # gate DOWN when the run-to-completion refactor lands, and the
+    # RTC projection gates UP like the other what-if row
+    "dispatch_hops_per_op": "lower",
+    "whatif_rtc_MBps": "higher",
 }
 
 

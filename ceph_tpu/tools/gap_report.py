@@ -214,6 +214,59 @@ def _what_if(report: dict) -> dict:
         return {}
 
 
+#: commit-envelope stage -> dispatch-machinery kind (ISSUE 17): what
+#: each slice of the residual commit_wait IS, in run-to-completion
+#: vocabulary — a cross-thread hop, continuation run time, durability
+#: ship, or the wakeup/ack sweep
+_DISPATCH_KINDS = {
+    "commit_handoff": "hop (continuation re-enqueue)",
+    "commit_dispatch": "run (PG lock + fan-out build)",
+    "commit_ship_wait": "ship (txn group + sub-writes)",
+    "commit_ack_wait": "wakeup (ack sweep + completion)",
+}
+
+
+def _dispatch_section(report: dict) -> dict:
+    """The dispatch X-ray (ISSUE 17): the residual commit_wait sliced
+    into named hop/run/ship/wakeup sub-stages (the commit envelope,
+    so coverage is inherited from the >= 90% commit-path bar), joined
+    with the per-seam handoff spans, per-connection wakeup accounting,
+    timed-lock waits, and the profiler's commit_wait sample share."""
+    try:
+        from ceph_tpu.utils.dispatch_telemetry import SEAMS, telemetry
+        tel = telemetry()
+        commit = report.get("commit_path") or {}
+        rows = {stage: dict(ent, kind=_DISPATCH_KINDS.get(stage, ""))
+                for stage, ent in (commit.get("stages") or {}).items()}
+        c = tel.perf.dump()
+        chains = c.get("op_chains", 0)
+        hops = sum(c.get(f"ophop_{s}", 0) for s in SEAMS)
+        out = {
+            "commit_wait_ms": commit.get("commit_wait_ms"),
+            "coverage_pct": commit.get("coverage_pct", 0.0),
+            "stages": rows,
+            "op_chains": chains,
+            "hops_per_op": round(hops / chains, 2) if chains else 0.0,
+            "seams": tel.seam_table(),
+            "wakeups": tel.wakeup_table(),
+            "locks": tel.lock_table(),
+        }
+        prof = report.get("profiler") or {}
+        by_stage = prof.get("by_stage") or {}
+        total = sum(by_stage.values())
+        if total:
+            # the profiler join: what share of sampled wall the
+            # dispatch-flavored stages own (commit_wait continuations
+            # run tagged commit_wait; client_wait is completion park)
+            out["profiler_share_pct"] = {
+                s: round(100.0 * n / total, 1)
+                for s, n in by_stage.items()
+                if s in ("commit_wait", "client_wait", "idle")}
+        return out
+    except Exception:
+        return {}
+
+
 def run_report(seconds: float, n_osds: int, obj_size: int,
                threads: int, k: int, m: int, backend: str,
                args) -> dict:
@@ -229,14 +282,27 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
         _st().reset()
     except Exception:
         pass
+    try:
+        from ceph_tpu.utils.dispatch_telemetry import telemetry as _dt
+        _dt().reset()
+    except Exception:
+        pass
+    # lock timing (ISSUE 17): armed BEFORE the cluster is built so
+    # every make_lock/make_condition site constructed for this run is
+    # timed — the dispatch table's lock-wait plane
+    from ceph_tpu.analysis import lock_witness as _lw
+    _lw.enable_timing()
     prof = None
     if getattr(args, "profile", False):
         from ceph_tpu.utils.profiler import profiler
         prof = profiler()
         prof.reset()
         prof.start(hz=getattr(args, "profile_hz", None))
-    cluster = cluster_bench.run_one(backend, seconds, n_osds,
-                                    obj_size, threads, k=k, m=m)
+    try:
+        cluster = cluster_bench.run_one(backend, seconds, n_osds,
+                                        obj_size, threads, k=k, m=m)
+    finally:
+        _lw.disable_timing()
     if prof is not None:
         prof.stop()
     engine = _engine_side(args)
@@ -276,6 +342,21 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
     report["what_if"] = _what_if(report)
     if prof is not None:
         report["profiler"] = _profile_section(prof)
+    # ISSUE 17: the dispatch X-ray over the residual commit_wait +
+    # the run-to-completion projection (needs commit_path/profiler)
+    report["dispatch"] = _dispatch_section(report)
+    try:
+        from ceph_tpu.utils.dispatch_telemetry import telemetry as _dt
+        ch = ((report.get("commit_path") or {}).get("stages", {})
+              .get("commit_handoff") or {}).get("mean_ms")
+        report.setdefault("what_if", {})["run_to_completion"] = \
+            _dt().rtc_projection(
+                report.get("ops") or 0,
+                report.get("mean_ms") or 0.0,
+                report.get("cluster_MBps") or 0.0,
+                handoff_ms_per_op=ch)
+    except Exception:
+        pass
     return report
 
 
@@ -327,6 +408,7 @@ def print_table(report: dict) -> None:
     for stage, ent in report.get("subops", {}).items():
         print(f"  (subop) {stage:<20}{ent['mean_ms']:>9.3f} ms")
     _print_commit_path(report)
+    _print_dispatch(report)
     if prof:
         print(f"profiler: {prof['samples']} samples @ {prof['hz']} Hz"
               f", {prof['attributed_pct']}% stage-attributed, "
@@ -376,6 +458,43 @@ def _print_commit_path(report: dict) -> None:
               f"coalesces {obj.get('mean_batch')} ops/batch "
               f"(max {obj.get('max_batch')}) -> projected "
               f"{wi.get('projected_MBps')} MB/s")
+
+
+def _print_dispatch(report: dict) -> None:
+    """The dispatch X-ray block (ISSUE 17): residual commit_wait
+    sliced by dispatch-machinery kind, the hop/wakeup/lock-wait
+    annotations, and the run-to-completion what-if line."""
+    dsp = report.get("dispatch") or {}
+    if dsp.get("stages"):
+        print()
+        print(f"dispatch (under commit_wait "
+              f"{dsp['commit_wait_ms']:.3f} ms, coverage "
+              f"{dsp['coverage_pct']:.1f}%):")
+        for stage, ent in dsp["stages"].items():
+            print(f"  {stage:<18}{ent.get('kind', ''):<32}"
+                  f"{ent['mean_ms']:>9.3f} ms"
+                  f"{ent['share_of_commit_pct']:>7.1f}%")
+        wk = dsp.get("wakeups") or {}
+        locks = (dsp.get("locks") or {}).get("locks") or {}
+        worst = next(iter(locks.items()), None)
+        locknote = f"  top lock-wait: {worst[0]} " \
+                   f"{worst[1]['wait_ms']:.2f}ms" if worst else ""
+        print(f"  hops/op {dsp.get('hops_per_op', 0.0)}"
+              f"  wakeups/frame {wk.get('wakeups_per_frame', 0.0)}"
+              f" (mean wake {wk.get('mean_latency_us', 0.0):.0f}us)"
+              f"{locknote}")
+        shares = dsp.get("profiler_share_pct") or {}
+        if shares:
+            parts = "  ".join(f"{s}={p}%"
+                              for s, p in sorted(shares.items()))
+            print(f"  profiler sample shares: {parts}")
+    rtc = (report.get("what_if") or {}).get("run_to_completion") or {}
+    if rtc:
+        print(f"what-if run-to-completion: saves "
+              f"{rtc.get('continuation_hops_saved')} continuation "
+              f"hops + {rtc.get('wakeups_saved')} wakeups "
+              f"({rtc.get('saved_ms_per_op')} ms/op) -> projected "
+              f"{rtc.get('whatif_rtc_MBps')} MB/s")
 
 
 def main(argv=None) -> int:

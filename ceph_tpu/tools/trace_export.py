@@ -28,8 +28,13 @@ CLI (also via the repo-root shim ``tools/trace_export.py``)::
 
 ``--input`` accepts any of: a kept-trace record (``{"spans": [...]}``,
 the mgr ``trace dump``/archive shape), a bare span list (the asok
-``dump_traces`` shape), or an autopsy entry (``{"spans", "timeline",
-...}`` from ``dump_autopsies``). ``-`` reads stdin.
+``dump_traces`` shape), an autopsy entry (``{"spans", "timeline",
+...}`` from ``dump_autopsies``), or a dispatch snapshot
+(``{"recent_chains": [...]}`` from ``dump_dispatch`` — ISSUE 17: one
+track per logical thread of the data path, one slice per queue wait,
+and a flow arrow per cross-thread hop, so an op's causal chain
+``admission -> N hops -> commit reply`` reads as connected arrows in
+Perfetto). ``-`` reads stdin.
 """
 
 from __future__ import annotations
@@ -146,10 +151,57 @@ def _timeline_events(timeline: dict, pid: int) -> list[dict]:
     return events
 
 
+def to_dispatch_trace(chains: list[dict]) -> dict:
+    """Per-op causal handoff chains (the ``dump_dispatch``
+    ``recent_chains`` ring) -> Chrome-trace JSON: one ``dispatch``
+    process, one thread row per logical track, each hop an X slice of
+    its queue wait on the DESTINATION track, plus a flow-event pair
+    (``ph: "s"``/``"f"``) from the source track to the slice end so
+    the cross-thread arrow renders in Perfetto."""
+    events: list[dict] = [{"ph": "M", "pid": 1, "tid": 0,
+                           "name": "process_name",
+                           "args": {"name": "dispatch"}}]
+    tids: dict[str, int] = {}
+
+    def tid(track: str) -> int:
+        if track not in tids:
+            tids[track] = len(tids) + 1
+            events.append({"ph": "M", "pid": 1, "tid": tids[track],
+                           "name": "thread_name",
+                           "args": {"name": track}})
+        return tids[track]
+
+    flow = 0
+    for ci, chain in enumerate(chains):
+        wall0 = chain.get("wall_epoch", 0.0) * 1e6
+        for hop in chain.get("hops", ()):
+            flow += 1
+            src = tid(hop.get("src", "?"))
+            dst = tid(hop.get("dst", "?"))
+            wait = max(hop.get("wait_us", 0.0), 0.0)
+            end = wall0 + hop.get("t_us", 0.0)
+            start = end - wait
+            name = hop.get("seam") or hop.get("stage") or "hop"
+            base = {"name": name, "cat": "handoff", "pid": 1}
+            events.append(dict(base, ph="X", tid=dst, ts=start,
+                               dur=wait,
+                               args={"stage": hop.get("stage", ""),
+                                     "chain": ci}))
+            events.append(dict(base, ph="s", tid=src, ts=start,
+                               id=flow))
+            events.append(dict(base, ph="f", bp="e", tid=dst, ts=end,
+                               id=flow))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 def export(doc) -> dict:
     """Accept any supported input shape (see module docstring)."""
     if isinstance(doc, list):
+        if doc and isinstance(doc[0], dict) and "hops" in doc[0]:
+            return to_dispatch_trace(doc)    # bare chain ring
         return to_chrome_trace(doc)
+    if isinstance(doc, dict) and "recent_chains" in doc:
+        return to_dispatch_trace(doc["recent_chains"])
     if isinstance(doc, dict) and "spans" in doc:
         return to_chrome_trace(
             doc["spans"], title=doc.get("root", ""),
@@ -158,7 +210,7 @@ def export(doc) -> dict:
         return doc        # already exported
     raise ValueError(
         "unrecognized input: expected a span list, a kept-trace "
-        "record, or an autopsy entry")
+        "record, an autopsy entry, or a dispatch snapshot")
 
 
 def main(argv=None) -> int:

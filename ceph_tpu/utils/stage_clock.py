@@ -63,8 +63,8 @@ SUBOP_STAGES = ("subop_send", "subop_wire", "subop_dispatch_wait",
 #: mark commit_wait measures from (device_finalize on the engine
 #: path, pg_process on the host path), so the child's intervals sum
 #: to the op's commit_wait (the >= 90% commit-path coverage bar)
-COMMIT_STAGES = ("commit_dispatch", "commit_ship_wait",
-                 "commit_ack_wait")
+COMMIT_STAGES = ("commit_handoff", "commit_dispatch",
+                 "commit_ship_wait", "commit_ack_wait")
 
 #: one-line glossary served by ``dump_op_timeline`` and BASELINE.md
 GLOSSARY = {
@@ -85,8 +85,11 @@ GLOSSARY = {
     "subop_dispatch_wait": "shard fast dispatch -> op-wq dequeue",
     "subop_commit": "shard store transaction commit",
     "commit_start": "anchor: where commit_wait starts measuring",
-    "commit_dispatch": "continuation queue wait + PG lock + fan-out "
-                       "txn build",
+    "commit_handoff": "engine-retire continuation re-enqueue -> "
+                      "op-wq worker dequeue (the cross-thread hop; "
+                      "ISSUE 17)",
+    "commit_dispatch": "continuation run: PG lock + fan-out txn "
+                       "build (queue wait split into commit_handoff)",
     "commit_ship_wait": "flush-group ship: local store txn group + "
                         "per-peer sub-write batch serialize/send",
     "commit_ack_wait": "last local/remote shard commit ack + "

@@ -38,6 +38,12 @@ from ceph_tpu.analysis import lock_witness as _lock_witness
 if _lock_witness.env_enabled():
     _lock_witness.enable()
 
+# Lock timing (ISSUE 17): CEPH_TPU_LOCK_TIMING=1 arms the wait-vs-hold
+# timing layer for the session — observations feed the `dispatch`
+# telemetry registry. Independent of the witness; off by default.
+if _lock_witness.timing_env_enabled():
+    _lock_witness.enable_timing()
+
 
 def pytest_sessionfinish(session, exitstatus):
     if _lock_witness.env_enabled() and _lock_witness.enabled():

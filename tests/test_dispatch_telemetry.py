@@ -1,0 +1,421 @@
+"""Dispatch X-ray (ISSUE 17): the dispatch telemetry registry under
+scripted schedules — injectable clocks, no sleeping — plus the lock
+timing layer, the Chrome-trace chain export, and the gap_report
+acceptance pin (dispatch table prints; run-to-completion what-if
+parses with hops_saved > 0 on a real CPU quick run)."""
+
+import threading
+
+import pytest
+
+from ceph_tpu.utils import dispatch_telemetry as dt
+from ceph_tpu.utils.stage_clock import StageClock
+
+
+@pytest.fixture
+def tel():
+    dt.telemetry().reset()
+    t = dt.telemetry()
+    yield t
+    dt.telemetry().reset()
+
+
+def _op_timeline() -> dict:
+    """One hand-scripted op timeline (all marks at explicit times, in
+    seconds): four main-chain hops + the commit child's
+    ``commit_handoff`` hop, with known waits."""
+    clk = StageClock("client_submit", t=100.0)
+    clk.mark("send_queue_wait", t=100.001)       # msgr_send   1000us
+    clk.mark("wire", t=100.002)                  # msgr_dispatch 1000us
+    clk.mark("dispatch_queue_wait", t=100.0025)  # wq_op        500us
+    clk.mark("engine_stage_wait", t=100.003)     # engine_stage 500us
+    clk.mark("commit_start", t=100.0031)
+    cclock = StageClock("commit_start", t=100.0031)
+    cclock.mark("commit_handoff", t=100.0035)    # wq_continuation 400us
+    cclock.mark("commit_dispatch", t=100.0036)
+    cclock.mark("commit_ack_wait", t=100.005)
+    clk.merge_child("commit", cclock)
+    clk.mark("commit_wait", t=100.005)
+    return clk.dump()
+
+
+# -- plane 1: causal chains --------------------------------------------
+
+def test_chain_of_scripted_timeline():
+    chain = dt.chain_of(_op_timeline())
+    seams = [h["seam"] for h in chain]
+    assert seams == ["msgr_send", "msgr_dispatch", "wq_op",
+                     "engine_stage", "wq_continuation"]
+    waits = {h["seam"]: h["wait_us"] for h in chain}
+    assert waits["msgr_send"] == pytest.approx(1000.0, abs=0.6)
+    assert waits["msgr_dispatch"] == pytest.approx(1000.0, abs=0.6)
+    assert waits["wq_op"] == pytest.approx(500.0, abs=0.6)
+    assert waits["engine_stage"] == pytest.approx(500.0, abs=0.6)
+    assert waits["wq_continuation"] == pytest.approx(400.0, abs=0.6)
+    # chain is time-ordered; every hop names its tracks
+    ts = [h["t_us"] for h in chain]
+    assert ts == sorted(ts)
+    for hop in chain:
+        assert hop["src"] and hop["dst"]
+
+
+def test_chain_of_skips_absent_and_zero_stages():
+    clk = StageClock("client_submit", t=1.0)
+    clk.mark("wire", t=1.001)
+    clk.mark("wire_zero_marker", t=1.001)  # not a hop stage
+    assert [h["seam"] for h in dt.chain_of(clk.dump())] \
+        == ["msgr_dispatch"]
+    assert dt.chain_of({}) == []
+
+
+def test_note_op_chain_counts_hops_and_keeps_ring(tel):
+    dump = _op_timeline()
+    for _ in range(3):
+        tel.note_op_chain(dump)
+    c = tel.perf.dump()
+    assert c["op_chains"] == 3
+    assert c["ophop_wq_continuation"] == 3
+    assert c["ophop_wq_op"] == 3
+    assert c["ophop_msgr_send"] == 3
+    # the ring keeps the chain for the trace export
+    chains = tel.recent_chains()
+    assert len(chains) == 3
+    assert len(chains[0]["hops"]) == 5
+    assert chains[0]["wall_epoch"] == dump["wall_epoch"]
+    # bounded: the ring never outgrows its maxlen
+    for _ in range(dt._RECENT_CHAINS + 8):
+        tel.note_op_chain(dump)
+    assert len(tel.recent_chains()) == dt._RECENT_CHAINS
+    # brief exposes the exact mean (5 hops per op here)
+    assert tel.snapshot_brief()["hops_per_op"] == 5.0
+
+
+def test_note_handoff_drops_unknown_and_negative(tel):
+    tel.note_handoff("bogus_seam", 1.0)
+    tel.note_handoff("wq_op", -0.5)
+    assert tel.perf.get("hops") == 0
+    tel.note_handoff("wq_op", 0.002)
+    assert tel.perf.get("hops") == 1
+    ent = tel.perf.dump()["handoff_wq_op"]
+    assert ent["avgcount"] == 1
+    assert ent["sum"] == pytest.approx(0.002)
+
+
+def test_note_wq_dequeue_classifies_seam_by_stage_tag(tel):
+    def cont():
+        pass
+
+    cont._profile_stage = "commit_wait"
+    assert dt.note_wq_dequeue(cont, (5.0, "t"), now=5.002) \
+        == "wq_continuation"
+    assert dt.current_hop() == ("wq_continuation", 5.002,
+                                pytest.approx(0.002))
+
+    def op():
+        pass
+
+    assert dt.note_wq_dequeue(op, (5.0, "t"), now=5.0005) == "wq_op"
+    dt.clear_current_hop()
+    assert dt.current_hop() is None
+    c = tel.perf.dump()
+    assert c["handoff_wq_continuation"]["sum"] \
+        == pytest.approx(0.002)
+    assert c["handoff_wq_op"]["sum"] == pytest.approx(0.0005)
+    assert c["hops"] == 2
+
+
+# -- plane 2: wakeups + locks ------------------------------------------
+
+def test_wakeup_per_flush_accounting(tel):
+    # two frames on one connection: a singleton then a 3-op sweep;
+    # all four completions wake their waiters
+    tel.note_reply_frame("client.1", 1)
+    tel.note_reply_frame("client.1", 3)
+    for _ in range(4):
+        tel.note_wakeup("client.1", 0.001)
+    wt = tel.wakeup_table()
+    assert wt["wakeups"] == 4
+    assert wt["reply_frames"] == 2
+    assert wt["wakeups_per_frame"] == 2.0
+    assert wt["mean_latency_us"] == pytest.approx(1000.0)
+    conn = wt["connections"]["client.1"]
+    assert conn["wakeups"] == 4 and conn["frames"] == 2
+    assert conn["wakeups_per_frame"] == 2.0
+    # empty/invalid frames are dropped
+    tel.note_reply_frame("client.1", 0)
+    assert tel.wakeup_table()["reply_frames"] == 2
+    # negative latency clamps to zero rather than corrupting the sum
+    tel.note_wakeup("client.1", -1.0)
+    assert tel.perf.dump()["wakeup_latency"]["sum"] \
+        == pytest.approx(0.004)
+
+
+def test_conn_table_bounded(tel):
+    for i in range(dt._MAX_CONNS + 5):
+        tel.note_wakeup(f"client.{i}", 0.0)
+    wt = tel.wakeup_table()
+    assert len(wt["connections"]) == dt._MAX_CONNS
+    assert wt["connections_dropped"] == 5
+
+
+def test_lock_table_orders_worst_waiters_first(tel):
+    tel.note_lock_wait("PG::lock", 0.004)
+    tel.note_lock_hold("PG::lock", 0.010)
+    tel.note_lock_wait("OSDShard::lock", 0.001)
+    tel.note_condvar_wakeup("OSDShard::cv", 0.0002)
+    lt = tel.lock_table()
+    assert list(lt["locks"])[0] == "PG::lock"
+    row = lt["locks"]["PG::lock"]
+    assert row["waits"] == 1
+    assert row["wait_ms"] == pytest.approx(4.0)
+    assert row["hold_ms"] == pytest.approx(10.0)
+    assert row["max_wait_us"] == pytest.approx(4000.0)
+    cv = lt["locks"]["OSDShard::cv"]
+    assert cv["cv_wakeups"] == 1
+    assert cv["cv_mean_latency_us"] == pytest.approx(200.0)
+    assert lt["total_wait_ms"] == pytest.approx(5.0)
+
+
+# -- plane 3: the run-to-completion projection -------------------------
+
+def test_rtc_projection_hand_computed(tel):
+    # 4 completed ops, each crossing one continuation hop; 4 wakeups
+    # over 2 reply frames (so 2 excess wakeups collapse under RTC)
+    dump = _op_timeline()
+    for _ in range(4):
+        tel.note_op_chain(dump)
+        tel.note_wakeup("client.1", 0.001)      # 1 ms signal->wake
+    tel.note_reply_frame("client.1", 2)
+    tel.note_reply_frame("client.1", 2)
+    proj = tel.rtc_projection(4, mean_ms=10.0, mbps=100.0,
+                              handoff_ms_per_op=2.0)
+    assert proj["continuation_hops_saved"] == 4
+    assert proj["wakeups_saved"] == 2
+    assert proj["hops_saved"] == 6
+    # saved = 2.0ms handoff * (4/4) + 1.0ms wake * (2/4) = 2.5 ms/op
+    assert proj["saved_handoff_ms_per_op"] == pytest.approx(2.0)
+    assert proj["saved_wakeup_ms_per_op"] == pytest.approx(0.5)
+    assert proj["saved_ms_per_op"] == pytest.approx(2.5)
+    # PR 14's latency-scaling model: 100 * 10 / (10 - 2.5)
+    assert proj["whatif_rtc_MBps"] == pytest.approx(133.3)
+    assert "continuations inline" in proj["rules"]
+
+
+def test_rtc_projection_falls_back_to_seam_mean(tel):
+    tel.note_op_chain(_op_timeline())
+    # seam mean: one 2 ms continuation handoff observed
+    tel.note_handoff("wq_continuation", 0.002)
+    proj = tel.rtc_projection(1, mean_ms=10.0, mbps=100.0)
+    assert proj["saved_handoff_ms_per_op"] == pytest.approx(2.0)
+    assert proj["whatif_rtc_MBps"] > 100.0
+
+
+def test_rtc_projection_clamps_and_degrades(tel):
+    # no observations at all: nothing saved, nothing projected wrong
+    proj = tel.rtc_projection(0, mean_ms=0.0, mbps=0.0)
+    assert proj["hops_saved"] == 0
+    assert proj["whatif_rtc_MBps"] == 0.0
+    # savings larger than the mean clamp at the 5% floor, never
+    # projecting a negative/infinite mean
+    tel.note_op_chain(_op_timeline())
+    proj = tel.rtc_projection(1, mean_ms=1.0, mbps=100.0,
+                              handoff_ms_per_op=50.0)
+    assert proj["whatif_rtc_MBps"] == pytest.approx(100.0 / 0.05)
+
+
+# -- the lock-timing layer (analysis/lock_witness) ---------------------
+
+def test_lock_timing_default_off_returns_bare_primitives():
+    from ceph_tpu.analysis import lock_witness as lw
+    if lw.enabled() or lw.timing_enabled():
+        pytest.skip("witness/timing armed by the environment")
+    lk = lw.make_lock("X::plain")
+    assert isinstance(lk, type(threading.Lock()))
+    cv = lw.make_condition("X::cv")
+    assert isinstance(cv, threading.Condition)
+
+
+def test_timed_lock_reports_wait_and_hold(tel):
+    from ceph_tpu.analysis import lock_witness as lw
+    lw.enable_timing()
+    try:
+        lk = lw.make_lock("Timed::lock")
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                held.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        held.wait(5.0)
+        # contended acquire: measured as lock wait on THIS thread
+        acquired = threading.Event()
+
+        def waiter():
+            with lk:
+                acquired.set()
+
+        w = threading.Thread(target=waiter)
+        w.start()
+        release.set()
+        t.join(5.0)
+        w.join(5.0)
+        assert acquired.is_set()
+    finally:
+        lw.disable_timing()
+    lt = tel.lock_table()
+    assert "Timed::lock" in lt["locks"], lt
+    row = lt["locks"]["Timed::lock"]
+    assert row["waits"] >= 2          # both acquisitions counted
+    assert row["hold_ms"] > 0.0       # holder's span measured
+
+
+def test_timed_condition_reports_signal_to_wake(tel):
+    from ceph_tpu.analysis import lock_witness as lw
+    lw.enable_timing()
+    try:
+        cv = lw.make_condition("Timed::cv")
+        ready = threading.Event()
+        woke = threading.Event()
+
+        def waiter():
+            with cv:
+                ready.set()
+                if cv.wait(5.0):
+                    woke.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        ready.wait(5.0)
+        with cv:
+            cv.notify_all()
+        t.join(5.0)
+        assert woke.is_set()
+    finally:
+        lw.disable_timing()
+    c = tel.perf.dump()
+    assert c["condvar_wakeups"] >= 1
+    lt = tel.lock_table()
+    assert lt["locks"]["Timed::cv"]["cv_wakeups"] >= 1
+
+
+# -- the Chrome-trace export -------------------------------------------
+
+def test_dispatch_trace_export_shapes(tel):
+    from ceph_tpu.tools import trace_export
+    tel.note_op_chain(_op_timeline())
+    chains = tel.recent_chains()
+    doc = trace_export.to_dispatch_trace(chains)
+    ev = doc["traceEvents"]
+    assert ev[0] == {"ph": "M", "pid": 1, "tid": 0,
+                     "name": "process_name",
+                     "args": {"name": "dispatch"}}
+    slices = [e for e in ev if e["ph"] == "X"]
+    starts = [e for e in ev if e["ph"] == "s"]
+    ends = [e for e in ev if e["ph"] == "f"]
+    names = [e for e in ev if e.get("name") == "thread_name"]
+    # one slice + one flow pair per hop
+    assert len(slices) == len(chains[0]["hops"]) == 5
+    assert len(starts) == len(ends) == 5
+    # flow pairs bind: same id/cat/name, finish carries bp=e on the
+    # destination track at the slice end
+    by_id = {e["id"]: e for e in starts}
+    tracks = {e["tid"]: e["args"]["name"] for e in names}
+    for fin in ends:
+        start = by_id[fin["id"]]
+        assert fin["bp"] == "e"
+        assert start["name"] == fin["name"]
+        assert fin["ts"] >= start["ts"]
+    # each slice sits on its hop's DESTINATION track, dur == wait
+    for sl, hop in zip(slices, chains[0]["hops"]):
+        assert tracks[sl["tid"]] == hop["dst"]
+        assert sl["dur"] == pytest.approx(hop["wait_us"])
+        assert sl["name"] == hop["seam"]
+    # wall-anchored: slice end == wall_epoch + t_us
+    wall0 = chains[0]["wall_epoch"] * 1e6
+    for sl, hop in zip(slices, chains[0]["hops"]):
+        assert sl["ts"] + sl["dur"] == pytest.approx(
+            wall0 + hop["t_us"], abs=1.0)
+
+
+def test_export_routes_dispatch_snapshots(tel):
+    from ceph_tpu.tools import trace_export
+    tel.note_op_chain(_op_timeline())
+    snap = tel.snapshot()
+    # full dump_dispatch payload, the bare ring, and a pre-exported
+    # doc all route; 5 slices + 5 flow pairs + metadata
+    for doc in (snap, snap["recent_chains"]):
+        out = trace_export.export(doc)
+        assert len([e for e in out["traceEvents"]
+                    if e["ph"] == "X"]) == 5
+    again = trace_export.export(out)
+    assert again is out
+    with pytest.raises(ValueError, match="dispatch snapshot"):
+        trace_export.export({"nope": 1})
+
+
+# -- snapshot shape ----------------------------------------------------
+
+def test_snapshot_sections(tel):
+    tel.note_op_chain(_op_timeline())
+    tel.note_reply_frame("client.1", 1)
+    tel.note_wakeup("client.1", 0.0005)
+    tel.note_lock_wait("PG::lock", 0.001)
+    snap = tel.snapshot()
+    for section in ("glossary", "seams", "wakeups", "locks",
+                    "counters", "recent_chains"):
+        assert section in snap, section
+    assert "wq_continuation" in snap["glossary"]
+    assert snap["counters"]["op_chains"] == 1
+    # seam_table only lists seams with observations
+    tel.note_handoff("wq_op", 0.001)
+    st = tel.seam_table()
+    assert set(st) == {"wq_op"}
+    assert st["wq_op"]["hops"] == 1
+    assert st["wq_op"]["mean_us"] == pytest.approx(1000.0)
+
+
+# -- the gap_report acceptance pin (real CPU quick run) ----------------
+
+def test_gap_report_carries_dispatch_xray(capsys):
+    """ISSUE 17 acceptance: on a CPU quick run the dispatch table
+    prints, the dispatch section attributes the residual commit_wait
+    (coverage inherited from the >= 90% commit-path bar), and the
+    run-to-completion what-if parses with hops_saved > 0."""
+    import json
+
+    from ceph_tpu.tools import gap_report
+
+    rc = gap_report.main([
+        "--seconds", "0.5", "--osds", "3", "--obj-kb", "32",
+        "--threads", "2", "--backend", "jax"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "dispatch (under commit_wait" in out
+    assert "what-if run-to-completion:" in out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith('{"gap_report"')][-1]
+    rep = json.loads(line)["gap_report"]
+    dsp = rep["dispatch"]
+    # the commit envelope slices attribute the residual commit_wait;
+    # coverage rides the commit-path >= 90% bar
+    assert dsp["coverage_pct"] >= 90.0, dsp
+    for stage in ("commit_handoff", "commit_dispatch",
+                  "commit_ship_wait", "commit_ack_wait"):
+        assert stage in dsp["stages"], dsp["stages"]
+        assert dsp["stages"][stage]["kind"]
+    assert dsp["op_chains"] > 0
+    assert dsp["hops_per_op"] > 0
+    assert dsp["seams"].get("wq_op", {}).get("hops", 0) > 0
+    assert dsp["wakeups"]["wakeups"] > 0
+    # lock timing was armed for the run: named waits observed
+    assert dsp["locks"]["locks"], dsp["locks"]
+    # the RTC projection: continuation hops exist on every engine-path
+    # op, so the replay always saves hops
+    rtc = rep["what_if"]["run_to_completion"]
+    assert rtc["hops_saved"] > 0, rtc
+    assert rtc["whatif_rtc_MBps"] > 0
+    assert rtc["saved_ms_per_op"] >= 0

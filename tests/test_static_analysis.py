@@ -481,6 +481,75 @@ class Daemon:
 
 
 # ---------------------------------------------------------------------------
+# notify-under-lock (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _notify_keys(text: str) -> set[str]:
+    return {f.key for f in
+            linters.check_notify_under_lock(_src(text))}
+
+
+_NOTIFY_CLASS = '''
+import threading
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv_lock = threading.Lock()
+        self._cv = threading.Condition(self._cv_lock)
+    {method}
+'''
+
+
+def test_notify_under_foreign_lock_caught():
+    keys = _notify_keys(_NOTIFY_CLASS.format(method=(
+        "def hurry_up_and_wait(self):\n"
+        "        with self._lock:\n"
+        "            with self._cv:\n"
+        "                self._cv.notify_all()\n")))
+    assert "notify_under_lock:ceph_tpu/synthetic.py:" \
+        "Daemon.hurry_up_and_wait:_cv" in keys
+
+
+def test_notify_under_own_lock_clean():
+    # Python REQUIRES holding the cond's own lock to notify — the
+    # canonical `with self._cv: self._cv.notify()` must not flag,
+    # nor holding the exact lock the cond was built over
+    keys = _notify_keys(_NOTIFY_CLASS.format(method=(
+        "def ok(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.notify()\n"
+        "    def ok2(self):\n"
+        "        with self._cv_lock:\n"
+        "            self._cv.notify_all()\n")))
+    assert not keys, keys
+
+
+def test_notify_after_release_clean():
+    keys = _notify_keys(_NOTIFY_CLASS.format(method=(
+        "def polite(self):\n"
+        "        with self._lock:\n"
+        "            self._ready = True\n"
+        "        with self._cv:\n"
+        "            self._cv.notify_all()\n")))
+    assert not keys, keys
+
+
+def test_notify_under_lock_sees_make_condition_seam():
+    text = '''
+from ceph_tpu.analysis.lock_witness import make_condition, make_lock
+class Daemon:
+    def __init__(self):
+        self._lock = make_lock("daemon.state")
+        self._cv = make_condition("daemon.cv")
+    def racy(self):
+        with self._lock:
+            self._cv.notify()
+'''
+    assert "notify_under_lock:ceph_tpu/synthetic.py:" \
+        "Daemon.racy:_cv" in _notify_keys(text)
+
+
+# ---------------------------------------------------------------------------
 # satellite: auto-generated wire round-trip over every message type
 # ---------------------------------------------------------------------------
 
