@@ -38,6 +38,13 @@ the *static twin* of a runtime contract this repo already gates:
    exact blind spot this PR closed; future stores don't get to
    reopen it.
 
+6. **reactor affinity** (ISSUE 18) — shared-nothing discipline for
+   ``ceph_tpu/crimson/``: no module-global mutable state, no blocking
+   ``time.sleep`` inside reactor coroutines, no raw ``threading``
+   sync primitives outside the witnessed ``make_lock`` seam. The
+   static twin of the runtime hop counters (``wq_continuation == 0``)
+   and the lock witness.
+
 Findings diff against the justified allowlist in
 ``analysis/baseline.json``; any NEW finding (or a stale baseline
 entry) fails ``tests/test_static_analysis.py`` in tier-1. Keys carry
@@ -1042,6 +1049,80 @@ def check_fsync_seam(src: SourceFile) -> list[Finding]:
     return findings
 
 
+#: reactor-affinity scope (repo-relative directory prefix): the
+#: shard-per-core subsystem whose run-to-completion discipline the
+#: checker pins statically
+REACTOR_DIR = "ceph_tpu/crimson"
+
+#: sync primitives whose DIRECT construction inside crimson bypasses
+#: the lock witness (cross-shard edges must go through make_lock /
+#: make_condition so contention is attributable)
+_RAW_LOCK_CALLS = frozenset((
+    "threading.Lock", "threading.RLock", "threading.Condition"))
+
+
+def check_reactor_affinity(src: SourceFile) -> list[Finding]:
+    """Shared-nothing discipline for ``ceph_tpu/crimson/`` (ISSUE
+    18) — the static twin of the runtime hop counters (``ophop_
+    wq_continuation == 0``) and the lock witness. Three violation
+    classes:
+
+    * ``global`` statements — module-level mutable state is shared
+      across every reactor thread; crimson state lives on the shard
+      (``Reactor``/``ReactorServices``) or on the OSD control plane,
+      never in module globals.
+    * blocking ``time.sleep`` inside ``async def`` — parks the whole
+      reactor (every PG pinned to it stalls admission-to-commit);
+      coroutines use ``asyncio.sleep`` or an injectable seam.
+    * direct ``threading.Lock/RLock/Condition`` construction — a
+      cross-shard edge the lock witness cannot see; the deliberate
+      edges (map waiters, tid counter, sub-write batch fan-in) go
+      through ``make_lock`` and are witnessed.
+    """
+    rel = src.rel.replace(os.sep, "/")
+    if not rel.startswith(REACTOR_DIR + "/"):
+        return []
+    findings: list[Finding] = []
+
+    def visit(node: ast.AST, func: str, in_async: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            name, is_async = func, in_async
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                name = child.name
+                is_async = isinstance(child, ast.AsyncFunctionDef)
+            if isinstance(child, ast.Global):
+                findings.append(Finding(
+                    "reactor_affinity", src.rel, child.lineno,
+                    f"reactor-affinity:{rel}:{func}:global",
+                    f"global {', '.join(child.names)} in {func}(): "
+                    "module-level mutable state is visible to every "
+                    "reactor — shared-nothing state lives on the "
+                    "shard or the OSD control plane"))
+            if isinstance(child, ast.Call):
+                callee = _unparse(child.func)
+                if in_async and callee == "time.sleep":
+                    findings.append(Finding(
+                        "reactor_affinity", src.rel, child.lineno,
+                        f"reactor-affinity:{rel}:{func}:"
+                        "blocking-sleep",
+                        f"time.sleep in async {func}(): blocks the "
+                        "whole reactor (every PG pinned to it) — "
+                        "use asyncio.sleep or an injectable seam"))
+                if callee in _RAW_LOCK_CALLS:
+                    findings.append(Finding(
+                        "reactor_affinity", src.rel, child.lineno,
+                        f"reactor-affinity:{rel}:{func}:raw-lock",
+                        f"{callee}() in {func}(): cross-shard sync "
+                        "primitive invisible to the lock witness — "
+                        "route through analysis.lock_witness."
+                        "make_lock/make_condition"))
+            visit(child, name, is_async)
+
+    visit(src.tree, "<module>", False)
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # driver + baseline
 # ---------------------------------------------------------------------------
@@ -1058,6 +1139,7 @@ def run_all(root: str = PKG_ROOT,
         findings.extend(check_lock_discipline(src))
         findings.extend(check_notify_under_lock(src))
         findings.extend(check_fsync_seam(src))
+        findings.extend(check_reactor_affinity(src))
         drift.collect(src)
     findings.extend(drift.findings())
     findings.sort(key=lambda f: (f.path, f.line, f.key))

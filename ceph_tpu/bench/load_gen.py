@@ -369,6 +369,19 @@ class LoadGen:
                         on_action=self._exec_action)
         return self.report()
 
+    def run_healthy(self, seconds: float | None = None) -> dict:
+        """Healthy-phase-only run (no fault ladder): the steady-state
+        throughput probe the crimson-vs-threaded A/B uses. Same
+        workload, same byte-exact verification, same durability
+        sweep in :meth:`report`."""
+        self.health.evaluate(self._status(),
+                             self.cluster.mon.osdmap)   # arm deltas
+        self.preload()
+        self._run_phase("healthy",
+                        seconds if seconds is not None
+                        else self.spec.phase_seconds)
+        return self.report()
+
     def final_verify(self) -> dict:
         """The durability sweep: every key with an acked write must
         read back bit-exact with an issued token (an unacked write

@@ -1,21 +1,27 @@
-"""crimson-lite — single-reactor OSD prototype (src/crimson/ role).
+"""crimson — shard-per-core, run-to-completion OSD (src/crimson/).
 
-The reference's crimson is an early-stage seastar rewrite of the OSD:
-a shared-nothing, futures-based reactor replacing the thread-pool
-daemon (src/crimson/: SocketMessenger, mon client, config — 3,309 LoC
-skeleton, no peering/recovery yet). The analog here keeps the same
-scope and the same architectural bet, in asyncio:
+The reference's crimson is a seastar rewrite of the OSD built on one
+bet: cores never share mutable state. Every PG is pinned to exactly
+one reactor from admission to commit reply; cross-core work travels
+as messages (``smp::submit_to``); within a reactor nothing preempts
+between awaits, so the threaded OSD's synchronous-critical-section
+locks disappear. The analog here keeps that discipline in asyncio
+and — as of ISSUE 18 — serves the MAINLINE data path:
 
-- ONE event loop runs everything — boot, heartbeats, map handling and
-  the op path are coroutines on the messenger's reactor; there is no
-  sharded thread pool, no pg.lock (per-object ordering falls out of
-  cooperative scheduling + per-object asyncio locks).
-- The wire protocol is the mainline one (typed messages over the
-  framed messenger), exactly as crimson speaks ceph's msgr protocol —
-  a stock client cannot tell which flavor of OSD answered it.
-- Scope matches the reference prototype: boot + maps + beacons + a
-  flat object service. No peering, no recovery, no EC — those live in
-  the mainline OSD (osd/osd.py), as in the reference.
+- ``crimson/osd.py``: admission, per-PG sequencing, the
+  run-to-completion EC write/read paths, replica sub-op service,
+  batched commit acks (one wakeup per client connection per flush);
+- ``crimson/reactor.py``: the reactor (event loop + per-shard
+  ``ObjectStore`` + every per-op table) and the per-shard
+  ``pg_backend.Listener`` the mainline ``ECBackend`` runs against;
+- ``crimson/readpath.py``: the awaitable EC shard-read fan-out
+  (retry ladder + version agreement, host-codec reconstruct).
+
+The wire protocol is the mainline one: a stock objecter/load_gen
+cannot tell which OSD flavor answered, and crimson + threaded OSDs
+interoperate shard-for-shard in one cluster. Still out of scope
+(reference parity): peering, recovery, snapshots, tiering, scrub.
 """
 
 from ceph_tpu.crimson.osd import CrimsonOSD  # noqa: F401
+from ceph_tpu.crimson.reactor import Reactor, ReactorServices  # noqa: F401

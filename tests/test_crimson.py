@@ -1,9 +1,17 @@
-"""crimson-lite: the single-reactor OSD prototype speaks the mainline
-wire protocol — a stock client boots a pool on it and does I/O without
-knowing which OSD flavor answered (src/crimson/ scope: boot + maps +
-beacons + flat object service; no peering/recovery, as the reference
-prototype)."""
+"""Crimson (shard-per-core, run-to-completion OSD) — ISSUE 18.
 
+Two surfaces under test. The single-OSD flat path (the round-4
+prototype's scenarios: boot + maps + beacons + replicated object
+service) and the MAINLINE data path: a stock client against a crimson
+MiniCluster serving EC pools through the real ECBackend — byte-
+identical to the threaded OSD, per-PG ordered under concurrent
+multi-connection load, zero lost acked writes under the msgr fault
+family, and run-to-completion telemetry (no ``wq_continuation``
+hops, ~one wakeup per reply frame).
+"""
+
+import asyncio
+import concurrent.futures
 import time
 
 import pytest
@@ -11,6 +19,18 @@ import pytest
 from ceph_tpu.crimson import CrimsonOSD
 from ceph_tpu.client.rados import RadosClient, RadosError
 from ceph_tpu.parallel.mon import Monitor
+from ceph_tpu.qa.cluster import MiniCluster
+from ceph_tpu.utils.config import g_conf
+from ceph_tpu.utils.dispatch_telemetry import telemetry
+
+
+def _wait_up(mon, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if any(i.up for i in mon.osdmap.osds.values()):
+            return
+        time.sleep(0.05)
+    raise TimeoutError("no OSD came up")
 
 
 @pytest.fixture
@@ -24,13 +44,11 @@ def setup():
     mon.stop()
 
 
+# -- flat path (single reactor-sharded OSD, replicated pools) ----------
+
 def test_crimson_osd_serves_stock_client(setup):
     mon, osd, mon_addr = setup
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        if any(i.up for i in mon.osdmap.osds.values()):
-            break
-        time.sleep(0.05)
+    _wait_up(mon)
     client = RadosClient(mon_addr).connect()
     try:
         code, outs, _ = client.mon_command(
@@ -50,29 +68,12 @@ def test_crimson_osd_serves_stock_client(setup):
         client.shutdown()
 
 
-def test_crimson_beacons_keep_it_alive(setup):
-    """The reactor's beacon coroutine keeps the mon's grace window
-    fed — the OSD stays up across several heartbeat intervals."""
-    mon, osd, mon_addr = setup
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        if any(i.up for i in mon.osdmap.osds.values()):
-            break
-        time.sleep(0.05)
-    time.sleep(2.0)
-    assert mon.osdmap.osds[0].up
-
-
 def test_shared_nothing_sharding_and_parallel_pgs(setup):
     """PGs are statically placed on reactors (pg_to_shard role): every
     PG's data lives on exactly ONE reactor's store, multiple reactors
     carry load, and a stock client sees one coherent OSD."""
     mon, osd, mon_addr = setup
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        if any(i.up for i in mon.osdmap.osds.values()):
-            break
-        time.sleep(0.05)
+    _wait_up(mon)
     client = RadosClient(mon_addr).connect()
     try:
         code, outs, _ = client.mon_command(
@@ -80,28 +81,31 @@ def test_shared_nothing_sharding_and_parallel_pgs(setup):
              "pg_num": "16", "size": "1"})
         assert code == 0, outs
         io = client.open_ioctx("shards")
-        import concurrent.futures
         with concurrent.futures.ThreadPoolExecutor(8) as pool:
             list(pool.map(
-                lambda i: io.write_full(f"obj{i}", b"s" * 512 + bytes([i])),
+                lambda i: io.write_full(f"obj{i}",
+                                        b"s" * 512 + bytes([i])),
                 range(48)))
         for i in range(48):
             assert io.read(f"obj{i}") == b"s" * 512 + bytes([i])
         stats = osd.shard_stats()
         assert len(stats) == osd.smp and osd.smp >= 2
-        # load actually spread across reactors
         assert sum(1 for s in stats if s["ops"] > 0) >= 2, stats
-        assert sum(s["objects"] for s in stats) == 48
         # shared-nothing: every PG collection exists on exactly one
-        # reactor's store
-        all_pgids = [pgid for r in osd.reactors
-                     for pgid in r.store.colls]
-        assert len(all_pgids) == len(set(all_pgids)), (
-            "a PG's state exists on two reactors", all_pgids)
-        # and placement agrees with pg_to_shard
+        # reactor's store, and placement agrees with shard_of
+        seen = []
         for r in osd.reactors:
-            for pgid in r.store.colls:
-                assert osd.shard_of(pgid) is r
+            for cid in r.store.list_collections():
+                seen.append(cid)
+                pool_ps = cid.split("_", 1)[1].split("s")[0]
+                pgid = tuple(int(x) for x in pool_ps.split("."))
+                assert osd.shard_of(pgid) is r, (cid, r.idx)
+        assert len(seen) == len(set(seen)), (
+            "a PG's state exists on two reactors", seen)
+        total = sum(len(r.store.list_objects(cid))
+                    for r in osd.reactors
+                    for cid in r.store.list_collections())
+        assert total == 48
     finally:
         client.shutdown()
 
@@ -111,11 +115,7 @@ def test_per_pg_sequencer_orders_ops(setup):
     coroutines (OrderedExclusivePhase role): concurrent appends from
     many client threads never lose bytes or interleave."""
     mon, osd, mon_addr = setup
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        if any(i.up for i in mon.osdmap.osds.values()):
-            break
-        time.sleep(0.05)
+    _wait_up(mon)
     client = RadosClient(mon_addr).connect()
     try:
         code, outs, _ = client.mon_command(
@@ -124,7 +124,6 @@ def test_per_pg_sequencer_orders_ops(setup):
         assert code == 0, outs
         io = client.open_ioctx("seq")
         io.write_full("log", b"")
-        import concurrent.futures
         with concurrent.futures.ThreadPoolExecutor(8) as pool:
             list(pool.map(
                 lambda i: io.append("log", bytes([i]) * 7),
@@ -135,7 +134,6 @@ def test_per_pg_sequencer_orders_ops(setup):
         for off in range(0, len(data), 7):
             run = data[off:off + 7]
             assert run == run[:1] * 7, (off, run)
-        # xattrs ride the same sharded path
         io.setxattr("log", "who", b"crimson")
         assert io.getxattr("log", "who") == b"crimson"
     finally:
@@ -147,11 +145,7 @@ def test_crimson_pgls_lists_every_pg(setup):
     must route it by msg.ps (mapping "" through crush would fold all
     listings onto one PG and lose objects)."""
     mon, osd, mon_addr = setup
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        if any(i.up for i in mon.osdmap.osds.values()):
-            break
-        time.sleep(0.05)
+    _wait_up(mon)
     client = RadosClient(mon_addr).connect()
     try:
         code, outs, _ = client.mon_command(
@@ -164,3 +158,220 @@ def test_crimson_pgls_lists_every_pg(setup):
         assert io.list_objects() == sorted(f"k{i}" for i in range(24))
     finally:
         client.shutdown()
+
+
+# -- the beacon seam (satellite: injectable clock/interval) ------------
+
+def test_beacon_loop_injectable_seam():
+    """The beacon loop resolves its interval through the injectable
+    seam every lap and sleeps through the injected sleeper — a test
+    observes N beacons without ANY wall-clock heartbeat waits."""
+    mon = Monitor("a")
+    mon_addr = mon.start()
+    laps = []
+
+    async def fake_sleep(interval):
+        laps.append(interval)
+        if len(laps) >= 5:
+            await asyncio.Event().wait()     # park forever
+        await asyncio.sleep(0)
+
+    osd = CrimsonOSD(0, mon_addr, beacon_interval=0.125,
+                     beacon_sleep=fake_sleep)
+    try:
+        osd.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and osd.beacons_sent < 4:
+            time.sleep(0.01)
+        assert osd.beacons_sent >= 4
+        # every lap read the injected interval, not the config Option
+        assert laps[:4] == [0.125] * 4
+        assert mon.osdmap.osds[0].up
+    finally:
+        osd.stop()
+        mon.stop()
+
+
+# -- the mainline EC data path on a crimson cluster --------------------
+
+def test_stock_client_ec_roundtrip_on_crimson_cluster():
+    """A stock objecter speaks to a 3-OSD crimson cluster serving an
+    EC pool through the mainline ECBackend: full op surface, then
+    wait_for_clean (eager PG instantiation on map updates)."""
+    with MiniCluster(n_osds=3, osd_flavor="crimson") as cluster:
+        cluster.create_ec_pool("ec", k=2, m=1, pg_num=8)
+        io = cluster.client().open_ioctx("ec")
+        io.op_timeout = 30.0
+        payload = b"crimson-ec" * 500
+        io.write_full("obj", payload)
+        assert io.read("obj") == payload
+        io.append("obj", b"tail")
+        assert io.read("obj") == payload + b"tail"
+        assert io.stat("obj") == len(payload) + 4
+        io.setxattr("obj", "k", b"v")
+        assert io.getxattr("obj", "k") == b"v"
+        for i in range(12):
+            io.write_full(f"m{i}", bytes([i]) * 333)
+        for i in range(12):
+            assert io.read(f"m{i}") == bytes([i]) * 333
+        assert set(io.list_objects()) >= {f"m{i}" for i in range(12)}
+        io.remove("obj")
+        with pytest.raises(RadosError):
+            io.read("obj")
+        cluster.wait_for_clean(timeout=15)
+
+
+def test_byte_identical_readback_vs_threaded():
+    """Wire compatibility pin: the SAME op sequence against a
+    threaded and a crimson cluster reads back byte-identical — a
+    client cannot tell which flavor answered."""
+    def drive(flavor):
+        out = {}
+        with MiniCluster(n_osds=3, osd_flavor=flavor) as cluster:
+            cluster.create_ec_pool("ab", k=2, m=1, pg_num=4)
+            io = cluster.client().open_ioctx("ab")
+            io.op_timeout = 30.0
+            for i in range(6):
+                io.write_full(f"o{i}", bytes([0x40 + i]) * (1000 + i))
+            io.append("o0", b"-suffix")
+            io.write_full("o1", b"overwritten")
+            io.setxattr("o2", "tag", b"ab")
+            for i in range(6):
+                out[f"o{i}"] = io.read(f"o{i}")
+            out["stat_o0"] = io.stat("o0")
+            out["xattr_o2"] = io.getxattr("o2", "tag")
+            out["ls"] = io.list_objects()
+        return out
+
+    assert drive("threaded") == drive("crimson")
+
+
+def test_per_pg_ordering_under_concurrent_connections():
+    """Satellite: the per-PG ordering property under concurrent
+    MULTI-CONNECTION load. Several independent client connections
+    hammer one PG (pg_num=1) with appends; the sequencer must keep
+    every append atomic (uniform runs) and each connection's own ops
+    in issue order, across coroutine await points."""
+    with MiniCluster(n_osds=3, osd_flavor="crimson") as cluster:
+        cluster.create_ec_pool("ord", k=2, m=1, pg_num=1)
+        setup_io = cluster.client().open_ioctx("ord")
+        setup_io.op_timeout = 30.0
+        setup_io.write_full("log", b"")
+        n_conns, per_conn = 4, 6
+
+        def hammer(c):
+            client = cluster.client()
+            io = client.open_ioctx("ord")
+            io.op_timeout = 30.0
+            for s in range(per_conn):
+                io.append("log", bytes([16 * c + s]) * 5)
+            client.shutdown()
+
+        with concurrent.futures.ThreadPoolExecutor(n_conns) as pool:
+            list(pool.map(hammer, range(n_conns)))
+        data = setup_io.read("log")
+        assert len(data) == n_conns * per_conn * 5
+        runs = []
+        for off in range(0, len(data), 5):
+            run = data[off:off + 5]
+            assert run == run[:1] * 5, (off, run)   # atomic append
+            runs.append(run[0])
+        # per-connection issue order is preserved in the object
+        for c in range(n_conns):
+            seq = [b % 16 for b in runs if b // 16 == c]
+            assert seq == sorted(seq), (c, seq)
+            assert len(seq) == per_conn
+
+
+def test_dropped_frames_zero_lost_acked_writes():
+    """Satellite: the msgr fault family against crimson. Client op
+    AND reply frames (singleton + batch) are dropped mid-burst; the
+    objecter resend ladder re-drives them, crimson's dup-op cache
+    answers resends of already-applied writes without double-apply —
+    zero lost acked writes, every read byte-exact."""
+    from ceph_tpu.parallel import messages as M
+    conf = g_conf()
+    old_resend = conf["objecter_resend_interval"]
+    conf.set("objecter_resend_interval", 0.3)
+    try:
+        with MiniCluster(n_osds=3, osd_flavor="crimson") as cluster:
+            reg = cluster.faults
+            reg.reseed(11)
+            cluster.create_ec_pool("dz", k=2, m=1, pg_num=4,
+                                   backend="jax")
+            io = cluster.client().open_ioctx("dz")
+            io.op_timeout = 60.0
+            payload_of = (lambda i: bytes(((i * 13 + j) & 0xFF)
+                                          for j in range(4096)))
+            io.write_full("warm", b"w")     # admission warm-up
+            rules = [
+                reg.add("msgr_drop", entity="client.*",
+                        msg_type=M.MOSDOp.MSG_TYPE,
+                        every=4, max_fires=3),
+                reg.add("msgr_drop", entity="client.*",
+                        msg_type=M.MOSDOpBatch.MSG_TYPE,
+                        every=3, max_fires=3),
+                reg.add("msgr_drop", entity="osd.*",
+                        msg_type=M.MOSDOpReplyBatch.MSG_TYPE,
+                        every=5, max_fires=2),
+            ]
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                list(pool.map(
+                    lambda i: io.write_full(f"s{i}", payload_of(i)),
+                    range(24)))
+            for r in rules:
+                r.remove()
+            assert sum(r.fires for r in rules) >= 1
+            for i in range(24):
+                assert io.read(f"s{i}") == payload_of(i), \
+                    f"s{i} lost or wrong"
+    finally:
+        conf.set("objecter_resend_interval", old_resend)
+
+
+def test_rtc_telemetry_no_continuation_hops_single_wakeups():
+    """The run-to-completion acceptance shape, as counters: a crimson
+    write burst crosses ZERO ``wq_continuation`` hops (continuations
+    resume inline on the owning reactor), every op's chain crosses
+    the ``reactor_submit`` seam, and reply frames wake ~one client
+    thread each (the batched-ack rule)."""
+    with MiniCluster(n_osds=3, osd_flavor="crimson") as cluster:
+        cluster.create_ec_pool("tl", k=2, m=1, pg_num=4,
+                               backend="jax")
+        io = cluster.client().open_ioctx("tl")
+        io.op_timeout = 30.0
+        io.write_full("warm", b"w" * 1024)
+        telemetry().reset()
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            list(pool.map(
+                lambda i: io.write_full(f"b{i}", b"x" * 8192),
+                range(16)))
+        for i in range(16):
+            assert io.read(f"b{i}") == b"x" * 8192
+        snap = telemetry().snapshot()
+        c = snap["counters"]
+        assert c["ophop_wq_continuation"] == 0, c
+        assert c["ophop_wq_op"] == 0, c
+        assert c["ophop_reactor_submit"] >= 32, c
+        assert c["op_chains"] >= 32
+        wf = snap["wakeups"]["wakeups_per_frame"]
+        assert wf <= 1.05, snap["wakeups"]
+
+
+def test_crimson_kill_revive_preserves_shard_data():
+    """A revived crimson OSD gets its per-shard stores back (the
+    threaded MiniCluster's store-cache rule): acked writes survive a
+    kill/revive of any OSD with no recovery machinery in play."""
+    with MiniCluster(n_osds=3, osd_flavor="crimson") as cluster:
+        cluster.create_ec_pool("kr", k=2, m=1, pg_num=4)
+        io = cluster.client().open_ioctx("kr")
+        io.op_timeout = 30.0
+        for i in range(8):
+            io.write_full(f"d{i}", bytes([i]) * 2048)
+        victim = max(cluster.osds)
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim, timeout=30)
+        cluster.revive_osd(victim)
+        cluster.wait_for_osds_up(timeout=15)
+        for i in range(8):
+            assert io.read(f"d{i}") == bytes([i]) * 2048

@@ -689,3 +689,98 @@ def test_real_store_files_have_no_untimed_fsyncs():
     assert store_srcs
     for src in store_srcs:
         assert linters.check_fsync_seam(src) == [], src.rel
+
+# ---------------------------------------------------------------------------
+# family 6: reactor affinity (ISSUE 18) — seeded violations
+# ---------------------------------------------------------------------------
+
+def _affinity_keys(text: str,
+                   rel: str = "ceph_tpu/crimson/synth.py") -> set[str]:
+    fs = linters.check_reactor_affinity(_src(text, rel=rel))
+    return {f.key for f in fs}
+
+
+def test_reactor_affinity_global_state_caught():
+    keys = _affinity_keys('''
+_EPOCH = 0
+
+def bump():
+    global _EPOCH
+    _EPOCH += 1
+''')
+    assert ("reactor-affinity:ceph_tpu/crimson/synth.py:bump:global"
+            in keys)
+
+
+def test_reactor_affinity_blocking_sleep_in_coroutine_caught():
+    keys = _affinity_keys('''
+import time
+
+async def beacon_loop(self):
+    while True:
+        time.sleep(1.0)
+''')
+    assert ("reactor-affinity:ceph_tpu/crimson/synth.py:"
+            "beacon_loop:blocking-sleep" in keys)
+
+
+def test_reactor_affinity_sync_sleep_outside_coroutine_clean():
+    """time.sleep in a plain (control-plane) function is not a
+    reactor stall — only coroutines run on the reactor."""
+    assert _affinity_keys('''
+import time
+
+def wait_for_boot(self):
+    time.sleep(0.1)
+''') == set()
+
+
+def test_reactor_affinity_raw_lock_caught():
+    keys = _affinity_keys('''
+import threading
+
+class Shard:
+    def __init__(self):
+        self._lock = threading.Lock()
+''')
+    assert ("reactor-affinity:ceph_tpu/crimson/synth.py:"
+            "__init__:raw-lock" in keys)
+
+
+def test_reactor_affinity_witnessed_lock_and_asyncio_clean():
+    assert _affinity_keys('''
+import asyncio
+from ceph_tpu.analysis.lock_witness import make_lock
+
+class Shard:
+    def __init__(self):
+        self._lock = make_lock("crimson.synth")
+
+    async def tick(self):
+        await asyncio.sleep(0.1)
+''') == set()
+
+
+def test_reactor_affinity_scoped_to_crimson():
+    """The discipline scopes to ceph_tpu/crimson/ — threaded daemons
+    may use module state and raw primitives (their own lints apply)."""
+    assert _affinity_keys('''
+import threading
+
+_STATE = {}
+
+def anywhere():
+    global _STATE
+    _STATE = {"lock": threading.Lock()}
+''', rel="ceph_tpu/osd/synth.py") == set()
+
+
+def test_reactor_affinity_live_crimson_tree_clean():
+    """The live contract: the shipped crimson subsystem satisfies its
+    own discipline TODAY."""
+    crimson_srcs = [s for s in linters.iter_sources()
+                    if s.rel.replace(os.sep, "/").startswith(
+                        "ceph_tpu/crimson/")]
+    assert crimson_srcs
+    for src in crimson_srcs:
+        assert linters.check_reactor_affinity(src) == [], src.rel
