@@ -81,6 +81,11 @@ BUDGETS = {
     # plus the projection-honesty row against whatif_rtc_MBps.
     # Wall-clock-budgeted.
     "crimson": (30.0, 0.0),
+    # ISSUE 19 (ROADMAP 3): the planet-scale read path — a zipfian
+    # read storm A/B'd primary-pinned vs affine+any-k vs +client
+    # cache, plus the microsecond cache-hit p99 row. Cluster-level,
+    # wall-clock-budgeted.
+    "hot_object_read": (35.0, 0.0),
 }
 
 #: global sampling deadline (seconds from process start). Sampling
@@ -102,7 +107,10 @@ BUDGETS = {
 #: budget, adding no structural term)
 #: r22: 355 -> 320 absorbs the crimson row's reservation (ISSUE 18;
 #: a pure-host cluster burst — no device programs of its own)
-TOTAL_BUDGET = 320.0
+#: r24: 320 -> 285 absorbs the hot_object_read row's reservation
+#: (ISSUE 19; three short cluster bursts — host-path work, its EC
+#: decodes ride programs the earlier rows already warmed)
+TOTAL_BUDGET = 285.0
 
 #: tunnel worst-case seconds for ONE cold per-signature compile
 COLD_COMPILE_S = 35.0
@@ -336,9 +344,19 @@ def main() -> None:
     try:
         dg_contended = _bench_degraded_read(expect, clean_metrics)
         any_contended = any_contended or dg_contended
-    except Exception as exc:  # both degraded rows must still land
-        emit("degraded_read_GBps", {"error": repr(exc)})
-        emit("degraded_p99_ms", {"error": repr(exc)})
+    except Exception as exc:  # both degraded rows must still land,
+        # SCHEMA-COMPLETE: every key a success row carries is present
+        # (value None) so bench_trend and any JSON-line consumer
+        # indexing a failed arm reads None instead of KeyError-ing
+        emit("degraded_read_GBps", {
+            "value": None, "unit": "GB/s",
+            "objects_per_flush": DEGRADED_OBJECTS,
+            "spread_pct": None, "samples": 0, "error": repr(exc)})
+        emit("degraded_p99_ms", {
+            "value": None, "unit": "ms", "p50_ms": None,
+            "per_object_p99_ms": None,
+            "objects_per_flush": DEGRADED_OBJECTS,
+            "samples": 0, "error": repr(exc)})
 
     try:
         _bench_load_gen()
@@ -364,6 +382,21 @@ def main() -> None:
                     "wire_framing_tcp_MBps"):
             if row not in _RESULTS:
                 emit(row, {"error": repr(exc)})
+
+    try:
+        _bench_hot_object_read()
+    except Exception as exc:  # both ISSUE-19 rows must land,
+        # schema-complete (the degraded_read error-row convention)
+        if "hot_object_read_GBps" not in _RESULTS:
+            emit("hot_object_read_GBps", {
+                "value": None, "unit": "GB/s",
+                "primary_only_GBps": None, "cached_GBps": None,
+                "win_x_vs_primary": None, "samples": 0,
+                "error": repr(exc)})
+        if "cache_hit_p99_us" not in _RESULTS:
+            emit("cache_hit_p99_us", {
+                "value": None, "unit": "us", "p50_us": None,
+                "hit_rate": None, "samples": 0, "error": repr(exc)})
 
     if any_contended:
         # independent chip-health probe (different program, same
@@ -446,6 +479,19 @@ def _combined(any_contended: bool) -> dict:
                 out["load_gen_" + k2] = lg[k2]
         for ph, ent in (lg.get("phases") or {}).items():
             out[f"load_gen_{ph}_p99_ms"] = ent["p99_ms"]
+    hr = _RESULTS.get("hot_object_read_GBps")
+    if hr:
+        for k2 in ("value", "primary_only_GBps", "cached_GBps",
+                   "win_x_vs_primary", "samples", "heat_skew",
+                   "error"):
+            if k2 in hr:
+                out["hot_object_read_" + k2] = hr[k2]
+    chp = _RESULTS.get("cache_hit_p99_us")
+    if chp:
+        for k2 in ("value", "p50_us", "hit_rate", "samples",
+                   "error"):
+            if k2 in chp:
+                out["cache_hit_p99_" + k2] = chp[k2]
     probe = _RESULTS.get("xla_probe_GBps")
     if probe:
         out["xla_probe_GBps"] = probe["value"]
@@ -1212,6 +1258,179 @@ def _bench_crimson_load_gen() -> None:
         "wq_continuation_hops": c.get("ophop_wq_continuation", 0),
         "wakeups_per_frame":
             tel.wakeup_table().get("wakeups_per_frame"),
+    })
+
+
+#: injected per-shard store read latency for the hot-read arms. The
+#: in-process MiniCluster's memstore answers in microseconds, so the
+#: CLIENT is the bottleneck and server-side balancing cannot show on
+#: aggregate GB/s; the injection models a loaded store (the planet-
+#: scale regime the read path is FOR) where serving capacity binds —
+#: then primary-pinned routing saturates one member while any-k
+#: rotation multiplies across the acting set.
+HOT_READ_STORE_LAT_MS = 25.0
+
+
+def _hot_read_arm(seconds: float, affinity: bool, spread: int,
+                  cache: bool, n_objs: int = 8, obj_kb: int = 256,
+                  clients: int = 2, threads: int = 8) -> dict:
+    """One zipfian read-storm arm against a fresh EC MiniCluster
+    (isa k=2,m=1 — every rotated reconstruct rides the XOR fast
+    path) with HOT_READ_STORE_LAT_MS of injected store read latency.
+    The config toggles are set BEFORE boot (the objecter and OSD
+    cache them at init) and the caller restores them. Returns GB/s-
+    grade numbers + per-OSD serve attribution + (cache arms) the
+    timed hit-path latencies. Every read is byte-exact-checked
+    against the written payload, in-storm and post-storm."""
+    import concurrent.futures
+
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.utils import read_heat
+    from ceph_tpu.utils.config import g_conf
+
+    conf = g_conf()
+    conf.set("objecter_read_affinity", affinity)
+    conf.set("osd_read_set_spread", spread)
+    conf.set("osd_hot_read_threshold", 8)
+    conf.set("client_cache", cache)
+    read_heat.reset()
+    payload = b"\x5a" * (obj_kb * 1024)
+    rng = np.random.default_rng(21)
+    # zipfian key schedule: a few hot objects dominate, exactly the
+    # storm the affine+any-k+cache path exists for
+    keys = np.minimum(rng.zipf(1.6, size=40000) - 1, n_objs - 1)
+    totals = [0] * (clients * threads)
+    hit_lats: list = []
+    with MiniCluster(n_osds=4) as c:
+        c.create_ec_pool("hr", k=2, m=1, pg_num=8, backend="jax",
+                         plugin="isa")
+        cls = [c.client() for _ in range(clients)]
+        ios = [cl.open_ioctx("hr") for cl in cls]
+        io = ios[0]
+        for i in range(n_objs):
+            io.write_full(f"h{i}", payload)
+        assert io.read("h0") == payload, \
+            "hot-read arm: read-back is not byte-exact"
+        rule = c.faults.add("store_latency", oid_prefix="h",
+                            delay_s=HOT_READ_STORE_LAT_MS / 1000.0)
+        stop = time.perf_counter() + seconds
+
+        def worker(w: int) -> None:
+            wio = ios[w % clients]
+            i = w * 997
+            while time.perf_counter() < stop:
+                oid = f"h{keys[i % len(keys)]}"
+                data = wio.read(oid)
+                assert data == payload, \
+                    f"hot-read arm: {oid} not byte-exact mid-storm"
+                totals[w] += len(data)
+                i += 1
+
+        t0 = time.perf_counter()
+        try:
+            with concurrent.futures.ThreadPoolExecutor(
+                    clients * threads) as pool:
+                list(pool.map(worker, range(clients * threads)))
+            elapsed = max(time.perf_counter() - t0, 1e-6)
+            # byte-exactness across the whole set, post-storm
+            for i in range(n_objs):
+                assert io.read(f"h{i}") == payload, \
+                    f"hot-read arm: h{i} not byte-exact after storm"
+        finally:
+            rule.remove()
+        if cache and cls[0].cache is not None:
+            # the microsecond hit path, timed alone: h0 is cached
+            # (just read), every probe is a pure local hit — the
+            # store-latency rule is already gone, so a stray miss
+            # costs wire time, not injected sleep
+            for _ in range(400):
+                h0 = time.perf_counter()
+                io.read("h0")
+                hit_lats.append(time.perf_counter() - h0)
+        per_osd = {
+            o: {"op_r": osd.logger.get("op_r"),
+                "affine_reads": osd.logger.get("affine_reads"),
+                "anyk_rotated_reads":
+                    osd.logger.get("anyk_rotated_reads"),
+                "xor_fast_decodes":
+                    osd.logger.get("xor_fast_decodes"),
+                "hot_shard_cache_hits":
+                    osd.logger.get("hot_shard_cache_hits")}
+            for o, osd in sorted(c.osds.items())}
+        cache_stats = (cls[0].cache.stats()
+                       if cls[0].cache is not None else {})
+    return {"GBps": round(sum(totals) / elapsed / 1e9, 4),
+            "reads": int(sum(totals) // len(payload)),
+            "elapsed_s": round(elapsed, 2),
+            "per_osd": per_osd,
+            "heat": read_heat.snapshot_brief(top=3),
+            "hit_lats": hit_lats,
+            "cache": cache_stats}
+
+
+def _bench_hot_object_read() -> None:
+    """ISSUE 19 (ROADMAP 3): reading at pod bandwidth. Three arms of
+    the SAME zipfian read storm — primary-pinned (the pre-fix
+    routing), placement-affine + any-k rotated read sets, and that
+    plus the client cache tier — land ``hot_object_read_GBps``
+    (value = the affine+any-k arm, the server-side win; the cached
+    arm rides the line) and ``cache_hit_p99_us`` (the microsecond
+    hit path, timed over pure local hits). Wall-clock budgeted; the
+    config toggles are restored whatever happens."""
+    from ceph_tpu.utils.config import g_conf
+    budget, _ = BUDGETS["hot_object_read"]
+    deadline = min(_deadline(), time.perf_counter() + budget)
+    arm_s = max(1.0, min(5.0, (deadline - time.perf_counter()) / 6))
+    conf = g_conf()
+    saved = {k: conf.get(k) for k in
+             ("objecter_read_affinity", "osd_read_set_spread",
+              "osd_hot_read_threshold", "client_cache")}
+    try:
+        primary = _hot_read_arm(arm_s, affinity=False, spread=1,
+                                cache=False)
+        anyk = _hot_read_arm(arm_s, affinity=True, spread=3,
+                             cache=False)
+        cached = _hot_read_arm(arm_s, affinity=True, spread=3,
+                               cache=True)
+    finally:
+        for k, v in saved.items():
+            conf.set(k, v)
+    p_gbps = primary["GBps"] or 1e-9
+    emit("hot_object_read_GBps", {
+        "value": anyk["GBps"],
+        "unit": "GB/s",
+        "primary_only_GBps": primary["GBps"],
+        "cached_GBps": cached["GBps"],
+        "win_x_vs_primary": round(anyk["GBps"] / p_gbps, 2),
+        "samples": anyk["reads"],
+        "arm_seconds": round(arm_s, 2),
+        "store_latency_ms": HOT_READ_STORE_LAT_MS,
+        "heat_skew": anyk["heat"].get("skew"),
+        "hot_shard_cache_hits": sum(
+            v["hot_shard_cache_hits"]
+            for v in anyk["per_osd"].values()),
+        "per_osd": anyk["per_osd"],
+        "primary_per_osd": primary["per_osd"],
+        "cache_stats": cached["cache"],
+    })
+    lats = sorted(cached["hit_lats"])
+
+    def _nr_us(pct: float) -> float | None:
+        if not lats:
+            return None
+        idx = max(0, min(len(lats) - 1,
+                         int(round(pct / 100 * len(lats) + 0.5)) - 1))
+        return round(lats[idx] * 1e6, 2)
+
+    cs = cached["cache"] or {}
+    lookups = cs.get("hits", 0) + cs.get("misses", 0)
+    emit("cache_hit_p99_us", {
+        "value": _nr_us(99),
+        "unit": "us",
+        "p50_us": _nr_us(50),
+        "hit_rate": round(cs.get("hits", 0) / lookups, 3)
+        if lookups else None,
+        "samples": len(lats),
     })
 
 

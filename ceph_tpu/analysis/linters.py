@@ -580,6 +580,10 @@ class RegistryDrift:
         #: (prefix, suffix) families touched via f-string keys
         self.counter_affixes: list[tuple[str, str]] = []
         self.option_affixes: list[tuple[str, str]] = []
+        #: knobs named by tuner policy Rules (ROADMAP 3 read-path
+        #: widening): every rule's actuator must be a registered
+        #: TUNER_KNOBS entry, or its firings silently step nothing
+        self.rule_knobs: dict[str, tuple[str, int]] = {}
 
     # -- collection ----------------------------------------------------
     def collect(self, src: SourceFile) -> None:
@@ -677,6 +681,16 @@ class RegistryDrift:
                         and isinstance(node.args[1], ast.Constant):
                     self.asok_invoked.setdefault(
                         node.args[1].value, (src.rel, node.lineno))
+                elif fn.id == "Rule" and len(node.args) >= 3 and \
+                        isinstance(node.args[1], ast.Constant) and \
+                        isinstance(node.args[1].value, str) and \
+                        isinstance(node.args[2], ast.Constant) and \
+                        node.args[2].value in ("up", "down"):
+                    # a tuner policy rule (Rule(name, knob, dir, ...));
+                    # the direction literal disambiguates it from
+                    # crush/fault Rule constructors
+                    self.rule_knobs.setdefault(
+                        node.args[1].value, (src.rel, node.lineno))
 
     @staticmethod
     def _is_conf(recv: ast.AST, aliases: set[str]) -> bool:
@@ -738,6 +752,17 @@ class RegistryDrift:
                     f"tuner-managed knob {key!r} has no add_observer "
                     "consumer: runtime pushes either cost a hot-path "
                     "config read or never reach the daemon")
+        # every tuner policy rule must actuate a registered Knob —
+        # a typo'd knob name makes the rule's firings step nothing
+        # (the engine looks the knob up and skips silently)
+        knob_names = set(self._tuner_knob_names())
+        if knob_names:
+            for key, where in sorted(self.rule_knobs.items()):
+                if key not in knob_names:
+                    add("rule-knob-unregistered", key, where,
+                        f"tuner rule steps knob {key!r} but "
+                        "TUNER_KNOBS has no such entry — the rule "
+                        "can never actuate")
         return out
 
     @staticmethod

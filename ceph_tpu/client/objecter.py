@@ -13,6 +13,18 @@ messenger is this layer's job, as in the reference:
     for the next map push / tick instead of hammering the ex-primary
     with the same stale target at RTT rate.
 
+Placement-affine reads (ROADMAP 3): with ``objecter_read_affinity``
+on, plain head reads target the PG's CRUSH-stable affine acting
+member (the same ``stable_hash`` the server-side placement map uses
+to pick a PG's chip slot) instead of always the primary — every
+client lands the same member per PG, so a hot PG's reads coalesce
+there and a zipfian storm spreads across the acting set instead of
+melting the primaries. The member serves committed data (every
+acting position acked the write before the client saw its ack); if
+its map disagrees it answers ESTALE and the op falls back to the
+primary IMMEDIATELY — affine routing is an optimization and must
+never add a map-push round trip to correctness.
+
 Duplicate delivery on resend is safe for ALL ops: the OSD keeps a
 (client, tid) dup-op cache and answers a resend of an already-applied
 mutation with the original reply instead of re-executing it (the
@@ -30,6 +42,7 @@ from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Connection, Messenger
 from ceph_tpu.parallel.mon_client import MonClient
 from ceph_tpu.parallel.osdmap import OSDMap
+from ceph_tpu.parallel.placement import stable_hash
 from ceph_tpu.utils import profiler as _profiler
 from ceph_tpu.utils import stage_clock
 from ceph_tpu.utils.config import g_conf
@@ -51,7 +64,7 @@ class ObjecterError(Exception):
 
 class _Op:
     __slots__ = ("tid", "msg", "event", "reply", "sent_at", "attempts",
-                 "wake_t")
+                 "wake_t", "affine", "no_affine", "skey", "rsalt")
 
     def __init__(self, tid: int, msg: M.MOSDOp) -> None:
         self.tid = tid
@@ -63,6 +76,19 @@ class _Op:
         #: monotonic stamp taken just before event.set() — the waiter
         #: side measures signal->wake latency from it (ISSUE 17)
         self.wake_t = 0.0
+        #: last transmission targeted a non-primary affine member
+        self.affine = False
+        #: affine routing disabled for this op's lifetime (an affine
+        #: ESTALE demoted it; every retransmission pins the primary)
+        self.no_affine = False
+        #: stream key the op entered _streams under (None = never
+        #: streamed; _stream_note_done keys its drain off this)
+        self.skey: tuple | None = None
+        #: any-k rotation salt, fixed at first submission: 0 for cold
+        #: objects (the CRUSH-stable affine member — full coalescing),
+        #: advancing once per _ROT_WINDOW reads of a hot object so its
+        #: serving fans out over the whole acting set
+        self.rsalt = 0
 
 
 EBLOCKLISTED = -108
@@ -75,9 +101,26 @@ EBLOCKLISTED = -108
 TRACE_ERRNOS = (-5, -110)
 
 
-#: op codes the streaming seam may coalesce (plain data writes; the
-#: guarded / snap-context / cls / read families keep singleton frames)
-_STREAM_OPS = (1, 5, 6)          # WRITE_FULL, WRITE, APPEND
+#: op codes the streaming seam may coalesce (plain data writes and —
+#: round 19 — plain head reads; the guarded / snap-context / cls
+#: families keep singleton frames). Read and write runs stream under
+#: SEPARATE keys: a read frame targets the PG's affine acting member,
+#: a write frame its primary.
+_STREAM_OPS = (1, 2, 5, 6)       # WRITE_FULL, READ, WRITE, APPEND
+
+#: client-side any-k rotation window: an object's affine target stays
+#: put for this many of OUR reads, then rotates one acting position.
+#: Cold objects (fewer reads than the window) never leave the
+#: CRUSH-stable pick, so cross-client coalescing is undisturbed; a
+#: hot object's storm fans out over every acting member — all of
+#: which hold every acked write (the commit rule acks only after all
+#: acting positions commit), so any member serves consistent reads.
+_ROT_WINDOW = 16
+
+#: per-object read-count book cap (mirrors utils/read_heat): at the
+#: cap the coldest half is dropped — losing a count only resets a
+#: cold object's rotation to the stable pick
+_ROT_CAP = 8192
 
 
 class Objecter:
@@ -99,14 +142,27 @@ class Objecter:
         self._lock = make_lock("objecter.state")
         self._next_tid = 1
         self._pending: dict[int, _Op] = {}
-        # the streaming submission seam (ROADMAP 1b): per-(pool, PG)
-        # coalescing state — ops arriving while that PG has a frame
-        # in flight accumulate and ship as ONE MOSDOpBatch the moment
-        # the in-flight frame drains (no hold timer: solo traffic
-        # ships immediately; batching emerges under concurrency,
-        # exactly the adjacency the PR-14 ledger measured)
-        self._streams: dict[tuple[int, int], dict] = {}
+        # the streaming submission seam (ROADMAP 1b): per-(pool, PG,
+        # kind) coalescing state — ops arriving while that stream has
+        # a frame in flight accumulate and ship as ONE MOSDOpBatch the
+        # moment the in-flight frame drains (no hold timer: solo
+        # traffic ships immediately; batching emerges under
+        # concurrency, exactly the adjacency the PR-14 ledger
+        # measured). kind splits reads from writes, and affine reads
+        # further split by target member: frames to different acting
+        # members fly concurrently (the any-k read parallelism).
+        self._streams: dict[tuple, dict] = {}
         self._stream_enabled = bool(g_conf()["objecter_stream"])
+        # placement-affine read routing (ROADMAP 3): plain literal
+        # read — an on/off policy switch, not a tuner-stepped knob
+        self._read_affinity = bool(g_conf()["objecter_read_affinity"])
+        # per-object read counts driving client-side any-k rotation
+        # (under _lock; capped at _ROT_CAP, coldest half dropped).
+        # The per-client seed de-phases concurrent clients: a storm
+        # from N clients lands N different acting members at any
+        # instant instead of all rotating onto the same one together.
+        self._read_rot: dict[tuple[int, str], int] = {}
+        self._rot_seed = stable_hash(self.client_id)
         # the batch window is a tuner-managed Knob: cache it through
         # the config-observer seam, never a hot-path config read
         self._stream_max = int(g_conf()["objecter_stream_max_ops"])
@@ -178,6 +234,15 @@ class Objecter:
         if op is None:
             return             # dup reply after resend: drop
         if msg.code == ESTALE:
+            if op.affine:
+                # the AFFINE member declined (its map disagrees /
+                # mid-backfill): demote this op to primary routing
+                # and resend NOW — the primary is always correct, and
+                # an optimization must not cost a map-push round trip
+                op.affine = False
+                op.no_affine = True
+                self._send(op)
+                return
             # reached a non-primary; our map is behind. Leave the op
             # pending: the mon's map push retargets it (and the tick
             # loop backstops a lost push).
@@ -233,6 +298,9 @@ class Objecter:
         # marks-so-far into msg.stages right before the frame build
         msg._stage_clock = clock
         rec = _Op(tid, msg)
+        if (self._read_affinity and op == M.OSD_OP_READ
+                and not snapid and not cls and not gname):
+            rec.rsalt = self._rot_salt(pool, oid)
         with self._lock:
             self._pending[tid] = rec
         span.event("submitted")
@@ -335,9 +403,10 @@ class Objecter:
 
     # -- streaming submission seam (ROADMAP 1b) ------------------------
     def _streamable(self, msg: M.MOSDOp) -> bool:
-        """Plain data writes only: guarded, snap-context, xattr/omap,
-        cls and read ops keep their singleton frames (their reply
-        shapes and admission paths are op-specific)."""
+        """Plain data writes and plain head reads: guarded,
+        snap-context, xattr/omap, cls and snapshot reads keep their
+        singleton frames (their reply shapes and admission paths are
+        op-specific)."""
         return (self._stream_enabled and self._stream_max > 1
                 and msg.op in _STREAM_OPS and not msg.cls
                 and not msg.gname and not msg.xname
@@ -357,9 +426,21 @@ class Objecter:
         msg = rec.msg
         if osdmap is None or osdmap.pools.get(msg.pool) is None:
             return              # wait for a map that has the pool
-        ps, _, _ = osdmap.object_locator(msg.pool, msg.oid)
+        ps, acting, primary = osdmap.object_locator(msg.pool, msg.oid)
         msg.ps = ps
-        key = (msg.pool, ps)
+        kind = "r" if msg.op == M.OSD_OP_READ else "w"
+        # read streams split by affine target: each acting member
+        # gets its OWN in-flight frame window, so a hot PG's reads
+        # pipeline to several members concurrently instead of
+        # serializing behind one frame — the any-k parallelism is
+        # client-visible, not just server-side shard balance. Writes
+        # (and affinity-off reads) keep the single (pool, PG) stream.
+        tgt = -1
+        if kind == "r" and self._read_affinity and not rec.no_affine:
+            tgt = self._read_target(osdmap, msg.pool, ps, acting,
+                                    primary, salt=rec.rsalt)
+        key = (msg.pool, ps, kind, tgt)
+        rec.skey = key
         ship = None
         with self._lock:
             st = self._streams.get(key)
@@ -397,7 +478,9 @@ class Objecter:
         """An op left ``_pending`` (reply or timeout): drain its
         stream bookkeeping, and when the in-flight frame is done,
         ship the accumulated run."""
-        key = (rec.msg.pool, rec.msg.ps)
+        key = rec.skey
+        if key is None:
+            return              # never entered a stream
         ship = None
         with self._lock:
             st = self._streams.get(key)
@@ -411,11 +494,15 @@ class Objecter:
         if ship:
             self._ship_stream(key, ship)
 
-    def _ship_stream(self, key: tuple[int, int], recs: list) -> None:
-        """Frame the accumulated run: one MOSDOpBatch per (pool, PG)
-        — one serialize, one wire traversal, one reply sweep. A run
-        of one keeps the singleton frame (no batch overhead for solo
-        traffic)."""
+    def _ship_stream(self, key: tuple, recs: list) -> None:
+        """Frame the accumulated run: one MOSDOpBatch per (pool, PG,
+        kind, affine target) — one serialize, one wire traversal, one
+        reply sweep. A run of one keeps the singleton frame (no batch
+        overhead for solo traffic). Write frames target the primary;
+        read frames the PG's affine acting member (same-slot reads
+        coalesce server-side — the whole point of placement
+        affinity). The target is recomputed from the run's rotation
+        salt against the CURRENT map, not trusted from the key."""
         if not recs:
             return
         if len(recs) == 1:
@@ -424,11 +511,20 @@ class Objecter:
         osdmap = self.monc.osdmap
         if osdmap is None:
             return              # tick/map-push resend singletons
-        pool, ps = key
-        _, _, primary = osdmap.pg_to_up_acting(pool, ps)
-        info = osdmap.osds.get(primary) if primary >= 0 else None
+        pool, ps, kind = key[0], key[1], key[2]
+        _, acting, primary = osdmap.pg_to_up_acting(pool, ps)
+        target = primary
+        affine = False
+        if (kind == "r" and self._read_affinity
+                and not any(r.no_affine for r in recs)):
+            target = self._read_target(osdmap, pool, ps, acting,
+                                       primary, salt=recs[0].rsalt)
+            affine = target != primary
+        info = osdmap.osds.get(target) if target >= 0 else None
         if info is None or not info.addr:
             return              # PG unserviceable; tick retries
+        for r in recs:
+            r.affine = affine
         now = time.monotonic()
         stages = []
         for r in recs:
@@ -464,6 +560,47 @@ class Objecter:
             pass                # telemetry faults never cost an op
         self.msgr.send_message(batch, info.addr)
 
+    def _rot_salt(self, pool: int, oid: str) -> int:
+        """Count this read and return the object's any-k rotation
+        salt: the per-client seed plus the read-count window. The
+        seed spreads DIFFERENT clients over different acting members
+        from their very first read (balance without coordination);
+        the window term walks each client's pick around the set as
+        its own storm grows."""
+        key = (pool, oid)
+        with self._lock:
+            n = self._read_rot.get(key, 0) + 1
+            self._read_rot[key] = n
+            if len(self._read_rot) > _ROT_CAP:
+                keep = sorted(self._read_rot.items(),
+                              key=lambda kv: kv[1],
+                              reverse=True)[:_ROT_CAP // 2]
+                self._read_rot = dict(keep)
+        return self._rot_seed + n // _ROT_WINDOW
+
+    @staticmethod
+    def _read_target(osdmap: OSDMap, pool: int, ps: int,
+                     acting: list, primary: int,
+                     salt: int = 0) -> int:
+        """The PG's placement-affine read member: the CRUSH-stable
+        ``stable_hash`` pick over the acting set — the same pure
+        function the server-side placement map keys a PG's chip slot
+        on, so every client (and every retry with the same map)
+        lands the SAME member and its reads coalesce there. A
+        nonzero ``salt`` (the client's per-object rotation window,
+        any-k balanced reads) steps the pick around the acting set —
+        every member holds every acked write, so any of them serves
+        a consistent read. Falls back to the primary when the pick
+        is down or addressless."""
+        live = [o for o in acting if o >= 0]
+        if live:
+            cand = live[(stable_hash((pool, ps)) + salt) % len(live)]
+            info = osdmap.osds.get(cand)
+            if info is not None and getattr(info, "up", True) \
+                    and info.addr:
+                return cand
+        return primary
+
     def _send(self, op: _Op) -> None:
         osdmap = self.monc.osdmap
         if osdmap is None:
@@ -473,14 +610,25 @@ class Objecter:
             return                      # wait for a map that has it
         if op.msg.op == M.OSD_OP_LIST:
             ps = op.msg.ps
-            _, _, primary = osdmap.pg_to_up_acting(op.msg.pool, ps)
+            _, acting, primary = osdmap.pg_to_up_acting(op.msg.pool,
+                                                        ps)
         else:
-            ps, _, primary = osdmap.object_locator(op.msg.pool,
-                                                   op.msg.oid)
+            ps, acting, primary = osdmap.object_locator(op.msg.pool,
+                                                        op.msg.oid)
             op.msg.ps = ps
         if primary < 0:
             return                      # PG unserviceable; tick retries
-        info = osdmap.osds.get(primary)
+        target = primary
+        op.affine = False
+        if (self._read_affinity and not op.no_affine
+                and op.msg.op == M.OSD_OP_READ
+                and not op.msg.snapid and not op.msg.cls
+                and not op.msg.gname):
+            target = self._read_target(osdmap, op.msg.pool, ps,
+                                       acting, primary,
+                                       salt=op.rsalt)
+            op.affine = target != primary
+        info = osdmap.osds.get(target)
         if info is None or not info.addr:
             return
         op.msg.epoch = osdmap.epoch

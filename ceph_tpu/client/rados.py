@@ -17,12 +17,24 @@ from __future__ import annotations
 
 import json
 
+from ceph_tpu.client.object_cacher import ObjectCacher
 from ceph_tpu.client.objecter import Objecter, ObjecterError
 from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Messenger
 from ceph_tpu.parallel.mon_client import MonClient
+from ceph_tpu.utils.config import g_conf
 
 _client_seq = [0]
+
+#: ops whose success invalidates the client cache's copy of the oid
+#: (every head mutation librados can issue against cached data).
+#: Invalidate AFTER the ack, matching the striper's ordering: dropping
+#: before lets a concurrent reader refill pre-write bytes and pin them.
+_CACHE_INVAL_OPS = frozenset((
+    M.OSD_OP_WRITE_FULL, M.OSD_OP_WRITE, M.OSD_OP_APPEND,
+    M.OSD_OP_REMOVE, M.OSD_OP_CREATE, M.OSD_OP_TRUNCATE,
+    M.OSD_OP_ZERO, M.OSD_OP_ROLLBACK, M.OSD_OP_WRITESAME,
+    M.OSD_OP_CALL))
 
 
 class RadosError(Exception):
@@ -59,10 +71,17 @@ class IoCtx:
                 op != M.OSD_OP_LIST:
             pool_id = p.read_tier
         try:
-            return self.client.objecter.op_submit(
+            rep = self.client.objecter.op_submit(
                 pool_id, oid, op, **kw)
         except ObjecterError as exc:
             raise RadosError(exc.code, str(exc)) from None
+        # cache-tier coherence, local half (read-your-writes): our own
+        # successful mutation drops our cached copy AFTER the ack —
+        # the OSD's inval-hold handles every OTHER client's copy
+        cache = self.client.cache
+        if cache is not None and op in _CACHE_INVAL_OPS:
+            cache.invalidate_object(oid)
+        return rep
 
     def _snapc(self) -> dict:
         """The pool's snap context for mutations (librados attaches
@@ -95,12 +114,44 @@ class IoCtx:
         return self._submit(oid, M.OSD_OP_APPEND, data=data,
                             **(snapc or self._snapc())).version
 
+    def _cacheable(self) -> bool:
+        """Head reads of a plain pool may use the client cache; a
+        tiering overlay redirects both reads and writes to the cache
+        POOL, so our inval watch on the base pool would never fire —
+        those reads stay uncached."""
+        if self.client.cache is None:
+            return False
+        m = self.client.monc.osdmap
+        p = m.pools.get(self.pool_id) if m else None
+        return p is not None and p.read_tier < 0
+
     def read(self, oid: str, length: int = 0, offset: int = 0,
              snap: int = 0) -> bytes:
         """``snap``: read the object's state as of that pool snapshot
-        (0 = head)."""
-        return self._submit(oid, M.OSD_OP_READ, offset=offset,
-                            length=length, snapid=snap).data
+        (0 = head). With ``client_cache`` on, head reads are served
+        from the local cache tier when covered — the hit path is a
+        dict probe, no wire. Coherence: a per-object inval watch is
+        registered BEFORE the filling read, and the OSD holds every
+        mutating op's ack until all inval watchers dropped their
+        copies, so a hit can never return bytes older than any write
+        whose ack anyone has seen."""
+        if snap != 0 or not self._cacheable():
+            return self._submit(oid, M.OSD_OP_READ, offset=offset,
+                                length=length, snapid=snap).data
+        cache = self.client.cache
+        data = cache.get(oid, offset, length)
+        if data is not None:
+            return data
+        # the watch must be live BEFORE the read: a write landing
+        # between read and watch would otherwise not invalidate us
+        watched = self.client._ensure_inval_watch(self, oid)
+        gen = cache.generation()
+        data = self._submit(oid, M.OSD_OP_READ, offset=offset,
+                            length=length, snapid=0).data
+        if watched:
+            cache.put(oid, offset, length, data, gen=gen,
+                      whole=(length == 0 and offset == 0))
+        return data
 
     def stat(self, oid: str, snap: int = 0) -> int:
         """Object size in bytes."""
@@ -416,6 +467,21 @@ class RadosClient:
         self._watches: dict[int, dict] = {}
         #: tid -> [Event, reply]
         self._wn_waits: dict[int, list] = {}
+        # librados cache tier (ROADMAP 3): per-client read cache kept
+        # coherent through per-object inval watches + the OSD's
+        # reply-hold (osd._inval_hold)
+        self.cache: ObjectCacher | None = None
+        if bool(g_conf()["client_cache"]):
+            self.cache = ObjectCacher(
+                int(g_conf()["client_cache_bytes"]))
+            # capacity is a tuner-stepped Knob: observe it
+            g_conf().add_observer("client_cache_bytes",
+                                  self._on_cache_bytes)
+        #: (pool_id, oid) -> inval-watch cookie; registration is
+        #: serialized by _inval_reg_lock (one wire round trip per
+        #: object, ever — never on the hit path)
+        self._inval_cookies: dict[tuple[int, str], int] = {}
+        self._inval_reg_lock = _th.Lock()
 
     def connect(self, timeout: float = 10.0) -> "RadosClient":
         self.msgr.set_dispatcher(self._dispatch)
@@ -434,7 +500,21 @@ class RadosClient:
         self._connected = True
         return self
 
+    def _on_cache_bytes(self, _name: str, value) -> None:
+        try:
+            value = int(value)
+        except (TypeError, ValueError):
+            return
+        if self.cache is not None:
+            self.cache.resize(value)
+
     def shutdown(self) -> None:
+        if self.cache is not None:
+            try:
+                g_conf().remove_observer("client_cache_bytes",
+                                         self._on_cache_bytes)
+            except Exception:
+                pass
         if self.objecter:
             self.objecter.shutdown()
         self.msgr.shutdown()
@@ -505,7 +585,8 @@ class RadosClient:
             with self._wn_lock:
                 self._wn_waits.pop(msg.tid, None)
 
-    def _watch(self, io: IoCtx, oid: str, callback) -> int:
+    def _watch(self, io: IoCtx, oid: str, callback,
+               inval: bool = False) -> int:
         addr, ps, primary = self._primary_addr(io.pool_id, oid)
         with self._wn_lock:
             self._wn_seq += 1
@@ -517,11 +598,11 @@ class RadosClient:
             # callback would count an unseen notify as seen)
             self._watches[cookie] = {
                 "pool": io.pool_id, "oid": oid, "cb": callback,
-                "osd": primary, "addr": addr}
+                "osd": primary, "addr": addr, "inval": inval}
         try:
             rep = self._wn_call(self._mwatch(
                 tid=tid, pool=io.pool_id, ps=ps, oid=oid,
-                cookie=cookie, watch=True), addr)
+                cookie=cookie, watch=True, inval=inval), addr)
         except RadosError:
             with self._wn_lock:
                 self._watches.pop(cookie, None)
@@ -531,6 +612,29 @@ class RadosClient:
                 self._watches.pop(cookie, None)
             raise RadosError(rep.code, "watch refused")
         return cookie
+
+    def _ensure_inval_watch(self, io: IoCtx, oid: str) -> bool:
+        """A live invalidation watch on ``(pool, oid)`` — register
+        one on first miss; True when the object is covered (only
+        covered reads may fill the cache). Serialized per client: the
+        round trip happens once per object, never on the hit path."""
+        key = (io.pool_id, oid)
+        with self._inval_reg_lock:
+            with self._wn_lock:
+                if key in self._inval_cookies:
+                    return True
+
+            def cb(_payload: bytes, oid: str = oid) -> None:
+                if self.cache is not None:
+                    self.cache.invalidate_object(oid)
+
+            try:
+                cookie = self._watch(io, oid, cb, inval=True)
+            except RadosError:
+                return False     # uncovered: this read stays uncached
+            with self._wn_lock:
+                self._inval_cookies[key] = cookie
+            return True
 
     def _unwatch(self, cookie: int) -> None:
         with self._wn_lock:
@@ -612,6 +716,20 @@ class RadosClient:
                 # restarted osd (same id, wiped in-memory watch
                 # table) rebinds to a new addr, so the addr compare
                 # is what makes 're-watches automatically' true
+                continue
+            if w.get("inval"):
+                # an inval watch died with its primary: writes landed
+                # in the gap WITHOUT holding for us. Drop the cached
+                # copy and the registration — the next read miss
+                # re-registers on the current primary through the
+                # normal path, so every post-gap fill is covered
+                with self._wn_lock:
+                    self._watches.pop(cookie, None)
+                    k = (w["pool"], w["oid"])
+                    if self._inval_cookies.get(k) == cookie:
+                        self._inval_cookies.pop(k, None)
+                if self.cache is not None:
+                    self.cache.invalidate_object(w["oid"])
                 continue
             try:
                 rep = self._wn_call(self._mwatch(

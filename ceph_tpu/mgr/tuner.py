@@ -93,7 +93,8 @@ def tuner_on() -> bool:
 SENSOR_KEYS = ("p99_ms", "mbps", "hbm_live", "hbm_limit", "inflight",
                "window", "occupancy", "flush_bytes_mean",
                "health_rank", "fault_events", "mesh_slots",
-               "slot_staged", "stream_batch_mean")
+               "slot_staged", "stream_batch_mean", "read_skew",
+               "cache_hit_rate", "cache_lookups")
 
 
 class LiveSensors:
@@ -156,6 +157,24 @@ class LiveSensors:
             if st is not None:
                 snap["stream_batch_mean"] = \
                     st.snapshot_brief().get("mean_stream_batch", 0.0)
+        except Exception:
+            pass
+        try:
+            # per-object read concentration (ROADMAP 3): the any-k
+            # read_set_spread actuator's sensor — zipfian storms
+            # score far above 1.0, even traffic sits at it
+            from ceph_tpu.utils import read_heat
+            snap["read_skew"] = read_heat.skew()
+        except Exception:
+            pass
+        try:
+            # client cache-tier hit picture, process-wide (the
+            # client_cache_bytes actuator's sensor)
+            from ceph_tpu.client.object_cacher import aggregate_stats
+            cs = aggregate_stats()
+            snap["cache_lookups"] = cs["hits"] + cs["misses"]
+            if cs["hit_rate"] is not None:
+                snap["cache_hit_rate"] = cs["hit_rate"]
         except Exception:
             pass
         try:
@@ -296,6 +315,33 @@ DEFAULT_RULES = (
          lambda s, e: s["window"] > 0 and
          s["inflight"] >= s["window"] and s["hbm_frac"] < 0.5 and
          s["health_rank"] == 0),
+    # read-path levers (ROADMAP 3): the any-k rotation width steps
+    # on MEASURED per-object skew — wide only while a storm is
+    # actually concentrated (width costs decode-signature reuse, so
+    # even traffic walks it back); the cache tier's capacity steps
+    # on its measured hit rate
+    Rule("read_spread_grow", "osd_read_set_spread", "up",
+         "hot-object read skew: rotate shard read sets across more "
+         "of the acting set to spread the storm",
+         lambda s, e: s["read_skew"] >= 4.0),
+    Rule("read_spread_shrink", "osd_read_set_spread", "down",
+         "reads even again: narrow the rotation back toward the "
+         "canonical read set (shared decode signatures)",
+         lambda s, e: 0 < s["read_skew"] <= 1.5 and
+         e.conf.get("osd_read_set_spread") >
+         _default_of(e, "osd_read_set_spread")),
+    Rule("cache_grow", "client_cache_bytes", "up",
+         "client cache missing under live lookups: more capacity "
+         "for the hot set",
+         lambda s, e: s["cache_lookups"] > 0 and
+         s["cache_hit_rate"] < 0.5),
+    Rule("cache_shrink", "client_cache_bytes", "down",
+         "client cache hit rate saturated: hand the surplus "
+         "capacity back",
+         lambda s, e: s["cache_lookups"] > 0 and
+         s["cache_hit_rate"] >= 0.9 and
+         e.conf.get("client_cache_bytes") >
+         _default_of(e, "client_cache_bytes")),
     # observability levers: keep more evidence while degraded, give
     # the overhead back when healthy
     Rule("trace_keep_more", "trace_sample_every", "down",

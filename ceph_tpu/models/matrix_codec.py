@@ -115,6 +115,23 @@ class MatrixErasureCode(ErasureCode):
         if not present:
             some = np.asarray(chunks[have[0]], dtype=np.uint8)
             rec = np.zeros((len(missing), len(some)), dtype=np.uint8)
+        elif ((dmat == 0) | (dmat == 1)).all():
+            # XOR fast path (ISSUE 19): a decode row whose nonzero
+            # coefficients are all 1 is plain GF addition — multiply
+            # by 1 is identity, add is XOR — so reconstruction is a
+            # bitwise XOR of the survivor chunks, bit-exact by
+            # construction and orders of magnitude cheaper than a
+            # GF matvec launch. Single-parity RS (the RAID5 shape)
+            # and XOR-structured codes hit this on EVERY
+            # single-erasure signature; the any-k rotated hot-read
+            # sets are exactly such signatures.
+            data = np.stack([np.asarray(chunks[c], dtype=np.uint8)
+                             for c in present])
+            rec = np.stack([
+                np.bitwise_xor.reduce(data[dmat[row] == 1], axis=0)
+                if (dmat[row] == 1).any() else
+                np.zeros_like(data[0])
+                for row in range(dmat.shape[0])])
         else:
             data = np.stack([np.asarray(chunks[c], dtype=np.uint8)
                              for c in present])

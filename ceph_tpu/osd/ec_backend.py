@@ -31,7 +31,9 @@ from __future__ import annotations
 
 import json
 import random
+import threading
 import time
+from collections import OrderedDict
 from typing import Callable
 
 import numpy as np
@@ -57,6 +59,8 @@ from ceph_tpu.osd.pg_backend import (
     object_write_txn,
 )
 from ceph_tpu.parallel import messages as M
+from ceph_tpu.parallel.placement import stable_hash
+from ceph_tpu.utils import read_heat
 from ceph_tpu.store.object_store import (
     EIOError,
     NoSuchCollection,
@@ -121,6 +125,55 @@ class ECBackend(PGBackend):
         stripe_unit = pool_info.stripe_unit
         self.sinfo = StripeInfo(stripe_width=self.k * stripe_unit,
                                 chunk_size=stripe_unit)
+        # any-k balanced reads (ROADMAP 3): reads past this per-object
+        # count rotate their shard read set. The threshold is a plain
+        # cached read (not tuner-managed); the rotation WIDTH comes
+        # from the parent's cached osd_read_set_spread observer
+        self._hot_threshold = int(g_conf()["osd_hot_read_threshold"])
+        self._spread_src = getattr(parent, "read_set_spread", None)
+        # hot-shard cache (ISSUE 19): remotely-fetched partner chunks
+        # of HOT objects, keyed (pool, ps, oid, pos) -> (version,
+        # chunk bytes). A hit makes a rotated hot serve fully local —
+        # no MECSubRead to a partner that is itself busy serving — so
+        # the acting members stop queueing on each other and any-k
+        # rotation actually multiplies serving capacity. Consistency
+        # is by VERSION, not invalidation messages: every acting
+        # position commits (and bumps its shard's "v" attr) before a
+        # write acks, so the serving member's LOCAL shard version is
+        # always current; a cached entry is used only when its stored
+        # version equals the local one, and a mismatch drops it. The
+        # existing version-agreement check in _read_shards then
+        # revalidates the assembled set end to end.
+        self._shard_cache: OrderedDict[tuple, tuple[int, np.ndarray]] \
+            = OrderedDict()
+        self._shard_cache_lock = threading.Lock()
+
+    #: hot-shard cache entry cap — entries are single chunks of hot
+    #: objects only, so this bounds worst-case memory at cap × chunk
+    SHARD_CACHE_ENTRIES = 128
+
+    def _shard_cache_get(self, pg: PG, oid: str, pos: int,
+                         version: int) -> np.ndarray | None:
+        """Version-checked lookup; a stale entry self-invalidates."""
+        key = (pg.pool, pg.ps, oid, pos)
+        with self._shard_cache_lock:
+            ent = self._shard_cache.get(key)
+            if ent is None:
+                return None
+            if ent[0] != version:
+                del self._shard_cache[key]
+                return None
+            self._shard_cache.move_to_end(key)
+            return ent[1]
+
+    def _shard_cache_put(self, pg: PG, oid: str, pos: int,
+                         version: int, chunk: np.ndarray) -> None:
+        key = (pg.pool, pg.ps, oid, pos)
+        with self._shard_cache_lock:
+            self._shard_cache[key] = (version, chunk)
+            self._shard_cache.move_to_end(key)
+            while len(self._shard_cache) > self.SHARD_CACHE_ENTRIES:
+                self._shard_cache.popitem(last=False)
 
     # -- layout helpers -----------------------------------------------
     def local_cid(self, pg: PG) -> str:
@@ -789,10 +842,56 @@ class ECBackend(PGBackend):
             return ver_avoid
         return set()
 
+    #: consecutive reads of one hot object that share a rotated set
+    #: before advancing to the next rotation: the erasure signature
+    #: (survivor set + missing set) stays fixed inside the window, so
+    #: the engine's signature-grouped decode flushes still coalesce
+    ROTATE_WINDOW = 64
+
+    def _rotated_plan(self, oid: str, want_chunks: list[int],
+                      available: list[int], count: int,
+                      mypos: int = -1):
+        """Any-k balanced reads (ROADMAP 3): a hot object's reads
+        cycle through up to ``osd_read_set_spread`` rotations of the
+        available positions, so one primary's shards stop carrying
+        every hot read. Locality-first: the serving member's OWN
+        shard position (``mypos``) always leads the rotated set —
+        its chunk is a local store read, so a rotated serve never
+        costs more sub-op wire bytes than the canonical one; the
+        rotation spreads which REMOTE partners fill the rest.
+        Returns a decode plan, or None to take the canonical
+        (primary-preferred) set — rotation NEVER costs availability:
+        any failure falls back to the full set."""
+        spread = 1
+        if self._spread_src is not None:
+            try:
+                spread = int(self._spread_src())
+            except Exception:
+                spread = 1
+        spread = min(spread, len(available))
+        if spread <= 1 or len(available) <= len(want_chunks):
+            return None
+        r = (stable_hash(oid) + count // self.ROTATE_WINDOW) % spread
+        if not r:
+            return None          # rotation 0 IS the canonical set
+        rot = available[r:] + available[:r]
+        if mypos in available:
+            rot = [mypos] + [p for p in rot if p != mypos]
+        subset = rot[:len(want_chunks)]
+        try:
+            plan = self.codec.minimum_to_decode(want_chunks, subset)
+        except Exception:
+            return None          # codec cannot decode from this set
+        logger = getattr(self.parent, "logger", None)
+        if logger is not None:
+            logger.inc("anyk_rotated_reads")
+        return plan
+
     def _read_shards(self, pg: PG, oid: str, want_chunks: list[int],
                      avoid: set[int] | None = None,
                      chunk_off: int = 0, chunk_len: int = 0,
-                     accept_versions: frozenset[int] | None = None
+                     accept_versions: frozenset[int] | None = None,
+                     rotate_count: int | None = None
                      ) -> tuple[dict[int, np.ndarray], dict[str, bytes]]:
         """Read the chunks named by minimum_to_decode over (up - avoid)
         positions; returns ({chunk: bytes}, attrs-from-one-shard).
@@ -848,9 +947,18 @@ class ECBackend(PGBackend):
                         avoid.add(pos)
             available = [p for p in self.up_positions(pg)
                          if p not in avoid]
+            plan = None
+            if rotate_count is not None and attempt == 0 \
+                    and avoid == orig_avoid:
+                # hot object, healthy PG, first attempt: try a rotated
+                # any-k set; degraded objects and every retry keep the
+                # canonical selection (signature + availability first)
+                plan = self._rotated_plan(oid, want_chunks, available,
+                                          rotate_count, mypos=mypos)
             try:
-                plan = self.codec.minimum_to_decode(
-                    want_chunks, available)
+                if plan is None:
+                    plan = self.codec.minimum_to_decode(
+                        want_chunks, available)
             except Exception:
                 if enoent_everywhere and attempt > 0:
                     # every shard said ENOENT: the object does not
@@ -870,6 +978,55 @@ class ECBackend(PGBackend):
             attrs: dict[str, bytes] = {}
             attrs_by_pos: dict[int, dict] = {}
             remote = {p for p in need if p != mypos}
+
+            def local_read() -> None:
+                nonlocal attrs, enoent_everywhere
+                cid = pg_cid(pg.pool, pg.ps, mypos)
+                try:
+                    results[mypos] = np.frombuffer(
+                        self.parent.store.read(
+                            cid, oid, chunk_off,
+                            chunk_len or None),
+                        dtype=np.uint8)
+                    local_attrs = self.parent.store.getattrs(
+                        cid, oid)
+                    vers[mypos] = int.from_bytes(
+                        local_attrs.get("v", b""), "little")
+                    attrs = attrs or local_attrs
+                    attrs_by_pos[mypos] = local_attrs
+                    enoent_everywhere = False
+                except (NoSuchObject, NoSuchCollection):
+                    # match the remote mapping: a shard whose PG
+                    # collection does not exist yet answers ENOENT
+                    base_avoid.add(mypos)
+                except StoreError:
+                    enoent_everywhere = False
+                    base_avoid.add(mypos)
+
+            # hot-shard cache: full-chunk hot reads do the LOCAL read
+            # first (its "v" attr is current — every acting position
+            # commits before a write acks) and serve partner positions
+            # whose cached chunk matches that version without any
+            # MECSubRead at all. Partial ranges and the RMW overlay
+            # mode (accept_versions) never touch the cache.
+            local_done = False
+            cacheable = (rotate_count is not None and not chunk_off
+                         and not chunk_len and accept_versions is None
+                         and mypos in need)
+            if cacheable:
+                local_read()
+                local_done = True
+                lv = vers.get(mypos)
+                if lv is not None:
+                    for pos in sorted(remote):
+                        hit = self._shard_cache_get(pg, oid, pos, lv)
+                        if hit is None:
+                            continue
+                        results[pos] = hit
+                        vers[pos] = lv
+                        remote.discard(pos)
+                        if logger is not None:
+                            logger.inc("hot_shard_cache_hits")
             tid = self.parent.new_tid()
             wait = SubOpWait(set(remote))
             self.parent.register_wait(tid, wait)
@@ -879,28 +1036,8 @@ class ECBackend(PGBackend):
                         tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
                         oid=oid, offset=chunk_off, length=chunk_len,
                         want_attrs=True))
-                if mypos in need:
-                    cid = pg_cid(pg.pool, pg.ps, mypos)
-                    try:
-                        results[mypos] = np.frombuffer(
-                            self.parent.store.read(
-                                cid, oid, chunk_off,
-                                chunk_len or None),
-                            dtype=np.uint8)
-                        local_attrs = self.parent.store.getattrs(
-                            cid, oid)
-                        vers[mypos] = int.from_bytes(
-                            local_attrs.get("v", b""), "little")
-                        attrs = attrs or local_attrs
-                        attrs_by_pos[mypos] = local_attrs
-                        enoent_everywhere = False
-                    except (NoSuchObject, NoSuchCollection):
-                        # match the remote mapping: a shard whose PG
-                        # collection does not exist yet answers ENOENT
-                        base_avoid.add(mypos)
-                    except StoreError:
-                        enoent_everywhere = False
-                        base_avoid.add(mypos)
+                if mypos in need and not local_done:
+                    local_read()
                 replies = wait.wait(SUBOP_TIMEOUT) if remote else {}
             finally:
                 self.parent.unregister_wait(tid)
@@ -918,6 +1055,9 @@ class ECBackend(PGBackend):
                 if rep.attrs:
                     attrs = dict(rep.attrs)
                     attrs_by_pos[pos] = dict(rep.attrs)
+                if cacheable:
+                    self._shard_cache_put(pg, oid, pos, rep.version,
+                                          results[pos])
             missing_reads = set(need) - set(results)
             if missing_reads:
                 base_avoid |= failed | missing_reads
@@ -1022,10 +1162,20 @@ class ECBackend(PGBackend):
         signature-grouped decode flush instead of N serial
         ``decode_sync`` launches. ``cont(data, err)`` then runs on
         the engine thread; a device fault falls back to the host twin
-        inline (counted, never silent)."""
+        inline (counted, never silent).
+
+        Hot objects (read_heat past osd_hot_read_threshold) rotate
+        their shard read set (any-k balanced reads, ROADMAP 3): a
+        rotated set that includes parity positions reconstructs
+        through the SAME signature-batched decode machinery, and the
+        ROTATE_WINDOW keeps consecutive reads on one signature so
+        they still coalesce."""
         want = list(range(self.k))
+        count = read_heat.note((pg.pool, oid))
+        rotate = count if count >= self._hot_threshold else None
         try:
-            chunks, attrs = self._read_shards(pg, oid, want)
+            chunks, attrs = self._read_shards(pg, oid, want,
+                                              rotate_count=rotate)
             size = self._attr_size(attrs)
         except Exception as exc:
             cont(None, exc)
@@ -1037,6 +1187,23 @@ class ECBackend(PGBackend):
         if logger is not None:
             logger.inc("degraded_reads")
         missing = [i for i in want if i not in chunks]
+        if ec_util.xor_decodable(self.codec, chunks, missing):
+            # host XOR reconstruction is microseconds for these
+            # signatures — a device staging round-trip (batched or
+            # not) can only lose. This is what keeps the any-k
+            # rotated hot-read sets of single-parity pools near
+            # canonical-read cost.
+            try:
+                dec = ec_util.decode(self.sinfo, self.codec, chunks,
+                                     want)
+                data = self._chunks_to_logical(dec, size)
+            except Exception as exc:
+                cont(None, exc)
+                return
+            if logger is not None:
+                logger.inc("xor_fast_decodes")
+            cont(data, None)
+            return
         if self.device is not None and self.device_codec is not None \
                 and ec_util.device_decodable(self.device_codec):
             span = tracing.current().child("engine_decode")

@@ -119,6 +119,29 @@ def encode(sinfo: StripeInfo, codec, data: bytes | np.ndarray,
     return out
 
 
+def xor_decodable(codec, shards: dict[int, np.ndarray],
+                  missing: list[int]) -> bool:
+    """True when reconstructing ``missing`` from ``shards`` reduces
+    to bitwise XOR — the decode matrix for this erasure signature has
+    only 0/1 coefficients (GF multiply by 1 is identity, GF add is
+    XOR). Single-parity RS and XOR-structured codes hit this on every
+    single-erasure signature; for those a host XOR beats any device
+    staging round-trip, so callers use this to skip the engine.
+    Mirrors decode_chunks' survivor selection (sorted, first k)."""
+    from ceph_tpu.models.matrix_codec import MatrixErasureCode
+    if not missing or not isinstance(codec, MatrixErasureCode):
+        return False
+    have = sorted(shards)
+    k = codec.get_data_chunk_count()
+    if len(have) < k:
+        return False
+    try:
+        dmat = codec._decode_matrix(tuple(have[:k]), tuple(missing))
+    except Exception:
+        return False
+    return bool(((dmat == 0) | (dmat == 1)).all())
+
+
 def decode(sinfo: StripeInfo, codec, shards: dict[int, np.ndarray],
            want: list[int]) -> dict[int, np.ndarray]:
     """Reconstruct wanted shards from surviving per-shard buffers

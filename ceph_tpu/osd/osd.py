@@ -452,6 +452,26 @@ class OSD:
         self._watch_lock = make_lock("osd.watch")
         self._watchers: dict[tuple, dict] = {}
         self._notifies: dict[int, dict] = {}
+        # inval watchers (the librados cache tier's coherence channel,
+        # round 19): (pool, oid) -> {(peer, cookie): conn}. A mutating
+        # op's reply is HELD until every one acked the invalidation
+        # notify or timed out — see _inval_hold
+        self._inval_watchers: dict[tuple, dict] = {}
+        # placement-affine read serving (ROADMAP 3): non-primary
+        # acting members serve plain head reads through per-OSD proxy
+        # PG shells — never the authoritative self.pgs entries, whose
+        # lifecycle (peering, waiting_for_active) is primary-side
+        self._read_pgs: dict[tuple[int, int], PG] = {}
+        self._read_pgs_lock = make_lock("osd.read_pgs")
+        self._read_affinity = bool(g_conf()["objecter_read_affinity"])
+        self._inval_timeout_ms = \
+            int(g_conf()["osd_cache_inval_timeout_ms"])
+        # any-k rotation width (tuner-managed: consumed through a
+        # cached observer, never re-read per op; backends read it via
+        # read_set_spread())
+        self._read_set_spread = int(g_conf()["osd_read_set_spread"])
+        g_conf().add_observer("osd_read_set_spread",
+                              self._on_read_spread)
         self.op_wq = ShardedOpWQ(f"osd.{osd_id}",
                                  g_conf()["osd_op_num_shards"],
                                  after_item=self._drain_store_barrier)
@@ -571,6 +591,24 @@ class OSD:
                              "EC reads that resolved a persistent "
                              "shard-version split (unacked write cut "
                              "short) to a k-agreed version")
+        # the planet-scale read path (round 19): affine serving,
+        # any-k rotation, and the cache tier's write-hold channel
+        perf.add_u64_counter("affine_reads",
+                             "client reads served on a non-primary "
+                             "acting member (placement-affine "
+                             "routing)")
+        perf.add_u64_counter("anyk_rotated_reads",
+                             "EC reads planned on a rotated any-k "
+                             "shard set (hot-object read balance)")
+        perf.add_u64_counter("cache_inval_notifies",
+                             "mutating-op replies held for cache-tier "
+                             "invalidation acks")
+        perf.add_u64_counter("xor_fast_decodes",
+                             "reconstructs served by the host XOR "
+                             "fast path (all-ones decode rows)")
+        perf.add_u64_counter("hot_shard_cache_hits",
+                             "hot-read partner chunks served from the "
+                             "version-checked shard cache (no sub-op)")
         perf.add_time_avg("op_latency", "client op latency")
         return perf
 
@@ -656,6 +694,8 @@ class OSD:
 
     def stop(self) -> None:
         self._stopping = True
+        g_conf().remove_observer("osd_read_set_spread",
+                                 self._on_read_spread)
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=5)
@@ -1088,15 +1128,24 @@ class OSD:
             conn.send_message(M.MWatchAck(tid=msg.tid,
                                           code=EBLOCKLISTED))
             return
+        # inval watches (cache-tier coherence) live in their own
+        # registry: user notifies never fan to them, and only they
+        # hold mutating-op replies (_inval_hold)
+        reg = self._inval_watchers if getattr(msg, "inval", False) \
+            else self._watchers
         with self._watch_lock:
             if msg.watch:
-                self._watchers.setdefault(key, {})[
+                reg.setdefault(key, {})[
                     (conn.peer_name, msg.cookie)] = conn
             else:
-                watchers = self._watchers.get(key, {})
-                watchers.pop((conn.peer_name, msg.cookie), None)
-                if not watchers:
-                    self._watchers.pop(key, None)
+                # unregistration sweeps BOTH registries: the ghost-
+                # watch cleanup path sends watch=False without knowing
+                # which kind the stale cookie was
+                for r in (self._watchers, self._inval_watchers):
+                    watchers = r.get(key, {})
+                    watchers.pop((conn.peer_name, msg.cookie), None)
+                    if not watchers:
+                        r.pop(key, None)
         conn.send_message(M.MWatchAck(tid=msg.tid, code=0))
 
     def _handle_notify(self, msg: M.MNotify, conn: Connection) -> None:
@@ -1156,13 +1205,24 @@ class OSD:
             if ent["pending"]:
                 return
             del self._notifies[notify_id]
+        self._notify_complete(ent)
+
+    @staticmethod
+    def _notify_complete(ent: dict, late: int = 0) -> None:
+        """Deliver a settled notify's completion: the notifier's
+        MNotifyComplete, or — for an internal inval-hold entry — the
+        held reply's ``done`` continuation."""
+        done = ent.get("done")
+        if done is not None:
+            done()
+            return
         ent["conn"].send_message(M.MNotifyComplete(
             tid=ent["tid"], code=0, acked=ent["acked"],
-            missed=ent["missed"]))
+            missed=ent["missed"] + late))
 
     def _sweep_notifies(self) -> None:
         """Timeout expiry (run from the tick): a dead watcher must not
-        block the notifier forever."""
+        block the notifier — or a held mutating-op reply — forever."""
         now = time.monotonic()
         done = []
         with self._watch_lock:
@@ -1171,9 +1231,41 @@ class OSD:
                     done.append(ent)
                     del self._notifies[nid]
         for ent in done:
-            ent["conn"].send_message(M.MNotifyComplete(
-                tid=ent["tid"], code=0, acked=ent["acked"],
-                missed=ent["missed"] + len(ent["pending"])))
+            self._notify_complete(ent, late=len(ent["pending"]))
+
+    def _inval_hold(self, pool: int, oid: str, deliver) -> bool:
+        """Cache-tier write coherence (round 19): fan an invalidation
+        notify to this object's inval watchers and HOLD the mutating
+        op's reply — ``deliver`` runs — until every cached copy acked
+        or the timeout wrote the laggards off. Returns False when
+        nobody inval-watches the object (the common case: one dict
+        probe, no hold). Read-your-writes follows: once the writer's
+        ack arrives, no cache anywhere still serves pre-write bytes."""
+        key = (pool, oid)
+        with self._watch_lock:
+            watchers = dict(self._inval_watchers.get(key, {}))
+            for who, wconn in list(watchers.items()):
+                if getattr(wconn, "closed", False):
+                    watchers.pop(who)
+                    ws = self._inval_watchers.get(key, {})
+                    ws.pop(who, None)
+                    if not ws:
+                        self._inval_watchers.pop(key, None)
+            if not watchers:
+                return False
+            notify_id = self.new_tid()
+            self._notifies[notify_id] = {
+                "done": deliver, "tid": 0, "conn": None,
+                "pending": set(watchers), "acked": 0, "missed": 0,
+                "deadline": time.monotonic() +
+                self._inval_timeout_ms / 1000.0,
+            }
+        self.logger.inc("cache_inval_notifies")
+        for (_peer, cookie), wconn in watchers.items():
+            wconn.send_message(M.MWatchNotify(
+                notify_id=notify_id, pool=pool, oid=oid,
+                cookie=cookie, payload=b"inval"))
+        return True
 
     # -- replica-side handlers ----------------------------------------
     def _handle_sub_write(self, msg: M.MECSubWrite, conn: Connection
@@ -1572,20 +1664,31 @@ class OSD:
             out = M.MOSDOpReply(
                 tid=msg.tid, code=code, epoch=osdmap.epoch, data=data,
                 version=version, stages=clock.to_wire())
-            if msg.op in self._MUTATING_OPS:
-                with self._op_cache_lock:
-                    # execution obligation settled either way: a
-                    # failed op may be re-executed by a resend
-                    self._op_inflight.pop(cache_key, None)
-                    if code == 0:
-                        if cache_key not in self._op_cache:
-                            self._op_cache_order.append(cache_key)
-                        self._op_cache[cache_key] = out
-                        while len(self._op_cache_order) > \
-                                self._OP_CACHE_MAX:
-                            old = self._op_cache_order.pop(0)
-                            self._op_cache.pop(old, None)
-            conn.send_message(out)
+
+            def deliver(code=code, out=out):
+                if msg.op in self._MUTATING_OPS:
+                    with self._op_cache_lock:
+                        # execution obligation settled either way: a
+                        # failed op may be re-executed by a resend
+                        self._op_inflight.pop(cache_key, None)
+                        if code == 0:
+                            if cache_key not in self._op_cache:
+                                self._op_cache_order.append(cache_key)
+                            self._op_cache[cache_key] = out
+                            while len(self._op_cache_order) > \
+                                    self._OP_CACHE_MAX:
+                                old = self._op_cache_order.pop(0)
+                                self._op_cache.pop(old, None)
+                conn.send_message(out)
+
+            # cache-tier coherence: a successful mutation's reply is
+            # held until every inval watcher dropped its cached copy
+            # (the dup-cache insert rides deliver, so a resend racing
+            # the hold cannot leak the ack early)
+            if code == 0 and msg.op in self._MUTATING_OPS and \
+                    self._inval_hold(msg.pool, msg.oid, deliver):
+                return
+            deliver()
 
         pool = osdmap.pools.get(msg.pool)
         if pool is None:
@@ -1595,6 +1698,17 @@ class OSD:
             if msg.op != M.OSD_OP_LIST else msg.ps
         _, acting, primary = osdmap.pg_to_up_acting(msg.pool, ps)
         if primary != self.whoami:
+            if (msg.op == M.OSD_OP_READ and self._read_affinity
+                    and not msg.snapid and not msg.gname
+                    and not pool.is_cache_tier
+                    and self.whoami in acting):
+                # placement-affine routing (ROADMAP 3): any acting
+                # member serves plain head reads — consistency holds
+                # because every acked write committed on EVERY acting
+                # position before the client saw the ack
+                self._serve_affine_read(msg, ps, acting, reply,
+                                        clock=clock, span=span)
+                return
             reply(ESTALE)
             return
         pgid = (msg.pool, ps)
@@ -1642,6 +1756,69 @@ class OSD:
             finally:
                 tracing.set_current(tracing.NOOP)
                 stage_clock.set_current(stage_clock.NOOP)
+
+    def _serve_affine_read(self, msg: M.MOSDOp, ps: int,
+                           acting: list, reply, clock=None,
+                           span=None) -> None:
+        """Serve a plain head read on a NON-PRIMARY acting member
+        (placement-affine routing, ROADMAP 3). The read plans through
+        a proxy PG shell — acting set + backend, nothing else — kept
+        apart from self.pgs, whose entries carry primary-side
+        lifecycle (a later promotion to primary peers from scratch).
+        ANY failure degrades to ESTALE so the client retries at the
+        primary: a replica mid-backfill must not turn its missing
+        local shard into a spurious ENOENT.
+
+        ``clock``/``span`` are the op's stage clock and trace span:
+        the primary path installs them as thread-currents around PG
+        processing (below); this path must do the same or an affine
+        degraded read's engine decode stages under the NOOPs and
+        drops out of the dataplane timeline entirely."""
+        self.logger.inc("op_r")
+        pgid = (msg.pool, ps)
+        with self._read_pgs_lock:
+            pg = self._read_pgs.get(pgid)
+            if pg is None:
+                pg = PG(msg.pool, ps)
+                pg.backend = self.backend_for(msg.pool)
+                pg.state = PG.ACTIVE
+                self._read_pgs[pgid] = pg
+
+        def read_done(data, err, msg=msg, reply=reply):
+            if err is not None:
+                reply(ESTALE)
+                return
+            if msg.length:
+                data = data[msg.offset:msg.offset + msg.length]
+            elif msg.offset:
+                data = data[msg.offset:]
+            self.logger.inc("affine_reads")
+            reply(0, bytes(data))
+
+        try:
+            with pg.lock:
+                pg.acting = list(acting)
+                if span is not None:
+                    tracing.set_current(span)
+                if clock is not None:
+                    stage_clock.set_current(clock)
+                pg.backend.read_object_async(pg, msg.oid, read_done)
+        except Exception:
+            reply(ESTALE)
+        finally:
+            tracing.set_current(tracing.NOOP)
+            stage_clock.set_current(stage_clock.NOOP)
+
+    def _on_read_spread(self, _name: str, value) -> None:
+        try:
+            self._read_set_spread = max(int(value), 1)
+        except (TypeError, ValueError):
+            pass
+
+    def read_set_spread(self) -> int:
+        """Cached osd_read_set_spread (the config observer keeps it
+        hot — backends must never re-read config per op)."""
+        return self._read_set_spread
 
     def _flush_waiting(self, pg: PG) -> None:
         """Re-run parked ops (caller holds pg.lock, state ACTIVE)."""

@@ -296,10 +296,13 @@ class MOSDOpBatch(Message):
     lengths[i], datas[i], traces[i], stages[i]); ``stages`` stays
     per-entry because each op owns its client-side timeline (unlike
     MECSubWriteBatch, whose entries are born on one shared clock).
-    Restricted by the sender to plain data writes — guarded, snap-
-    context, cls and read ops ride singleton MOSDOps. Each entry is
-    individually resendable as a singleton (the OSD's (client, tid)
-    dup-op cache dedups), so the reliability machinery is unchanged."""
+    Restricted by the sender to plain data writes and (round 19)
+    plain head reads — guarded, snap-context and cls ops ride
+    singleton MOSDOps. Read frames target the placement-affine acting
+    member instead of the primary (same-slot reads coalesce; ROADMAP
+    3). Each entry is individually resendable as a singleton (the
+    OSD's (client, tid) dup-op cache dedups mutations; reads are
+    idempotent), so the reliability machinery is unchanged."""
     MSG_TYPE = 69
     FIELDS = [("tid", "u64"), ("client", "str"), ("epoch", "u32"),
               ("pool", "i32"), ("ps", "u32"),
@@ -608,7 +611,12 @@ class MWatch(Message):
               # blocklist fences; admission checks it (r5) — and the
               # client's map epoch so a stale-map OSD parks the
               # registration instead of missing a fresh fence
-              ("client", "str"), ("epoch", "u32")]
+              ("client", "str"), ("epoch", "u32"),
+              # appended round 19 (old readers skip): an INVAL watch —
+              # the client caches this object and wants mutating ops'
+              # replies held until it acknowledged the invalidation
+              # notify (the librados cache tier's coherence channel)
+              ("inval", "bool")]
 
 
 class MWatchAck(Message):

@@ -62,6 +62,12 @@ DIRECTIONS = {
     # gates DOWN (the run-to-completion discipline is the point)
     "crimson_load_gen_MBps": "higher",
     "dispatch_hops_per_op@crimson": "lower",
+    # ISSUE 19: the planet-scale read path — aggregate hot-read GB/s
+    # gates UP (any-k balanced reads are the point) and the client
+    # cache-hit p99 gates DOWN (the name heuristic would catch the
+    # _p99, but the row is the acceptance gate: pin it)
+    "hot_object_read_GBps": "higher",
+    "cache_hit_p99_us": "lower",
 }
 
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import time
 
 #: BASELINE.md "Engine capacity": the chip-measured closed-loop GB/s
 #: used when this host cannot measure it (CPU-only quick runs)
@@ -374,6 +375,17 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
         except Exception as exc:  # pragma: no cover - defensive
             report["crimson"] = {"error":
                                  f"{type(exc).__name__}: {exc}"}
+    # ISSUE 19: the read-path A/B — zipfian storm primary-pinned vs
+    # any-k balanced, with the read_balance verdict row. Also LAST
+    # (fresh clusters of its own) and skippable for quick looks.
+    if not getattr(args, "no_read_balance", False):
+        try:
+            report["read_balance"] = _read_balance_arm(
+                min(seconds, 3.0), max(n_osds, k + m + 1), k, m,
+                backend)
+        except Exception as exc:  # pragma: no cover - defensive
+            report["read_balance"] = {"error":
+                                      f"{type(exc).__name__}: {exc}"}
     return report
 
 
@@ -568,6 +580,149 @@ def _crimson_arm(seconds: float, n_osds: int, obj_size: int,
     }
 
 
+def _read_storm(seconds: float, n_osds: int, k: int, m: int,
+                backend: str, affinity: bool, spread: int,
+                lat_ms: float) -> dict:
+    """One zipfian read-storm pass: boot, write the hot set, inject
+    ``lat_ms`` of store read latency (models a loaded store — the
+    regime where serving capacity binds), storm, and return GB/s +
+    per-OSD serve attribution. Byte-exact-checked throughout."""
+    import concurrent.futures
+
+    import numpy as np
+
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.utils import read_heat
+    from ceph_tpu.utils.config import g_conf
+
+    conf = g_conf()
+    saved = {kk: conf.get(kk) for kk in
+             ("objecter_read_affinity", "osd_read_set_spread",
+              "osd_hot_read_threshold", "client_cache")}
+    conf.set("objecter_read_affinity", affinity)
+    conf.set("osd_read_set_spread", spread)
+    conf.set("osd_hot_read_threshold", 8)
+    conf.set("client_cache", False)
+    read_heat.reset()
+    n_objs, obj_kb, clients, threads = 8, 256, 2, 8
+    payload = b"\x5a" * (obj_kb * 1024)
+    keys = np.minimum(
+        np.random.default_rng(21).zipf(1.6, size=40000) - 1,
+        n_objs - 1)
+    totals = [0] * (clients * threads)
+    try:
+        with MiniCluster(n_osds=n_osds) as c:
+            c.create_ec_pool("rb", k=k, m=m, pg_num=8,
+                             backend=backend, plugin="isa")
+            ios = [c.client().open_ioctx("rb")
+                   for _ in range(clients)]
+            for i in range(n_objs):
+                ios[0].write_full(f"h{i}", payload)
+            rule = c.faults.add("store_latency", oid_prefix="h",
+                                delay_s=lat_ms / 1000.0)
+            stop = time.perf_counter() + seconds
+
+            def worker(w: int) -> None:
+                wio = ios[w % clients]
+                i = w * 997
+                while time.perf_counter() < stop:
+                    oid = f"h{keys[i % len(keys)]}"
+                    assert wio.read(oid) == payload, \
+                        f"read-balance arm: {oid} not byte-exact"
+                    totals[w] += len(payload)
+                    i += 1
+
+            t0 = time.perf_counter()
+            try:
+                with concurrent.futures.ThreadPoolExecutor(
+                        clients * threads) as pool:
+                    list(pool.map(worker, range(clients * threads)))
+                elapsed = max(time.perf_counter() - t0, 1e-6)
+            finally:
+                rule.remove()
+            per_osd = {o: osd.logger.get("op_r")
+                       for o, osd in sorted(c.osds.items())}
+            rotated = sum(osd.logger.get("anyk_rotated_reads")
+                          for osd in c.osds.values())
+            cache_hits = sum(osd.logger.get("hot_shard_cache_hits")
+                             for osd in c.osds.values())
+    finally:
+        for kk, vv in saved.items():
+            conf.set(kk, vv)
+    serves = [v for v in per_osd.values() if v]
+    mean = sum(serves) / len(serves) if serves else 0.0
+    return {"GBps": round(sum(totals) / elapsed / 1e9, 4),
+            "reads": int(sum(totals) // len(payload)),
+            "per_osd_op_r": per_osd,
+            "serve_imbalance": round(max(serves) / mean, 2)
+            if serves else None,
+            "anyk_rotated_reads": rotated,
+            "hot_shard_cache_hits": cache_hits,
+            "heat_skew": read_heat.snapshot_brief(top=3).get("skew")}
+
+
+def _read_balance_arm(seconds: float, n_osds: int, k: int, m: int,
+                      backend: str) -> dict:
+    """ISSUE 19 acceptance arm: the SAME zipfian read storm primary-
+    pinned (affinity off, spread 1 — the pre-fix routing) vs any-k
+    (affine routing + rotated read sets + the hot-shard cache), with
+    store read latency injected so serving capacity — not the in-
+    process client — is the binding constraint. The verdict row says
+    whether balanced reads actually moved aggregate GB/s, not just
+    the per-OSD serve histogram."""
+    lat_ms = 25.0
+    if n_osds < k + m + 1:
+        return {"skipped": f"n_osds {n_osds} < k+m+1 {k + m + 1} "
+                           "(rotation needs a spare position)"}
+    primary = _read_storm(seconds, n_osds, k, m, backend,
+                          affinity=False, spread=1, lat_ms=lat_ms)
+    anyk = _read_storm(seconds, n_osds, k, m, backend,
+                       affinity=True, spread=3, lat_ms=lat_ms)
+    ratio = round(anyk["GBps"] / primary["GBps"], 2) \
+        if primary["GBps"] else None
+    flatter = (primary["serve_imbalance"] or 0) > \
+        (anyk["serve_imbalance"] or 0)
+    if ratio is not None and ratio >= 1.0 and flatter:
+        verdict = "balanced"
+    elif flatter:
+        # serves spread but GB/s did not follow — the client side or
+        # noise is binding at this scale
+        verdict = "balanced-no-speedup"
+    else:
+        verdict = "primary-pinned"
+    return {"primary": primary, "anyk": anyk,
+            "win_x_vs_primary": ratio,
+            "store_latency_ms": lat_ms,
+            "verdict": verdict}
+
+
+def _print_read_balance(report: dict) -> None:
+    arm = report.get("read_balance")
+    if not arm:
+        return
+    print()
+    print("--- read balance (zipfian storm, primary vs any-k) ---")
+    if "error" in arm:
+        print(f"  arm failed: {arm['error']}")
+        return
+    if "skipped" in arm:
+        print(f"  arm skipped: {arm['skipped']}")
+        return
+    p, a = arm["primary"], arm["anyk"]
+    print(f"  primary-pinned: {p['GBps']} GB/s   "
+          f"imbalance {p['serve_imbalance']}x   "
+          f"op_r {p['per_osd_op_r']}")
+    print(f"  any-k:          {a['GBps']} GB/s   "
+          f"imbalance {a['serve_imbalance']}x   "
+          f"op_r {a['per_osd_op_r']}")
+    print(f"  any-k serves:   rotated {a['anyk_rotated_reads']}   "
+          f"hot-shard cache hits {a['hot_shard_cache_hits']}   "
+          f"heat skew {a['heat_skew']}")
+    print(f"  verdict:        {arm['win_x_vs_primary']}x vs primary "
+          f"(store_latency {arm['store_latency_ms']}ms)  -> "
+          f"{arm['verdict']}")
+
+
 def _print_dispatch(report: dict) -> None:
     """The dispatch X-ray block (ISSUE 17): residual commit_wait
     sliced by dispatch-machinery kind, the hop/wakeup/lock-wait
@@ -604,6 +759,7 @@ def _print_dispatch(report: dict) -> None:
               f"({rtc.get('saved_ms_per_op')} ms/op) -> projected "
               f"{rtc.get('whatif_rtc_MBps')} MB/s")
     _print_crimson(report)
+    _print_read_balance(report)
 
 
 def main(argv=None) -> int:
@@ -636,6 +792,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-crimson", action="store_true",
                     help="skip the measured crimson arm (and its "
                          "projection-honesty row)")
+    ap.add_argument("--no-read-balance", action="store_true",
+                    help="skip the primary-vs-any-k read storm "
+                         "(and its read_balance verdict row)")
     args = ap.parse_args(argv)
     if args.full:
         args.osds, args.k, args.m = 12, 8, 3

@@ -532,6 +532,36 @@ for _o in [
            "distinct folded stacks the profiler holds (fixed "
            "memory; overflow aggregates under one sentinel key)",
            min=1),
+    Option("objecter_read_affinity", bool, True, "advanced",
+           "route reads to the placement-affine acting-set member "
+           "(the slot owner under parallel/placement's CRUSH-stable "
+           "hash) instead of pinning every read on the primary; "
+           "servers serve affine reads from any acting member and "
+           "the client falls back to primary routing on ESTALE"),
+    Option("osd_read_set_spread", int, 1, "advanced",
+           "any-k balanced reads: distinct rotated k-of-(k+m) shard "
+           "read sets a hot object's reads spread across (1 = the "
+           "primary-preferred set only; tuner-managed, stepped on "
+           "measured per-object read skew)", min=1, max=16),
+    Option("osd_hot_read_threshold", int, 8, "advanced",
+           "reads of one object before the EC backend starts "
+           "rotating its read set (cold objects keep the canonical "
+           "set so their decode signatures stay shared)", min=1),
+    Option("client_cache", bool, False, "advanced",
+           "librados-level object cache tier: reads fill a "
+           "client-side extent cache kept coherent by per-object "
+           "inval watches (writers' acks are held until cached "
+           "copies are invalidated — read-your-writes under "
+           "concurrent writers). Default off: rbd/striper attach "
+           "their own caches"),
+    Option("client_cache_bytes", int, 32 << 20, "advanced",
+           "librados object-cache capacity per client, bytes "
+           "(tuner-managed: stepped on measured hit rate)",
+           min=1 << 20),
+    Option("osd_cache_inval_timeout_ms", int, 2000, "advanced",
+           "how long a mutating op's reply may be held waiting for "
+           "cache-invalidation acks from inval watchers before the "
+           "laggards are written off as missed", min=50),
 ]:
     SCHEMA.add(_o)
 

@@ -171,6 +171,14 @@ TUNER_KNOBS = KnobRegistry([
          desc="streaming-objecter batch window: writes coalesced "
               "per (pool, PG) frame — batching amortization vs "
               "head-of-line latency (ROADMAP 1b/5d)"),
+    Knob("osd_read_set_spread", lo=1, hi=8, step=1, kind="add",
+         cooldown_s=3.0, subsystem="osd/ec_backend",
+         desc="any-k read-set rotation width: hot-object read "
+              "balance vs decode-signature reuse (ROADMAP 3)"),
+    Knob("client_cache_bytes", lo=8 << 20, hi=256 << 20, step=2.0,
+         kind="mul", cooldown_s=3.0, subsystem="client/object_cacher",
+         desc="librados cache-tier capacity: hit rate vs client "
+              "memory (stepped on measured hit rate)"),
     Knob("trace_sample_every", lo=8, hi=1024, step=2.0, kind="mul",
          cooldown_s=6.0, subsystem="utils/tracing",
          desc="head-sample keep rate: observability vs overhead"),

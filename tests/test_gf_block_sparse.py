@@ -177,8 +177,12 @@ def test_matrix_codec_zero_column_pruning():
     from ceph_tpu.models.matrix_codec import MatrixErasureCode
 
     class _LocalParity(MatrixErasureCode):
+        # GF coefficients 2 and 3 keep the decode rows off the
+        # all-ones XOR fast path, which would bypass _matvec and
+        # hide the pruning this test pins (the XOR path is pinned
+        # separately below).
         def init(self, profile):
-            self._setup(4, 2, np.array([[1, 1, 0, 0], [0, 0, 1, 1]],
+            self._setup(4, 2, np.array([[1, 2, 0, 0], [0, 0, 1, 3]],
                                        dtype=np.uint8), profile)
 
     codec = _LocalParity()
@@ -202,6 +206,24 @@ def test_matrix_codec_zero_column_pruning():
     # chunk 0 depends only on its local group {1, parity 4}: the
     # decode matmul must have shrunk from 4 survivor rows to 2
     assert shapes and shapes[-1][0][1] == 2, shapes
+
+    # an ALL-ONES local parity reconstructs by plain XOR: _matvec
+    # must not run at all, and the result stays byte-identical
+    class _XorParity(MatrixErasureCode):
+        def init(self, profile):
+            self._setup(4, 2, np.array([[1, 1, 0, 0], [0, 0, 1, 1]],
+                                       dtype=np.uint8), profile)
+
+    xcodec = _XorParity()
+    xcodec.init({"backend": "numpy"})
+    xenc = xcodec.encode_chunks([4, 5], data)
+    xhave = {1: data[1], 2: data[2], 3: data[3],
+             4: xenc[4], 5: xenc[5]}
+    shapes.clear()
+    with mock.patch.object(MatrixErasureCode, "_matvec", spy):
+        xout = xcodec.decode_chunks([0], xhave)
+    assert np.array_equal(xout[0], data[0])
+    assert shapes == [], shapes
 
     # dense RS: pruning must not engage (every column nonzero)
     rs = ErasureCodeJerasure()
