@@ -86,6 +86,12 @@ BUDGETS = {
     # cache, plus the microsecond cache-hit p99 row. Cluster-level,
     # wall-clock-budgeted.
     "hot_object_read": (35.0, 0.0),
+    # ISSUE 20: the tenant-fairness row — a named-tenant mix with one
+    # scripted hot tenant starved past the client's patience, scoring
+    # the Jain index + demand/served shares and asserting the
+    # FLOW_STARVATION health check fires. Cluster-level, wall-clock-
+    # budgeted.
+    "multi_tenant": (35.0, 0.0),
 }
 
 #: global sampling deadline (seconds from process start). Sampling
@@ -110,7 +116,9 @@ BUDGETS = {
 #: r24: 320 -> 285 absorbs the hot_object_read row's reservation
 #: (ISSUE 19; three short cluster bursts — host-path work, its EC
 #: decodes ride programs the earlier rows already warmed)
-TOTAL_BUDGET = 285.0
+#: r25: 285 -> 250 absorbs the multi_tenant row's reservation (ISSUE
+#: 20; one host-path cluster burst — no device programs of its own)
+TOTAL_BUDGET = 250.0
 
 #: tunnel worst-case seconds for ONE cold per-signature compile
 COLD_COMPILE_S = 35.0
@@ -398,6 +406,16 @@ def main() -> None:
                 "value": None, "unit": "us", "p50_us": None,
                 "hit_rate": None, "samples": 0, "error": repr(exc)})
 
+    try:
+        _bench_multi_tenant()
+    except Exception as exc:  # the ISSUE-20 row must still land,
+        # schema-complete (the degraded_read error-row convention)
+        if "multi_tenant_fairness" not in _RESULTS:
+            emit("multi_tenant_fairness", {
+                "value": None, "unit": "jain", "tenants": None,
+                "starved": None, "flow_starvation_raised": None,
+                "error": repr(exc)})
+
     if any_contended:
         # independent chip-health probe (different program, same
         # chip): a low number here confirms the collapse is
@@ -492,6 +510,13 @@ def _combined(any_contended: bool) -> dict:
                    "error"):
             if k2 in chp:
                 out["cache_hit_p99_" + k2] = chp[k2]
+    mt = _RESULTS.get("multi_tenant_fairness")
+    if mt:
+        for k2 in ("value", "starved", "flow_starvation_raised",
+                   "attribution_ops_pct", "attribution_bytes_pct",
+                   "error"):
+            if k2 in mt:
+                out["multi_tenant_" + k2] = mt[k2]
     probe = _RESULTS.get("xla_probe_GBps")
     if probe:
         out["xla_probe_GBps"] = probe["value"]
@@ -1431,6 +1456,76 @@ def _bench_hot_object_read() -> None:
         "hit_rate": round(cs.get("hits", 0) / lookups, 3)
         if lookups else None,
         "samples": len(lats),
+    })
+
+
+def _bench_multi_tenant() -> None:
+    """ISSUE 20: the tenant-fairness row. A named-tenant zipfian mix
+    (three tenants over per-tenant keyspaces, ``acme`` scripted hot
+    at 4x arrival share) against a threaded MiniCluster, with store
+    latency injected on the hot tenant's keyspace BEYOND its clients'
+    patience — every hot op's demand is noted at submit but the op
+    times out unserved, so the windowed fairness ledger starves the
+    flow for real and FLOW_STARVATION raises through the live health
+    engine. ``value`` is the Jain index over per-flow service ratios
+    (higher = fairer — a regression that silently starves MORE trips
+    bench_trend downward); demand/served shares, per-tenant p99s,
+    the starvation verdict, health status and attribution coverage
+    ride the line."""
+    budget, _ = BUDGETS["multi_tenant"]
+    deadline = min(_deadline(), time.perf_counter() + budget)
+    remaining = max(deadline - time.perf_counter(), 6.0)
+    phase_s = max(1.5, min(4.0, remaining / 4))
+    from ceph_tpu.bench.load_gen import LoadGen, LoadSpec
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.utils import flow_telemetry as _flow_tel
+    tel = _flow_tel.telemetry_if_exists()
+    if tel is not None:
+        tel.reset()            # the row attributes THIS burst only
+    t0 = time.perf_counter()
+    tenants = ("acme", "globex", "initech")
+    with MiniCluster(n_osds=3) as cluster:
+        cluster.create_ec_pool("mt", k=2, m=1, pg_num=8,
+                               backend="jax")
+        spec = LoadSpec(n_keys=8, obj_size=32768, read_frac=0.5,
+                        concurrency=4, phase_seconds=phase_s,
+                        seed=13, tenants=tenants, hot_tenant="acme",
+                        hot_factor=4.0, tenant_keyspaces=True)
+        gen = LoadGen(cluster, "mt", spec)
+        gen.health.evaluate(gen._status(),
+                            cluster.mon.osdmap)      # arm deltas
+        gen.preload()          # BEFORE the fault rule: tagged, fast
+        # scripted starvation: acme's keyspace answers slower than
+        # acme's clients are willing to wait
+        gen._tenant_ios["acme"].op_timeout = 0.3
+        rule = cluster.faults.add("store_latency", oid_prefix="acme_",
+                                  delay_s=0.5)
+        try:
+            gen._run_phase("healthy", phase_s)
+        finally:
+            rule.remove()
+            gen._tenant_ios["acme"].op_timeout = spec.op_timeout
+        out = gen.report()
+    healthy = out["phases"][0]
+    tb = healthy.get("tenants") or {}
+    checks = (healthy.get("health") or {}).get("checks") or {}
+    tel = _flow_tel.telemetry_if_exists()
+    attr = tel.attribution() if tel is not None else {}
+    emit("multi_tenant_fairness", {
+        "value": tb.get("jain_index"),
+        "unit": "jain",
+        "tenants": tb.get("per_tenant"),
+        "starved": tb.get("starved"),
+        "flow_starvation_raised": "FLOW_STARVATION" in checks,
+        "health": (healthy.get("health") or {}).get("status"),
+        "hot_tenant": "acme",
+        "hot_factor": 4.0,
+        "phase_seconds": round(phase_s, 2),
+        "attribution_ops_pct": attr.get("ops_pct"),
+        "attribution_bytes_pct": attr.get("bytes_pct"),
+        "lost_acked": len(out["verify"]["lost_acked"]),
+        "wrong_bytes": len(out["verify"]["wrong_bytes"]),
+        "wall_s": round(time.perf_counter() - t0, 1),
     })
 
 

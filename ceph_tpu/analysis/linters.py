@@ -45,6 +45,12 @@ the *static twin* of a runtime contract this repo already gates:
    static twin of the runtime hop counters (``wq_continuation == 0``)
    and the lock witness.
 
+7. **flow context** (ISSUE 20) — every enqueue seam accepting a
+   ``qos=`` parameter must thread the per-tenant flow context
+   (``capture_flow``/``current_flow``) across the handoff; one that
+   doesn't silently drops the tenant label and erodes the >=95%%
+   attribution coverage gate.
+
 Findings diff against the justified allowlist in
 ``analysis/baseline.json``; any NEW finding (or a stale baseline
 entry) fails ``tests/test_static_analysis.py`` in tier-1. Keys carry
@@ -1149,6 +1155,62 @@ def check_reactor_affinity(src: SourceFile) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 7. flow context (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+#: the module that DEFINES the flow-context seam — its own helpers
+#: take ``qos`` by construction and are exempt
+FLOW_SEAM_MODULE = "ceph_tpu/utils/flow_telemetry.py"
+
+
+def check_flow_context(src: SourceFile) -> list[Finding]:
+    """Every enqueue seam that accepts a ``qos=`` parameter must
+    thread the flow context across the handoff (ISSUE 20): a queue
+    admission point classifies the op for scheduling, which is exactly
+    where the submitting thread's flow label dies unless the seam
+    captures it (``flow_telemetry.capture_flow(qos)``) or reads it
+    (``current_flow()``) into whatever rides the queue. A ``qos``
+    parameter with neither is a per-tenant attribution hole: every op
+    through it lands in the unattributed bucket and the gap_report
+    coverage gate erodes silently. Static twin of the >=95%%
+    ops+bytes attribution acceptance run."""
+    rel = src.rel.replace(os.sep, "/")
+    if rel == FLOW_SEAM_MODULE:
+        return []
+    findings: list[Finding] = []
+
+    def visit(node: ast.AST, owner: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            name = owner
+            if isinstance(child, ast.ClassDef):
+                name = child.name
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                args = child.args
+                params = {a.arg for a in (args.posonlyargs + args.args
+                                          + args.kwonlyargs)}
+                if "qos" in params:
+                    seg = ast.get_source_segment(src.text, child) or ""
+                    if "capture_flow" not in seg and \
+                            "current_flow" not in seg:
+                        qual = f"{owner}.{child.name}" if owner \
+                            else child.name
+                        findings.append(Finding(
+                            "flow_context", src.rel, child.lineno,
+                            f"flow_context:{rel}:{qual}",
+                            f"{qual}: accepts qos= but never threads "
+                            "the flow context (capture_flow/"
+                            "current_flow) — ops crossing this seam "
+                            "lose their tenant label and land "
+                            "unattributed"))
+                name = child.name
+            visit(child, name)
+
+    visit(src.tree, "")
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver + baseline
 # ---------------------------------------------------------------------------
 
@@ -1165,6 +1227,7 @@ def run_all(root: str = PKG_ROOT,
         findings.extend(check_notify_under_lock(src))
         findings.extend(check_fsync_seam(src))
         findings.extend(check_reactor_affinity(src))
+        findings.extend(check_flow_context(src))
         drift.collect(src)
     findings.extend(drift.findings())
     findings.sort(key=lambda f: (f.path, f.line, f.key))

@@ -55,6 +55,7 @@ from bisect import bisect_right
 from dataclasses import dataclass, field
 
 from ceph_tpu.utils import checksum
+from ceph_tpu.utils import flow_telemetry as _flow_tel
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dout import Dout
 
@@ -147,6 +148,22 @@ class LoadSpec:
     #: None = read from config degraded_qos_p99_ms
     qos_p99_ms: float | None = None
     op_timeout: float = 30.0
+    #: named tenant flows (ISSUE 20): every op is deterministically
+    #: assigned one of these labels and submitted through a flow-
+    #: tagged ioctx; () = untagged single-tenant traffic
+    tenants: tuple = ()
+    #: scripted hot tenant: this label's arrival share is
+    #: ``hot_factor`` x each other tenant's — the skew that drives
+    #: the multi_tenant_fairness bench row and FLOW_STARVATION
+    hot_tenant: str | None = None
+    hot_factor: float = 4.0
+    #: fairness-window roll period (s) while tenants are configured
+    window_seconds: float = 0.25
+    #: per-tenant key namespaces: each tenant's zipfian stream runs
+    #: over its OWN objects (``<tenant>_<rank>``), so a fault rule
+    #: can target one tenant's keyspace — the scripted-starvation
+    #: harness the multi_tenant_fairness bench row uses
+    tenant_keyspaces: bool = False
 
 
 @dataclass
@@ -159,6 +176,10 @@ class _State:
     issued: dict = field(default_factory=dict)
     #: key -> acked write tokens (write_full returned)
     acked: dict = field(default_factory=dict)
+    #: key -> tenant whose tagged ioctx acked the last write; the
+    #: durability sweep reads back through the same tenant so the
+    #: verify ops stay attributed (ISSUE 20 coverage bar)
+    owner: dict = field(default_factory=dict)
     corruptions: list = field(default_factory=list)
 
 
@@ -173,6 +194,23 @@ class LoadGen:
         self.zipf = Zipf(self.spec.n_keys, self.spec.zipf_theta)
         self.io = cluster.client().open_ioctx(pool)
         self.io.op_timeout = self.spec.op_timeout
+        # per-tenant ioctxs (ISSUE 20): one flow-tagged handle per
+        # named tenant; weighted inverse-CDF pick per op index keeps
+        # the tenant stream deterministic like the key/kind streams
+        self._tenant_ios: dict[str, object] = {}
+        self._tenant_cum: list[float] = []
+        if self.spec.tenants:
+            weights = [self.spec.hot_factor
+                       if t == self.spec.hot_tenant else 1.0
+                       for t in self.spec.tenants]
+            total, acc = sum(weights), 0.0
+            for t, w in zip(self.spec.tenants, weights):
+                acc += w / total
+                self._tenant_cum.append(acc)
+                tio = cluster.client().open_ioctx(pool)
+                tio.op_timeout = self.spec.op_timeout
+                tio.set_flow(t)
+                self._tenant_ios[t] = tio
         self.state = _State()
         self._next_token = [0]
         self._token_lock = threading.Lock()
@@ -204,32 +242,61 @@ class LoadGen:
                            for n, c in rep["checks"].items()}}
 
     # -- workload -----------------------------------------------------
+    def _tenant_for(self, n: int) -> str:
+        """Deterministic weighted tenant pick for op index ``n``
+        ('' when no tenants are configured)."""
+        if not self._tenant_cum:
+            return ""
+        u = _hash01(self.spec.seed, "tenant", n)
+        idx = min(bisect_right(self._tenant_cum, u),
+                  len(self._tenant_cum) - 1)
+        return self.spec.tenants[idx]
+
     def preload(self) -> None:
         """Token-0 write of every key so reads always have a target
-        (counts as acked writes for the durability sweep)."""
+        (counts as acked writes for the durability sweep). With
+        tenants configured the preload round-robins the tagged
+        ioctxs, so attribution coverage includes these writes."""
+        tenants = self.spec.tenants
         for r in range(self.spec.n_keys):
-            key = f"lg_{r:05d}"
-            tok = self._take_token()
-            with self.state.lock:
-                self.state.issued.setdefault(key, []).append(tok)
-            self.io.write_full(key, payload_for(key, tok,
-                                                self.spec.obj_size))
-            with self.state.lock:
-                self.state.acked.setdefault(key, []).append(tok)
+            for t in (tenants if self.spec.tenant_keyspaces and tenants
+                      else (None,)):
+                if t is None:
+                    key = f"lg_{r:05d}"
+                    owner = tenants[r % len(tenants)] if tenants else ""
+                    io = self._tenant_ios[owner] if tenants else self.io
+                else:
+                    key = f"{t}_{r:05d}"
+                    owner = t
+                    io = self._tenant_ios[t]
+                tok = self._take_token()
+                with self.state.lock:
+                    self.state.issued.setdefault(key, []).append(tok)
+                io.write_full(key, payload_for(key, tok,
+                                               self.spec.obj_size))
+                with self.state.lock:
+                    self.state.acked.setdefault(key, []).append(tok)
+                    if owner:
+                        self.state.owner[key] = owner
 
     def _take_token(self) -> int:
         with self._token_lock:
             self._next_token[0] += 1
             return self._next_token[0]
 
-    def _one_op(self, n: int, lats: list, errors: list) -> None:
+    def _one_op(self, n: int, lats: list, errors: list,
+                tlats: dict | None = None) -> None:
         spec = self.spec
-        key = f"lg_{self.zipf.rank(_hash01(spec.seed, 'key', n)):05d}"
+        rank = self.zipf.rank(_hash01(spec.seed, "key", n))
         is_read = _hash01(spec.seed, "rw", n) < spec.read_frac
+        tenant = self._tenant_for(n)
+        key = f"{tenant}_{rank:05d}" \
+            if spec.tenant_keyspaces and tenant else f"lg_{rank:05d}"
+        io = self._tenant_ios.get(tenant, self.io)
         t0 = time.monotonic()
         try:
             if is_read:
-                data = self.io.read(key)
+                data = io.read(key)
                 try:
                     k, tok = verify_payload(data)
                     if k != key:
@@ -245,15 +312,20 @@ class LoadGen:
                 tok = self._take_token()
                 with self.state.lock:
                     self.state.issued.setdefault(key, []).append(tok)
-                self.io.write_full(
+                io.write_full(
                     key, payload_for(key, tok, spec.obj_size))
                 with self.state.lock:
                     self.state.acked.setdefault(key, []).append(tok)
+                    if tenant:
+                        self.state.owner[key] = tenant
         except Exception as exc:
             errors.append(f"{'read' if is_read else 'write'} {key}: "
                           f"{type(exc).__name__}")
         finally:
-            lats.append(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            lats.append(dt)
+            if tlats is not None and tenant:
+                tlats.setdefault(tenant, []).append(dt)
             with self.state.lock:
                 self.state.ops_done += 1
 
@@ -262,6 +334,7 @@ class LoadGen:
         spec = self.spec
         lats: list[float] = []
         errors: list[str] = []
+        tlats: dict[str, list[float]] = {}
         deadline = time.monotonic() + seconds
         stop = threading.Event()
         pace = (spec.concurrency / spec.open_loop_rate
@@ -273,7 +346,8 @@ class LoadGen:
                 with self.state.lock:
                     n = self.state.op_seq
                     self.state.op_seq += 1
-                self._one_op(n, lats, errors)
+                self._one_op(n, lats, errors,
+                             tlats if spec.tenants else None)
                 if pace:
                     # open loop: hold this worker to its share of the
                     # arrival rate; a slow op eats its own slack first
@@ -290,8 +364,16 @@ class LoadGen:
             t.start()
         # fault-schedule pump: actions due by workload time/op count
         # fire mid-phase (the registry logs them; we execute them)
+        next_roll = time.monotonic() + spec.window_seconds
         while time.monotonic() < deadline:
             time.sleep(0.05)
+            # fairness windows roll on the pump, never implicitly —
+            # starvation streaks advance at a deterministic cadence
+            if spec.tenants and time.monotonic() >= next_roll:
+                next_roll += spec.window_seconds
+                ft = _flow_tel.telemetry_if_exists()
+                if ft is not None:
+                    ft.roll_window()
             if on_action is not None:
                 with self.state.lock:
                     done = self.state.ops_done
@@ -318,11 +400,37 @@ class LoadGen:
                      f"closed x{spec.concurrency}"),
             "health": self.health_brief(),
         }
+        if spec.tenants:
+            report["tenants"] = self._tenant_brief(tlats)
         self.phase_reports.append(report)
         log(1, f"load_gen phase {name}: {report['ops']} ops, "
             f"p99={report['p99_ms']}ms, "
             f"health={report['health']['status']}")
         return report
+
+    def _tenant_brief(self, tlats: dict) -> dict:
+        """Per-tenant phase metrics (ISSUE 20): the phase's own p50/
+        p99 per tenant joined with the flow registry's cumulative
+        served/demand shares + Jain's index."""
+        fair = {"flows": {}, "jain_index": 1.0}
+        ft = _flow_tel.telemetry_if_exists()
+        if ft is not None:
+            fair = ft.fairness()
+        per = {}
+        for t in self.spec.tenants:
+            ls = tlats.get(t, [])
+            frow = fair["flows"].get(t, {})
+            per[t] = {"ops": len(ls),
+                      "p50_ms": percentile_ms(ls, 50),
+                      "p99_ms": percentile_ms(ls, 99),
+                      "demand_share": frow.get("demand_share", 0.0),
+                      "served_share": frow.get("served_share", 0.0),
+                      "service_ratio": frow.get("service_ratio", 0.0),
+                      "hot": t == self.spec.hot_tenant}
+        return {"per_tenant": per,
+                "jain_index": fair["jain_index"],
+                "starved": sorted(ft.starved_flows())
+                if ft is not None else []}
 
     def _exec_action(self, act: dict) -> None:
         if act["action"] == "kill_osd":
@@ -392,11 +500,15 @@ class LoadGen:
         with self.state.lock:
             acked = {k: list(v) for k, v in self.state.acked.items()}
             issued = {k: list(v) for k, v in self.state.issued.items()}
+            owner = dict(self.state.owner)
         for key, toks in acked.items():
             if not toks:
                 continue
             try:
-                data = self.io.read(key)
+                # read back through the last-acking tenant's tagged
+                # ioctx so the sweep's ops stay attributed (ISSUE 20)
+                io = self._tenant_ios.get(owner.get(key), self.io)
+                data = io.read(key)
                 k, tok = verify_payload(data)
                 if k != key or tok not in issued.get(key, []):
                     wrong.append(f"{key}: read back ({k}, {tok})")
@@ -456,6 +568,11 @@ def main(argv=None) -> int:
     ap.add_argument("--m", type=int, default=1)
     ap.add_argument("--backend", default=None,
                     help="EC profile backend (e.g. jax/pallas)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="run N named tenant flows (tn0..tnN-1) "
+                         "with tn0 scripted hot")
+    ap.add_argument("--hot-factor", type=float, default=4.0,
+                    help="hot tenant's arrival-share multiplier")
     args = ap.parse_args(argv)
     conf = g_conf()
     conf.set("osd_heartbeat_interval", 0.25)
@@ -465,12 +582,16 @@ def main(argv=None) -> int:
         extra = {"backend": args.backend} if args.backend else {}
         cluster.create_ec_pool("lg", k=args.k, m=args.m, pg_num=8,
                                **extra)
+        tenants = tuple(f"tn{i}" for i in range(args.tenants))
         spec = LoadSpec(n_keys=args.keys,
                         obj_size=int(args.obj_kb * 1024),
                         read_frac=args.read_frac,
                         concurrency=args.concurrency,
                         open_loop_rate=args.rate,
-                        phase_seconds=args.seconds, seed=args.seed)
+                        phase_seconds=args.seconds, seed=args.seed,
+                        tenants=tenants,
+                        hot_tenant=tenants[0] if tenants else None,
+                        hot_factor=args.hot_factor)
         gen = LoadGen(cluster, "lg", spec)
         out = gen.run()
         print(json.dumps(out, default=str), flush=True)

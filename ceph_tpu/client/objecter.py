@@ -50,6 +50,7 @@ from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.store_telemetry import telemetry as _store_tel
 from ceph_tpu.utils.dispatch_telemetry import telemetry as _dsp_tel
+from ceph_tpu.utils import flow_telemetry as _flows
 
 log = Dout("objecter")
 
@@ -261,7 +262,7 @@ class Objecter:
                   snap_seq: int = 0, snaps: list | tuple = (),
                   snapid: int = 0, xname: str = "", xop: int = 0,
                   gname: str = "", gop: int = 0, gval: bytes = b"",
-                  gflags: int = 0,
+                  gflags: int = 0, flow: str = "",
                   timeout: float = 30.0) -> M.MOSDOpReply:
         """Synchronous submit (the aio variant is just this on a
         thread); raises ObjecterError on errno replies."""
@@ -285,6 +286,12 @@ class Objecter:
         span = tracer().new_trace(f"osd_op(op={op} oid={oid})",
                                   self.msgr.entity_name,
                                   op_type=f"osd_op_{op}")
+        # flow attribution (ISSUE 20): the tenant label rides the op
+        # end to end; with flows disabled the wire field stays "" and
+        # nothing is accounted (the literal-NOOP contract)
+        ft = _flows.flows_if_active()
+        if ft is None:
+            flow = ""
         msg = M.MOSDOp(tid=tid, client=self.client_id, epoch=0,
                        pool=pool, ps=max(ps, 0), oid=oid, op=op,
                        offset=offset, length=length, data=bytes(data),
@@ -292,7 +299,12 @@ class Objecter:
                        snap_seq=snap_seq, snaps=list(snaps),
                        snapid=snapid, xname=xname, xop=xop,
                        gname=gname, gop=gop, gval=bytes(gval),
-                       gflags=gflags)
+                       gflags=gflags, flow=flow)
+        if ft is not None and flow:
+            try:
+                ft.note_demand(flow, nbytes=len(data))
+            except Exception:
+                pass   # telemetry faults never cost an op
         clock.mark("objecter_encode")
         # the messenger marks send_queue_wait and serializes the
         # marks-so-far into msg.stages right before the frame build
@@ -390,6 +402,14 @@ class Objecter:
                     # derived from the merged timeline — no new wire
                     # fields
                     _dsp_tel().note_op_chain(timeline.dump())
+                except Exception:
+                    pass
+            if ft is not None and flow:
+                try:
+                    # the fairness ledger's served half: demand was
+                    # noted at submit, so a starved flow's deficit is
+                    # exactly its unserved backlog
+                    ft.note_served(flow, nbytes=len(reply.data or b""))
                 except Exception:
                     pass
             return reply
@@ -553,7 +573,10 @@ class Objecter:
             lengths=[r.msg.length for r in recs],
             datas=[r.msg.data for r in recs],
             traces=[r.msg.trace for r in recs],
-            stages=stages)
+            stages=stages,
+            # per-entry flow labels (ISSUE 20): coalescing must not
+            # lose attribution — each entry keeps its own tenant
+            flows=[r.msg.flow for r in recs])
         try:
             _store_tel().note_stream_batch(len(recs))
         except Exception:

@@ -22,6 +22,7 @@ from ceph_tpu.client.objecter import Objecter, ObjecterError
 from ceph_tpu.parallel import messages as M
 from ceph_tpu.parallel.messenger import Messenger
 from ceph_tpu.parallel.mon_client import MonClient
+from ceph_tpu.utils import flow_telemetry as _flow_tel
 from ceph_tpu.utils.config import g_conf
 
 _client_seq = [0]
@@ -55,10 +56,24 @@ class IoCtx:
         #: so device-kernel compile stalls slow ops instead of
         #: failing them
         self.op_timeout: float | None = None
+        #: tenant/flow label stamped on every op this ioctx submits
+        #: (ISSUE 20; falls back to the client-level label, then to
+        #: the thread's ambient flow context)
+        self.flow: str | None = None
+
+    def set_flow(self, label: str | None) -> None:
+        """Tag subsequent ops from this ioctx with a tenant/flow
+        label ('' or None clears back to the client default)."""
+        self.flow = label or None
+
+    def _flow_label(self) -> str:
+        return (self.flow or self.client.flow
+                or _flow_tel.current_flow() or "")
 
     def _submit(self, oid: str, op: int, **kw) -> M.MOSDOpReply:
         if self.op_timeout is not None:
             kw.setdefault("timeout", self.op_timeout)
+        kw.setdefault("flow", self._flow_label())
         # cache-tier overlay (OSDMap read_tier/write_tier role): object
         # ops against a base pool with an overlay go to the CACHE pool;
         # its OSDs promote on miss and the agent writes back. PGLS
@@ -457,6 +472,9 @@ class RadosClient:
         self.msgr = Messenger(name)
         self.monc = MonClient(self.msgr, mon_addr)
         self.objecter: Objecter | None = None
+        #: client-wide default tenant/flow label (ISSUE 20): every
+        #: ioctx without its own label stamps ops with this one
+        self.flow: str | None = None
         self._auth = auth          # (entity, secret) for cephx clusters
         self._connected = False
         # watch/notify client state

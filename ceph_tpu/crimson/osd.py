@@ -82,6 +82,7 @@ from ceph_tpu.store.object_store import (
 )
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dispatch_telemetry import telemetry as _dsp_tel
+from ceph_tpu.utils import flow_telemetry as _flows
 from ceph_tpu.analysis.lock_witness import make_lock
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.perf_counters import collection
@@ -446,6 +447,14 @@ class CrimsonOSD:
         reactor = self.shard_of(pgid)
         hops = ["reactor_submit"]
         self.logger.inc("op")
+        ft = _flows.flows_if_active()
+        if ft is not None and not getattr(msg, "_flow_noted", False):
+            # once per op even when the map park re-admits this msg
+            msg._flow_noted = True
+            try:
+                ft.note_op(msg.flow, bytes_in=len(msg.data or b""))
+            except Exception:
+                pass
         t0 = time.perf_counter()
         cache_key = (msg.client, msg.tid)
         if msg.op in _MUTATING_OPS:
@@ -497,6 +506,12 @@ class CrimsonOSD:
         if msg.op in _MUTATING_OPS and code == 0:
             reactor.cache_op(cache_key, (code, data, version))
         _dsp_tel().note_op_hops(hops)
+        if ft is not None:
+            try:
+                ft.note_op_done(msg.flow, bytes_out=len(data or b""),
+                                latency_s=time.perf_counter() - t0)
+            except Exception:
+                pass
         reactor.queue_ack(conn, self._make_reply(msg, code, data,
                                                  version))
 
@@ -549,9 +564,17 @@ class CrimsonOSD:
                     epoch=msg.epoch, pool=msg.pool, ps=int(msg.ps),
                     oid=msg.oids[i], op=msg.ops[i],
                     offset=msg.offsets[i], length=msg.lengths[i],
-                    data=msg.datas[i])
+                    data=msg.datas[i],
+                    flow=msg.flows[i] if i < len(msg.flows) else "")
                 hops = ["reactor_submit"]
                 self.logger.inc("op")
+                ft = _flows.flows_if_active()
+                if ft is not None:
+                    try:
+                        ft.note_op(sub.flow,
+                                   bytes_in=len(sub.data or b""))
+                    except Exception:
+                        pass
                 cache_key = (msg.client, sub.tid)
                 if sub.op in _MUTATING_OPS:
                     cached = reactor.op_cache.get(cache_key)
@@ -603,6 +626,13 @@ class CrimsonOSD:
         if sub.op in _MUTATING_OPS and code == 0:
             reactor.cache_op(cache_key, (code, data, version))
         _dsp_tel().note_op_hops(hops)
+        ft = _flows.flows_if_active()
+        if ft is not None:
+            try:
+                ft.note_op_done(sub.flow,
+                                bytes_out=len(data or b""))
+            except Exception:
+                pass
         reactor.queue_ack(conn, self._make_reply(sub, code, data,
                                                  version))
 
@@ -712,7 +742,8 @@ class CrimsonOSD:
             return await self._ec_mutate(
                 reactor, pg, hops,
                 lambda version, on_commit: be.submit_remove(
-                    pg, msg.oid, version, on_commit))
+                    pg, msg.oid, version, on_commit),
+                flow=msg.flow)
         if op == M.OSD_OP_CREATE:
             try:
                 await readpath.object_attrs(svc, be, pg, msg.oid)
@@ -728,13 +759,15 @@ class CrimsonOSD:
                 reactor, pg, hops,
                 lambda version, on_commit: be.submit_setattrs(
                     pg, msg.oid, {msg.xname: bytes(msg.data)}, [],
-                    version, on_commit))
+                    version, on_commit),
+                flow=msg.flow)
         if op == M.OSD_OP_RMXATTR:
             return await self._ec_mutate(
                 reactor, pg, hops,
                 lambda version, on_commit: be.submit_setattrs(
                     pg, msg.oid, {}, [msg.xname], version,
-                    on_commit))
+                    on_commit),
+                flow=msg.flow)
         if op == M.OSD_OP_GETXATTR:
             if release:
                 release()
@@ -785,9 +818,15 @@ class CrimsonOSD:
             # completion swept there — the common case)
             reactor.call(lambda: fut.done() or fut.set_result(code))
 
-        with pg.lock:
-            version = pg.alloc_version()
-            be.submit_write(pg, msg.oid, payload, version, on_commit)
+        # flow context installed for the SYNCHRONOUS submit half only
+        # (ISSUE 20): engine staging + sub-write fan-out self-
+        # attribute; scoping across awaits would leak the label onto
+        # interleaved coroutines of this run-to-completion reactor
+        with _flows.flow_scope(msg.flow):
+            with pg.lock:
+                version = pg.alloc_version()
+                be.submit_write(pg, msg.oid, payload, version,
+                                on_commit)
         if be.device is not None:
             hops += ["engine_stage", "reactor_submit"]
         if len(be.up_positions(pg)) > 1:
@@ -807,16 +846,17 @@ class CrimsonOSD:
         return await self._await_commit(fut, version)
 
     async def _ec_mutate(self, reactor: Reactor, pg: PG, hops: list,
-                         submit) -> tuple:
+                         submit, flow: str = "") -> tuple:
         be: ECBackend = pg.backend
         fut = reactor.loop.create_future()
 
         def on_commit(code: int) -> None:
             reactor.call(lambda: fut.done() or fut.set_result(code))
 
-        with pg.lock:
-            version = pg.alloc_version()
-            submit(version, on_commit)
+        with _flows.flow_scope(flow):
+            with pg.lock:
+                version = pg.alloc_version()
+                submit(version, on_commit)
         if be.device is not None:
             hops += ["engine_stage", "reactor_submit"]
         if len(be.up_positions(pg)) > 1:
@@ -941,6 +981,12 @@ class CrimsonOSD:
         def apply() -> None:
             txn = Transaction.decode(msg.txn_bytes)
             self.logger.inc("subop_w")
+            ft = _flows.flows_if_active()
+            if ft is not None:
+                try:
+                    ft.note_store_txn(msg.flow, len(msg.txn_bytes))
+                except Exception:
+                    pass
 
             def committed() -> None:
                 conn.send_message(M.MECSubWriteReply(
@@ -970,9 +1016,18 @@ class CrimsonOSD:
 
         def apply_group(reactor: Reactor, idxs: list[int]) -> None:
             pairs = []
+            ft = _flows.flows_if_active()
             for i in idxs:
                 txn = Transaction.decode(msg.txns[i])
                 self.logger.inc("subop_w")
+                if ft is not None:
+                    try:
+                        # per-entry wire flow: one frame, many tenants
+                        ft.note_store_txn(
+                            msg.flows[i] if i < len(msg.flows)
+                            else "", len(msg.txns[i]))
+                    except Exception:
+                        pass
 
                 def entry_committed(i=i) -> None:
                     with state["lock"]:

@@ -31,6 +31,7 @@ from collections import deque
 from ceph_tpu.osd import device_engine as _dev_engine
 from ceph_tpu.store.object_store import group_commit_enabled
 from ceph_tpu.utils.dispatch_telemetry import telemetry as _dsp_tel
+from ceph_tpu.utils import flow_telemetry as _flow_tel
 from ceph_tpu.utils.dout import Dout
 
 log = Dout("crimson")
@@ -230,7 +231,28 @@ class ReactorServices:
         self._waits.pop(tid, None)
 
     def queue_local_txn(self, txn, on_commit) -> None:
+        # flow attribution happens HERE, while the submitter's flow
+        # context is still installed — the deferred reactor.call runs
+        # after the scope closed (ISSUE 20)
+        self._note_txn_flow(txn)
         self.reactor.call(self.store.queue_transaction, txn, on_commit)
+
+    @staticmethod
+    def _note_txn_flow(txn) -> None:
+        """Charge a store txn's payload bytes to its flow (ISSUE 20).
+        A label stamped on the txn at defer time (the engine flush-
+        group local leg) wins over the reactor thread's context —
+        group ship runs flow-less."""
+        ft = _flow_tel.flows_if_active()
+        if ft is None:
+            return
+        try:
+            label = getattr(txn, "_flow", None)
+            if label is None:
+                label = _flow_tel.current_flow() or ""
+            ft.note_store_txn(label, _flow_tel.txn_nbytes(txn))
+        except Exception:
+            pass
 
     def queue_local_txn_group(self, pairs) -> None:
         """One engine flush's local txns as ONE store group — PR 15's
@@ -239,6 +261,9 @@ class ReactorServices:
         FlushGroup may ship from whichever reactor finished last, so
         this routes: one counted hop at worst, then commit callbacks
         sweep inline."""
+        for txn, _cb in pairs:
+            self._note_txn_flow(txn)
+
         def apply():
             if len(pairs) > 1 and group_commit_enabled():
                 self.store.queue_transaction_group(pairs)

@@ -28,6 +28,11 @@ from the mgr's cluster view:
     GET /api/tuner    closed-loop tuner: enabled flag, knob vector
                       with sources/pins, pending step, decision
                       history (ISSUE 13)
+    GET /api/flows    tenant X-ray: per-flow cost attribution
+                      (ops/bytes, queue credit, stage waits, engine +
+                      store shares), fairness windows with Jain's
+                      index, starvation streaks, SLO burn rates
+                      (ISSUE 20)
 
 Commands: ``dashboard status|on|off`` over the mgr asok; ``on`` binds
 an ephemeral port (reported by status) on 127.0.0.1.
@@ -100,6 +105,12 @@ _PAGE = """<!doctype html>
 <p>{dispatch_summary}</p>
 <table><tr><th>handoff seam</th><th>hops</th><th>mean us</th>
 <th>total ms</th></tr>{dispatch_rows}</table>
+<h3>tenant flows</h3>
+<p>{flows_summary}</p>
+<table><tr><th>flow</th><th>ops</th><th>bytes in/out</th>
+<th>p50 ms</th><th>p99 ms</th><th>served/demand</th>
+<th>served share</th><th>starve streak</th><th>slo burn</th></tr>
+{flow_rows}</table>
 <h3>profiler</h3>
 <p>{prof_status}</p>
 <table><tr><th>stage</th><th>hot frame</th><th>samples</th>
@@ -165,6 +176,9 @@ class Module(MgrModule):
         if path == "/api/store":
             return 200, "application/json", json.dumps(
                 self._store_payload()).encode()
+        if path == "/api/flows":
+            return 200, "application/json", json.dumps(
+                self._flows_payload()).encode()
         if path == "/api/dispatch":
             from ceph_tpu.utils.dispatch_telemetry import telemetry
             return 200, "application/json", json.dumps(
@@ -278,6 +292,19 @@ class Module(MgrModule):
         from ceph_tpu.utils.store_telemetry import telemetry
         out = telemetry().snapshot()
         out["commit_path"] = dataplane().commit_path()
+        return out
+
+    @staticmethod
+    def _flows_payload() -> dict:
+        """The tenant X-ray panel (ISSUE 20). Never instantiates the
+        registry: with flows off (or before the first attributed op)
+        the panel reports disabled — the literal-NOOP contract."""
+        from ceph_tpu.utils import flow_telemetry as _flow_tel
+        tel = _flow_tel.telemetry_if_exists()
+        if tel is None:
+            return {"enabled": _flow_tel.enabled(), "flows": {}}
+        out = tel.snapshot()
+        out["enabled"] = True
         return out
 
     @staticmethod
@@ -420,6 +447,36 @@ class Module(MgrModule):
             f"({dwk.get('wakeups_per_frame', 0)}/frame, mean wake "
             f"{dwk.get('mean_latency_us', 0)}us) · lock waits "
             f"{dc.get('lock_waits', 0)}")
+        fp = self._flows_payload()
+        if not fp.get("flows"):
+            flows_summary = html.escape(
+                "flows on — no attributed ops yet"
+                if fp.get("enabled") else "off (flows_enabled=false)")
+            flow_rows = "<tr><td colspan=9>no tenant flows</td></tr>"
+        else:
+            attr = fp.get("attribution", {})
+            fair = fp.get("fairness", {})
+            starved = fp.get("starvation", {}).get("starved", {})
+            flows_summary = html.escape(
+                f"attribution {attr.get('ops_pct', 0)}% ops / "
+                f"{attr.get('bytes_pct', 0)}% bytes · jain "
+                f"{fair.get('jain_index', 1.0)} · "
+                f"{len(starved)} starved")
+            fair_flows = fair.get("flows", {})
+            slo = fp.get("slo", {})
+            flow_rows = "".join(
+                f"<tr><td>{html.escape(label or '(unlabelled)')}</td>"
+                f"<td>{ent['ops']}</td>"
+                f"<td>{ent['bytes_in']}/{ent['bytes_out']}</td>"
+                f"<td>{ent['p50_ms']}</td><td>{ent['p99_ms']}</td>"
+                f"<td>{fair_flows.get(label, {}).get('service_ratio', '')}"
+                f"</td>"
+                f"<td>{fair_flows.get(label, {}).get('served_share', '')}"
+                f"</td>"
+                f"<td>{ent['starve_streak']}</td>"
+                f"<td>{slo.get(label, {}).get('burn_rate', '')}</td>"
+                f"</tr>"
+                for label, ent in fp.get("flows", {}).items())
         return _PAGE.format(
             health=html.escape(health),
             check_rows=check_rows,
@@ -455,6 +512,8 @@ class Module(MgrModule):
             store_rows=store_rows,
             dispatch_summary=dispatch_summary,
             dispatch_rows=dispatch_rows,
+            flows_summary=flows_summary,
+            flow_rows=flow_rows,
         ).encode()
 
     # -- server --------------------------------------------------------

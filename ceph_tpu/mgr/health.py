@@ -248,6 +248,43 @@ def _check_hbm_pressure(ctx: CheckContext) -> dict | None:
          f"{int(ctx.value('device.hbm_retired_bytes'))}"])
 
 
+def _check_flow_starvation(ctx: CheckContext) -> dict | None:
+    """A tenant flow with queued demand has been served below the
+    configured floor for N consecutive fairness windows (ISSUE 20's
+    starvation detector). ERR, not WARN: sustained starvation under
+    load is an isolation failure, and the first transition into
+    HEALTH_ERR auto-emits the diagnostics bundle whose flows section
+    carries the per-tenant evidence the autopsy chain needs."""
+    from ceph_tpu.utils import flow_telemetry as _flow_tel
+    tel = _flow_tel.telemetry_if_exists()
+    if tel is None:
+        return None
+    try:
+        starved = tel.starved_flows()
+    except Exception:
+        return None
+    if not starved:
+        return None
+    floor = g_conf()["flow_starvation_floor"]
+    need = g_conf()["flow_starvation_windows"]
+    fairness = tel.fairness()
+    detail = []
+    for label, streak in sorted(starved.items()):
+        row = fairness["flows"].get(label, {})
+        detail.append(
+            f"flow {label!r}: {streak} consecutive windows below "
+            f"floor {floor:.2f} (service_ratio "
+            f"{row.get('service_ratio', 0.0):.3f}, served_share "
+            f"{row.get('served_share', 0.0):.3f}, demand_share "
+            f"{row.get('demand_share', 0.0):.3f})")
+    detail.append(f"jain_index: {fairness['jain_index']:.4f}")
+    return check(
+        "FLOW_STARVATION", ERR,
+        f"{len(starved)} tenant flow(s) starved: queued demand "
+        f"served below floor {floor:.2f} for >= {need} windows",
+        detail)
+
+
 BUILTIN_CHECKS = (
     ("SLOW_OPS", _check_slow_ops),
     ("OSD_DOWN", _check_osd_down),
@@ -257,6 +294,7 @@ BUILTIN_CHECKS = (
     ("SCRUB_MISMATCH", _check_scrub_mismatch),
     ("COMPILE_CACHE_MISS_STORM", _check_cache_miss_storm),
     ("HBM_PRESSURE", _check_hbm_pressure),
+    ("FLOW_STARVATION", _check_flow_starvation),
 )
 
 
@@ -431,6 +469,13 @@ class HealthEngine:
         section("autopsies", lambda: autopsy_store().dump())
         from ceph_tpu.utils.device_telemetry import telemetry
         section("device", lambda: telemetry().snapshot())
+        # tenant X-ray (ISSUE 20): per-flow attribution + fairness +
+        # starvation evidence ride the bundle ONLY when the flows
+        # registry is live — diagnosing must not instantiate one
+        from ceph_tpu.utils import flow_telemetry as _flow_tel
+        flows_tel = _flow_tel.telemetry_if_exists()
+        if flows_tel is not None:
+            section("flows", flows_tel.snapshot)
         from ceph_tpu.utils import profiler as _profiler
         # status + hot frames only when a profiler EXISTS — diagnosing
         # must not allocate one (the OFF-cost contract)

@@ -108,6 +108,7 @@ from ceph_tpu.utils import profiler as _prof
 from ceph_tpu.utils import stage_clock as _stage_clock
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils import dispatch_telemetry as _dsp
+from ceph_tpu.utils import flow_telemetry as _flows
 from ceph_tpu.utils.dout import Dout
 from ceph_tpu.utils.tracing import NOOP
 
@@ -610,6 +611,25 @@ class DeviceEncodeEngine:
             cb()
 
     # -- producer side (op-shard threads) -----------------------------
+    @staticmethod
+    def _note_staged_flow(cont, nbytes: int) -> None:
+        """Tenant attribution at the staging seam (ISSUE 20): the
+        producer thread's flow owns these HBM-staged bytes; the label
+        rides the continuation so retirement can split the flush's
+        occupancy per flow."""
+        ft = _flows.flows_if_active()
+        if ft is None:
+            return
+        label = _flows.current_flow() or ""
+        try:
+            cont._flow = label
+        except AttributeError:
+            pass
+        try:
+            ft.note_engine_staged(label, nbytes)
+        except Exception:
+            pass
+
     def stage_encode(self, key, codec, sinfo: ec_util.StripeInfo,
                      data: np.ndarray,
                      cont: Callable[[dict | None, dict | None,
@@ -629,6 +649,7 @@ class DeviceEncodeEngine:
         # HBM ledger: bytes enter the staged bucket here and leave it
         # at launch (-> in-window) or on a launch fault (-> retired)
         _telemetry().note_hbm(staged_delta=data.nbytes)
+        self._note_staged_flow(cont, data.nbytes)
         # PG placement (ISSUE 12): the slot is part of the staging
         # key, so each stripe row's bytes accumulate contiguously and
         # flush onto their owning chips. The per-slot staged ledger
@@ -667,6 +688,7 @@ class DeviceEncodeEngine:
         blocked decode_sync caller)."""
         import time as _time
         _telemetry().note_hbm(staged_delta=_shards_nbytes(shards))
+        self._note_staged_flow(cont, _shards_nbytes(shards))
         pslot = _placement_slot(key)
         _telemetry().note_slot_staged(pslot, _shards_nbytes(shards))
         self._q.put(("dec", key, codec, sinfo, shards, want, cont,
@@ -1110,6 +1132,21 @@ class DeviceEncodeEngine:
             self.stats["flushes"] += 1
             self.stats["ops"] += len(items)
             self.stats["bytes"] += nbytes
+            ft = _flows.flows_if_active()
+            if ft is not None:
+                # each flow's byte share of THIS retired flush is its
+                # occupancy slice of the device round (ISSUE 20)
+                shares: dict = {}
+                for key, data, cont, *_rest in items:
+                    fl = getattr(cont, "_flow", "")
+                    if fl:
+                        shares[fl] = shares.get(fl, 0) + \
+                            getattr(data, "nbytes", 0)
+                if shares:
+                    try:
+                        ft.note_flush_group(shares)
+                    except Exception:
+                        pass
             self.stats["max_batch_ops"] = max(
                 self.stats["max_batch_ops"], len(items))
             if self._counters is not None:
@@ -1390,7 +1427,16 @@ def _detach(engine: DeviceEncodeEngine, token: int) -> None:
 
 
 def _bind(cont, shards, crcs, err):
-    fn = lambda: cont(shards, crcs, err)   # noqa: E731
+    # re-install the flow label stamped at stage time: the retire
+    # thread (threaded) / owning reactor (crimson) has no tenant
+    # context of its own, and the continuation's fan-out captures
+    # current_flow() when it defers sub-writes into the flush group
+    flow = getattr(cont, "_flow", "")
+
+    def fn():
+        with _flows.flow_scope(flow or None):
+            cont(shards, crcs, err)
+
     # the continuation builds hinfo/shard txns and fans sub-writes out
     # — commit_wait work; the op-wq worker running it picks the tag up
     # for the profiler's stage join

@@ -72,6 +72,7 @@ from ceph_tpu.utils import stage_clock, tracing
 from ceph_tpu.utils.config import g_conf
 from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils import dispatch_telemetry
+from ceph_tpu.utils import flow_telemetry as _flows
 from ceph_tpu.utils.device_telemetry import telemetry as _telemetry
 from ceph_tpu.utils.dout import Dout
 
@@ -316,6 +317,11 @@ class ECBackend(PGBackend):
                 commit_cb = (lambda p=pos:
                              iw.complete(p) and iw.on_all_commit())
                 if group is not None:
+                    # the group ships from whichever thread finishes
+                    # last, with no tenant context — stamp the flow on
+                    # the txn so the ship-time store attribution keeps
+                    # per-item labels (ISSUE 20)
+                    txn._flow = _flows.current_flow() or ""
                     group.defer((id(self.parent), "local"),
                                 self._apply_local_txn_group,
                                 (txn, commit_cb))
@@ -330,12 +336,14 @@ class ECBackend(PGBackend):
                         self._ship_subwrite_batch(osd, items),
                         (tid, pg.pool, pg.ps, pos, oid, version,
                          txn.encode(), child.wire(), epoch,
-                         op_clock is not stage_clock.NOOP))
+                         op_clock is not stage_clock.NOOP,
+                         _flows.current_flow() or ""))
                 else:
                     sub = M.MECSubWrite(
                         tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
                         epoch=epoch, oid=oid, version=version,
-                        txn_bytes=txn.encode(), trace=child.wire())
+                        txn_bytes=txn.encode(), trace=child.wire(),
+                        flow=_flows.current_flow() or "")
                     if op_clock is not stage_clock.NOOP:
                         # child timeline anchor: handed to the
                         # messenger (which serializes it into
@@ -375,7 +383,7 @@ class ECBackend(PGBackend):
         object reach the shard in version order."""
         batch = M.MECSubWriteBatch(
             tid=self.parent.new_tid(),
-            epoch=max(e for *_rest, e, _timed in items),
+            epoch=max(it[8] for it in items),
             tids=[it[0] for it in items],
             pools=[it[1] for it in items],
             pss=[it[2] for it in items],
@@ -383,8 +391,9 @@ class ECBackend(PGBackend):
             oids=[it[4] for it in items],
             versions=[it[5] for it in items],
             txns=[it[6] for it in items],
-            traces=[it[7] for it in items])
-        if any(timed for *_rest, timed in items):
+            traces=[it[7] for it in items],
+            flows=[it[10] for it in items])
+        if any(it[9] for it in items):
             # ONE child-timeline anchor for the whole frame: every
             # contained sub-op genuinely shares the batch's send/
             # wire/dispatch intervals; the shard forks a child clock

@@ -74,6 +74,7 @@ from ceph_tpu.utils.dataplane import dataplane
 from ceph_tpu.utils.msgr_telemetry import telemetry as _msgr_telemetry
 from ceph_tpu.utils import store_telemetry as _store_telemetry
 from ceph_tpu.utils import dispatch_telemetry as _dsp
+from ceph_tpu.utils import flow_telemetry as _flows
 from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf_counters import PerfCounters, collection
 
@@ -294,6 +295,11 @@ class ShardedOpWQ:
             # attributes; bound methods may not — skip silently.
             fn._dsp_enq = (time.monotonic(),
                            threading.current_thread().name)
+            # flow seat capture (ISSUE 20): the tenant context of the
+            # enqueuing thread rides the work item, so the worker can
+            # charge this seat's WPQ/dmclock credit to the flow and
+            # re-install the context for the item's own attribution
+            fn._flow = _flows.capture_flow(qos)
         except AttributeError:
             pass
         sh = self._shards[hash(key) % len(self._shards)]
@@ -367,6 +373,12 @@ class ShardedOpWQ:
             enq = getattr(fn, "_dsp_enq", None)
             if enq is not None:
                 _dsp.note_wq_dequeue(fn, enq)
+            # flow seat grant (ISSUE 20): one dequeue = one unit of
+            # queue credit charged to the item's captured flow; the
+            # captured context becomes current for the item so store
+            # txns / engine staging attribute without replumbing
+            fctx = getattr(fn, "_flow", None)
+            _flows.note_wq_grant(fctx)
             # profiler stage join: a worker sample belongs to the
             # stage of the work it runs — PG/op processing by default,
             # or the stage a producer tagged on the continuation
@@ -379,6 +391,7 @@ class ShardedOpWQ:
                 log(0, f"op worker exception: {exc!r}")
             finally:
                 _prof.pop_stage(_pstage)
+                _flows.note_wq_done(fctx)
                 if enq is not None:
                     _dsp.clear_current_hop()
                 if self._after_item is not None:
@@ -657,6 +670,7 @@ class OSD:
         from ceph_tpu.utils import store_telemetry as _st
         _st.register_asok(self.asok)
         _dsp.register_asok(self.asok)
+        _flows.register_asok(self.asok)
         from ceph_tpu.utils import faults as _faults
         _faults.register_asok(self.asok)
         self.asok.start()
@@ -768,6 +782,32 @@ class OSD:
         ack here, where no lock is held — the witness contract)."""
         if self.store.barrier_pending():
             self.store.barrier()
+            ft = _flows.flows_if_active()
+            if ft is not None:
+                try:
+                    # one durability barrier: amortize the fsync over
+                    # the flows whose txn bytes rode this window
+                    ft.note_fsync()
+                except Exception:
+                    pass
+
+    @staticmethod
+    def _note_txn_flow(txn) -> None:
+        """Charge a queued store txn's payload bytes to its flow
+        (ISSUE 20); the same bytes feed the fsync amortization window
+        the barrier drain settles. A label stamped on the txn at
+        defer time (the engine flush-group local leg) wins over the
+        calling thread's context — group ship runs flow-less."""
+        ft = _flows.flows_if_active()
+        if ft is None:
+            return
+        try:
+            label = getattr(txn, "_flow", None)
+            if label is None:
+                label = _flows.current_flow() or ""
+            ft.note_store_txn(label, _flows.txn_nbytes(txn))
+        except Exception:
+            pass
 
     def queue_local_txn(self, txn: Transaction, on_commit) -> None:
         """One local shard txn. From a wq item (the op/sub-op paths —
@@ -775,6 +815,7 @@ class OSD:
         worker's end-of-item drain, where the shared leader-follower
         rounds coalesce them with everything else the item (and its
         shard neighbors) committed; other threads commit inline."""
+        self._note_txn_flow(txn)
         if group_commit_enabled() and _on_wq_thread():
             self.store.queue_transaction_group([(txn, on_commit)],
                                                defer=True)
@@ -787,6 +828,12 @@ class OSD:
         sub-writes share one apply pass, one WAL append, one barrier
         set — ``ObjectStore.queue_transaction_group``, ROADMAP 1a —
         with completions swept in list order by the store)."""
+        if len(pairs) != 1:
+            # txn-byte attribution to the current flow; the single-
+            # pair delegation below lands in queue_local_txn, which
+            # notes its own
+            for txn, _cb in pairs:
+                self._note_txn_flow(txn)
         if len(pairs) == 1 or not group_commit_enabled():
             if len(pairs) > 1:
                 # A/B fallback (CEPH_TPU_GROUP_COMMIT=0): the pre-15
@@ -1052,17 +1099,25 @@ class OSD:
         pgid = (msg.pool, msg.ps) if hasattr(msg, "pool") else None
         if isinstance(msg, M.MOSDOp):
             pgid = (msg.pool, msg.ps)
-            self.op_wq.enqueue(pgid,
-                               lambda: self._handle_osd_op(msg, conn))
+            # the wire flow label becomes current across enqueue so
+            # the wq seam captures it — the op's WPQ/dmclock seat
+            # credit lands on the tenant, not on "" (ISSUE 20)
+            with _flows.flow_scope(msg.flow):
+                self.op_wq.enqueue(
+                    pgid, lambda: self._handle_osd_op(msg, conn))
         elif isinstance(msg, M.MOSDOpBatch):
             # the streaming client leg (ROADMAP 1b): one frame of
             # same-PG writes — one wq traversal on the PG's key, so
-            # FIFO against singleton MOSDOps is preserved
-            self.op_wq.enqueue(
-                pgid, lambda: self._handle_osd_op_batch(msg, conn))
+            # FIFO against singleton MOSDOps is preserved. The frame
+            # consumed ONE seat grant; charge it to the lead entry's
+            # flow (streaming frames are single-tenant in practice)
+            with _flows.flow_scope(msg.flows[0] if msg.flows else ""):
+                self.op_wq.enqueue(
+                    pgid, lambda: self._handle_osd_op_batch(msg, conn))
         elif isinstance(msg, M.MECSubWrite):
-            self.op_wq.enqueue(pgid,
-                               lambda: self._handle_sub_write(msg, conn))
+            with _flows.flow_scope(msg.flow):
+                self.op_wq.enqueue(
+                    pgid, lambda: self._handle_sub_write(msg, conn))
         elif isinstance(msg, M.MECSubWriteBatch):
             self._handle_sub_write_batch(msg, conn)
         elif isinstance(msg, M.MECSubRead):
@@ -1333,10 +1388,21 @@ class OSD:
                                conn: Connection, idxs: list[int],
                                state: dict, rx_t) -> None:
         grouped = group_commit_enabled()
+        ft = _flows.flows_if_active()
         pairs = []
         for i in idxs:
             txn = Transaction.decode(msg.txns[i])
             self.logger.inc("subop_w")
+            if ft is not None:
+                try:
+                    # per-entry wire flow (ISSUE 20): charge this
+                    # entry's encoded txn bytes to its own tenant —
+                    # one frame may carry many flows
+                    ft.note_store_txn(
+                        msg.flows[i] if i < len(msg.flows) else "",
+                        len(msg.txns[i]))
+                except Exception:
+                    pass
             span = tracing.tracer().from_wire(
                 msg.traces[i] if i < len(msg.traces) else "",
                 f"sub_write(shard={int(msg.shards[i])})",
@@ -1540,7 +1606,8 @@ class OSD:
                 op=msg.ops[i], offset=msg.offsets[i],
                 length=msg.lengths[i], data=msg.datas[i],
                 trace=msg.traces[i] if i < len(msg.traces) else "",
-                stages=msg.stages[i] if i < len(msg.stages) else "")
+                stages=msg.stages[i] if i < len(msg.stages) else "",
+                flow=msg.flows[i] if i < len(msg.flows) else "")
             if rx_t is not None:
                 sub._rx_t = rx_t
             self._handle_osd_op(
@@ -1551,6 +1618,15 @@ class OSD:
         t0 = time.perf_counter()
         _TP_OP_DEQUEUE(msg.oid, msg.op, msg.client)
         self.logger.inc("op")
+        ft = _flows.flows_if_active()
+        if ft is not None and not getattr(msg, "_flow_noted", False):
+            # admission: ops/bytes-in land once per op even when the
+            # handler re-runs (map park, waiting_for_active requeue)
+            msg._flow_noted = True
+            try:
+                ft.note_op(msg.flow, bytes_in=len(msg.data or b""))
+            except Exception:
+                pass
         track = self.op_tracker.create(
             f"osd_op(client={msg.client} tid={msg.tid} op={msg.op} "
             f"oid={msg.oid})")
@@ -1652,6 +1728,15 @@ class OSD:
                     trace_id=getattr(span, "trace_id", "") or None)
             except Exception:
                 pass           # telemetry faults never cost an op
+            if ft is not None:
+                try:
+                    ft.note_op_done(
+                        msg.flow, bytes_out=len(data),
+                        latency_s=time.perf_counter() - t0,
+                        trace_id=getattr(span, "trace_id", "") or None,
+                        stages=clock.own_durations())
+                except Exception:
+                    pass
             track.finish()
             span.event(f"reply code={code}")
             if code in (EIO,):
@@ -1752,7 +1837,10 @@ class OSD:
             tracing.set_current(span)
             stage_clock.set_current(clock)
             try:
-                self._execute_op(pg, msg, reply)
+                # the op's tenant context is current across execution
+                # so store txns and engine staging self-attribute
+                with _flows.flow_scope(msg.flow):
+                    self._execute_op(pg, msg, reply)
             finally:
                 tracing.set_current(tracing.NOOP)
                 stage_clock.set_current(stage_clock.NOOP)

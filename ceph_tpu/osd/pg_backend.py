@@ -44,6 +44,7 @@ from ceph_tpu.store.object_store import (
     Transaction,
 )
 from ceph_tpu.utils.dout import Dout
+from ceph_tpu.utils import flow_telemetry as _flows
 
 log = Dout("osd")
 
@@ -383,7 +384,8 @@ class ReplicatedBackend(PGBackend):
                 self.parent.send_osd(osd, M.MECSubWrite(
                     tid=tid, pool=pg.pool, ps=pg.ps, shard=pos,
                     epoch=epoch, oid=oid, version=entry.version,
-                    txn_bytes=txn.encode(), trace=child.wire()))
+                    txn_bytes=txn.encode(), trace=child.wire(),
+                    flow=_flows.current_flow() or ""))
                 child.finish()
 
     def submit_write(self, pg: PG, oid: str, data: bytes, version: int,

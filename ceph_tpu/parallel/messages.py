@@ -275,7 +275,11 @@ class MOSDOp(Message):
               # appended round 11: the op's StageClock marks so far
               # (utils/stage_clock wire form, "" = untimed) — the
               # per-op data-plane timeline the OSD continues
-              ("stages", "str")]
+              ("stages", "str"),
+              # appended round 24: the tenant/flow label the client
+              # stamped (utils/flow_telemetry; "" = unattributed) —
+              # every daemon attributes its owned costs to it
+              ("flow", "str")]
 
 
 class MOSDOpReply(Message):
@@ -309,7 +313,11 @@ class MOSDOpBatch(Message):
               ("tids", "u64_list"), ("oids", "str_list"),
               ("ops", "i32_list"), ("offsets", "u64_list"),
               ("lengths", "u64_list"), ("datas", "bytes_list"),
-              ("traces", "str_list"), ("stages", "str_list")]
+              ("traces", "str_list"), ("stages", "str_list"),
+              # appended round 24: PER-ENTRY flow labels — a batched
+              # frame coalesces many tenants' writes, and attribution
+              # must never be lost to batching (ISSUE 20)
+              ("flows", "str_list")]
 
     #: scatter-gather framing (ROADMAP 1c): ship ``datas`` payloads
     #: as their own frame parts instead of re-copying into one blob
@@ -467,7 +475,10 @@ class MECSubWrite(Message):
               ("trace", "str"),
               # appended round 11: the sub-op's child StageClock
               # (anchor = handed to the messenger on the primary)
-              ("stages", "str")]
+              ("stages", "str"),
+              # appended round 24: the client op's flow label, so the
+              # shard attributes its store txn + fsync share too
+              ("flow", "str")]
 
 
 class MECSubWriteReply(Message):
@@ -497,7 +508,11 @@ class MECSubWriteBatch(Message):
               ("pss", "u64_list"), ("shards", "u64_list"),
               ("oids", "str_list"), ("versions", "u64_list"),
               ("txns", "bytes_list"), ("traces", "str_list"),
-              ("stages", "str")]
+              ("stages", "str"),
+              # appended round 24: PER-ENTRY flow labels — one flush
+              # batches many tenants' sub-writes; the receiving shard
+              # attributes each entry's txn bytes to its own flow
+              ("flows", "str_list")]
 
     #: scatter-gather framing (ROADMAP 1c): the shard txns ship as
     #: their own frame parts — no re-copy into one contiguous payload

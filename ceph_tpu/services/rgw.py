@@ -1616,7 +1616,14 @@ class _Handler(BaseHTTPRequestHandler):
                     self.headers.get("Authorization"):
                 self.requester = verify_sigv4(self, self.auth,
                                               payload)
-            fn()
+            # tenant attribution (ISSUE 20): every rados op this
+            # request fans into carries the requester's flow label
+            # through the handler thread's ambient context (the
+            # gateway's ioctx falls back to current_flow())
+            from ceph_tpu.utils import flow_telemetry as _flow_tel
+            with _flow_tel.flow_scope(
+                    f"rgw:{self.requester or 'anonymous'}"):
+                fn()
         except RGWError as exc:
             # S3 Error document; the message doubles as the Code when
             # it is one (NoSuchBucket/NoSuchKey/BucketNotEmpty/...)

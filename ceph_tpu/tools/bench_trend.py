@@ -68,6 +68,11 @@ DIRECTIONS = {
     # _p99, but the row is the acceptance gate: pin it)
     "hot_object_read_GBps": "higher",
     "cache_hit_p99_us": "lower",
+    # ISSUE 20: multi-tenant fairness — the row's value is the Jain
+    # index over served shares under a scripted hot-tenant skew; the
+    # name heuristic has no idea what a "jain" is, and the row must
+    # gate DOWN-is-bad (silently starving MORE tenants shrinks it)
+    "multi_tenant_fairness": "higher",
 }
 
 

@@ -18,6 +18,7 @@ Output: a human table plus one machine-readable JSON line
     python -m ceph_tpu.tools.gap_report                 # quick (CPU ok)
     python -m ceph_tpu.tools.gap_report --full          # driver scale
     python -m ceph_tpu.tools.gap_report --run-engine-loop  # chip only
+    python -m ceph_tpu.tools.gap_report --tenants       # tenant X-ray
 
 On a CPU-only host the engine side defaults to the recorded BASELINE
 capacity (marked ``engine_source: baseline``) instead of re-measuring
@@ -386,7 +387,102 @@ def run_report(seconds: float, n_osds: int, obj_size: int,
         except Exception as exc:  # pragma: no cover - defensive
             report["read_balance"] = {"error":
                                       f"{type(exc).__name__}: {exc}"}
+    # ISSUE 20: the tenant X-ray arm — per-flow attribution coverage
+    # on BOTH flavors. Opt-in (--tenants); fresh clusters of its own.
+    if getattr(args, "tenants", False):
+        report["tenants"] = _tenants_section(
+            min(seconds, 2.0), n_osds, obj_size, threads, k, m,
+            backend)
     return report
+
+
+def _tenants_arm(seconds: float, n_osds: int, obj_size: int,
+                 threads: int, k: int, m: int, backend: str,
+                 flavor: str) -> dict:
+    """One tenant-attributed pass (ISSUE 20): a named-tenant traffic
+    mix against a fresh ``flavor`` cluster with the flow registry
+    reset first, so the attribution/coverage table scores THIS arm
+    only. The acceptance bar: >= 95% of ops AND bytes carry a tenant
+    label, on BOTH the threaded and crimson flavors."""
+    from ceph_tpu.bench.load_gen import LoadGen, LoadSpec
+    from ceph_tpu.qa.cluster import MiniCluster
+    from ceph_tpu.utils import flow_telemetry as _flow_tel
+    if not _flow_tel.enabled():
+        return {"skipped": "flows_enabled=false"}
+    tel = _flow_tel.telemetry_if_exists()
+    if tel is not None:
+        tel.reset()
+    with MiniCluster(n_osds=n_osds, osd_flavor=flavor) as cluster:
+        cluster.create_ec_pool("tx", k=k, m=m, pg_num=8,
+                               backend=backend)
+        tenants = ("acme", "globex", "initech")
+        spec = LoadSpec(n_keys=32, obj_size=obj_size, read_frac=0.5,
+                        concurrency=threads, phase_seconds=seconds,
+                        seed=11, tenants=tenants,
+                        hot_tenant=tenants[0], hot_factor=4.0)
+        gen = LoadGen(cluster, "tx", spec)
+        out = gen.run_healthy()
+    tel = _flow_tel.telemetry_if_exists()
+    if tel is None:
+        return {"error": "no flow registry materialized"}
+    attr = tel.attribution()
+    healthy = out["phases"][0]
+    return {
+        "flavor": flavor,
+        "ops": healthy.get("ops"),
+        "MBps": healthy.get("MBps"),
+        "tenants": healthy.get("tenants"),
+        "attribution": attr,
+        "coverage_ok": attr["ops_pct"] >= 95.0
+        and attr["bytes_pct"] >= 95.0,
+        "lost_acked": len(out["verify"]["lost_acked"]),
+        "wrong_bytes": len(out["verify"]["wrong_bytes"]),
+    }
+
+
+def _tenants_section(seconds: float, n_osds: int, obj_size: int,
+                     threads: int, k: int, m: int,
+                     backend: str) -> dict:
+    out = {}
+    for flavor in ("threaded", "crimson"):
+        try:
+            out[flavor] = _tenants_arm(seconds, n_osds, obj_size,
+                                       threads, k, m, backend, flavor)
+        except Exception as exc:  # pragma: no cover - defensive
+            out[flavor] = {"error": f"{type(exc).__name__}: {exc}"}
+    out["coverage_ok"] = all(
+        arm.get("coverage_ok") for arm in out.values()
+        if isinstance(arm, dict))
+    return out
+
+
+def _print_tenants(report: dict) -> None:
+    sec = report.get("tenants")
+    if not sec:
+        return
+    print()
+    print("--- tenant X-ray (per-flow attribution, both flavors) ---")
+    for flavor in ("threaded", "crimson"):
+        arm = sec.get(flavor) or {}
+        if "error" in arm:
+            print(f"  {flavor}: arm failed: {arm['error']}")
+            continue
+        if "skipped" in arm:
+            print(f"  {flavor}: skipped: {arm['skipped']}")
+            continue
+        attr = arm["attribution"]
+        print(f"  {flavor}: ops {attr['ops_attributed']}/"
+              f"{attr['ops_total']} ({attr['ops_pct']}%)   bytes "
+              f"{attr['bytes_attributed']}/{attr['bytes_total']} "
+              f"({attr['bytes_pct']}%)   "
+              f"{'OK' if arm['coverage_ok'] else 'BELOW 95% BAR'}")
+        for tenant, row in sorted(attr["by_flow"].items()):
+            print(f"    {tenant or '(unlabelled)':<14}"
+                  f"ops {row['ops']:>7} ({100 * row['ops_share']:.1f}%)"
+                  f"   bytes {row['bytes']:>12} "
+                  f"({100 * row['bytes_share']:.1f}%)")
+    print(f"  coverage >= 95% both flavors: "
+          f"{'yes' if sec.get('coverage_ok') else 'NO'}")
 
 
 def _print_crimson(report: dict) -> None:
@@ -760,6 +856,7 @@ def _print_dispatch(report: dict) -> None:
               f"{rtc.get('whatif_rtc_MBps')} MB/s")
     _print_crimson(report)
     _print_read_balance(report)
+    _print_tenants(report)
 
 
 def main(argv=None) -> int:
@@ -795,6 +892,10 @@ def main(argv=None) -> int:
     ap.add_argument("--no-read-balance", action="store_true",
                     help="skip the primary-vs-any-k read storm "
                          "(and its read_balance verdict row)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="run the tenant X-ray arm: a named-tenant "
+                         "mix on BOTH flavors with the per-flow "
+                         "attribution-coverage table (>= 95% bar)")
     args = ap.parse_args(argv)
     if args.full:
         args.osds, args.k, args.m = 12, 8, 3

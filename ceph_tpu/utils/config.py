@@ -562,6 +562,23 @@ for _o in [
            "how long a mutating op's reply may be held waiting for "
            "cache-invalidation acks from inval watchers before the "
            "laggards are written off as missed", min=50),
+    Option("flows_enabled", bool, True, "advanced",
+           "per-tenant flow attribution (utils/flow_telemetry): "
+           "clients tag ops with a flow label and every daemon "
+           "attributes its owned costs to the flow (false = literal "
+           "NOOP: no registry, no TLS writes, no wire labels; env "
+           "CEPH_TPU_FLOWS overrides)"),
+    Option("flow_starvation_floor", float, 0.5, "advanced",
+           "fairness-window service-ratio floor: a flow with queued "
+           "demand served below this ratio scores the window "
+           "starved", min=0.0, max=1.0),
+    Option("flow_starvation_windows", int, 3, "advanced",
+           "consecutive starved windows before FLOW_STARVATION "
+           "raises for the flow", min=1),
+    Option("flow_slo_error_budget", float, 0.01, "advanced",
+           "default per-flow SLO error budget: tolerated fraction "
+           "of completed ops over the flow's p99 target (burn rate "
+           "= error rate / budget)", min=1e-9, max=1.0),
 ]:
     SCHEMA.add(_o)
 

@@ -103,7 +103,35 @@ def render_text() -> str:
                 lines.append(f"# TYPE {metric} counter")
                 seen_types.add(metric)
             lines.append(f'{metric}{{daemon="{daemon}"}} {val}')
+    lines.extend(_tenant_lines())
     return "\n".join(lines) + "\n"
+
+
+def _tenant_lines() -> list[str]:
+    """Per-tenant flow series (ISSUE 20): one sample per flow label,
+    ``tenant`` escaped per the exposition spec (a tenant name is
+    user-controlled input — quotes/backslashes/newlines must not
+    corrupt the scrape). Empty when no flows registry is live — the
+    exporter must not instantiate one."""
+    try:
+        from ceph_tpu.utils import flow_telemetry as _flow_tel
+        tel = _flow_tel.telemetry_if_exists()
+        if tel is None:
+            return []
+        series = tel.tenant_series()
+    except Exception:
+        return []
+    out: list[str] = []
+    for suffix, promtype, by_tenant in series:
+        if not by_tenant:
+            continue
+        metric = f"ceph_tpu_flows_{_sanitize(suffix)}"
+        out.append(f"# TYPE {metric} {promtype}")
+        for tenant in sorted(by_tenant):
+            out.append(
+                f'{metric}{{tenant="{_escape_label(tenant)}"}} '
+                f"{by_tenant[tenant]:g}")
+    return out
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -134,6 +134,23 @@ def test_multichip_direction_pins(tmp_path):
     assert not report["metrics"]["multichip_decode_GBps"]["regressed"]
 
 
+def test_multi_tenant_fairness_direction_pin(tmp_path):
+    """ISSUE 20: the fairness row's value is a Jain index — unitless,
+    no suffix the name heuristic could read — and it must gate DOWN
+    as a regression (silently starving MORE tenants shrinks it)."""
+    assert bench_trend.DIRECTIONS["multi_tenant_fairness"] == "higher"
+    assert not bench_trend.lower_is_better("multi_tenant_fairness")
+    files = [
+        _round_file(tmp_path, "BENCH_r01.json",
+                    {"multi_tenant_fairness": 0.67}),
+        _round_file(tmp_path, "BENCH_r02.json",
+                    {"multi_tenant_fairness": 0.34}),
+    ]
+    report = bench_trend.trend(files)
+    assert report["metrics"]["multi_tenant_fairness"]["regressed"]
+    assert "multi_tenant_fairness" in report["regressions"]
+
+
 def test_tuned_vs_fixed_mode(capsys):
     """ISSUE 13: --tuned-vs-fixed runs the deterministic controller
     comparison (bench/tuner_sim) — human table + one machine line —

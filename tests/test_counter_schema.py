@@ -20,6 +20,7 @@ def _ensure_registries():
     from ceph_tpu.utils.device_telemetry import telemetry
     from ceph_tpu.utils.dispatch_telemetry import telemetry as dsp_tel
     from ceph_tpu.utils.faults import registry as fault_registry
+    from ceph_tpu.utils.flow_telemetry import telemetry as flow_tel
     from ceph_tpu.utils.msgr_telemetry import telemetry as msgr
     from ceph_tpu.utils.profiler import profiler
     from ceph_tpu.utils.store_telemetry import telemetry as store_tel
@@ -33,6 +34,7 @@ def _ensure_registries():
     autopsy_store()
     store_tel()
     dsp_tel()
+    flow_tel()
 
 
 def test_every_counter_reaches_prometheus():
@@ -408,6 +410,44 @@ def test_dispatch_counters_covered_by_lint():
     assert set(payload["counters"]) >= expect
     for section in ("glossary", "seams", "wakeups", "locks",
                     "recent_chains"):
+        assert section in payload, section
+
+
+def test_flow_counters_covered_by_lint():
+    """ISSUE 20: the flows registry — per-tenant cost attribution,
+    fairness windows, SLO burn — is registered (so the generic
+    exporter lints above cover it) and reaches prometheus AND the
+    ``dump_flows`` asok payload every daemon registers."""
+    _ensure_registries()
+    from ceph_tpu.utils import flow_telemetry
+    keys = set(flow_telemetry.telemetry().perf.dump())
+    expect = {"ops", "bytes_in", "bytes_out", "unattributed_ops",
+              "unattributed_bytes", "queue_credit", "stage_wait",
+              "engine_staged_bytes", "flush_groups",
+              "store_txn_bytes", "fsyncs", "op_lat_ms", "windows",
+              "starved_windows", "slo_breaches"}
+    assert expect <= keys, expect - keys
+    text = prometheus.render_text()
+    for key in ("ops", "queue_credit", "stage_wait_sum",
+                "op_lat_ms_bucket", "starved_windows"):
+        assert f"ceph_tpu_{key}" in text, key
+    assert 'daemon="flows"' in text
+    # asok side: dump_flows carries every registered counter plus the
+    # fairness / starvation / SLO / attribution planes
+
+    class _StubAsok:
+        def __init__(self):
+            self.commands = {}
+
+        def register_command(self, prefix, handler, desc=""):
+            self.commands[prefix] = handler
+
+    asok = _StubAsok()
+    flow_telemetry.register_asok(asok)
+    payload = asok.commands["dump_flows"]({})
+    assert set(payload["counters"]) >= expect
+    for section in ("glossary", "flows", "fairness", "starvation",
+                    "slo", "attribution"):
         assert section in payload, section
 
 

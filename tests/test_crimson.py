@@ -375,3 +375,74 @@ def test_crimson_kill_revive_preserves_shard_data():
         cluster.wait_for_osds_up(timeout=15)
         for i in range(8):
             assert io.read(f"d{i}") == bytes([i]) * 2048
+
+
+def test_crimson_multi_tenant_burst_attributes_flows():
+    """ISSUE 20 satellite: crimson installs the flow context on its
+    INLINE continuation path (no cross-thread queue to capture
+    across), so a multi-tenant burst attributes per-tenant ops, bytes
+    and store-txn costs with >=95% coverage — witness-armed, since
+    the attribution seams run inside the reactors' submit halves and
+    must not add a blocking edge the lock discipline forbids."""
+    import json
+
+    from ceph_tpu.analysis import lock_witness as lw
+    from ceph_tpu.utils import flow_telemetry as ft
+
+    env_armed = lw.env_enabled()
+    if not env_armed:
+        lw.enable()
+    try:
+        with MiniCluster(n_osds=3, osd_flavor="crimson") as cluster:
+            cluster.create_ec_pool("mt", k=2, m=1, pg_num=4,
+                                   backend="jax")
+            client = cluster.client()
+            warm = client.open_ioctx("mt")
+            warm.op_timeout = 30.0
+            warm.set_flow("warmup")
+            warm.write_full("warm", b"w" * 1024)
+            tel = ft.telemetry_if_exists()
+            assert tel is not None, \
+                "a tagged write must materialize the flows registry"
+            tel.reset()
+            tenants = ("acme", "globex", "initech")
+            ios = []
+            for t in tenants:
+                tio = client.open_ioctx("mt")
+                tio.op_timeout = 30.0
+                tio.set_flow(t)
+                ios.append(tio)
+
+            def burst(i):
+                tio = ios[i % len(ios)]
+                tio.write_full(f"{tenants[i % 3]}_{i}", b"x" * 4096)
+                assert tio.read(f"{tenants[i % 3]}_{i}") \
+                    == b"x" * 4096
+
+            with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                list(pool.map(burst, range(18)))
+
+            tel = ft.telemetry()
+            table = tel.flow_table()["flows"]
+            for t in tenants:
+                row = table.get(t)
+                assert row is not None, (t, sorted(table))
+                # each tenant: 6 writes + 6 reads attributed, bytes
+                # both directions, and its EC sub-writes' store txn
+                # bytes charged back to it on the serving reactors
+                assert row["ops"] >= 12, (t, row)
+                assert row["bytes_in"] >= 6 * 4096, (t, row)
+                assert row["bytes_out"] >= 6 * 4096, (t, row)
+                assert row["store_txn_bytes"] > 0, (t, row)
+            att = tel.attribution()
+            assert att["ops_pct"] >= 95.0, att
+            assert att["bytes_pct"] >= 95.0, att
+    finally:
+        if not env_armed:
+            rep = lw.report()
+            bad = lw.unacknowledged(rep)
+            lw.disable()
+            lw.reset()
+            assert not bad, (
+                "unacknowledged witness findings on the multi-tenant "
+                "crimson burst: " + json.dumps(bad, indent=1)[:2000])

@@ -784,3 +784,60 @@ def test_reactor_affinity_live_crimson_tree_clean():
     assert crimson_srcs
     for src in crimson_srcs:
         assert linters.check_reactor_affinity(src) == [], src.rel
+
+# ---------------------------------------------------------------------------
+# family 7: flow context (ISSUE 20) — seeded violations
+# ---------------------------------------------------------------------------
+
+def _flow_keys(text: str,
+               rel: str = "ceph_tpu/osd/synth.py") -> set[str]:
+    fs = linters.check_flow_context(_src(text, rel=rel))
+    return {f.key for f in fs}
+
+
+def test_flow_context_dropped_at_qos_seam_caught():
+    keys = _flow_keys('''
+class SynthWQ:
+    def enqueue(self, key, fn, qos="client"):
+        self._queues[qos].append((key, fn))
+''')
+    assert ("flow_context:ceph_tpu/osd/synth.py:SynthWQ.enqueue"
+            in keys)
+
+
+def test_flow_context_captured_at_qos_seam_clean():
+    assert _flow_keys('''
+from ceph_tpu.utils import flow_telemetry as _flows
+
+class SynthWQ:
+    def enqueue(self, key, fn, qos="client"):
+        fn._flow = _flows.capture_flow(qos)
+        self._queues[qos].append((key, fn))
+''') == set()
+
+
+def test_flow_context_current_flow_read_also_satisfies():
+    assert _flow_keys('''
+from ceph_tpu.utils import flow_telemetry as _flows
+
+def submit(op, qos):
+    op.flow = _flows.current_flow() or ""
+    _ship(op, qos)
+''') == set()
+
+
+def test_flow_context_seam_module_itself_exempt():
+    """flow_telemetry's own helpers take qos by construction — the
+    module that DEFINES the seam is not a violation of it."""
+    assert _flow_keys('''
+def capture_flow(qos="client"):
+    return ("", qos)
+''', rel="ceph_tpu/utils/flow_telemetry.py") == set()
+
+
+def test_flow_context_live_tree_clean():
+    """The live contract: every shipped qos= seam threads the flow
+    context TODAY (ShardedOpWQ.enqueue captures it into the work
+    item; crimson has no cross-thread queue to lose it on)."""
+    for src in linters.iter_sources():
+        assert linters.check_flow_context(src) == [], src.rel
